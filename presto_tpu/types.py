@@ -1,0 +1,340 @@
+"""SQL type system for the TPU-native engine.
+
+Re-designed equivalent of the reference's type layer
+(presto-spi/src/main/java/com/facebook/presto/spi/type/ — Type.java,
+BigintType.java, DecimalType.java, VarcharType.java, ...). Instead of JVM
+objects reading io.airlift.slice memory, every type maps onto a fixed-width
+device array representation so relational kernels compile onto the TPU MXU/VPU:
+
+  BIGINT     -> int64 (XLA emulates 64-bit on TPU; exact SQL semantics win)
+  INTEGER    -> int32
+  SMALLINT   -> int16
+  TINYINT    -> int8
+  DOUBLE     -> float64 on CPU oracle, float32/float64 selectable on TPU
+  REAL       -> float32
+  BOOLEAN    -> bool
+  DATE       -> int32 days since 1970-01-01
+  TIMESTAMP  -> int64 microseconds since epoch
+  DECIMAL(p,s) (p<=18) -> int64 scaled integer (reference "short decimal",
+               presto-spi/.../type/DecimalType.java + Decimals.java)
+  VARCHAR/CHAR -> int32 dictionary codes over a host-side sorted dictionary
+               (reference DictionaryBlock precedent,
+               presto-spi/.../block/DictionaryBlock.java); sorted dictionaries
+               make code order == string order so comparisons/sorts stay on
+               device.
+
+Nulls are carried as a separate validity mask at the Block level (page.py),
+mirroring the reference's per-position isNull flags (spi/block/Block.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """Base class for SQL types. Hashable, comparable, usable as static aux data."""
+
+    name: ClassVar[str] = "unknown"
+
+    @property
+    def storage_dtype(self):
+        raise NotImplementedError
+
+    @property
+    def is_orderable(self) -> bool:
+        return True
+
+    @property
+    def is_comparable(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return self.name
+
+    def __str__(self) -> str:
+        return self.display()
+
+    # -- conversion helpers (host side) --
+    def to_python(self, storage_value, dictionary=None):
+        """Convert a storage scalar (numpy) to the natural Python value."""
+        return storage_value.item() if hasattr(storage_value, "item") else storage_value
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedWidthType(Type):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BigintType(FixedWidthType):
+    name: ClassVar[str] = "bigint"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerType(FixedWidthType):
+    name: ClassVar[str] = "integer"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class SmallintType(FixedWidthType):
+    name: ClassVar[str] = "smallint"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int16
+
+
+@dataclasses.dataclass(frozen=True)
+class TinyintType(FixedWidthType):
+    name: ClassVar[str] = "tinyint"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int8
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(FixedWidthType):
+    name: ClassVar[str] = "double"
+
+    @property
+    def storage_dtype(self):
+        return jnp.float64
+
+
+@dataclasses.dataclass(frozen=True)
+class RealType(FixedWidthType):
+    name: ClassVar[str] = "real"
+
+    @property
+    def storage_dtype(self):
+        return jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(FixedWidthType):
+    name: ClassVar[str] = "boolean"
+
+    @property
+    def storage_dtype(self):
+        return jnp.bool_
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(FixedWidthType):
+    """Days since 1970-01-01 in int32 (reference spi/type/DateType.java)."""
+
+    name: ClassVar[str] = "date"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    def to_python(self, storage_value, dictionary=None):
+        days = int(storage_value)
+        return (np.datetime64("1970-01-01") + np.timedelta64(days, "D")).astype(
+            "datetime64[D]"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(FixedWidthType):
+    """Microseconds since epoch in int64."""
+
+    name: ClassVar[str] = "timestamp"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(FixedWidthType):
+    """Short decimal: int64 scaled by 10**scale (reference DecimalType.java).
+
+    precision<=18 only for now; long decimal (int128) is a later milestone.
+    """
+
+    precision: int = 18
+    scale: int = 0
+    name: ClassVar[str] = "decimal"
+
+    def __post_init__(self):
+        if not (1 <= self.precision <= 18):
+            raise ValueError(f"unsupported decimal precision {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"bad decimal scale {self.scale}")
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+    def display(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def to_python(self, storage_value, dictionary=None):
+        import decimal as _dec
+
+        v = int(storage_value)
+        if self.scale == 0:
+            return v
+        return _dec.Decimal(v).scaleb(-self.scale)
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(Type):
+    """Dictionary-coded string type. Storage = int32 codes into a sorted
+    host-side dictionary attached to the Block (page.py:Block.dictionary)."""
+
+    max_length: Optional[int] = None
+    name: ClassVar[str] = "varchar"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    def display(self) -> str:
+        if self.max_length is None:
+            return "varchar"
+        return f"varchar({self.max_length})"
+
+    def to_python(self, storage_value, dictionary=None):
+        code = int(storage_value)
+        if dictionary is None:
+            return code
+        return dictionary[code]
+
+
+@dataclasses.dataclass(frozen=True)
+class CharType(VarcharType):
+    name: ClassVar[str] = "char"
+
+    def display(self) -> str:
+        return f"char({self.max_length})" if self.max_length else "char"
+
+
+@dataclasses.dataclass(frozen=True)
+class UnknownType(Type):
+    """Type of a bare NULL literal (reference spi/type/UnknownType)."""
+
+    name: ClassVar[str] = "unknown"
+
+    @property
+    def storage_dtype(self):
+        return jnp.bool_
+
+
+# Singletons
+BIGINT = BigintType()
+INTEGER = IntegerType()
+SMALLINT = SmallintType()
+TINYINT = TinyintType()
+DOUBLE = DoubleType()
+REAL = RealType()
+BOOLEAN = BooleanType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+VARCHAR = VarcharType()
+UNKNOWN = UnknownType()
+
+
+def decimal(precision: int, scale: int) -> DecimalType:
+    return DecimalType(precision=precision, scale=scale)
+
+
+INTEGRAL_TYPES = (BigintType, IntegerType, SmallintType, TinyintType)
+FLOAT_TYPES = (DoubleType, RealType)
+
+
+def is_integral(t: Type) -> bool:
+    return isinstance(t, INTEGRAL_TYPES)
+
+
+def is_floating(t: Type) -> bool:
+    return isinstance(t, FLOAT_TYPES)
+
+
+def is_numeric(t: Type) -> bool:
+    return is_integral(t) or is_floating(t) or isinstance(t, DecimalType)
+
+
+def is_string(t: Type) -> bool:
+    return isinstance(t, VarcharType)
+
+
+def parse_type(text: str) -> Type:
+    """Parse a type name as it appears in SQL (CAST targets, DDL)."""
+    s = text.strip().lower()
+    simple = {
+        "bigint": BIGINT,
+        "integer": INTEGER,
+        "int": INTEGER,
+        "smallint": SMALLINT,
+        "tinyint": TINYINT,
+        "double": DOUBLE,
+        "double precision": DOUBLE,
+        "real": REAL,
+        "float": REAL,
+        "boolean": BOOLEAN,
+        "date": DATE,
+        "timestamp": TIMESTAMP,
+        "varchar": VARCHAR,
+        "unknown": UNKNOWN,
+    }
+    if s in simple:
+        return simple[s]
+    if s.startswith("decimal"):
+        inner = s[len("decimal") :].strip()
+        if inner.startswith("(") and inner.endswith(")"):
+            parts = [p.strip() for p in inner[1:-1].split(",")]
+            p = int(parts[0])
+            sc = int(parts[1]) if len(parts) > 1 else 0
+            return decimal(p, sc)
+        return decimal(18, 0)
+    if s.startswith("varchar(") and s.endswith(")"):
+        return VarcharType(max_length=int(s[len("varchar(") : -1]))
+    if s.startswith("char(") and s.endswith(")"):
+        return CharType(max_length=int(s[len("char(") : -1]))
+    raise ValueError(f"unknown type: {text!r}")
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Implicit coercion lattice (reference metadata/TypeCoercion — simplified)."""
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    rank = {TinyintType: 0, SmallintType: 1, IntegerType: 2, BigintType: 3}
+    ta, tb = type(a), type(b)
+    if ta in rank and tb in rank:
+        return a if rank[ta] >= rank[tb] else b
+    if is_floating(a) and is_floating(b):
+        return DOUBLE
+    if (is_floating(a) and is_numeric(b)) or (is_floating(b) and is_numeric(a)):
+        return DOUBLE
+    if isinstance(a, DecimalType) and is_integral(b):
+        return DecimalType(18, a.scale)
+    if isinstance(b, DecimalType) and is_integral(a):
+        return DecimalType(18, b.scale)
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        return DecimalType(18, scale)
+    if is_string(a) and is_string(b):
+        return VARCHAR
+    raise TypeError(f"no common type for {a} and {b}")

@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's DistributedQueryRunner idea (presto-tests/.../
+DistributedQueryRunner.java:75 — N workers in one JVM): we test all
+multi-chip sharding logic on N virtual CPU devices in one process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import re
+
+flags = os.environ.get("XLA_FLAGS", "")
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize forces jax_platforms="axon,cpu"; tests always run on
+# the virtual CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

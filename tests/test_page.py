@@ -1,0 +1,81 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.page import Block, Page, round_capacity
+
+
+def test_block_from_numpy_types():
+    b = Block.from_numpy(np.array([1, 2, 3]), T.BIGINT)
+    assert b.data.dtype == jnp.int64
+    assert b.capacity == 3
+    assert b.valid is None
+
+    d = Block.from_numpy(np.array([1.5, 2.5]), T.DOUBLE)
+    assert d.data.dtype == jnp.float64
+
+
+def test_string_dictionary_block_sorted_codes():
+    b = Block.from_strings(["cherry", "apple", "banana", "apple"])
+    assert b.dictionary == ("apple", "banana", "cherry")
+    np.testing.assert_array_equal(b.to_numpy(), [2, 0, 1, 0])
+    # sorted dictionary => code order == string order
+    assert b.dictionary[0] < b.dictionary[1] < b.dictionary[2]
+
+
+def test_string_block_with_nulls():
+    b = Block.from_strings(["x", None, "y"])
+    assert b.valid is not None
+    np.testing.assert_array_equal(np.asarray(b.valid), [True, False, True])
+
+
+def test_page_from_dict_and_pylist():
+    p = Page.from_dict(
+        {
+            "a": np.array([1, 2, 3], np.int64),
+            "b": (np.array([100, 200, 300]), T.decimal(10, 2)),
+            "c": ["foo", "bar", "baz"],
+        }
+    )
+    assert p.num_columns == 3
+    assert int(p.count) == 3
+    rows = p.to_pylist()
+    assert rows[0] == (1, 1.0, "foo")
+    assert rows[1] == (2, 2.0, "bar")
+
+
+def test_page_padding_and_live_mask():
+    p = Page.from_dict({"a": np.arange(5, dtype=np.int64)}, pad_to=8)
+    assert p.capacity == 8
+    assert int(p.count) == 5
+    np.testing.assert_array_equal(
+        np.asarray(p.live_mask()), [True] * 5 + [False] * 3
+    )
+    assert p.to_pylist() == [(i,) for i in range(5)]
+
+
+def test_page_is_pytree_through_jit():
+    p = Page.from_dict({"a": np.arange(4, dtype=np.int64)})
+
+    @jax.jit
+    def double(page: Page) -> Page:
+        blk = page.block("a")
+        return page.with_columns(
+            [Block(blk.data * 2, blk.type, blk.valid, blk.dict_id)], ["a"]
+        )
+
+    out = double(p)
+    assert out.to_pylist() == [(0,), (2,), (4,), (6,)]
+
+
+def test_round_capacity():
+    assert round_capacity(1) == 16
+    assert round_capacity(16) == 16
+    assert round_capacity(17) == 32
+    assert round_capacity(1000) == 1024
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
