@@ -1,0 +1,22 @@
+from .ir import (  # noqa: F401
+    Call,
+    ColumnRef,
+    Literal,
+    RowExpression,
+    and_,
+    between,
+    binary,
+    call,
+    cast,
+    col,
+    comparison,
+    if_,
+    in_list,
+    is_null,
+    like,
+    lit,
+    not_,
+    or_,
+)
+from .compiler import compile_projection, evaluate, project_page  # noqa: F401
+from .functions import Val, infer_call_type  # noqa: F401

@@ -1,0 +1,79 @@
+"""Vectorized civil-calendar math on int32 days-since-epoch.
+
+The reference implements date/time scalars over JodaTime
+(presto-main/.../operator/scalar/DateTimeFunctions.java). On TPU we need
+branch-free integer algorithms that vmap/fuse; these are the classic
+Euclidean-affine civil conversions (public-domain algorithms, as used by
+Howard Hinnant's date library), expressed in jnp int32/int64 arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def days_to_civil(days):
+    """days since 1970-01-01 -> (year, month, day), elementwise."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146096), 146097)
+    doe = z - era * 146097  # [0, 146096]
+    yoe = jnp.floor_divide(doe - doe // 1460 + doe // 36524 - doe // 146096, 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365]
+    mp = jnp.floor_divide(5 * doy + 2, 153)  # [0, 11]
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1  # [1, 31]
+    m = mp + jnp.where(mp < 10, 3, -9)  # [1, 12]
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def civil_to_days(y, m, d):
+    """(year, month, day) -> days since 1970-01-01, elementwise."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400  # [0, 399]
+    mp = (m.astype(jnp.int64) + jnp.where(m > 2, -3, 9)) % 12
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def last_day_of_month(y, m):
+    is_leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    lengths = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], jnp.int32)
+    base = lengths[m - 1]
+    return jnp.where((m == 2) & is_leap, 29, base)
+
+
+def add_months(days, n_months):
+    """SQL date + INTERVAL 'n' MONTH with end-of-month clamping
+    (reference DateTimeFunctions uses Joda's addMonths, same semantics)."""
+    y, m, d = days_to_civil(days)
+    total = (y.astype(jnp.int64)) * 12 + (m - 1) + n_months
+    ny = jnp.floor_divide(total, 12).astype(jnp.int32)
+    nm = (total - ny.astype(jnp.int64) * 12).astype(jnp.int32) + 1
+    nd = jnp.minimum(d, last_day_of_month(ny, nm))
+    return civil_to_days(ny, nm, nd)
+
+
+def extract_year(days):
+    return days_to_civil(days)[0].astype(jnp.int64)
+
+
+def extract_month(days):
+    return days_to_civil(days)[1].astype(jnp.int64)
+
+
+def extract_day(days):
+    return days_to_civil(days)[2].astype(jnp.int64)
+
+
+def extract_quarter(days):
+    m = days_to_civil(days)[1]
+    return ((m - 1) // 3 + 1).astype(jnp.int64)
+
+
+def parse_date_literal(text: str) -> int:
+    """Host-side: 'YYYY-MM-DD' -> days since epoch (for DATE literals)."""
+    return (np.datetime64(text, "D") - np.datetime64("1970-01-01", "D")).astype(int)

@@ -1,0 +1,575 @@
+"""Scalar function registry.
+
+Re-designed equivalent of the reference's FunctionRegistry
+(presto-main/.../metadata/FunctionRegistry.java:360, ~380 built-ins) plus the
+scalar implementations under presto-main/.../operator/scalar/. Each function
+declares a type-inference rule and a trace-time implementation over `Val`
+(data array + validity mask + type + dictionary id). Implementations run
+inside jit tracing, so everything fuses into the surrounding kernel — the TPU
+replacement for per-function JVM bytecode.
+
+Varchar strategy: functions/predicates over strings are evaluated once per
+*dictionary entry* on the host at trace time (dictionaries are static pytree
+aux), then applied to the code array with one device gather. This turns
+O(rows) string work into O(|dict|) host work + O(rows) int gather.
+
+Null semantics: scalar functions are null-propagating (RETURNS NULL ON NULL
+INPUT, the reference default); special forms in compiler.py implement Kleene
+AND/OR, IS NULL, COALESCE, IF/CASE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..page import dictionary_by_id, intern_dictionary
+from . import datetime_kernels as dt
+
+
+@dataclasses.dataclass
+class Val:
+    """A vectorized SQL value during expression tracing."""
+
+    data: jnp.ndarray
+    valid: Optional[jnp.ndarray]  # None = no nulls
+    type: T.Type
+    dict_id: Optional[int] = None
+
+    @property
+    def dictionary(self) -> Optional[Tuple[str, ...]]:
+        return None if self.dict_id is None else dictionary_by_id(self.dict_id)
+
+    def valid_mask(self):
+        if self.valid is None:
+            return jnp.ones(self.data.shape, jnp.bool_)
+        return self.valid
+
+
+def and_valid(*valids):
+    """Combine validity masks; None means all-valid."""
+    out = None
+    for v in valids:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry plumbing
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScalarFunction:
+    name: str
+    infer: Callable[[Tuple[T.Type, ...]], T.Type]
+    impl: Callable[..., Val]  # (*vals, out_type=Type) -> Val
+
+
+FUNCTIONS: dict = {}
+
+
+def register(name, infer):
+    def deco(fn):
+        FUNCTIONS[name] = ScalarFunction(name, infer, fn)
+        return fn
+
+    return deco
+
+
+def infer_call_type(name: str, arg_types: Tuple[T.Type, ...]) -> T.Type:
+    f = FUNCTIONS.get(name)
+    if f is None:
+        raise KeyError(f"unknown function {name!r}")
+    return f.infer(arg_types)
+
+
+def apply_function(name: str, vals: Sequence[Val], out_type: T.Type) -> Val:
+    f = FUNCTIONS.get(name)
+    if f is None:
+        raise KeyError(f"unknown function {name!r}")
+    return f.impl(*vals, out_type=out_type)
+
+
+# ---------------------------------------------------------------------------
+# type rules
+# ---------------------------------------------------------------------------
+
+
+def _arith_infer(op):
+    def infer(ts: Tuple[T.Type, ...]) -> T.Type:
+        a, b = ts
+        # date/interval arithmetic
+        if isinstance(a, T.DateType) or isinstance(b, T.DateType):
+            if op in ("add", "subtract"):
+                if isinstance(a, T.DateType) and isinstance(b, T.DateType):
+                    return T.BIGINT  # date difference in days
+                return T.DATE
+        da, db = isinstance(a, T.DecimalType), isinstance(b, T.DecimalType)
+        if T.is_floating(a) or T.is_floating(b):
+            return T.DOUBLE
+        if da or db:
+            sa = a.scale if da else 0
+            sb = b.scale if db else 0
+            if op in ("add", "subtract"):
+                return T.DecimalType(18, max(sa, sb))
+            if op == "multiply":
+                return T.DecimalType(18, min(sa + sb, 18))
+            if op == "divide":
+                # reference: decimal division stays decimal
+                # (DecimalOperators.java); scale = max(sa, sb) after rescale
+                return T.DecimalType(18, max(sa, sb, 6))
+            if op == "modulus":
+                return T.DecimalType(18, max(sa, sb))
+        # integral
+        return T.common_super_type(a, b)
+
+    return infer
+
+
+def _bool_infer(ts):
+    return T.BOOLEAN
+
+
+def _same_as_first(ts):
+    return ts[0]
+
+
+def _double_infer(ts):
+    return T.DOUBLE
+
+
+def _bigint_infer(ts):
+    return T.BIGINT
+
+
+# ---------------------------------------------------------------------------
+# numeric helpers
+# ---------------------------------------------------------------------------
+
+
+def _scale_of(t: T.Type) -> int:
+    return t.scale if isinstance(t, T.DecimalType) else 0
+
+
+def _div_round(x, y):
+    """Round-half-up signed integer division (SQL decimal semantics,
+    reference Decimals.java HALF_UP rescale)."""
+    sign = jnp.sign(x) * jnp.sign(y)
+    q = (2 * jnp.abs(x) + jnp.abs(y)) // (2 * jnp.abs(y))
+    return sign * q
+
+
+def _rescale(data, from_scale: int, to_scale: int):
+    """Rescale a scaled-int decimal; scale-down rounds half-up."""
+    if to_scale == from_scale:
+        return data
+    if to_scale > from_scale:
+        return data * (10 ** (to_scale - from_scale))
+    d = 10 ** (from_scale - to_scale)
+    return _div_round(data, jnp.asarray(d, data.dtype))
+
+
+def _round_half_away(x):
+    """SQL ROUND for floats: half away from zero (not banker's rounding)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+def _to_double(v: Val):
+    s = _scale_of(v.type)
+    d = v.data.astype(jnp.float64)
+    return d / (10**s) if s else d
+
+
+def _numeric_align(a: Val, b: Val, out_type: T.Type):
+    """Bring both operands into the output type's representation."""
+    if isinstance(out_type, T.DoubleType) or isinstance(out_type, T.RealType):
+        return _to_double(a), _to_double(b)
+    if isinstance(out_type, T.DecimalType):
+        return (
+            _rescale(a.data.astype(jnp.int64), _scale_of(a.type), out_type.scale),
+            _rescale(b.data.astype(jnp.int64), _scale_of(b.type), out_type.scale),
+        )
+    dtype = out_type.storage_dtype
+    return a.data.astype(dtype), b.data.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+
+
+@register("add", _arith_infer("add"))
+def _add(a: Val, b: Val, out_type: T.Type) -> Val:
+    valid = and_valid(a.valid, b.valid)
+    if isinstance(out_type, T.DateType):
+        date, delta = (a, b) if isinstance(a.type, T.DateType) else (b, a)
+        if isinstance(delta.type, T.IntervalYearMonthType):
+            data = dt.add_months(date.data, delta.data)
+        else:
+            data = (date.data.astype(jnp.int64) + delta.data).astype(jnp.int32)
+        return Val(data, valid, T.DATE)
+    x, y = _numeric_align(a, b, out_type)
+    return Val(x + y, valid, out_type)
+
+
+@register("subtract", _arith_infer("subtract"))
+def _subtract(a: Val, b: Val, out_type: T.Type) -> Val:
+    valid = and_valid(a.valid, b.valid)
+    if isinstance(out_type, T.DateType):
+        if isinstance(b.type, T.IntervalYearMonthType):
+            data = dt.add_months(a.data, -b.data)
+        else:
+            data = (a.data.astype(jnp.int64) - b.data).astype(jnp.int32)
+        return Val(data, valid, T.DATE)
+    if isinstance(a.type, T.DateType) and isinstance(b.type, T.DateType):
+        return Val(a.data.astype(jnp.int64) - b.data.astype(jnp.int64), valid, T.BIGINT)
+    x, y = _numeric_align(a, b, out_type)
+    return Val(x - y, valid, out_type)
+
+
+@register("multiply", _arith_infer("multiply"))
+def _multiply(a: Val, b: Val, out_type: T.Type) -> Val:
+    valid = and_valid(a.valid, b.valid)
+    if isinstance(out_type, T.DecimalType):
+        # scales add under multiplication: compute in raw units then the
+        # result scale is sa+sb == out_type.scale (capped by inference)
+        x = a.data.astype(jnp.int64)
+        y = b.data.astype(jnp.int64)
+        raw = x * y
+        have = _scale_of(a.type) + _scale_of(b.type)
+        return Val(_rescale(raw, have, out_type.scale), valid, out_type)
+    x, y = _numeric_align(a, b, out_type)
+    return Val(x * y, valid, out_type)
+
+
+@register("divide", _arith_infer("divide"))
+def _divide(a: Val, b: Val, out_type: T.Type) -> Val:
+    valid = and_valid(a.valid, b.valid)
+    if isinstance(out_type, T.DecimalType):
+        xs, ys = _scale_of(a.type), _scale_of(b.type)
+        # scale numerator so raw-int division yields out_type.scale
+        x = _rescale(a.data.astype(jnp.int64), xs, out_type.scale + ys)
+        y = b.data.astype(jnp.int64)
+        safe = jnp.where(y == 0, 1, y)
+        q = _div_round(x, safe)
+        valid = and_valid(valid, b.data != 0)
+        return Val(q, valid, out_type)
+    x, y = _numeric_align(a, b, out_type)
+    if jnp.issubdtype(jnp.result_type(x), jnp.integer):
+        safe = jnp.where(y == 0, 1, y)
+        q = jnp.sign(x) * jnp.sign(safe) * (jnp.abs(x) // jnp.abs(safe))
+        return Val(q, and_valid(valid, y != 0), out_type)
+    return Val(x / y, valid, out_type)
+
+
+@register("modulus", _arith_infer("modulus"))
+def _modulus(a: Val, b: Val, out_type: T.Type) -> Val:
+    valid = and_valid(a.valid, b.valid)
+    x, y = _numeric_align(a, b, out_type)
+    if jnp.issubdtype(jnp.result_type(x), jnp.integer):
+        safe = jnp.where(y == 0, 1, y)
+        # truncated division remainder (sign follows dividend, SQL semantics)
+        r = x - (jnp.sign(x) * jnp.sign(safe) * (jnp.abs(x) // jnp.abs(safe))) * safe
+        return Val(r, and_valid(valid, y != 0), out_type)
+    r = x - jnp.trunc(x / y) * y
+    return Val(r, valid, out_type)
+
+
+@register("negate", _same_as_first)
+def _negate(a: Val, out_type: T.Type) -> Val:
+    return Val(-a.data, a.valid, out_type)
+
+
+# ---------------------------------------------------------------------------
+# comparisons (null-propagating; varchar via dictionary codes)
+# ---------------------------------------------------------------------------
+
+
+def _compare(op, a: Val, b: Val):
+    if isinstance(a.type, T.VarcharType) and isinstance(b.type, T.VarcharType):
+        x, y = _unify_codes(a, b)
+        return op(x, y)
+    if T.is_floating(a.type) or T.is_floating(b.type):
+        return op(_to_double(a), _to_double(b))
+    sa, sb = _scale_of(a.type), _scale_of(b.type)
+    s = max(sa, sb)
+    return op(
+        _rescale(a.data.astype(jnp.int64) if sa != s else a.data, sa, s),
+        _rescale(b.data.astype(jnp.int64) if sb != s else b.data, sb, s),
+    )
+
+
+def _unify_codes(a: Val, b: Val):
+    """Remap two dictionary-coded columns onto one merged sorted dictionary.
+    Returns (codes_a, codes_b); `unify_dictionaries` also returns the merged
+    interned dictionary id for callers that need the result dictionary."""
+    xa, xb, _ = unify_dictionaries(a, b)
+    return xa, xb
+
+
+def unify_dictionaries(a: Val, b: Val):
+    if a.dict_id is not None and a.dict_id == b.dict_id:
+        return a.data, b.data, a.dict_id
+    da = a.dictionary or ()
+    db = b.dictionary or ()
+    merged = tuple(sorted(set(da) | set(db)))
+    index = {s: i for i, s in enumerate(merged)}
+    map_a = jnp.asarray(np.array([index[s] for s in da], np.int32))
+    map_b = jnp.asarray(np.array([index[s] for s in db], np.int32))
+    xa = map_a[a.data] if len(da) else a.data
+    xb = map_b[b.data] if len(db) else b.data
+    return xa, xb, intern_dictionary(merged)
+
+
+def _cmp_factory(name, op):
+    @register(name, _bool_infer)
+    def _cmp(a: Val, b: Val, out_type: T.Type) -> Val:
+        return Val(_compare(op, a, b), and_valid(a.valid, b.valid), T.BOOLEAN)
+
+    return _cmp
+
+
+_cmp_factory("eq", lambda x, y: x == y)
+_cmp_factory("ne", lambda x, y: x != y)
+_cmp_factory("lt", lambda x, y: x < y)
+_cmp_factory("le", lambda x, y: x <= y)
+_cmp_factory("gt", lambda x, y: x > y)
+_cmp_factory("ge", lambda x, y: x >= y)
+
+
+# ---------------------------------------------------------------------------
+# math scalars
+# ---------------------------------------------------------------------------
+
+
+@register("abs", _same_as_first)
+def _abs(a: Val, out_type: T.Type) -> Val:
+    return Val(jnp.abs(a.data), a.valid, out_type)
+
+
+@register("sqrt", _double_infer)
+def _sqrt(a: Val, out_type: T.Type) -> Val:
+    x = _to_double(a)
+    return Val(jnp.sqrt(jnp.maximum(x, 0.0)), and_valid(a.valid, x >= 0), T.DOUBLE)
+
+
+@register("ln", _double_infer)
+def _ln(a: Val, out_type: T.Type) -> Val:
+    x = _to_double(a)
+    return Val(jnp.log(jnp.maximum(x, 1e-300)), and_valid(a.valid, x > 0), T.DOUBLE)
+
+
+@register("exp", _double_infer)
+def _exp(a: Val, out_type: T.Type) -> Val:
+    return Val(jnp.exp(_to_double(a)), a.valid, T.DOUBLE)
+
+
+@register("power", _double_infer)
+def _power(a: Val, b: Val, out_type: T.Type) -> Val:
+    return Val(jnp.power(_to_double(a), _to_double(b)), and_valid(a.valid, b.valid), T.DOUBLE)
+
+
+@register("floor", _same_as_first)
+def _floor(a: Val, out_type: T.Type) -> Val:
+    if T.is_floating(a.type):
+        return Val(jnp.floor(a.data), a.valid, out_type)
+    if isinstance(a.type, T.DecimalType):
+        s = 10 ** a.type.scale
+        d = jnp.where(a.data >= 0, a.data // s, -((-a.data + s - 1) // s)) * s
+        return Val(d, a.valid, out_type)
+    return Val(a.data, a.valid, out_type)
+
+
+@register("ceil", _same_as_first)
+def _ceil(a: Val, out_type: T.Type) -> Val:
+    if T.is_floating(a.type):
+        return Val(jnp.ceil(a.data), a.valid, out_type)
+    if isinstance(a.type, T.DecimalType):
+        s = 10 ** a.type.scale
+        d = jnp.where(a.data >= 0, (a.data + s - 1) // s, -((-a.data) // s)) * s
+        return Val(d, a.valid, out_type)
+    return Val(a.data, a.valid, out_type)
+
+
+def _round_infer(ts):
+    a = ts[0]
+    if isinstance(a, T.DecimalType):
+        return a
+    if T.is_floating(a):
+        return T.DOUBLE
+    return a
+
+
+@register("round", _round_infer)
+def _round(a: Val, *rest, out_type: T.Type) -> Val:
+    ndigits = 0
+    if rest:
+        (nd,) = rest
+        ndigits = int(np.asarray(nd.data).reshape(-1)[0])  # literal only
+    if T.is_floating(a.type):
+        f = 10.0**ndigits
+        return Val(_round_half_away(a.data * f) / f, a.valid, T.DOUBLE)
+    if isinstance(a.type, T.DecimalType):
+        drop = a.type.scale - ndigits
+        if drop <= 0:
+            return Val(a.data, a.valid, a.type)
+        s = 10**drop
+        d = _div_round(a.data, jnp.asarray(s, a.data.dtype)) * s
+        return Val(d, a.valid, a.type)
+    return Val(a.data, a.valid, a.type)
+
+
+# ---------------------------------------------------------------------------
+# date/time
+# ---------------------------------------------------------------------------
+
+
+@register("year", _bigint_infer)
+def _year(a: Val, out_type: T.Type) -> Val:
+    return Val(dt.extract_year(a.data), a.valid, T.BIGINT)
+
+
+@register("month", _bigint_infer)
+def _month(a: Val, out_type: T.Type) -> Val:
+    return Val(dt.extract_month(a.data), a.valid, T.BIGINT)
+
+
+@register("day", _bigint_infer)
+def _day(a: Val, out_type: T.Type) -> Val:
+    return Val(dt.extract_day(a.data), a.valid, T.BIGINT)
+
+
+@register("quarter", _bigint_infer)
+def _quarter(a: Val, out_type: T.Type) -> Val:
+    return Val(dt.extract_quarter(a.data), a.valid, T.BIGINT)
+
+
+# ---------------------------------------------------------------------------
+# varchar functions (host dictionary transforms + device gather)
+# ---------------------------------------------------------------------------
+
+
+def _dict_transform(a: Val, fn: Callable[[str], str], out_type=T.VARCHAR) -> Val:
+    """Apply a host string function entry-wise to the dictionary; produce a
+    re-sorted dictionary and remap codes with one gather."""
+    d = a.dictionary
+    if d is None:
+        raise TypeError("varchar value lost its dictionary")
+    transformed = [fn(s) for s in d]
+    new_dict = tuple(sorted(set(transformed)))
+    index = {s: i for i, s in enumerate(new_dict)}
+    mapping = jnp.asarray(np.array([index[t] for t in transformed], np.int32))
+    return Val(mapping[a.data], a.valid, out_type, intern_dictionary(new_dict))
+
+
+def _dict_predicate(a: Val, pred: Callable[[str], bool]) -> Val:
+    d = a.dictionary
+    if d is None:
+        raise TypeError("varchar value lost its dictionary")
+    table = jnp.asarray(np.array([bool(pred(s)) for s in d], np.bool_))
+    return Val(table[a.data], a.valid, T.BOOLEAN)
+
+
+def _varchar_infer(ts):
+    return T.VARCHAR
+
+
+@register("lower", _varchar_infer)
+def _lower(a: Val, out_type: T.Type) -> Val:
+    return _dict_transform(a, str.lower)
+
+
+@register("upper", _varchar_infer)
+def _upper(a: Val, out_type: T.Type) -> Val:
+    return _dict_transform(a, str.upper)
+
+
+@register("length", _bigint_infer)
+def _length(a: Val, out_type: T.Type) -> Val:
+    d = a.dictionary or ()
+    table = jnp.asarray(np.array([len(s) for s in d], np.int64))
+    return Val(table[a.data], a.valid, T.BIGINT)
+
+
+@register("substr", _varchar_infer)
+def _substr(a: Val, start: Val, *rest, out_type: T.Type) -> Val:
+    s0 = int(np.asarray(start.data).reshape(-1)[0])  # literal positions only
+    ln = int(np.asarray(rest[0].data).reshape(-1)[0]) if rest else None
+
+    def f(s: str) -> str:
+        i = s0 - 1 if s0 > 0 else len(s) + s0
+        return s[i : i + ln] if ln is not None else s[i:]
+
+    return _dict_transform(a, f)
+
+
+@register("trim", _varchar_infer)
+def _trim(a: Val, out_type: T.Type) -> Val:
+    return _dict_transform(a, str.strip)
+
+
+@register("concat", _varchar_infer)
+def _concat(*vals, out_type: T.Type) -> Val:
+    # concat of dictionary columns multiplies dictionaries; support the
+    # common literal/column cases by materializing the cross dictionary only
+    # when both sides are small.
+    a, b = vals
+    da, db = a.dictionary or (), b.dictionary or ()
+    if len(da) * len(db) > 1_000_000:
+        raise NotImplementedError("concat of two large-dictionary columns")
+    merged = tuple(sorted({x + y for x in da for y in db}))
+    index = {s: i for i, s in enumerate(merged)}
+    table = np.empty((len(da), len(db)), np.int32)
+    for i, x in enumerate(da):
+        for j, y in enumerate(db):
+            table[i, j] = index[x + y]
+    t = jnp.asarray(table)
+    return Val(
+        t[a.data, b.data], and_valid(a.valid, b.valid), T.VARCHAR, intern_dictionary(merged)
+    )
+
+
+def like_pattern_to_regex(pattern: str, escape: Optional[str] = None) -> "re.Pattern":
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)
+
+
+@register("like", _bool_infer)
+def _like(a: Val, pattern: Val, *rest, out_type: T.Type) -> Val:
+    pat = pattern.dictionary[int(np.asarray(pattern.data).reshape(-1)[0])]
+    esc = None
+    if rest:
+        esc = rest[0].dictionary[int(np.asarray(rest[0].data).reshape(-1)[0])]
+    rx = like_pattern_to_regex(pat, esc)
+    return _dict_predicate(a, lambda s: rx.fullmatch(s) is not None)
+
+
+@register("strpos", _bigint_infer)
+def _strpos(a: Val, needle: Val, out_type: T.Type) -> Val:
+    n = needle.dictionary[int(np.asarray(needle.data).reshape(-1)[0])]
+    d = a.dictionary or ()
+    table = jnp.asarray(np.array([s.find(n) + 1 for s in d], np.int64))
+    return Val(table[a.data], a.valid, T.BIGINT)

@@ -1,0 +1,160 @@
+"""Row-expression IR.
+
+Re-designed equivalent of the reference's RowExpression layer
+(presto-main/src/main/java/com/facebook/presto/sql/relational/RowExpression.java
+and SqlToRowExpressionTranslator.java). The analyzer produces *typed* nodes;
+expr/compiler.py traces them into fused jax functions — the TPU answer to the
+reference's runtime bytecode generation (sql/gen/ExpressionCompiler.java:93).
+
+Only three node kinds, like the reference (InputReference / ConstantExpression /
+CallExpression): special forms (AND/OR/IF/...) are Calls with reserved names,
+mirroring the reference's Signatures.
+
+Expressions are frozen dataclasses — hashable, so compiled plans can be cached
+on the expression tree itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+from .. import types as T
+
+
+@dataclasses.dataclass(frozen=True)
+class RowExpression:
+    def __post_init__(self):
+        pass
+
+    @property
+    def type(self) -> T.Type:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnRef(RowExpression):
+    """Reference to an input column by name (the planner guarantees unique
+    names per pipeline — equivalent of the reference's channel-indexed
+    InputReferenceExpression)."""
+
+    name: str
+    _type: T.Type
+
+    @property
+    def type(self) -> T.Type:
+        return self._type
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(RowExpression):
+    value: Any  # python scalar; None = SQL NULL; str for varchar
+    _type: T.Type
+
+    @property
+    def type(self) -> T.Type:
+        return self._type
+
+    def __str__(self):
+        return repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(RowExpression):
+    """Function call. `name` is either a scalar function from
+    expr/functions.py or a special form (see compiler.SPECIAL_FORMS)."""
+
+    name: str
+    args: Tuple[RowExpression, ...]
+    _type: T.Type
+
+    @property
+    def type(self) -> T.Type:
+        return self._type
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+# ---- convenience constructors (used by tests and the planner) ----
+
+
+def col(name: str, typ: T.Type) -> ColumnRef:
+    return ColumnRef(name, typ)
+
+
+def lit(value: Any, typ: Optional[T.Type] = None) -> Literal:
+    if typ is None:
+        if value is None:
+            typ = T.UNKNOWN
+        elif isinstance(value, bool):
+            typ = T.BOOLEAN
+        elif isinstance(value, int):
+            typ = T.BIGINT
+        elif isinstance(value, float):
+            typ = T.DOUBLE
+        elif isinstance(value, str):
+            typ = T.VARCHAR
+        else:
+            raise TypeError(f"cannot infer literal type for {value!r}")
+    return Literal(value, typ)
+
+
+def call(name: str, args, typ: T.Type) -> Call:
+    return Call(name, tuple(args), typ)
+
+
+def _binary_result_type(name: str, a: T.Type, b: T.Type) -> T.Type:
+    from . import functions
+
+    return functions.infer_call_type(name, (a, b))
+
+
+def binary(name: str, left: RowExpression, right: RowExpression) -> Call:
+    return Call(name, (left, right), _binary_result_type(name, left.type, right.type))
+
+
+def comparison(name: str, left: RowExpression, right: RowExpression) -> Call:
+    return Call(name, (left, right), T.BOOLEAN)
+
+
+def and_(*args: RowExpression) -> Call:
+    return Call("and", tuple(args), T.BOOLEAN)
+
+
+def or_(*args: RowExpression) -> Call:
+    return Call("or", tuple(args), T.BOOLEAN)
+
+
+def not_(arg: RowExpression) -> Call:
+    return Call("not", (arg,), T.BOOLEAN)
+
+
+def is_null(arg: RowExpression) -> Call:
+    return Call("is_null", (arg,), T.BOOLEAN)
+
+
+def cast(arg: RowExpression, to: T.Type) -> Call:
+    return Call("cast", (arg, Literal(to.display(), T.VARCHAR)), to)
+
+
+def between(v: RowExpression, lo: RowExpression, hi: RowExpression) -> Call:
+    return Call("between", (v, lo, hi), T.BOOLEAN)
+
+
+def in_list(v: RowExpression, options) -> Call:
+    return Call("in", (v,) + tuple(options), T.BOOLEAN)
+
+
+def like(v: RowExpression, pattern: str, escape: Optional[str] = None) -> Call:
+    args = (v, Literal(pattern, T.VARCHAR))
+    if escape is not None:
+        args = args + (Literal(escape, T.VARCHAR),)
+    return Call("like", args, T.BOOLEAN)
+
+
+def if_(cond: RowExpression, then: RowExpression, else_: RowExpression) -> Call:
+    return Call("if", (cond, then, else_), T.common_super_type(then.type, else_.type))

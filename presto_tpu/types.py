@@ -228,6 +228,29 @@ class CharType(VarcharType):
 
 
 @dataclasses.dataclass(frozen=True)
+class IntervalDayType(FixedWidthType):
+    """INTERVAL DAY TO SECOND, stored as int64 days (sub-day resolution is a
+    later milestone; TPC-H uses whole-day/month/year intervals only)."""
+
+    name: ClassVar[str] = "interval day to second"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalYearMonthType(FixedWidthType):
+    """INTERVAL YEAR TO MONTH, stored as int64 months."""
+
+    name: ClassVar[str] = "interval year to month"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(Type):
     """Type of a bare NULL literal (reference spi/type/UnknownType)."""
 
@@ -250,6 +273,8 @@ DATE = DateType()
 TIMESTAMP = TimestampType()
 VARCHAR = VarcharType()
 UNKNOWN = UnknownType()
+INTERVAL_DAY = IntervalDayType()
+INTERVAL_YEAR_MONTH = IntervalYearMonthType()
 
 
 def decimal(precision: int, scale: int) -> DecimalType:
