@@ -294,8 +294,68 @@ def _negate(a: Val, out_type: T.Type) -> Val:
 # ---------------------------------------------------------------------------
 
 
-def _compare(op, a: Val, b: Val):
+def require_sorted_dict(v: Val, what: str):
+    d = v.dictionary
+    if d is not None and not getattr(d, "is_sorted", True):
+        raise NotImplementedError(
+            f"{what} on a column with an unsorted dictionary "
+            f"({type(d).__name__}); codes do not order like strings"
+        )
+
+
+def _bisect(d, s: str, side: str) -> int:
+    """Binary search over any (possibly lazy) sorted dictionary — O(log n)
+    __getitem__ calls, never materializes the dictionary."""
+    lo, hi = 0, len(d)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        e = d[mid]
+        if e < s or (side == "right" and e == s):
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+_LITERAL_CMP = {
+    "eq": lambda codes, bl, br: (codes >= bl) & (codes < br),
+    "ne": lambda codes, bl, br: (codes < bl) | (codes >= br),
+    "lt": lambda codes, bl, br: codes < bl,
+    "le": lambda codes, bl, br: codes < br,
+    "gt": lambda codes, bl, br: codes >= br,
+    "ge": lambda codes, bl, br: codes >= bl,
+}
+
+
+def _literal_cmp_fastpath(name: str, a: Val, b: Val):
+    """column <op> 'literal' without unifying dictionaries: bisect the
+    literal's position in the (sorted, possibly lazy) column dictionary and
+    compare codes against it. Critical for LazyDict columns (tpch c_name …)
+    where unify would materialize millions of strings."""
+    col_v, lit_v, flip = (a, b, False) if len(b.dictionary or ()) == 1 else (b, a, True)
+    d = col_v.dictionary
+    if d is None:
+        return None
+    require_sorted_dict(col_v, f"comparison {name!r}")
+    s = lit_v.dictionary[0]
+    bl = _bisect(d, s, "left")
+    br = _bisect(d, s, "right")
+    if flip:
+        name = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(name, name)
+    return _LITERAL_CMP[name](col_v.data, jnp.int32(bl), jnp.int32(br))
+
+
+def _compare(op, a: Val, b: Val, name: str = ""):
     if isinstance(a.type, T.VarcharType) and isinstance(b.type, T.VarcharType):
+        if name in _LITERAL_CMP and (
+            len(a.dictionary or ()) == 1 or len(b.dictionary or ()) == 1
+        ):
+            fast = _literal_cmp_fastpath(name, a, b)
+            if fast is not None:
+                return fast
+        if name in ("lt", "le", "gt", "ge"):
+            require_sorted_dict(a, f"comparison {name!r}")
+            require_sorted_dict(b, f"comparison {name!r}")
         x, y = _unify_codes(a, b)
         return op(x, y)
     if T.is_floating(a.type) or T.is_floating(b.type):
@@ -316,11 +376,19 @@ def _unify_codes(a: Val, b: Val):
     return xa, xb
 
 
+_UNIFY_MATERIALIZE_LIMIT = 1_000_000
+
+
 def unify_dictionaries(a: Val, b: Val):
     if a.dict_id is not None and a.dict_id == b.dict_id:
         return a.data, b.data, a.dict_id
     da = a.dictionary or ()
     db = b.dictionary or ()
+    if len(da) + len(db) > _UNIFY_MATERIALIZE_LIMIT:
+        raise NotImplementedError(
+            f"dictionary unification would materialize {len(da)}+{len(db)} "
+            "entries; use a literal fast path or dictionary-preserving plan"
+        )
     merged = tuple(sorted(set(da) | set(db)))
     index = {s: i for i, s in enumerate(merged)}
     map_a = jnp.asarray(np.array([index[s] for s in da], np.int32))
@@ -333,7 +401,7 @@ def unify_dictionaries(a: Val, b: Val):
 def _cmp_factory(name, op):
     @register(name, _bool_infer)
     def _cmp(a: Val, b: Val, out_type: T.Type) -> Val:
-        return Val(_compare(op, a, b), and_valid(a.valid, b.valid), T.BOOLEAN)
+        return Val(_compare(op, a, b, name), and_valid(a.valid, b.valid), T.BOOLEAN)
 
     return _cmp
 

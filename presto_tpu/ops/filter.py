@@ -1,0 +1,62 @@
+"""Filter + compaction kernels.
+
+Equivalent of the reference's FilterAndProjectOperator /
+ScanFilterAndProjectOperator (presto-main/.../operator/
+ScanFilterAndProjectOperator.java:55) with codegen'd PageProcessors. On TPU a
+filter has two parts: evaluating the predicate (fused elementwise — see
+expr/compiler.py) and *compaction* — moving surviving rows to the front so the
+page keeps its "live rows in [0, count)" invariant. Compaction is an O(n)
+cumsum + scatter, the XLA answer to dynamic row counts under static shapes."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.compiler import evaluate
+from ..page import Block, Page
+
+
+def compact(page: Page, keep: jnp.ndarray) -> Page:
+    """Keep rows where `keep & live`, moved to the front, count updated."""
+    keep = keep & page.live_mask()
+    cap = page.capacity
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1  # target slot per kept row
+    count = pos[-1] + 1 if cap else jnp.asarray(0, jnp.int32)
+    idx = jnp.where(keep, pos, cap)  # dropped rows scatter out of bounds
+    blocks = []
+    for b in page.blocks:
+        data = jnp.zeros_like(b.data).at[idx].set(b.data, mode="drop")
+        valid = None
+        if b.valid is not None:
+            valid = jnp.zeros_like(b.valid).at[idx].set(b.valid, mode="drop")
+        blocks.append(Block(data, b.type, valid, b.dict_id))
+    return Page(tuple(blocks), page.names, count.astype(jnp.int32))
+
+
+def filter_page(page: Page, predicate) -> Page:
+    """Evaluate a predicate RowExpression and compact survivors."""
+    v = evaluate(predicate, page)
+    keep = v.data
+    if v.valid is not None:
+        keep = keep & v.valid  # NULL predicate == not selected
+    return compact(page, keep)
+
+
+def filter_project_page(page: Page, predicate, exprs, names) -> Page:
+    """Fused filter+project: project all expressions, then compact once.
+
+    Matches the reference's PageProcessor structure (filter first, then
+    projections on selected positions) — here XLA fuses both passes."""
+    from ..expr.compiler import project_page
+
+    projected = project_page(page, exprs, names)
+    if predicate is None:
+        return projected
+    v = evaluate(predicate, page)
+    keep = v.data
+    if v.valid is not None:
+        keep = keep & v.valid
+    return compact(projected, keep)

@@ -1,0 +1,107 @@
+"""Sort / TopN / Limit / Distinct kernels.
+
+Equivalents of the reference's OrderByOperator (PagesIndex sort),
+TopNOperator, LimitOperator and DistinctLimitOperator/MarkDistinctOperator
+(presto-main/.../operator/). TPU redesign: XLA's sort is the workhorse —
+multi-key ORDER BY is iterated stable argsort (last key first), NULLS
+FIRST/LAST is a validity-aware key transform, and TopN is sort + static
+truncation (lax.top_k only handles single keys)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..expr.compiler import evaluate
+from ..page import Block, Page
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    expr: object  # RowExpression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # SQL default: NULLS LAST for ASC, FIRST for DESC
+
+    @property
+    def effective_nulls_first(self) -> bool:
+        if self.nulls_first is not None:
+            return self.nulls_first
+        return not self.ascending
+
+
+def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
+    """Permutation that orders live rows by the sort keys; dead rows last."""
+    cap = page.capacity
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    # iterate keys from least to most significant; stable sorts compose
+    for k in reversed(list(keys)):
+        v = evaluate(k.expr, page)
+        if isinstance(v.type, T.VarcharType):
+            from ..expr.functions import require_sorted_dict
+
+            require_sorted_dict(v, "ORDER BY")
+        data = v.data[perm]
+        if jnp.issubdtype(data.dtype, jnp.bool_):
+            data = data.astype(jnp.int32)
+        if not k.ascending:
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                data = -data
+            else:
+                data = -data.astype(jnp.int64)
+        order = jnp.argsort(data, stable=True)
+        perm = perm[order]
+        if v.valid is not None:
+            # nulls to the requested end: a second stable sort on the null
+            # flag composes into (null_flag, value) lexicographic order
+            null_perm = ~v.valid[perm]
+            flag = ~null_perm if k.effective_nulls_first else null_perm
+            order = jnp.argsort(flag.astype(jnp.int8), stable=True)
+            perm = perm[order]
+    # dead rows to the end (stable over the composed order)
+    live = page.live_mask()[perm]
+    order = jnp.argsort(~live, stable=True)
+    return perm[order]
+
+
+def apply_permutation(page: Page, perm: jnp.ndarray) -> Page:
+    blocks = []
+    for b in page.blocks:
+        data = b.data[perm]
+        valid = None if b.valid is None else b.valid[perm]
+        blocks.append(Block(data, b.type, valid, b.dict_id))
+    return Page(tuple(blocks), page.names, page.count)
+
+
+def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
+    return apply_permutation(page, sort_permutation(page, keys))
+
+
+def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:
+    """ORDER BY + LIMIT n with static output capacity n (TopNOperator)."""
+    s = sort_page(page, keys)
+    cap = min(n, page.capacity)
+    blocks = []
+    for b in s.blocks:
+        data = b.data[:cap]
+        valid = None if b.valid is None else b.valid[:cap]
+        blocks.append(Block(data, b.type, valid, b.dict_id))
+    count = jnp.minimum(s.count, cap).astype(jnp.int32)
+    return Page(tuple(blocks), s.names, count)
+
+
+def limit_page(page: Page, n: int) -> Page:
+    """LIMIT without ORDER BY: keep the first n live rows."""
+    return Page(page.blocks, page.names, jnp.minimum(page.count, n).astype(jnp.int32))
+
+
+def distinct_page(page: Page, max_groups: int) -> Page:
+    """SELECT DISTINCT via the grouped-aggregation machinery (reference
+    MarkDistinctOperator uses the same GroupByHash)."""
+    from ..expr.ir import ColumnRef
+    from .aggregate import grouped_aggregate_sorted
+
+    exprs = [ColumnRef(n, b.type) for n, b in zip(page.names, page.blocks)]
+    return grouped_aggregate_sorted(page, exprs, page.names, (), max_groups)

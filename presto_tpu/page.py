@@ -43,8 +43,28 @@ _DICT_INTERN: dict = {}
 _DICT_BY_ID: list = []
 
 
-def intern_dictionary(d: Sequence[str]) -> int:
-    key = tuple(d)
+class LazyDict:
+    """A dictionary whose entries are computed on demand — for huge formatted
+    string domains (c_name = 'Customer#%09d', phones, …) where materializing
+    tuples of millions of python strings would defeat the point of dictionary
+    encoding. Subclasses must be hashable value objects and implement
+    __len__/__getitem__; `is_sorted` declares whether entry order equals
+    lexicographic order (required for <,>,ORDER BY on codes)."""
+
+    is_sorted: bool = True
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, i: int) -> str:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+
+def intern_dictionary(d) -> int:
+    key = d if isinstance(d, LazyDict) else tuple(d)
     did = _DICT_INTERN.get(key)
     if did is None:
         did = len(_DICT_BY_ID)

@@ -1,0 +1,274 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.page import Block, Page
+from presto_tpu.expr import col, lit, comparison, binary
+from presto_tpu.ops import (
+    AggSpec,
+    SortKey,
+    build,
+    compact,
+    distinct_page,
+    filter_page,
+    global_aggregate,
+    grouped_aggregate_direct,
+    grouped_aggregate_sorted,
+    join_expand,
+    join_n1,
+    limit_page,
+    sort_page,
+    top_n,
+)
+
+
+def test_compact():
+    p = Page.from_dict({"a": np.arange(8, dtype=np.int64)}, pad_to=8)
+    keep = jnp.asarray([True, False, True, False, True, False, False, True])
+    out = compact(p, keep)
+    assert out.to_pylist() == [(0,), (2,), (4,), (7,)]
+    assert int(out.count) == 4
+
+
+def test_filter_page():
+    p = Page.from_dict({"a": np.arange(10, dtype=np.int64)}, pad_to=16)
+    out = filter_page(p, comparison("ge", col("a", T.BIGINT), lit(7)))
+    assert out.to_pylist() == [(7,), (8,), (9,)]
+
+
+def test_global_aggregate_with_nulls():
+    blk = Block.from_numpy(
+        np.array([1, 2, 3, 4], np.int64),
+        T.BIGINT,
+        valid=np.array([True, False, True, True]),
+    )
+    p = Page.from_blocks([blk], ["x"])
+    out = global_aggregate(
+        p,
+        [
+            AggSpec("sum", col("x", T.BIGINT), "s", T.BIGINT),
+            AggSpec("count", col("x", T.BIGINT), "c", T.BIGINT),
+            AggSpec("count_star", None, "cs", T.BIGINT),
+            AggSpec("min", col("x", T.BIGINT), "mn", T.BIGINT),
+            AggSpec("max", col("x", T.BIGINT), "mx", T.BIGINT),
+            AggSpec("avg", col("x", T.BIGINT), "av", T.DOUBLE),
+        ],
+    )
+    assert out.to_pylist() == [(8, 3, 4, 1, 4, 8 / 3)]
+
+
+def test_global_aggregate_empty_input():
+    p = Page.from_dict({"x": np.array([], np.int64)}, pad_to=4)
+    out = global_aggregate(
+        p,
+        [
+            AggSpec("sum", col("x", T.BIGINT), "s", T.BIGINT),
+            AggSpec("count", col("x", T.BIGINT), "c", T.BIGINT),
+        ],
+    )
+    # SQL: sum over empty = NULL, count = 0
+    assert out.to_pylist() == [(None, 0)]
+
+
+def test_grouped_direct():
+    p = Page.from_dict(
+        {
+            "g": Block.from_strings(["b", "a", "b", "a", "c"]),
+            "x": np.array([10, 1, 20, 2, 100], np.int64),
+        },
+        pad_to=8,
+    )
+    g = p.block("g")
+    out = grouped_aggregate_direct(
+        p,
+        [col("g", T.VARCHAR)],
+        ["g"],
+        [AggSpec("sum", col("x", T.BIGINT), "s", T.BIGINT)],
+        domains=[3],
+    )
+    assert sorted(out.to_pylist()) == [("a", 3), ("b", 30), ("c", 100)]
+
+
+def test_grouped_sorted_general():
+    rng = np.random.default_rng(7)
+    n = 1000
+    g = rng.integers(0, 37, n)
+    x = rng.integers(0, 100, n)
+    p = Page.from_dict(
+        {"g": g.astype(np.int64), "x": x.astype(np.int64)}, pad_to=1024
+    )
+    out = grouped_aggregate_sorted(
+        p,
+        [col("g", T.BIGINT)],
+        ["g"],
+        [
+            AggSpec("sum", col("x", T.BIGINT), "s", T.BIGINT),
+            AggSpec("count_star", None, "c", T.BIGINT),
+        ],
+        max_groups=64,
+    )
+    got = {r[0]: (r[1], r[2]) for r in out.to_pylist()}
+    want = {}
+    for gi in np.unique(g):
+        want[gi] = (int(x[g == gi].sum()), int((g == gi).sum()))
+    assert got == want
+
+
+def test_grouped_sorted_multikey_with_nulls():
+    k1 = Block.from_numpy(
+        np.array([1, 1, 2, 1, 2, 1], np.int64),
+        T.BIGINT,
+        valid=np.array([True, True, True, False, True, False]),
+    )
+    k2 = Block.from_strings(["x", "y", "x", "x", "x", "x"])
+    x = Block.from_numpy(np.array([1, 2, 4, 8, 16, 32], np.int64), T.BIGINT)
+    p = Page.from_blocks([k1, k2, x], ["k1", "k2", "x"])
+    out = grouped_aggregate_sorted(
+        p,
+        [col("k1", T.BIGINT), col("k2", T.VARCHAR)],
+        ["k1", "k2"],
+        [AggSpec("sum", col("x", T.BIGINT), "s", T.BIGINT)],
+        max_groups=16,
+    )
+    got = sorted(out.to_pylist(), key=lambda r: (r[0] is None, r[0], r[1]))
+    # groups: (1,x)=1, (1,y)=2, (2,x)=4+16=20, (NULL,x)=8+32=40
+    assert got == [(1, "x", 1), (1, "y", 2), (2, "x", 20), (None, "x", 40)]
+
+
+def test_join_n1_inner_left_semi_anti():
+    build_page = Page.from_dict(
+        {
+            "k": np.array([1, 2, 3, 5], np.int64),
+            "name": ["one", "two", "three", "five"],
+        },
+        pad_to=8,
+    )
+    probe = Page.from_dict(
+        {"k": np.array([3, 1, 4, 1, 5], np.int64), "v": np.array([30, 10, 40, 11, 50], np.int64)},
+        pad_to=8,
+    )
+    bs = build(build_page, [col("k", T.BIGINT)])
+
+    out = join_n1(probe, bs, [col("k", T.BIGINT)], ["name"], ["name"], kind="inner")
+    assert out.to_pylist() == [
+        (3, 30, "three"),
+        (1, 10, "one"),
+        (1, 11, "one"),
+        (5, 50, "five"),
+    ]
+
+    out = join_n1(probe, bs, [col("k", T.BIGINT)], ["name"], ["name"], kind="left")
+    assert out.to_pylist() == [
+        (3, 30, "three"),
+        (1, 10, "one"),
+        (4, 40, None),
+        (1, 11, "one"),
+        (5, 50, "five"),
+    ]
+
+    out = join_n1(probe, bs, [col("k", T.BIGINT)], [], [], kind="semi")
+    assert [r[0] for r in out.to_pylist()] == [3, 1, 1, 5]
+    out = join_n1(probe, bs, [col("k", T.BIGINT)], [], [], kind="anti")
+    assert [r[0] for r in out.to_pylist()] == [4]
+
+
+def test_join_n1_null_keys_never_match():
+    bk = Block.from_numpy(
+        np.array([1, 2], np.int64), T.BIGINT, valid=np.array([True, False])
+    )
+    build_page = Page.from_blocks([bk], ["k"])
+    pk = Block.from_numpy(
+        np.array([1, 2, 3], np.int64), T.BIGINT, valid=np.array([True, False, True])
+    )
+    probe = Page.from_blocks([pk], ["k"])
+    bs = build(build_page, [col("k", T.BIGINT)])
+    out = join_n1(probe, bs, [col("k", T.BIGINT)], [], [], kind="semi")
+    assert out.to_pylist() == [(1,)]
+
+
+def test_join_expand_1n():
+    build_page = Page.from_dict(
+        {"k": np.array([1, 1, 2, 3, 3, 3], np.int64), "w": np.array([10, 11, 20, 30, 31, 32], np.int64)},
+        pad_to=8,
+    )
+    probe = Page.from_dict(
+        {"k": np.array([3, 1, 9], np.int64), "v": np.array([300, 100, 900], np.int64)},
+        pad_to=4,
+    )
+    bs = build(build_page, [col("k", T.BIGINT)])
+    out = join_expand(
+        probe,
+        bs,
+        [col("k", T.BIGINT)],
+        ["k", "v"],
+        [("w", "w")],
+        out_capacity=16,
+        kind="inner",
+    )
+    rows = sorted(out.to_pylist())
+    assert rows == [(1, 100, 10), (1, 100, 11), (3, 300, 30), (3, 300, 31), (3, 300, 32)]
+
+    out = join_expand(
+        probe,
+        bs,
+        [col("k", T.BIGINT)],
+        ["k", "v"],
+        [("w", "w")],
+        out_capacity=16,
+        kind="left",
+    )
+    rows = sorted(out.to_pylist(), key=lambda r: (r[0], r[2] is None, r[2] or 0))
+    assert (9, 900, None) in rows
+    assert len(rows) == 6
+
+
+def test_sort_multikey_desc_nulls():
+    a = Block.from_numpy(
+        np.array([2, 1, 2, 1, 3], np.int64),
+        T.BIGINT,
+        valid=np.array([True, True, True, True, False]),
+    )
+    b = Block.from_numpy(np.array([5.0, 7.0, 3.0, 9.0, 1.0]), T.DOUBLE)
+    p = Page.from_blocks([a, b], ["a", "b"])
+    out = sort_page(
+        p,
+        [SortKey(col("a", T.BIGINT), ascending=True), SortKey(col("b", T.DOUBLE), ascending=False)],
+    )
+    # default: ASC => NULLS LAST
+    assert out.to_pylist() == [
+        (1, 9.0),
+        (1, 7.0),
+        (2, 5.0),
+        (2, 3.0),
+        (None, 1.0),
+    ]
+
+
+def test_top_n_and_limit():
+    p = Page.from_dict({"x": np.array([5, 3, 9, 1, 7], np.int64)}, pad_to=8)
+    out = top_n(p, [SortKey(col("x", T.BIGINT), ascending=False)], 3)
+    assert out.to_pylist() == [(9,), (7,), (5,)]
+    assert out.capacity == 3
+    out = limit_page(p, 2)
+    assert out.to_pylist() == [(5,), (3,)]
+
+
+def test_distinct():
+    p = Page.from_dict({"x": np.array([3, 1, 3, 2, 1, 3], np.int64)}, pad_to=8)
+    out = distinct_page(p, max_groups=8)
+    assert sorted(out.to_pylist()) == [(1,), (2,), (3,)]
+
+
+def test_kernels_are_jittable():
+    @jax.jit
+    def pipeline(p: Page) -> Page:
+        f = filter_page(p, comparison("gt", col("x", T.BIGINT), lit(2)))
+        return global_aggregate(
+            f, [AggSpec("sum", col("x", T.BIGINT), "s", T.BIGINT)]
+        )
+
+    p = Page.from_dict({"x": np.array([1, 2, 3, 4, 5], np.int64)}, pad_to=8)
+    out = pipeline(p)
+    assert out.to_pylist() == [(12,)]

@@ -1,0 +1,127 @@
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors import tpch
+
+
+SF = 0.01  # 15k orders, ~60k lineitems — fast but exercises everything
+
+
+def test_cardinalities():
+    assert tpch.table("region").num_rows == 5
+    assert tpch.table("nation").num_rows == 25
+    assert tpch.table("supplier", SF).num_rows == 100
+    assert tpch.table("part", SF).num_rows == 2000
+    assert tpch.table("partsupp", SF).num_rows == 8000
+    assert tpch.table("customer", SF).num_rows == 1500
+    assert tpch.table("orders", SF).num_rows == 15000
+    li = tpch.table("lineitem", SF)
+    assert 15000 <= li.num_rows <= 7 * 15000
+
+
+def test_determinism():
+    tpch._CACHE.clear()
+    a = tpch.table("lineitem", SF).columns["l_extendedprice"].data.copy()
+    tpch._CACHE.clear()
+    b = tpch.table("lineitem", SF).columns["l_extendedprice"].data
+    np.testing.assert_array_equal(a, b)
+
+
+def test_referential_integrity():
+    li = tpch.table("lineitem", SF)
+    orders = tpch.table("orders", SF)
+    cust = tpch.table("customer", SF)
+    ps = tpch.table("partsupp", SF)
+
+    assert li.columns["l_orderkey"].data.max() <= orders.num_rows
+    assert orders.columns["o_custkey"].data.max() <= cust.num_rows
+    assert orders.columns["o_custkey"].data.min() >= 1
+    # every (l_partkey, l_suppkey) must exist in partsupp
+    ps_pairs = set(
+        zip(ps.columns["ps_partkey"].data.tolist(), ps.columns["ps_suppkey"].data.tolist())
+    )
+    li_pairs = set(
+        zip(li.columns["l_partkey"].data[:500].tolist(), li.columns["l_suppkey"].data[:500].tolist())
+    )
+    assert li_pairs <= ps_pairs
+
+
+def test_pricing_formulas():
+    li = tpch.table("lineitem", SF)
+    qty = li.columns["l_quantity"].data
+    ep = li.columns["l_extendedprice"].data
+    pk = li.columns["l_partkey"].data
+    np.testing.assert_array_equal(ep, (qty // 100) * tpch.retail_price_cents(pk))
+
+    part = tpch.table("part", SF)
+    rp = part.columns["p_retailprice"].data
+    assert rp.min() >= 90000
+    assert rp.max() <= 90000 + 20000 + 99900
+
+
+def test_totalprice_rollup():
+    li = tpch.table("lineitem", SF)
+    orders = tpch.table("orders", SF)
+    ok = li.columns["l_orderkey"].data
+    net = li.columns["l_extendedprice"].data * (100 - li.columns["l_discount"].data) // 100
+    gross = net * (100 + li.columns["l_tax"].data) // 100
+    total = np.bincount(ok, weights=gross.astype(np.float64), minlength=orders.num_rows + 1)[1:]
+    np.testing.assert_array_equal(orders.columns["o_totalprice"].data, total.astype(np.int64))
+
+
+def test_sorted_dictionaries():
+    for name in tpch.TABLE_NAMES:
+        t = tpch.table(name, SF)
+        for cname, c in t.columns.items():
+            if c.dictionary is None:
+                continue
+            d = c.dictionary
+            if getattr(d, "is_sorted", True):
+                entries = list(d) if not isinstance(d, tuple) else list(d)
+                assert entries == sorted(entries), f"{name}.{cname} dictionary unsorted"
+            assert c.data.max() < len(d), f"{name}.{cname} code out of range"
+            assert c.data.min() >= 0
+
+
+def test_dates_and_status_rules():
+    li = tpch.table("lineitem", SF)
+    sd = li.columns["l_shipdate"].data
+    rd = li.columns["l_receiptdate"].data
+    od_rep = None
+    assert (rd > sd).all()
+    ls = li.columns["l_linestatus"].data  # 0=F 1=O
+    assert ((sd > tpch.CURRENTDATE) == (ls == 1)).all()
+    rf = li.columns["l_returnflag"].data  # A,N,R
+    assert (np.isin(rf[rd <= tpch.CURRENTDATE], [0, 2])).all()
+    assert (rf[rd > tpch.CURRENTDATE] == 1).all()
+
+
+def test_to_page_device_roundtrip():
+    t = tpch.table("nation")
+    p = t.to_page()
+    rows = p.to_pylist()
+    assert rows[0][1] == "ALGERIA"
+    assert rows[6][1] == "FRANCE"
+    assert len(rows) == 25
+
+    # split slicing
+    li = tpch.table("lineitem", SF)
+    pg = li.to_page(0, 1000, pad_to=1024)
+    assert pg.capacity == 1024
+    assert int(pg.count) == 1000
+
+
+def test_lazy_dicts():
+    cust = tpch.table("customer", SF)
+    name_dict = cust.columns["c_name"].dictionary
+    assert name_dict[0] == "Customer#000000001"
+    assert name_dict[1499] == "Customer#000001500"
+    assert name_dict.is_sorted
+    phone = cust.columns["c_phone"].dictionary
+    s = phone[0]
+    assert len(s.split("-")) == 4
+    cc = int(s.split("-")[0])
+    assert 10 <= cc <= 34
+    # phone country code matches nationkey
+    nk = cust.columns["c_nationkey"].data
+    assert cc == 10 + nk[0]
