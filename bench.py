@@ -1,0 +1,103 @@
+"""Benchmark: TPC-H Q1 SF1 throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol mirrors the reference's in-process operator benchmark
+(presto-benchmark/.../HandTpchQuery1.java via BenchmarkSuite.java:32 —
+rows/sec over tpch data): data is generated once, resident on device, the
+query kernel pipeline is timed over several runs (prewarm excluded, best-of-N
+like AbstractBenchmark). `vs_baseline` is the speedup over a single-threaded
+vectorized-numpy columnar CPU implementation of the same query measured
+in-process (the reference publishes no absolute numbers — BASELINE.md §"What
+the reference defines"; the CPU oracle stands in as the single-node columnar
+baseline until the Java reference is benchmarked on identical data).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+SF = float(__import__("os").environ.get("BENCH_SF", "1.0"))
+RUNS = 5
+
+
+def numpy_q1_baseline(t):
+    """Vectorized numpy Q1 doing the SAME work as the device pipeline: exact
+    scaled-integer decimal math (disc_price scale 4, charge scale 6), all 8
+    aggregates including the three avgs, and the final group sort."""
+    ship = t.columns["l_shipdate"].data
+    cutoff = (np.datetime64("1998-09-02") - np.datetime64("1970-01-01")).astype(int)
+    m = ship <= cutoff
+    rf = t.columns["l_returnflag"].data[m]
+    ls = t.columns["l_linestatus"].data[m]
+    qty = t.columns["l_quantity"].data[m]  # scale 2
+    price = t.columns["l_extendedprice"].data[m]  # scale 2
+    disc = t.columns["l_discount"].data[m]  # scale 2
+    tax = t.columns["l_tax"].data[m]  # scale 2
+    gid = rf * 2 + ls
+    nbins = 6
+    # decimal arithmetic in scaled ints, matching the engine's expr types:
+    # (1 - disc) scale 2; price*(1-disc) scale 4; *(1+tax) scale 6
+    disc_price = price * (100 - disc)  # scale 4
+    charge = disc_price * (100 + tax)  # scale 6
+    cnt = np.bincount(gid, minlength=nbins)
+    sums = [
+        np.bincount(gid, weights=w.astype(np.float64), minlength=nbins)
+        for w in (qty, price, disc_price, charge, disc)
+    ]
+    safe = np.maximum(cnt, 1)
+    avg_qty = (2 * np.abs(sums[0]) + safe) // (2 * safe)  # HALF_UP scale 2
+    avg_price = (2 * np.abs(sums[1]) + safe) // (2 * safe)
+    avg_disc = (2 * np.abs(sums[4]) + safe) // (2 * safe)
+    order = np.argsort(np.arange(nbins)[cnt > 0])  # sort surviving groups
+    return (cnt, *sums, avg_qty, avg_price, avg_disc, order)
+
+
+def main():
+    import jax
+
+    import presto_tpu  # noqa: F401
+    from presto_tpu.benchmark.handcoded import lineitem_q1_page, q1_local
+    from presto_tpu.connectors import tpch
+
+    t = tpch.table("lineitem", SF)
+    n_rows = t.num_rows
+
+    # CPU baseline (single pass, numpy, same host)
+    numpy_q1_baseline(t)  # warm the cache
+    t0 = time.perf_counter()
+    numpy_q1_baseline(t)
+    cpu_s = time.perf_counter() - t0
+    cpu_rows_per_s = n_rows / cpu_s
+
+    # device pipeline
+    page = lineitem_q1_page(SF)
+    fn = jax.jit(q1_local)
+    out = fn(page)
+    jax.block_until_ready(out)  # compile + warm
+    times = []
+    for _ in range(RUNS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(page))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_s = n_rows / best
+
+    result = {
+        "metric": f"tpch_q1_sf{SF:g}_rows_per_sec",
+        "value": round(rows_per_s),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_s / cpu_rows_per_s, 3),
+    }
+    print(json.dumps(result))
+    print(
+        f"# device={jax.devices()[0].platform} rows={n_rows} "
+        f"best={best*1e3:.2f}ms cpu_baseline={cpu_rows_per_s:.3g} rows/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Hand-composed benchmark pipelines (reference: presto-benchmark module,
+presto-benchmark/src/main/java/com/facebook/presto/benchmark/BenchmarkSuite.java:32
+— HandTpchQuery1/HandTpchQuery6 and operator micro-benchmarks)."""
+
+from .handcoded import (  # noqa: F401
+    lineitem_q1_page,
+    lineitem_q6_page,
+    q1_aggs,
+    q1_local,
+    q1_distributed,
+    q6_local,
+)

@@ -112,22 +112,29 @@ def _segment_reduce(func, data, valid, gid, num_segments):
     raise KeyError(func)
 
 
+def avg_from_sum_count(s, cnt, output_type: T.Type, input_type: Optional[T.Type]):
+    """Finalize avg from (sum, count): decimal HALF_UP in scaled units, else
+    double division (descaling decimal inputs). Shared by the single-node
+    finalizer and the distributed post-exchange step so semantics can never
+    diverge between them."""
+    safe = jnp.maximum(cnt, 1)
+    if isinstance(output_type, T.DecimalType):
+        data = jnp.sign(s) * ((2 * jnp.abs(s) + safe) // (2 * safe))
+    else:
+        sd = s.astype(jnp.float64)
+        if input_type is not None and isinstance(input_type, T.DecimalType):
+            sd = sd / (10**input_type.scale)
+        data = sd / safe
+    return data.astype(output_type.storage_dtype)
+
+
 def _finalize(
     spec: AggSpec, raw, has, input_type: Optional[T.Type], dict_id=None
 ) -> Block:
     if spec.func == "avg":
         s, cnt = raw
-        safe = jnp.maximum(cnt, 1)
-        if isinstance(spec.output_type, T.DecimalType):
-            # HALF_UP integer average in scaled units
-            sign = jnp.sign(s)
-            q = (2 * jnp.abs(s) + safe) // (2 * safe)
-            data = sign * q
-        else:
-            if input_type is not None and isinstance(input_type, T.DecimalType):
-                s = s.astype(jnp.float64) / (10**input_type.scale)
-            data = s.astype(jnp.float64) / safe
-        return Block(data.astype(spec.output_type.storage_dtype), spec.output_type, has)
+        data = avg_from_sum_count(s, cnt, spec.output_type, input_type)
+        return Block(data, spec.output_type, has)
     if spec.func in ("count", "count_star"):
         return Block(raw.astype(jnp.int64), spec.output_type, None)
     # min/max over varchar operate on sorted-dictionary codes; keep the dict
@@ -333,6 +340,86 @@ def grouped_aggregate_sorted(
         names.append(spec.name)
 
     return Page.from_blocks(blocks, names, count=num_live_groups)
+
+
+# ---------------------------------------------------------------------------
+# partial/final decomposition (distributed aggregation)
+# ---------------------------------------------------------------------------
+#
+# The reference splits aggregations into PARTIAL (pre-exchange) and FINAL
+# (post-exchange) steps (sql/planner/optimizations/AddExchanges + Step in
+# AggregationNode). Here the same decomposition feeds the all_to_all exchange:
+# every worker partially aggregates its shard, partial rows are repartitioned
+# by group-key hash, and finals combine. `avg` decomposes into (sum, count).
+
+
+@dataclasses.dataclass(frozen=True)
+class AvgPost:
+    """Post-exchange step: name = sum_col / cnt_col with avg typing."""
+
+    name: str
+    sum_col: str
+    cnt_col: str
+    output_type: T.Type
+    input_type: T.Type
+
+
+def decompose_partial(aggs: Sequence[AggSpec]):
+    """Returns (partial_specs, final_specs, post_steps, final_keep_names).
+
+    partial_specs run on each shard before the exchange; final_specs run on
+    repartitioned partial rows; post_steps derive remaining columns (avg)."""
+    from ..expr.ir import ColumnRef
+
+    partial, final, post = [], [], []
+    for a in aggs:
+        if a.func in ("count", "count_star"):
+            partial.append(a)
+            final.append(AggSpec("sum", ColumnRef(a.name, T.BIGINT), a.name, T.BIGINT))
+        elif a.func in ("sum", "min", "max"):
+            partial.append(a)
+            final.append(
+                AggSpec(a.func, ColumnRef(a.name, a.output_type), a.name, a.output_type)
+            )
+        elif a.func == "avg":
+            in_t = a.input.type
+            sum_t = AggSpec.infer_output_type("sum", in_t)
+            s_name, c_name = f"{a.name}$sum", f"{a.name}$cnt"
+            partial.append(AggSpec("sum", a.input, s_name, sum_t))
+            partial.append(AggSpec("count", a.input, c_name, T.BIGINT))
+            final.append(AggSpec("sum", ColumnRef(s_name, sum_t), s_name, sum_t))
+            final.append(AggSpec("sum", ColumnRef(c_name, T.BIGINT), c_name, T.BIGINT))
+            post.append(AvgPost(a.name, s_name, c_name, a.output_type, in_t))
+        else:
+            raise KeyError(f"cannot decompose aggregate {a.func!r}")
+    return tuple(partial), tuple(final), tuple(post)
+
+
+def apply_avg_post(page: Page, aggs: Sequence[AggSpec], post: Sequence[AvgPost]) -> Page:
+    """Produce the user-visible columns (group keys + aggregates in `aggs`
+    order) from a final-aggregated page containing decomposed columns."""
+    by_name = {p.name: p for p in post}
+    helper_cols = {x for p in post for x in (p.sum_col, p.cnt_col)}
+    agg_names = {a.name for a in aggs}
+    blocks, names = [], []
+    # group keys pass through in page order
+    for name, b in zip(page.names, page.blocks):
+        if name not in helper_cols and name not in agg_names:
+            blocks.append(b)
+            names.append(name)
+    # aggregates in spec order
+    for a in aggs:
+        p = by_name.get(a.name)
+        if p is None:
+            blocks.append(page.block(a.name))
+            names.append(a.name)
+            continue
+        s = page.block(p.sum_col).data
+        cnt = page.block(p.cnt_col).data
+        data = avg_from_sum_count(s, cnt, p.output_type, p.input_type)
+        blocks.append(Block(data, p.output_type, cnt > 0))
+        names.append(a.name)
+    return Page(tuple(blocks), tuple(names), page.count)
 
 
 def global_aggregate(page: Page, aggs: Sequence[AggSpec]) -> Page:

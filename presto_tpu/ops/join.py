@@ -159,12 +159,14 @@ def join_expand(
     build_out: Sequence[Tuple[str, str]],  # (build col, output name)
     out_capacity: int,
     kind: str = "inner",
-) -> Page:
+) -> Tuple[Page, jnp.ndarray]:
     """General 1:N inner/left join with static output capacity.
 
-    out_capacity bounds total matches (planner-estimated, like the reference
-    sizes lookup join output pages); host must check overflow via the
-    returned page's count vs capacity."""
+    out_capacity bounds total hash-range *candidates* (planner-estimated, like
+    the reference sizes lookup join output pages). Returns (page, overflow):
+    overflow is the number of candidate rows beyond out_capacity — the host
+    must check it is 0 and retry with a larger capacity otherwise (candidates
+    that merely fail true key equality are dropped exactly, not counted)."""
     probe_keys = [evaluate(e, probe) for e in probe_key_exprs]
     live = probe.live_mask()
     _, lo, hi = _probe_ranges(bs, probe_keys)
@@ -231,4 +233,5 @@ def join_expand(
     out = Page.from_blocks(blocks, names, count=out_capacity)
     from .filter import compact
 
-    return compact(out, keep)
+    overflow = jnp.maximum(total.astype(jnp.int64) - out_capacity, 0)
+    return compact(out, keep), overflow

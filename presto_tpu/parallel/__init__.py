@@ -1,0 +1,24 @@
+"""Multi-chip parallelism: device mesh, page exchange, distributed operators.
+
+The TPU-native replacement for the reference's exchange/communication layer
+(SURVEY.md §2.7): where Presto shuffles LZ4-serialized pages over HTTP
+(presto-main/.../execution/buffer/PagesSerde.java:39, operator/
+ExchangeClient.java:55), this package repartitions device-resident Pages with
+`jax.lax.all_to_all` over the ICI mesh inside `shard_map`, broadcasts build
+sides with `all_gather`, and expresses every stage as an SPMD program.
+"""
+
+from .mesh import (  # noqa: F401
+    default_mesh,
+    page_from_arrays,
+    page_schema,
+    page_to_arrays,
+    shard_rows,
+)
+from .exchange import (  # noqa: F401
+    all_gather_page,
+    all_to_all_page,
+    exchange_by_hash,
+    shuffle_write,
+)
+from .distributed import dist_grouped_aggregate  # noqa: F401

@@ -1,0 +1,216 @@
+"""Distributed operator compositions over the mesh.
+
+Each function here is a full SPMD *stage pipeline* — the analog of a Presto
+multi-stage plan (partial agg stage → exchange → final agg stage, see the
+SqlQueryScheduler stage wiring in SURVEY.md §3.2) collapsed into one
+shard_map'd program: XLA sees the whole thing and can overlap the all_to_all
+with local compute.
+
+Output schemas of staged sub-plans are inferred with jax.eval_shape — Page is
+a pytree whose aux data carries types/dictionaries, so shape inference gives
+the exact post-exchange schema without running anything.
+
+Compiled SPMD steps are cached on (mesh, schema, plan shape): re-running the
+same query shape must NOT recompile (the reference compiles bytecode once per
+plan in LocalExecutionPlanner, then reuses it for every page).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..expr.ir import ColumnRef
+from ..ops.aggregate import (
+    AggSpec,
+    apply_avg_post,
+    decompose_partial,
+    grouped_aggregate_sorted,
+)
+from ..ops.filter import compact
+from ..page import Page
+from .exchange import exchange_by_hash
+from .mesh import page_from_arrays, page_schema, page_to_arrays, shard_rows
+
+
+def _merge_shard_pages(out_leaves, out_schema, out_counts, rows_per_shard: int):
+    """Concatenated per-shard outputs -> one compacted global Page.
+
+    Shard counts are clamped to rows_per_shard; callers must separately check
+    counts <= rows_per_shard to detect overflow (see dist_grouped_aggregate)."""
+    n = out_counts.shape[0]
+    occ = (
+        jnp.arange(rows_per_shard, dtype=jnp.int32)[None, :]
+        < jnp.minimum(out_counts, rows_per_shard)[:, None]
+    ).reshape(-1)
+    merged = page_from_arrays(out_leaves, out_schema, n * rows_per_shard)
+    return compact(merged, occ)
+
+
+_STEP_CACHE: dict = {}
+
+
+def _agg_step(
+    mesh,
+    axis: str,
+    schema,
+    group_exprs,
+    group_names,
+    partial_specs,
+    final_specs,
+    max_groups: int,
+    part_capacity: int,
+    prelude,
+    shard_shape_key,
+):
+    """Build (or fetch) the compiled SPMD aggregation step for this plan
+    shape. Returns (step_fn, out_schema)."""
+    key = (
+        mesh,
+        axis,
+        schema,
+        tuple(group_exprs),
+        tuple(group_names),
+        partial_specs,
+        final_specs,
+        max_groups,
+        part_capacity,
+        prelude,
+        shard_shape_key,
+    )
+    hit = _STEP_CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    n = mesh.shape[axis]
+
+    def local_partial(shard_leaves, count):
+        local = page_from_arrays(shard_leaves, schema, count)
+        if prelude is not None:
+            local = prelude(local)
+        return grouped_aggregate_sorted(
+            local, group_exprs, group_names, partial_specs, max_groups
+        )
+
+    # static schema inference: the exchange preserves schema, so the final
+    # aggregation's output schema follows from the partial page's schema
+    shard_struct = tuple(
+        jax.ShapeDtypeStruct(shape, dtype) for shape, dtype in shard_shape_key
+    )
+    count_struct = jax.ShapeDtypeStruct((), jnp.int32)
+    partial_struct = jax.eval_shape(local_partial, shard_struct, count_struct)
+    key_exprs = [ColumnRef(nm, partial_struct.block(nm).type) for nm in group_names]
+
+    def local_final(recv: Page) -> Page:
+        return grouped_aggregate_sorted(
+            recv, key_exprs, group_names, final_specs, max_groups
+        )
+
+    final_struct = jax.eval_shape(local_final, partial_struct)
+    out_schema = page_schema(final_struct)
+    n_leaves = len(page_to_arrays(final_struct))
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(tuple(P(axis) for _ in schema_leaf_count(schema)), P(axis)),
+        out_specs=(
+            tuple(P(axis) for _ in range(n_leaves)),
+            P(axis),
+            P(axis),
+            P(axis),
+        ),
+        check_vma=False,
+    )
+    def step(shard_leaves, counts):
+        partial = local_partial(shard_leaves, counts[0])
+        recv, dropped = exchange_by_hash(partial, key_exprs, axis, n, part_capacity)
+        final = local_final(recv)
+        return (
+            page_to_arrays(final),
+            final.count.reshape(1),
+            partial.count.reshape(1),
+            dropped.reshape(1).astype(jnp.int32),
+        )
+
+    _STEP_CACHE[key] = (step, out_schema)
+    return step, out_schema
+
+
+def schema_leaf_count(schema):
+    """One entry per flat leaf of a page with this schema (data + valids)."""
+    leaves = []
+    for name, typ, dict_id, has_valid in schema:
+        leaves.append((name, "data"))
+        if has_valid:
+            leaves.append((name, "valid"))
+    return leaves
+
+
+def dist_grouped_aggregate(
+    mesh,
+    axis: str,
+    page: Page,
+    group_exprs,
+    group_names: Sequence[str],
+    aggs: Sequence[AggSpec],
+    max_groups: int,
+    part_capacity: int,
+    prelude=None,
+) -> Page:
+    """Distributed GROUP BY: shard rows → [prelude: local scan-filter-project
+    stage] → local partial agg → all_to_all repartition partial rows by
+    group-key hash → final agg → merge shards.
+
+    The canonical Presto two-stage aggregation (partial at the source stage,
+    FIXED_HASH exchange, final at the middle stage) as one SPMD program.
+    Returns a single compacted Page (the root stage output buffer analog).
+
+    Raises RuntimeError if max_groups or part_capacity were undersized —
+    static shapes make overflow a detect-and-retry condition, not silent
+    truncation (the reference instead grows hash tables / blocks producers)."""
+    n = mesh.shape[axis]
+    page, shard_counts = shard_rows(page, n)
+    schema = page_schema(page)
+    leaves = page_to_arrays(page)
+    partial_specs, final_specs, post = decompose_partial(aggs)
+    shard_shape_key = tuple(
+        ((l.shape[0] // n,) + l.shape[1:], l.dtype) for l in leaves
+    )
+
+    step, out_schema = _agg_step(
+        mesh,
+        axis,
+        schema,
+        group_exprs,
+        group_names,
+        partial_specs,
+        final_specs,
+        max_groups,
+        part_capacity,
+        prelude,
+        shard_shape_key,
+    )
+    out_leaves, out_counts, partial_counts, dropped = step(leaves, shard_counts)
+    if int(jnp.max(partial_counts)) > max_groups:
+        raise RuntimeError(
+            f"partial aggregation overflow: a shard produced "
+            f"{int(jnp.max(partial_counts))} groups > max_groups={max_groups}"
+        )
+    if int(jnp.max(out_counts)) > max_groups:
+        raise RuntimeError(
+            f"final aggregation overflow: a shard holds "
+            f"{int(jnp.max(out_counts))} groups > max_groups={max_groups}"
+        )
+    if int(jnp.sum(dropped)) != 0:
+        raise RuntimeError(
+            f"exchange overflow: {int(jnp.sum(dropped))} partial rows dropped; "
+            "increase part_capacity"
+        )
+    merged = _merge_shard_pages(out_leaves, out_schema, out_counts, max_groups)
+    return apply_avg_post(merged, aggs, post)

@@ -1,0 +1,88 @@
+"""Device mesh construction + Page <-> shard_map plumbing.
+
+The reference's analog is node discovery + partitioning handles
+(metadata/DiscoveryNodeManager.java, sql/planner/SystemPartitioningHandle.java:57-65):
+FIXED_HASH_DISTRIBUTION over N workers becomes a jax.sharding.Mesh axis of N
+chips. A Page's `count` is a scalar pytree leaf, which shard_map cannot split
+by rows, so staged SPMD functions pass block arrays + a per-shard count vector
+and rebuild local Pages inside the mapped function via `page_from_arrays`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..page import Block, Page
+
+WORKER_AXIS = "workers"
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = WORKER_AXIS):
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return jax.sharding.Mesh(np.array(devices), (axis,))
+
+
+# -- Page <-> flat arrays (for shard_map in/out trees) ----------------------
+
+# schema item: (name, Type, dict_id, has_valid)
+Schema = Tuple[Tuple[str, object, Optional[int], bool], ...]
+
+
+def page_schema(page: Page) -> Schema:
+    return tuple(
+        (n, b.type, b.dict_id, b.valid is not None)
+        for n, b in zip(page.names, page.blocks)
+    )
+
+
+def page_to_arrays(page: Page):
+    """Flatten to a tuple of arrays ordered (data, [valid]) per column."""
+    leaves = []
+    for b in page.blocks:
+        leaves.append(b.data)
+        if b.valid is not None:
+            leaves.append(b.valid)
+    return tuple(leaves)
+
+
+def page_from_arrays(leaves: Sequence[jax.Array], schema: Schema, count) -> Page:
+    blocks = []
+    i = 0
+    for name, typ, dict_id, has_valid in schema:
+        data = leaves[i]
+        i += 1
+        valid = None
+        if has_valid:
+            valid = leaves[i]
+            i += 1
+        blocks.append(Block(data, typ, valid, dict_id))
+    names = tuple(s[0] for s in schema)
+    return Page(tuple(blocks), names, jnp.asarray(count, jnp.int32))
+
+
+def shard_rows(page: Page, num_shards: int):
+    """Split a host/global Page into contiguous row shards.
+
+    Returns (padded_page, shard_counts) where padded_page's capacity is a
+    multiple of num_shards (shard i owns rows [i*c, (i+1)*c)) and
+    shard_counts[i] is the live row count of shard i. This is the analog of
+    leaf-split assignment (SourcePartitionedScheduler): contiguous ranges of
+    the table become per-worker morsels."""
+    cap = page.capacity
+    per = -(-cap // num_shards)  # ceil
+    target = per * num_shards
+    if target != cap:
+        from ..page import _pad_block
+
+        blocks = tuple(_pad_block(b, target) for b in page.blocks)
+        page = Page(blocks, page.names, page.count)
+    shard_counts = jnp.clip(
+        page.count - jnp.arange(num_shards, dtype=jnp.int32) * per, 0, per
+    ).astype(jnp.int32)
+    return page, shard_counts

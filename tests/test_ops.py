@@ -198,7 +198,7 @@ def test_join_expand_1n():
         pad_to=4,
     )
     bs = build(build_page, [col("k", T.BIGINT)])
-    out = join_expand(
+    out, overflow = join_expand(
         probe,
         bs,
         [col("k", T.BIGINT)],
@@ -207,10 +207,11 @@ def test_join_expand_1n():
         out_capacity=16,
         kind="inner",
     )
+    assert int(overflow) == 0
     rows = sorted(out.to_pylist())
     assert rows == [(1, 100, 10), (1, 100, 11), (3, 300, 30), (3, 300, 31), (3, 300, 32)]
 
-    out = join_expand(
+    out, overflow = join_expand(
         probe,
         bs,
         [col("k", T.BIGINT)],
@@ -219,6 +220,7 @@ def test_join_expand_1n():
         out_capacity=16,
         kind="left",
     )
+    assert int(overflow) == 0
     rows = sorted(out.to_pylist(), key=lambda r: (r[0], r[2] is None, r[2] or 0))
     assert (9, 900, None) in rows
     assert len(rows) == 6

@@ -1,0 +1,182 @@
+"""Exchange + distributed aggregation over the 8-device virtual CPU mesh.
+
+The testing analog of the reference's DistributedQueryRunner (presto-tests/
+.../DistributedQueryRunner.java:75 — N workers in one process): N virtual
+devices in one process, real collectives between them."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import presto_tpu.types as T
+from presto_tpu.expr.ir import ColumnRef, col
+from presto_tpu.ops.aggregate import AggSpec
+from presto_tpu.page import Page
+from presto_tpu.parallel import (
+    all_gather_page,
+    dist_grouped_aggregate,
+    exchange_by_hash,
+    default_mesh,
+)
+from presto_tpu.parallel.mesh import (
+    page_from_arrays,
+    page_schema,
+    page_to_arrays,
+    shard_rows,
+)
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def _exchange_harness(page, key_exprs, part_capacity):
+    """Run exchange_by_hash over the full mesh; return per-shard results."""
+    mesh = default_mesh()
+    n = mesh.shape["workers"]
+    page, shard_counts = shard_rows(page, n)
+    schema = page_schema(page)
+    leaves = page_to_arrays(page)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(tuple(P("workers") for _ in leaves), P("workers")),
+        out_specs=(tuple(P("workers") for _ in leaves), P("workers"), P("workers")),
+        check_vma=False,
+    )
+    def step(shard_leaves, counts):
+        local = page_from_arrays(shard_leaves, schema, counts[0])
+        recv, dropped = exchange_by_hash(
+            local, key_exprs, "workers", n, part_capacity
+        )
+        return page_to_arrays(recv), recv.count.reshape(1), dropped.reshape(1)
+
+    out_leaves, out_counts, dropped = step(leaves, shard_counts)
+    assert int(jnp.sum(dropped)) == 0
+    per_shard_cap = n * part_capacity
+    shards = []
+    for i in range(n):
+        shard = [l[i * per_shard_cap : (i + 1) * per_shard_cap] for l in out_leaves]
+        pg = page_from_arrays(shard, schema, out_counts[i])
+        shards.append(pg)
+    return shards
+
+
+def test_exchange_by_hash_partitions_all_rows():
+    rng = np.random.default_rng(7)
+    n_rows = 512
+    keys = rng.integers(0, 100, n_rows)
+    vals = rng.integers(0, 1000, n_rows)
+    page = Page.from_dict({"k": (keys, T.BIGINT), "v": (vals, T.BIGINT)})
+    shards = _exchange_harness(page, [col("k", T.BIGINT)], part_capacity=256)
+
+    seen = []
+    for i, pg in enumerate(shards):
+        rows = pg.to_pylist()
+        # every key on this shard must hash here
+        for k, v in rows:
+            seen.append((k, v))
+        ks = {k for k, _ in rows}
+        for other_i, other in enumerate(shards):
+            if other_i == i:
+                continue
+            other_ks = {k for k, _ in other.to_pylist()}
+            assert not (ks & other_ks), "same key on two shards"
+    assert sorted(seen) == sorted(zip(keys.tolist(), vals.tolist()))
+
+
+def test_all_gather_page_replicates():
+    rng = np.random.default_rng(8)
+    n_rows = 64
+    vals = rng.integers(0, 50, n_rows)
+    page = Page.from_dict({"v": (vals, T.BIGINT)})
+    mesh = default_mesh()
+    n = mesh.shape["workers"]
+    page, shard_counts = shard_rows(page, n)
+    schema = page_schema(page)
+    leaves = page_to_arrays(page)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(tuple(P("workers") for _ in leaves), P("workers")),
+        out_specs=(tuple(P("workers") for _ in leaves), P("workers")),
+        check_vma=False,
+    )
+    def step(shard_leaves, counts):
+        local = page_from_arrays(shard_leaves, schema, counts[0])
+        g = all_gather_page(local, "workers", n)
+        return page_to_arrays(g), g.count.reshape(1)
+
+    out_leaves, out_counts = step(leaves, shard_counts)
+    # every shard sees all rows
+    assert np.all(np.asarray(out_counts) == n_rows)
+    shard0 = [l[: n * (page.capacity // n)] for l in out_leaves]
+    pg0 = page_from_arrays(shard0, schema, out_counts[0])
+    assert sorted(x[0] for x in pg0.to_pylist()) == sorted(vals.tolist())
+
+
+def test_dist_grouped_aggregate_overflow_raises():
+    """Undersized max_groups must raise, never silently truncate."""
+    rng = np.random.default_rng(11)
+    g = rng.integers(0, 37, 1000)
+    page = Page.from_dict({"g": (g, T.BIGINT)}, pad_to=1024)
+    mesh = default_mesh()
+    with pytest.raises(RuntimeError, match="overflow"):
+        dist_grouped_aggregate(
+            mesh,
+            "workers",
+            page,
+            [col("g", T.BIGINT)],
+            ["g"],
+            (AggSpec("count_star", None, "cnt", T.BIGINT),),
+            max_groups=4,
+            part_capacity=64,
+        )
+
+
+def test_dist_grouped_aggregate_matches_single_node():
+    rng = np.random.default_rng(9)
+    n_rows = 1000
+    g = rng.integers(0, 37, n_rows)
+    x = rng.integers(-50, 50, n_rows)
+    d = (rng.random(n_rows) * 100).astype(np.float64)
+    page = Page.from_dict(
+        {"g": (g, T.BIGINT), "x": (x, T.BIGINT), "d": (d, T.DOUBLE)},
+        pad_to=1024,
+    )
+    aggs = (
+        AggSpec("count_star", None, "cnt", T.BIGINT),
+        AggSpec("sum", col("x", T.BIGINT), "sx", T.BIGINT),
+        AggSpec("min", col("x", T.BIGINT), "mn", T.BIGINT),
+        AggSpec("max", col("x", T.BIGINT), "mx", T.BIGINT),
+        AggSpec("avg", col("d", T.DOUBLE), "ad", T.DOUBLE),
+    )
+    mesh = default_mesh()
+    out = dist_grouped_aggregate(
+        mesh,
+        "workers",
+        page,
+        [col("g", T.BIGINT)],
+        ["g"],
+        aggs,
+        max_groups=64,
+        part_capacity=64,
+    )
+    rows = {r[0]: r[1:] for r in out.to_pylist()}
+    assert len(rows) == len(set(g.tolist()))
+    for gv in set(g.tolist()):
+        m = g == gv
+        cnt, sx, mn, mx, ad = rows[gv]
+        assert cnt == int(m.sum())
+        assert sx == int(x[m].sum())
+        assert mn == int(x[m].min())
+        assert mx == int(x[m].max())
+        assert ad == pytest.approx(float(d[m].mean()), rel=1e-12)
