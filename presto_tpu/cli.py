@@ -1,0 +1,104 @@
+"""Interactive SQL CLI.
+
+Equivalent of the reference's presto-cli (presto-cli/src/main/java/com/
+facebook/presto/cli/ — jline REPL, table rendering, timing). Runs against
+an in-process Session by default; `--server` mode (HTTP client against a
+coordinator) arrives with the server layer.
+
+Usage:
+  python -m presto_tpu.cli                 # REPL on tpch sf0.01
+  python -m presto_tpu.cli --sf 1 "SELECT ...;"
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _render(rows, titles, max_rows: int = 200) -> str:
+    cells = [[_fmt(v) for v in r] for r in rows[:max_rows]]
+    widths = [len(t) for t in titles]
+    for r in cells:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(c))
+    sep = "-+-".join("-" * w for w in widths)
+    out = [" | ".join(t.ljust(w) for t, w in zip(titles, widths)), sep]
+    for r in cells:
+        out.append(" | ".join(c.rjust(w) for c, w in zip(r, widths)))
+    if len(rows) > max_rows:
+        out.append(f"... ({len(rows) - max_rows} more rows)")
+    return "\n".join(out)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NULL"
+    return str(v)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="presto-tpu")
+    ap.add_argument("query", nargs="?", help="SQL to run (REPL if omitted)")
+    ap.add_argument("--sf", type=float, default=0.01, help="TPC-H scale factor")
+    ap.add_argument("--catalog", default="tpch")
+    args = ap.parse_args(argv)
+
+    from .connectors.tpch import TpchCatalog
+    from .session import Session
+
+    if args.catalog != "tpch":
+        ap.error(f"unknown catalog {args.catalog}")
+    session = Session(TpchCatalog(sf=args.sf))
+
+    def run_one(sql: str):
+        sql = sql.strip().rstrip(";")
+        if not sql:
+            return
+        low = sql.lower()
+        t0 = time.perf_counter()
+        if low.startswith("explain"):
+            print(session.explain(sql))
+            return
+        if low == "show tables":
+            for t in session.catalog.table_names():
+                print(t)
+            return
+        if low.startswith("show columns from "):
+            tname = sql.split()[-1]
+            for c, ty in session.catalog.schema(tname).items():
+                print(f"{c:24s} {ty}")
+            return
+        r = session.query(sql)
+        dt = time.perf_counter() - t0
+        print(_render(r.rows(), r.titles))
+        print(f"({r.row_count()} rows in {dt:.2f}s)")
+
+    if args.query:
+        run_one(args.query)
+        return
+
+    print(f"presto-tpu CLI — tpch sf{args.sf:g}. End statements with ';'.")
+    buf = []
+    while True:
+        try:
+            prompt = "presto> " if not buf else "     -> "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return
+        if line.strip().lower() in ("quit", "exit"):
+            return
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            sql = "\n".join(buf)
+            buf = []
+            try:
+                run_one(sql)
+            except Exception as e:  # keep the REPL alive
+                print(f"error: {e}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -530,3 +530,63 @@ def schema(name: str, sf: float = 1.0):
     big tables (generates small ones; uses a cached prototype otherwise)."""
     t = table(name, sf if name in ("region", "nation") else min(sf, 0.01))
     return {cname: c.type for cname, c in t.columns.items()}
+
+
+# base cardinality per unit scale factor (spec §4.2.5); lineitem is ~6M/sf
+_BASE_ROWS = {
+    "region": 5,
+    "nation": 25,
+    "supplier": 10_000,
+    "part": 200_000,
+    "partsupp": 800_000,
+    "customer": 150_000,
+    "orders": 1_500_000,
+    "lineitem": 6_000_000,
+}
+
+_UNIQUE_COLUMNS = {
+    "region": [("r_regionkey",)],
+    "nation": [("n_nationkey",)],
+    "supplier": [("s_suppkey",)],
+    "part": [("p_partkey",)],
+    "partsupp": [("ps_partkey", "ps_suppkey")],
+    "customer": [("c_custkey",)],
+    "orders": [("o_orderkey",)],
+    "lineitem": [("l_orderkey", "l_linenumber")],
+}
+
+
+class TpchCatalog:
+    """Catalog + runtime data provider for the embedded TPC-H connector
+    (reference presto-tpch: TpchMetadata + statistics provider). Implements
+    the planner's Catalog protocol and serves device-resident Pages to the
+    executor, cached per table."""
+
+    name = "tpch"
+
+    def __init__(self, sf: float = 1.0):
+        self.sf = sf
+        self._pages: Dict[str, "Page"] = {}
+
+    def table_names(self):
+        return list(TABLE_NAMES)
+
+    def schema(self, tname: str):
+        return schema(tname, self.sf)
+
+    def row_count(self, tname: str) -> int:
+        if tname in ("region", "nation"):
+            return _BASE_ROWS[tname]
+        return int(_BASE_ROWS[tname] * self.sf)
+
+    def unique_columns(self, tname: str):
+        return _UNIQUE_COLUMNS.get(tname, [])
+
+    def page(self, tname: str) -> "Page":
+        """Full-table Page with SOURCE column names (executor renames to
+        plan channels). Cached: repeated queries reuse device arrays."""
+        pg = self._pages.get(tname)
+        if pg is None:
+            pg = table(tname, self.sf).to_page()
+            self._pages[tname] = pg
+        return pg

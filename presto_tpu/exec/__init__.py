@@ -1,0 +1,11 @@
+"""Execution layer: plan -> kernel pipeline.
+
+Equivalent of the reference's worker data plane (SURVEY.md §1 L6):
+LocalExecutionPlanner compiling PlanFragments into operator pipelines
+(presto-main/.../sql/planner/LocalExecutionPlanner.java:364) and the
+Driver hot loop (operator/Driver.java:347-430). On TPU the "operators" are
+whole-page kernels; the host walks the plan once per page-set and all
+per-row work happens on device.
+"""
+
+from .executor import Executor  # noqa: F401

@@ -53,17 +53,22 @@ class BuildSide:
 
 
 def build(page: Page, key_exprs) -> BuildSide:
-    """Sort the build side by key hash (HashBuilderOperator.finish analog)."""
+    """Sort the build side by key hash (HashBuilderOperator.finish analog).
+    Empty key_exprs = all rows in one bucket (cross join support)."""
     keys = [evaluate(e, page) for e in key_exprs]
     live = page.live_mask()
-    h = hash_rows(keys)
+    h = hash_rows(keys) if keys else jnp.zeros(page.capacity, jnp.uint64)
     h = jnp.where(live, h, MAX_HASH)  # dead rows cluster at the end
     order = jnp.argsort(h)
     return BuildSide(h[order], order, page, tuple(keys), page.count)
 
 
-def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val]):
+def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val], capacity: int):
     """For each probe row: [lo, hi) candidate range in the sorted build."""
+    if not probe_keys:  # cross join: every live build row is a candidate
+        lo = jnp.zeros(capacity, jnp.int32)
+        hi = jnp.broadcast_to(bs.count.astype(jnp.int32), (capacity,))
+        return None, lo, hi
     h = hash_rows(probe_keys)
     lo = jnp.searchsorted(bs.sorted_hash, h, side="left")
     hi = jnp.searchsorted(bs.sorted_hash, h, side="right")
@@ -73,6 +78,8 @@ def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val]):
 def _keys_equal(bs: BuildSide, probe_keys: Sequence[Val], build_rows):
     """Verify actual key equality probe[i] == build[build_rows[i]].
     SQL join semantics: NULL keys never match."""
+    if not probe_keys:
+        return jnp.ones(build_rows.shape, jnp.bool_)
     eq = None
     for pv, bv in zip(probe_keys, bs.key_vals):
         bd = bv.data[build_rows]
@@ -124,7 +131,7 @@ def join_n1(
     payload columns are gathered (null where unmatched, for `left`)."""
     probe_keys = [evaluate(e, probe) for e in probe_key_exprs]
     live = probe.live_mask()
-    _, lo, hi = _probe_ranges(bs, probe_keys)
+    _, lo, hi = _probe_ranges(bs, probe_keys, probe.capacity)
     matched, build_row = _collision_scan(bs, probe_keys, lo, hi)
     matched = matched & live
 
@@ -169,7 +176,7 @@ def join_expand(
     that merely fail true key equality are dropped exactly, not counted)."""
     probe_keys = [evaluate(e, probe) for e in probe_key_exprs]
     live = probe.live_mask()
-    _, lo, hi = _probe_ranges(bs, probe_keys)
+    _, lo, hi = _probe_ranges(bs, probe_keys, probe.capacity)
 
     # counts per probe row: number of hash-range candidates. Candidates that
     # fail true key equality are dropped at emission (conservative capacity,

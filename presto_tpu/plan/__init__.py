@@ -1,0 +1,11 @@
+"""Logical plan: nodes + planner output.
+
+Equivalent of the reference's sql/planner PlanNode vocabulary
+(presto-main/.../sql/planner/plan/ — TableScanNode, FilterNode, ProjectNode,
+AggregationNode, JoinNode, SemiJoinNode, SortNode, TopNNode, LimitNode,
+ExchangeNode ...). Nodes are frozen dataclasses with typed output schemas;
+every node maps onto one kernel-library call (ops/) or a mesh exchange
+(parallel/).
+"""
+
+from .nodes import *  # noqa: F401,F403

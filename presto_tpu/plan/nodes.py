@@ -1,0 +1,302 @@
+"""Logical plan nodes.
+
+Mirrors the reference's plan-node vocabulary (presto-main/.../sql/planner/
+plan/) with TPU-relevant reductions: expressions are already-typed
+RowExpressions (expr/ir.py), and every node carries its output schema as
+(channel_name, Type) pairs. Channel names are globally unique per planning
+session (the analog of the reference's Symbol allocator,
+sql/planner/SymbolAllocator.java), so joins can concatenate columns without
+collisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from .. import types as T
+from ..expr.ir import RowExpression
+from ..ops.aggregate import AggSpec
+from ..ops.sort import SortKey
+
+Field = Tuple[str, T.Type]  # (channel name, type)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanNode:
+    @property
+    def fields(self) -> Tuple[Field, ...]:
+        raise NotImplementedError
+
+    @property
+    def children(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+    def field_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.fields)
+
+    def field_type(self, name: str) -> T.Type:
+        for n, t in self.fields:
+            if n == name:
+                return t
+        raise KeyError(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScan(PlanNode):
+    """Scan of a connector table (reference TableScanNode). `columns` maps
+    output channel -> source column name."""
+
+    catalog: str
+    table: str
+    columns: Tuple[Tuple[str, str, T.Type], ...]  # (channel, source col, type)
+
+    @property
+    def fields(self):
+        return tuple((c, t) for c, _, t in self.columns)
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(PlanNode):
+    child: PlanNode
+    predicate: RowExpression
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(PlanNode):
+    child: PlanNode
+    exprs: Tuple[RowExpression, ...]
+    names: Tuple[str, ...]
+
+    @property
+    def fields(self):
+        return tuple((n, e.type) for n, e in zip(self.names, self.exprs))
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(PlanNode):
+    """Grouped aggregation (reference AggregationNode). Empty group_exprs =
+    global aggregation (one output row)."""
+
+    child: PlanNode
+    group_exprs: Tuple[RowExpression, ...]
+    group_names: Tuple[str, ...]
+    aggs: Tuple[AggSpec, ...]
+
+    @property
+    def fields(self):
+        out = tuple(
+            (n, e.type) for n, e in zip(self.group_names, self.group_exprs)
+        )
+        return out + tuple((a.name, a.output_type) for a in self.aggs)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(PlanNode):
+    """Equi-join with optional residual filter (reference JoinNode).
+
+    kind: inner | left. Output = left fields then right fields (for `left`
+    joins the right side's values are NULL on no match)."""
+
+    kind: str
+    left: PlanNode
+    right: PlanNode
+    left_keys: Tuple[RowExpression, ...]
+    right_keys: Tuple[RowExpression, ...]
+    residual: Optional[RowExpression] = None  # over combined channels
+    unique_build: bool = False  # planner knows build keys are unique (n:1)
+
+    @property
+    def fields(self):
+        return self.left.fields + self.right.fields
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class SemiJoin(PlanNode):
+    """EXISTS/IN-subquery join (reference SemiJoinNode): keeps probe rows
+    with (anti: without) a match in `source`. Residual (for correlated
+    EXISTS with extra predicates) references both sides' channels."""
+
+    child: PlanNode
+    source: PlanNode
+    probe_keys: Tuple[RowExpression, ...]
+    source_keys: Tuple[RowExpression, ...]
+    anti: bool = False
+    residual: Optional[RowExpression] = None
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child, self.source)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarApply(PlanNode):
+    """Append an uncorrelated single-row subquery's outputs as broadcast
+    columns (reference: EnforceSingleRowNode + cross join of a 1-row side)."""
+
+    child: PlanNode
+    subquery: PlanNode
+
+    @property
+    def fields(self):
+        return self.child.fields + self.subquery.fields
+
+    @property
+    def children(self):
+        return (self.child, self.subquery)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(PlanNode):
+    child: PlanNode
+    keys: Tuple[SortKey, ...]
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopN(PlanNode):
+    child: PlanNode
+    keys: Tuple[SortKey, ...]
+    count: int
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(PlanNode):
+    child: PlanNode
+    count: int
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Distinct(PlanNode):
+    child: PlanNode
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Union(PlanNode):
+    """UNION ALL of same-arity inputs (reference UnionNode); inputs are
+    renamed to the first input's channels by the planner."""
+
+    inputs: Tuple[PlanNode, ...]
+    distinct: bool = False
+
+    @property
+    def fields(self):
+        return self.inputs[0].fields
+
+    @property
+    def children(self):
+        return self.inputs
+
+
+@dataclasses.dataclass(frozen=True)
+class Output(PlanNode):
+    """Final projection to user-visible column names (reference OutputNode)."""
+
+    child: PlanNode
+    channels: Tuple[str, ...]
+    titles: Tuple[str, ...]
+
+    @property
+    def fields(self):
+        return tuple(
+            (t, self.child.field_type(c))
+            for c, t in zip(self.channels, self.titles)
+        )
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style rendering (reference sql/planner/planPrinter)."""
+    pad = "  " * indent
+    name = type(node).__name__
+    detail = ""
+    if isinstance(node, TableScan):
+        detail = f" {node.table} [{', '.join(c for c, _, _ in node.columns)}]"
+    elif isinstance(node, Filter):
+        detail = f" [{node.predicate}]"
+    elif isinstance(node, Project):
+        detail = f" [{', '.join(f'{n} := {e}' for n, e in zip(node.names, node.exprs))}]"
+    elif isinstance(node, Aggregate):
+        keys = ", ".join(node.group_names)
+        aggs = ", ".join(f"{a.name} := {a.func}({a.input})" for a in node.aggs)
+        detail = f" [keys: {keys}] [{aggs}]"
+    elif isinstance(node, Join):
+        pairs = ", ".join(
+            f"{l} = {r}" for l, r in zip(node.left_keys, node.right_keys)
+        )
+        detail = f" [{node.kind}] [{pairs}]" + (
+            f" [residual: {node.residual}]" if node.residual else ""
+        )
+    elif isinstance(node, SemiJoin):
+        pairs = ", ".join(
+            f"{l} = {r}" for l, r in zip(node.probe_keys, node.source_keys)
+        )
+        detail = f" [{'anti' if node.anti else 'semi'}] [{pairs}]"
+    elif isinstance(node, (TopN,)):
+        detail = f" [{node.count}]"
+    elif isinstance(node, Limit):
+        detail = f" [{node.count}]"
+    elif isinstance(node, Output):
+        detail = f" [{', '.join(node.titles)}]"
+    lines = [f"{pad}- {name}{detail}"]
+    for c in node.children:
+        lines.append(plan_tree_str(c, indent + 1))
+    return "\n".join(lines)

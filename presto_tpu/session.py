@@ -1,0 +1,63 @@
+"""Session: SQL in, rows out.
+
+The single-process equivalent of the reference's LocalQueryRunner
+(presto-main/.../testing/LocalQueryRunner.java:204 — full
+parse->plan->execute in one process, no HTTP), and the embedding API the
+CLI/server layers build on.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .exec.executor import Executor
+from .plan import nodes as N
+from .sql import tree as t
+from .sql.parser import parse
+from .sql.planner import Planner
+
+
+class QueryResult:
+    def __init__(self, page, titles):
+        self.page = page
+        self.titles = list(titles)
+
+    def rows(self) -> List[tuple]:
+        return self.page.to_pylist()
+
+    def row_count(self) -> int:
+        return int(self.page.count)
+
+
+class Session:
+    def __init__(self, catalog):
+        self.catalog = catalog
+        self.executor = Executor(catalog)
+
+    def plan(self, sql: str) -> N.PlanNode:
+        ast = parse(sql)
+        if isinstance(ast, t.Explain):
+            ast = ast.query
+        if not isinstance(ast, t.Query):
+            raise ValueError("only SELECT queries supported here")
+        planner = Planner(self.catalog)
+        rp = planner.plan_query(ast, outer=None, ctes={})
+        scope = rp.scope
+        channels = tuple(f.channel for f in scope.fields)
+        titles = tuple(f.name for f in scope.fields)
+        return N.Output(rp.node, channels, titles)
+
+    def explain(self, sql: str) -> str:
+        return N.plan_tree_str(self.plan(sql))
+
+    def query(self, sql: str) -> QueryResult:
+        ast = parse(sql)
+        node = self.plan(sql)
+        if isinstance(ast, t.Explain):
+            from .page import Page
+
+            lines = N.plan_tree_str(node).split("\n")
+            pg = Page.from_dict({"Query Plan": lines})
+            return QueryResult(pg, ("Query Plan",))
+        page = self.executor.run(node)
+        return QueryResult(page, node.titles)

@@ -1,0 +1,12 @@
+"""SQL frontend: lexer, parser, analyzer, logical planner.
+
+Re-designed equivalent of the reference's presto-parser (ANTLR4 SqlBase.g4,
+762 lines, ~170 AST classes under sql/tree/) and presto-main's
+sql/analyzer + sql/planner. Scope-first: the grammar targets the analytic
+SELECT dialect TPC-H/TPC-DS need (CTEs, joins, subqueries, aggregates,
+window functions) and grows from there; the planner emits the PlanNode
+vocabulary of SURVEY.md §1 L4 which maps 1:1 onto kernel calls and mesh
+shardings.
+"""
+
+from .parser import parse  # noqa: F401

@@ -1,0 +1,729 @@
+"""Hand-written SQL lexer + recursive-descent parser.
+
+Replaces the reference's generated ANTLR4 parser (presto-parser/src/main/
+antlr4/.../SqlBase.g4 + SqlParser.java). A recursive-descent parser keeps
+the whole grammar in one readable file and error messages precise; the
+grammar covers the analytic SELECT dialect (precedence follows SqlBase.g4's
+expression hierarchy: OR < AND < NOT < predicate < additive <
+multiplicative < unary < primary).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from . import tree as t
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*\n?|/\*.*?\*/)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><=|>=|<>|!=|\|\||->|[=<>+\-*/%(),.;])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "distinct", "all", "as", "and", "or", "not", "in", "exists", "between",
+    "like", "escape", "is", "null", "true", "false", "case", "when", "then",
+    "else", "end", "cast", "try_cast", "extract", "date", "timestamp",
+    "interval", "join", "inner", "left", "right", "full", "outer", "cross",
+    "on", "using", "with", "union", "intersect", "except", "asc", "desc",
+    "nulls", "first", "last", "over", "partition", "rows", "range",
+    "unbounded", "preceding", "following", "current", "row", "filter",
+    "explain", "analyze", "show", "tables", "columns", "substring", "for",
+}
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind: str, text: str, pos: int):
+        self.kind = kind  # 'number' | 'string' | 'ident' | 'kw' | op text
+        self.text = text
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind!r}, {self.text!r})"
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    i = 0
+    n = len(sql)
+    while i < n:
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise SqlParseError(f"unexpected character {sql[i]!r}", sql, i)
+        i = m.end()
+        if m.lastgroup == "ws":
+            continue
+        text = m.group()
+        if m.lastgroup == "number":
+            out.append(Token("number", text, m.start()))
+        elif m.lastgroup == "string":
+            out.append(Token("string", text[1:-1].replace("''", "'"), m.start()))
+        elif m.lastgroup == "qident":
+            out.append(Token("ident", text[1:-1].replace('""', '"'), m.start()))
+        elif m.lastgroup == "ident":
+            low = text.lower()
+            out.append(Token("kw" if low in KEYWORDS else "ident", low if low in KEYWORDS else text, m.start()))
+        else:
+            out.append(Token(text, text, m.start()))
+    out.append(Token("eof", "", n))
+    return out
+
+
+class SqlParseError(ValueError):
+    def __init__(self, message: str, sql: str, pos: int):
+        line = sql.count("\n", 0, pos) + 1
+        col = pos - (sql.rfind("\n", 0, pos) + 1) + 1
+        super().__init__(f"{message} at line {line}:{col}")
+        self.pos = pos
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers --
+    @property
+    def tok(self) -> Token:
+        return self.tokens[self.i]
+
+    def peek(self, k: int = 1) -> Token:
+        return self.tokens[min(self.i + k, len(self.tokens) - 1)]
+
+    def error(self, msg: str):
+        raise SqlParseError(f"{msg} (got {self.tok.text or 'end of input'!r})", self.sql, self.tok.pos)
+
+    def at_kw(self, *kws: str) -> bool:
+        return self.tok.kind == "kw" and self.tok.text in kws
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.accept_kw(kw):
+            self.error(f"expected {kw.upper()}")
+
+    def accept(self, op: str) -> bool:
+        if self.tok.kind == op:
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, op: str):
+        if not self.accept(op):
+            self.error(f"expected {op!r}")
+
+    def ident(self) -> str:
+        if self.tok.kind == "ident":
+            s = self.tok.text
+            self.i += 1
+            return s
+        # permissive: non-reserved keywords usable as identifiers
+        if self.tok.kind == "kw" and self.tok.text in _NONRESERVED:
+            s = self.tok.text
+            self.i += 1
+            return s
+        self.error("expected identifier")
+
+    # -- entry --
+    def parse_statement(self) -> t.Node:
+        if self.accept_kw("explain"):
+            analyze = self.accept_kw("analyze")
+            q = self.parse_query()
+            self.finish()
+            return t.Explain(q, analyze)
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                self.finish()
+                return t.ShowTables()
+            if self.accept_kw("columns"):
+                self.expect_kw("from")
+                name = self.ident()
+                self.finish()
+                return t.ShowColumns(name)
+            self.error("expected TABLES or COLUMNS")
+        q = self.parse_query()
+        self.finish()
+        return q
+
+    def finish(self):
+        self.accept(";")
+        if self.tok.kind != "eof":
+            self.error("unexpected trailing input")
+
+    # -- query --
+    def parse_query(self) -> t.Query:
+        with_items: Tuple[t.WithItem, ...] = ()
+        if self.accept_kw("with"):
+            items = []
+            while True:
+                name = self.ident()
+                col_aliases: Tuple[str, ...] = ()
+                if self.accept("("):
+                    cols = [self.ident()]
+                    while self.accept(","):
+                        cols.append(self.ident())
+                    self.expect(")")
+                    col_aliases = tuple(cols)
+                self.expect_kw("as")
+                self.expect("(")
+                sub = self.parse_query()
+                self.expect(")")
+                items.append(t.WithItem(name, sub, col_aliases))
+                if not self.accept(","):
+                    break
+            with_items = tuple(items)
+
+        body = self.parse_set_operation()
+
+        order_by: Tuple[t.SortItem, ...] = ()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by = self.parse_sort_items()
+        limit = None
+        if self.accept_kw("limit"):
+            if self.accept_kw("all"):
+                limit = None
+            else:
+                if self.tok.kind != "number":
+                    self.error("expected LIMIT count")
+                limit = int(self.tok.text)
+                self.i += 1
+        return t.Query(body, with_items, order_by, limit)
+
+    def parse_sort_items(self) -> Tuple[t.SortItem, ...]:
+        items = []
+        while True:
+            e = self.parse_expr()
+            asc = True
+            if self.accept_kw("asc"):
+                asc = True
+            elif self.accept_kw("desc"):
+                asc = False
+            nulls_first = None
+            if self.accept_kw("nulls"):
+                if self.accept_kw("first"):
+                    nulls_first = True
+                else:
+                    self.expect_kw("last")
+                    nulls_first = False
+            items.append(t.SortItem(e, asc, nulls_first))
+            if not self.accept(","):
+                break
+        return tuple(items)
+
+    def parse_set_operation(self) -> t.Node:
+        left = self.parse_select_or_parens()
+        while self.at_kw("union", "intersect", "except"):
+            op = self.tok.text
+            self.i += 1
+            if op == "union":
+                op = "union_all" if self.accept_kw("all") else "union"
+            else:
+                self.accept_kw("all")  # INTERSECT/EXCEPT ALL unsupported later
+                self.accept_kw("distinct")
+            right = self.parse_select_or_parens()
+            left = t.SetOperation(op, left, right)
+        return left
+
+    def parse_select_or_parens(self) -> t.Node:
+        if self.accept("("):
+            inner = self.parse_query()
+            self.expect(")")
+            # a parenthesized query as a set-op operand: unwrap if trivial
+            if not inner.with_items and not inner.order_by and inner.limit is None:
+                return inner.body
+            return inner
+        return self.parse_select()
+
+    def parse_select(self) -> t.Select:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items: List[t.Node] = []
+        while True:
+            items.append(self.parse_select_item())
+            if not self.accept(","):
+                break
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.parse_relation_list()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        group_by: Tuple[t.Node, ...] = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            gs = [self.parse_expr()]
+            while self.accept(","):
+                gs.append(self.parse_expr())
+            group_by = tuple(gs)
+        having = self.parse_expr() if self.accept_kw("having") else None
+        return t.Select(tuple(items), from_, where, group_by, having, distinct)
+
+    def parse_select_item(self) -> t.Node:
+        if self.accept("*"):
+            return t.Star()
+        # t.* form
+        if (
+            self.tok.kind == "ident"
+            and self.peek().kind == "."
+            and self.peek(2).kind == "*"
+        ):
+            q = self.ident()
+            self.expect(".")
+            self.expect("*")
+            return t.Star(q)
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.tok.kind == "ident":
+            alias = self.ident()
+        return t.SelectItem(e, alias)
+
+    # -- relations --
+    def parse_relation_list(self) -> t.Node:
+        rel = self.parse_join_tree()
+        while self.accept(","):
+            right = self.parse_join_tree()
+            rel = t.Join("cross", rel, right)
+        return rel
+
+    def parse_join_tree(self) -> t.Node:
+        rel = self.parse_primary_relation()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_primary_relation()
+                rel = t.Join("cross", rel, right)
+                continue
+            kind = None
+            if self.at_kw("join", "inner"):
+                kind = "inner"
+                self.accept_kw("inner")
+                self.expect_kw("join")
+            elif self.at_kw("left", "right", "full"):
+                kind = self.tok.text
+                self.i += 1
+                self.accept_kw("outer")
+                self.expect_kw("join")
+            else:
+                break
+            right = self.parse_primary_relation()
+            if self.accept_kw("on"):
+                cond = self.parse_expr()
+                rel = t.Join(kind, rel, right, cond)
+            elif self.accept_kw("using"):
+                self.expect("(")
+                cols = [self.ident()]
+                while self.accept(","):
+                    cols.append(self.ident())
+                self.expect(")")
+                rel = t.Join(kind, rel, right, None, tuple(cols))
+            else:
+                self.error("expected ON or USING")
+        return rel
+
+    def parse_primary_relation(self) -> t.Node:
+        if self.accept("("):
+            # subquery or parenthesized join tree
+            if self.at_kw("select", "with") or self.tok.kind == "(":
+                sub = self.parse_query()
+                self.expect(")")
+                alias, col_aliases = self._parse_alias(required=True)
+                return t.SubqueryRelation(sub, alias, col_aliases)
+            rel = self.parse_relation_list()
+            self.expect(")")
+            return rel
+        name = self.ident()
+        alias, _ = self._parse_alias(required=False)
+        return t.Table(name, alias)
+
+    def _parse_alias(self, required: bool):
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.tok.kind == "ident":
+            alias = self.ident()
+        elif required:
+            self.error("expected subquery alias")
+        col_aliases: Tuple[str, ...] = ()
+        if alias is not None and self.accept("("):
+            cols = [self.ident()]
+            while self.accept(","):
+                cols.append(self.ident())
+            self.expect(")")
+            col_aliases = tuple(cols)
+        return alias, col_aliases
+
+    # -- expressions (precedence climbing) --
+    def parse_expr(self) -> t.Node:
+        return self.parse_or()
+
+    def parse_or(self) -> t.Node:
+        terms = [self.parse_and()]
+        while self.accept_kw("or"):
+            terms.append(self.parse_and())
+        return terms[0] if len(terms) == 1 else t.LogicalOp("or", tuple(terms))
+
+    def parse_and(self) -> t.Node:
+        terms = [self.parse_not()]
+        while self.accept_kw("and"):
+            terms.append(self.parse_not())
+        return terms[0] if len(terms) == 1 else t.LogicalOp("and", tuple(terms))
+
+    def parse_not(self) -> t.Node:
+        if self.accept_kw("not"):
+            return t.NotOp(self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> t.Node:
+        if self.at_kw("exists"):
+            self.i += 1
+            self.expect("(")
+            q = self.parse_query()
+            self.expect(")")
+            return t.Exists(q)
+        e = self.parse_additive()
+        while True:
+            if self.accept_kw("is"):
+                negated = self.accept_kw("not")
+                self.expect_kw("null")
+                e = t.IsNull(e, negated)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                lo = self.parse_additive()
+                self.expect_kw("and")
+                hi = self.parse_additive()
+                e = t.Between(e, lo, hi, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect("(")
+                if self.at_kw("select", "with"):
+                    q = self.parse_query()
+                    self.expect(")")
+                    e = t.InSubquery(e, q, negated)
+                else:
+                    opts = [self.parse_expr()]
+                    while self.accept(","):
+                        opts.append(self.parse_expr())
+                    self.expect(")")
+                    e = t.InList(e, tuple(opts), negated)
+                continue
+            if self.accept_kw("like"):
+                pat = self.parse_additive()
+                esc = None
+                if self.accept_kw("escape"):
+                    esc = self.parse_additive()
+                e = t.Like(e, pat, esc, negated)
+                continue
+            if negated:
+                self.i = save
+                break
+            op = None
+            for cand in ("=", "<>", "!=", "<=", ">=", "<", ">"):
+                if self.tok.kind == cand:
+                    op = "<>" if cand == "!=" else cand
+                    break
+            if op is None:
+                break
+            self.i += 1
+            # quantified comparison / subquery comparand
+            if self.tok.kind == "(" and self.peek().kind == "kw" and self.peek().text in ("select", "with"):
+                self.i += 1
+                q = self.parse_query()
+                self.expect(")")
+                right: t.Node = t.ScalarSubquery(q)
+            else:
+                right = self.parse_additive()
+            e = t.BinaryOp(op, e, right)
+        return e
+
+    def parse_additive(self) -> t.Node:
+        e = self.parse_multiplicative()
+        while True:
+            if self.tok.kind in ("+", "-", "||"):
+                op = self.tok.kind
+                self.i += 1
+                e = t.BinaryOp(op, e, self.parse_multiplicative())
+            else:
+                return e
+
+    def parse_multiplicative(self) -> t.Node:
+        e = self.parse_unary()
+        while True:
+            if self.tok.kind in ("*", "/", "%"):
+                op = self.tok.kind
+                self.i += 1
+                e = t.BinaryOp(op, e, self.parse_unary())
+            else:
+                return e
+
+    def parse_unary(self) -> t.Node:
+        if self.tok.kind == "-":
+            self.i += 1
+            return t.UnaryOp("-", self.parse_unary())
+        if self.tok.kind == "+":
+            self.i += 1
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> t.Node:
+        tok = self.tok
+        if tok.kind == "number":
+            self.i += 1
+            return t.NumberLiteral(tok.text)
+        if tok.kind == "string":
+            self.i += 1
+            return t.StringLiteral(tok.text)
+        if self.at_kw("null"):
+            self.i += 1
+            return t.NullLiteral()
+        if self.at_kw("true"):
+            self.i += 1
+            return t.BooleanLiteral(True)
+        if self.at_kw("false"):
+            self.i += 1
+            return t.BooleanLiteral(False)
+        if self.at_kw("date"):
+            if self.peek().kind == "string":
+                self.i += 1
+                s = self.tok.text
+                self.i += 1
+                return t.DateLiteral(s)
+        if self.at_kw("timestamp"):
+            if self.peek().kind == "string":
+                self.i += 1
+                s = self.tok.text
+                self.i += 1
+                return t.TimestampLiteral(s)
+        if self.at_kw("interval"):
+            self.i += 1
+            negative = False
+            if self.tok.kind == "-":
+                negative = True
+                self.i += 1
+            if self.tok.kind != "string":
+                self.error("expected interval literal string")
+            value = self.tok.text
+            self.i += 1
+            unit = self.ident().lower()
+            unit = unit.rstrip("s") if unit.endswith("s") else unit
+            return t.IntervalLiteral(value, unit, negative)
+        if self.at_kw("case"):
+            return self.parse_case()
+        if self.at_kw("cast", "try_cast"):
+            try_cast = self.tok.text == "try_cast"
+            self.i += 1
+            self.expect("(")
+            operand = self.parse_expr()
+            self.expect_kw("as")
+            type_name = self.parse_type_name()
+            self.expect(")")
+            return t.Cast(operand, type_name, try_cast)
+        if self.at_kw("extract"):
+            self.i += 1
+            self.expect("(")
+            field = self.ident().lower()
+            self.expect_kw("from")
+            operand = self.parse_expr()
+            self.expect(")")
+            return t.Extract(field, operand)
+        if self.at_kw("substring"):
+            # substring(x FROM a [FOR b]) or substring(x, a, b)
+            self.i += 1
+            self.expect("(")
+            val = self.parse_expr()
+            if self.accept_kw("from"):
+                start = self.parse_expr()
+                args = [val, start]
+                if self.accept_kw("for"):
+                    args.append(self.parse_expr())
+            else:
+                args = [val]
+                while self.accept(","):
+                    args.append(self.parse_expr())
+            self.expect(")")
+            return t.FunctionCall("substr", tuple(args))
+        if tok.kind == "(":
+            self.i += 1
+            if self.at_kw("select", "with"):
+                q = self.parse_query()
+                self.expect(")")
+                return t.ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if tok.kind == "ident" or (tok.kind == "kw" and tok.text in _NONRESERVED):
+            # function call?
+            if self.peek().kind == "(":
+                name = self.ident().lower()
+                self.i += 1  # '('
+                return self.parse_call_tail(name)
+            # qualified identifier
+            parts = [self.ident()]
+            while self.tok.kind == "." :
+                self.i += 1
+                parts.append(self.ident())
+            return t.Identifier(tuple(parts))
+        self.error("expected expression")
+
+    def parse_call_tail(self, name: str) -> t.Node:
+        distinct = False
+        is_star = False
+        args: List[t.Node] = []
+        if self.accept("*"):
+            is_star = True
+        elif not self.accept(")"):
+            if self.accept_kw("distinct"):
+                distinct = True
+            else:
+                self.accept_kw("all")
+            args.append(self.parse_expr())
+            while self.accept(","):
+                args.append(self.parse_expr())
+            self.expect(")")
+            return self._call_suffix(name, args, distinct, is_star)
+        else:
+            return self._call_suffix(name, args, distinct, is_star)
+        self.expect(")")
+        return self._call_suffix(name, args, distinct, is_star)
+
+    def _call_suffix(self, name, args, distinct, is_star) -> t.Node:
+        filt = None
+        if self.accept_kw("filter"):
+            self.expect("(")
+            self.expect_kw("where")
+            filt = self.parse_expr()
+            self.expect(")")
+        window = None
+        if self.accept_kw("over"):
+            window = self.parse_window_spec()
+        return t.FunctionCall(name, tuple(args), distinct, is_star, window, filt)
+
+    def parse_window_spec(self) -> t.WindowSpec:
+        self.expect("(")
+        partition: Tuple[t.Node, ...] = ()
+        order: Tuple[t.SortItem, ...] = ()
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            ps = [self.parse_expr()]
+            while self.accept(","):
+                ps.append(self.parse_expr())
+            partition = tuple(ps)
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = self.parse_sort_items()
+        if self.at_kw("rows", "range"):
+            ftype = self.tok.text
+            self.i += 1
+            if self.accept_kw("between"):
+                start = self.parse_frame_bound()
+                self.expect_kw("and")
+                end = self.parse_frame_bound()
+            else:
+                start = self.parse_frame_bound()
+                end = "current row"
+            frame = (ftype, start, end)
+        self.expect(")")
+        return t.WindowSpec(partition, order, frame)
+
+    def parse_frame_bound(self) -> str:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return "unbounded preceding"
+            self.expect_kw("following")
+            return "unbounded following"
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return "current row"
+        if self.tok.kind == "number":
+            n = self.tok.text
+            self.i += 1
+            if self.accept_kw("preceding"):
+                return f"{n} preceding"
+            self.expect_kw("following")
+            return f"{n} following"
+        self.error("expected frame bound")
+
+    def parse_case(self) -> t.Node:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            whens.append((cond, val))
+        else_ = None
+        if self.accept_kw("else"):
+            else_ = self.parse_expr()
+        self.expect_kw("end")
+        return t.Case(operand, tuple(whens), else_)
+
+    def parse_type_name(self) -> str:
+        parts = [self.ident() if self.tok.kind == "ident" else self._kw_as_type()]
+        # double precision
+        if parts[0].lower() == "double" and self.tok.kind == "ident" and self.tok.text.lower() == "precision":
+            self.i += 1
+        if self.accept("("):
+            nums = [self.tok.text]
+            self.i += 1
+            while self.accept(","):
+                nums.append(self.tok.text)
+                self.i += 1
+            self.expect(")")
+            return f"{parts[0]}({','.join(nums)})"
+        return parts[0]
+
+    def _kw_as_type(self) -> str:
+        if self.tok.kind == "kw" and self.tok.text in ("date", "timestamp", "interval"):
+            s = self.tok.text
+            self.i += 1
+            return s
+        self.error("expected type name")
+
+
+# keywords usable as plain identifiers (column/table names)
+_NONRESERVED = {
+    "date", "timestamp", "interval", "year", "month", "day", "hour", "minute",
+    "second", "quarter", "first", "last", "tables", "columns", "show", "row",
+    "range", "rows", "filter", "analyze", "substring",
+}
+
+
+def parse(sql: str) -> t.Node:
+    """Parse one SQL statement into an AST."""
+    return Parser(sql).parse_statement()

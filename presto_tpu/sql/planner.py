@@ -1,0 +1,1266 @@
+"""Analyzer + logical planner: AST -> typed PlanNode tree.
+
+Combines the reference's Analyzer/StatementAnalyzer/ExpressionAnalyzer
+(presto-main/.../sql/analyzer/) and LogicalPlanner + key optimizations
+(sql/planner/LogicalPlanner.java, PlanOptimizers.java) into one pass that is
+naturally "optimized-by-construction" for the common analytic shapes:
+
+* predicate pushdown — WHERE conjuncts are classified while planning and
+  single-relation filters land directly on their scan
+  (reference PredicatePushDown.java)
+* greedy join ordering over the equi-join graph using catalog row counts
+  (reference ReorderJoins + DetermineJoinDistributionType, simplified)
+* subquery decorrelation for the canonical patterns: uncorrelated scalar ->
+  ScalarApply; correlated scalar aggregate -> group-by + left join
+  (reference TransformCorrelatedScalarAggregationToJoin.java);
+  [NOT] EXISTS / IN -> SemiJoin with optional residual
+  (reference TransformExistsApplyToLateralNode + semi-join rewrites)
+* count(DISTINCT x) -> count over Distinct (reference
+  SingleDistinctAggregationToGroupBy.java)
+
+Channel names (`name#K`) are globally unique per Planner — the reference's
+SymbolAllocator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..expr import ir
+from ..expr.functions import FUNCTIONS
+from ..ops.aggregate import AggSpec
+from ..ops.sort import SortKey
+from ..plan import nodes as N
+from . import tree as t
+
+AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+_BINOP_FN = {
+    "+": "add",
+    "-": "subtract",
+    "*": "multiply",
+    "/": "divide",
+    "%": "modulus",
+    "||": "concat",
+    "=": "eq",
+    "<>": "ne",
+    "<": "lt",
+    "<=": "le",
+    ">": "gt",
+    ">=": "ge",
+}
+_CMP_OPS = {"=", "<>", "<", "<=", ">", ">="}
+
+
+class PlanningError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# scope
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FieldRef:
+    qualifier: Optional[str]  # relation alias (or table name)
+    name: str  # user-visible column name
+    channel: str
+    type: T.Type
+
+
+class Scope:
+    def __init__(self, fields: Sequence[FieldRef]):
+        self.fields = list(fields)
+
+    def resolve(self, parts: Tuple[str, ...]) -> Optional[FieldRef]:
+        if len(parts) == 1:
+            hits = [f for f in self.fields if f.name == parts[0]]
+        else:
+            q, name = parts[-2], parts[-1]
+            hits = [
+                f
+                for f in self.fields
+                if f.name == name and f.qualifier is not None and f.qualifier == q
+            ]
+        if len(hits) > 1:
+            raise PlanningError(f"ambiguous column {'.'.join(parts)!r}")
+        return hits[0] if hits else None
+
+    def visible(self, qualifier: Optional[str] = None) -> List[FieldRef]:
+        if qualifier is None:
+            return list(self.fields)
+        return [f for f in self.fields if f.qualifier == qualifier]
+
+
+# ---------------------------------------------------------------------------
+# catalog protocol
+# ---------------------------------------------------------------------------
+
+
+class Catalog:
+    """Connector metadata interface (reference ConnectorMetadata +
+    table statistics SPI)."""
+
+    name = "catalog"
+
+    def table_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        raise NotImplementedError
+
+    def row_count(self, table: str) -> int:
+        raise NotImplementedError
+
+    def unique_columns(self, table: str) -> List[Tuple[str, ...]]:
+        """Column sets known unique (primary keys) — enables n:1 joins."""
+        return []
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RelationPlan:
+    node: N.PlanNode
+    scope: Scope
+
+
+class Planner:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self._counter = itertools.count()
+
+    def channel(self, base: str) -> str:
+        return f"{base}#{next(self._counter)}"
+
+    # -- statements --
+    def plan_statement(self, ast: t.Node) -> N.PlanNode:
+        if isinstance(ast, t.Query):
+            rp = self.plan_query(ast, outer=None, ctes={})
+            return rp.node
+        if isinstance(ast, t.Explain):
+            return self.plan_statement(ast.query)
+        raise PlanningError(f"unsupported statement {type(ast).__name__}")
+
+    # -- queries --
+    def plan_query(
+        self, q: t.Query, outer: Optional["SelectContext"], ctes: Dict[str, t.WithItem]
+    ) -> RelationPlan:
+        if q.with_items:
+            ctes = dict(ctes)
+            for item in q.with_items:
+                ctes[item.name.lower()] = item
+
+        rp = self.plan_query_body(q.body, outer, ctes)
+
+        node, scope = rp.node, rp.scope
+        if q.order_by:
+            keys = []
+            for si in q.order_by:
+                e = self._order_expr(si.expr, scope, outer, ctes, node)
+                keys.append(SortKey(e, si.ascending, si.nulls_first))
+            if q.limit is not None:
+                node = N.TopN(node, tuple(keys), q.limit)
+            else:
+                node = N.Sort(node, tuple(keys))
+        elif q.limit is not None:
+            node = N.Limit(node, q.limit)
+        return RelationPlan(node, scope)
+
+    def plan_query_body(self, body, outer, ctes) -> RelationPlan:
+        if isinstance(body, t.Select):
+            return self.plan_select(body, outer, ctes)
+        if isinstance(body, t.SetOperation):
+            return self.plan_set_op(body, outer, ctes)
+        if isinstance(body, t.Query):
+            return self.plan_query(body, outer, ctes)
+        raise PlanningError(f"unsupported query body {type(body).__name__}")
+
+    def _order_expr(self, ast, scope: Scope, outer, ctes, node) -> ir.RowExpression:
+        """ORDER BY resolves against output columns (aliases) first."""
+        if isinstance(ast, t.Identifier) and len(ast.parts) == 1:
+            f = scope.resolve(ast.parts)
+            if f is not None:
+                return ir.ColumnRef(f.channel, f.type)
+        if isinstance(ast, t.NumberLiteral) and "." not in ast.text:
+            idx = int(ast.text) - 1
+            f = scope.fields[idx]
+            return ir.ColumnRef(f.channel, f.type)
+        ctx = SelectContext(self, [scope], outer, ctes, plan_holder=None)
+        return ctx.translate(ast)
+
+    def plan_set_op(self, op: t.SetOperation, outer, ctes) -> RelationPlan:
+        left = self.plan_query_body(op.left, outer, ctes)
+        right = self.plan_query_body(op.right, outer, ctes)
+        lf, rf = left.node.fields, right.node.fields
+        if len(lf) != len(rf):
+            raise PlanningError("set operation inputs differ in column count")
+        # per-column common super type; coerce both sides where needed
+        common = [
+            T.common_super_type(lt, rt) for (_, lt), (_, rt) in zip(lf, rf)
+        ]
+        lnode = self._coerce_columns(left.node, common)
+        # rename right channels to the (possibly coerced) left channels
+        rnode = self._coerce_columns(right.node, common)
+        exprs = tuple(ir.ColumnRef(n, ty) for n, ty in rnode.fields)
+        renamed = N.Project(rnode, exprs, tuple(n for n, _ in lnode.fields))
+        if op.op in ("union", "union_all"):
+            node: N.PlanNode = N.Union((lnode, renamed), distinct=op.op == "union")
+        else:
+            raise PlanningError(f"set operation {op.op} not yet supported")
+        scope = Scope(
+            [
+                FieldRef(f.qualifier, f.name, ch, ty)
+                for f, (ch, ty) in zip(left.scope.fields, lnode.fields)
+            ]
+        )
+        return RelationPlan(node, scope)
+
+    def _coerce_columns(self, node: N.PlanNode, target_types) -> N.PlanNode:
+        if all(ty == tt for (_, ty), tt in zip(node.fields, target_types)):
+            return node
+        exprs = []
+        names = []
+        for (ch, ty), tt in zip(node.fields, target_types):
+            ref = ir.ColumnRef(ch, ty)
+            if ty == tt:
+                exprs.append(ref)
+                names.append(ch)
+            else:
+                exprs.append(ir.cast(ref, tt))
+                names.append(self.channel("coerce"))
+        return N.Project(node, tuple(exprs), tuple(names))
+
+    # -- relations --
+    def plan_relation(self, rel, outer, ctes) -> RelationPlan:
+        if isinstance(rel, t.Table):
+            return self.plan_table(rel, ctes, outer)
+        if isinstance(rel, t.SubqueryRelation):
+            sub = self.plan_query(rel.query, outer, ctes)
+            names = rel.column_aliases or tuple(
+                f.name for f in sub.scope.fields
+            )
+            if len(names) != len(sub.scope.fields):
+                raise PlanningError("subquery column alias count mismatch")
+            scope = Scope(
+                [
+                    FieldRef(rel.alias, n, f.channel, f.type)
+                    for n, f in zip(names, sub.scope.fields)
+                ]
+            )
+            return RelationPlan(sub.node, scope)
+        if isinstance(rel, t.Join):
+            raise PlanningError("join nodes handled by plan_select")
+        raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_table(self, rel: t.Table, ctes, outer) -> RelationPlan:
+        name = rel.name.lower()
+        if name in ctes:
+            item = ctes[name]
+            sub = self.plan_query(item.query, outer, {k: v for k, v in ctes.items() if k != name})
+            names = item.column_aliases or tuple(f.name for f in sub.scope.fields)
+            alias = rel.alias or rel.name
+            scope = Scope(
+                [
+                    FieldRef(alias, n, f.channel, f.type)
+                    for n, f in zip(names, sub.scope.fields)
+                ]
+            )
+            return RelationPlan(sub.node, scope)
+        schema = self.catalog.schema(name)
+        alias = rel.alias or name
+        columns = []
+        fields = []
+        for cname, ctype in schema.items():
+            ch = self.channel(cname)
+            columns.append((ch, cname, ctype))
+            fields.append(FieldRef(alias, cname, ch, ctype))
+        node = N.TableScan(self.catalog.name, name, tuple(columns))
+        return RelationPlan(node, Scope(fields))
+
+    # -- SELECT --
+    def plan_select(self, sel: t.Select, outer, ctes) -> RelationPlan:
+        ctx = FromPlanner(self, outer, ctes)
+        if sel.from_ is not None:
+            ctx.add_relation(sel.from_)
+        plan, scope = ctx.assemble(sel.where)
+
+        holder = PlanHolder(plan)
+        sctx = SelectContext(self, [scope], outer, ctes, holder)
+
+        # apply deferred subquery conjuncts (EXISTS / IN / scalar comparisons)
+        for conj in ctx.subquery_conjuncts:
+            pred = sctx.translate(conj)
+            if pred is not None:
+                holder.plan = N.Filter(holder.plan, pred)
+
+        # aggregate extraction over select items, HAVING, ORDER BY handled by
+        # the caller via output scope
+        items = self._expand_stars(sel.items, scope)
+        agg_calls: List[t.FunctionCall] = []
+        for item in items:
+            _collect_aggregates(item.expr, agg_calls)
+        if sel.having is not None:
+            _collect_aggregates(sel.having, agg_calls)
+
+        group_exprs: List[ir.RowExpression] = []
+        group_names: List[str] = []
+        if sel.group_by or agg_calls:
+            for g in sel.group_by:
+                if isinstance(g, t.NumberLiteral) and "." not in g.text:
+                    item = items[int(g.text) - 1]
+                    e = sctx.translate(item.expr)
+                else:
+                    e = sctx.translate(g)
+                if isinstance(e, ir.ColumnRef):
+                    ch = e.name
+                else:
+                    ch = self.channel("gk")
+                group_exprs.append(e)
+                group_names.append(ch)
+
+            aggs, agg_map = self._plan_aggregates(agg_calls, sctx)
+            holder.plan, distinct_rewritten = self._build_aggregate(
+                holder.plan, group_exprs, group_names, aggs
+            )
+            # post-aggregation scope: group channels + agg channels
+            post_fields = []
+            for e, ch, g in zip(group_exprs, group_names, sel.group_by):
+                typ = e.type
+                # keep user name resolvable: if group expr was a column,
+                # reuse its field name/qualifier
+                fr = _field_for_channel(scope, ch)
+                if fr is not None:
+                    post_fields.append(FieldRef(fr.qualifier, fr.name, ch, typ))
+                else:
+                    post_fields.append(FieldRef(None, ch, ch, typ))
+            for a in aggs:
+                post_fields.append(FieldRef(None, a.name, a.name, a.output_type))
+            agg_scope = Scope(post_fields)
+            sctx = SelectContext(self, [agg_scope], outer, ctes, holder, agg_map)
+
+        if sel.having is not None:
+            pred = sctx.translate(sel.having)
+            holder.plan = N.Filter(holder.plan, pred)
+
+        # final projection
+        out_exprs: List[ir.RowExpression] = []
+        out_names: List[str] = []
+        out_fields: List[FieldRef] = []
+        for i, item in enumerate(items):
+            e = sctx.translate(item.expr)
+            name = item.alias or _derive_name(item.expr) or f"_col{i}"
+            if isinstance(e, ir.ColumnRef):
+                ch = e.name
+            else:
+                ch = self.channel(name)
+            out_exprs.append(e)
+            out_names.append(ch)
+            out_fields.append(FieldRef(None, name, ch, e.type))
+        node = N.Project(holder.plan, tuple(out_exprs), tuple(out_names))
+        if sel.distinct:
+            node = N.Distinct(node)
+        return RelationPlan(node, Scope(out_fields))
+
+    def _expand_stars(self, items, scope: Scope) -> List[t.SelectItem]:
+        out = []
+        for item in items:
+            if isinstance(item, t.Star):
+                for f in scope.visible(item.qualifier):
+                    out.append(
+                        t.SelectItem(t.Identifier((f.qualifier, f.name) if f.qualifier else (f.name,)), f.name)
+                    )
+            else:
+                out.append(item)
+        return out
+
+    def _plan_aggregates(self, agg_calls, sctx) -> Tuple[List[AggSpec], Dict]:
+        aggs: List[AggSpec] = []
+        agg_map: Dict[t.Node, Tuple[str, T.Type]] = {}
+        seen: Dict[t.Node, int] = {}
+        for call in agg_calls:
+            if call in agg_map:
+                continue
+            fname = call.name
+            if fname not in AGG_FUNCS:
+                raise PlanningError(f"unsupported aggregate {fname!r}")
+            if call.is_star:
+                spec = AggSpec(
+                    "count_star", None, self.channel("count"), T.BIGINT
+                )
+            else:
+                (arg,) = call.args
+                e = sctx.translate(arg)
+                func = "count" if fname == "count" else fname
+                out_t = AggSpec.infer_output_type(func, e.type)
+                spec = AggSpec(func, e, self.channel(fname), out_t)
+                if call.distinct:
+                    spec = dataclasses.replace(spec, func=f"distinct_{func}")
+            aggs.append(spec)
+            agg_map[call] = (spec.name, spec.output_type)
+        return aggs, agg_map
+
+    def _build_aggregate(self, child, group_exprs, group_names, aggs):
+        """Build the Aggregate node, rewriting distinct aggregates as
+        aggregation over Distinct (reference
+        SingleDistinctAggregationToGroupBy)."""
+        distinct_specs = [a for a in aggs if a.func.startswith("distinct_")]
+        if not distinct_specs:
+            return (
+                N.Aggregate(child, tuple(group_exprs), tuple(group_names), tuple(aggs)),
+                False,
+            )
+        if len(distinct_specs) != len(aggs):
+            raise PlanningError("mixing DISTINCT and plain aggregates is not yet supported")
+        # project group keys + distinct args, dedupe, then aggregate plainly
+        proj_exprs = list(group_exprs)
+        proj_names = list(group_names)
+        inner_names = []
+        for a in distinct_specs:
+            ch = self.channel("darg")
+            proj_exprs.append(a.input)
+            proj_names.append(ch)
+            inner_names.append(ch)
+        pre = N.Distinct(N.Project(child, tuple(proj_exprs), tuple(proj_names)))
+        new_groups = tuple(
+            ir.ColumnRef(n, e.type) for n, e in zip(group_names, group_exprs)
+        )
+        new_aggs = tuple(
+            dataclasses.replace(
+                a,
+                func=a.func.replace("distinct_", ""),
+                input=ir.ColumnRef(ch, a.input.type),
+            )
+            for a, ch in zip(distinct_specs, inner_names)
+        )
+        return (
+            N.Aggregate(pre, new_groups, tuple(group_names), new_aggs),
+            True,
+        )
+
+
+def _field_for_channel(scope: Scope, channel: str) -> Optional[FieldRef]:
+    for f in scope.fields:
+        if f.channel == channel:
+            return f
+    return None
+
+
+def _derive_name(expr: t.Node) -> Optional[str]:
+    if isinstance(expr, t.Identifier):
+        return expr.name
+    if isinstance(expr, t.FunctionCall):
+        return expr.name
+    return None
+
+
+def _collect_aggregates(expr: t.Node, out: List[t.FunctionCall]):
+    """Find aggregate function calls (not descending into subqueries)."""
+    if isinstance(expr, t.FunctionCall):
+        if expr.name in AGG_FUNCS and expr.window is None:
+            out.append(expr)
+            return  # aggregates cannot nest
+    if isinstance(expr, (t.ScalarSubquery, t.InSubquery, t.Exists)):
+        return
+    for f in dataclasses.fields(expr):
+        v = getattr(expr, f.name)
+        if isinstance(v, t.Node):
+            _collect_aggregates(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node):
+                    _collect_aggregates(x, out)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, t.Node):
+                            _collect_aggregates(y, out)
+
+
+def collect_channels(e: ir.RowExpression, out: set):
+    if isinstance(e, ir.ColumnRef):
+        out.add(e.name)
+    elif isinstance(e, ir.Call):
+        for a in e.args:
+            collect_channels(a, out)
+
+
+def _contains_subquery(expr: t.Node) -> bool:
+    if isinstance(expr, (t.ScalarSubquery, t.InSubquery, t.Exists)):
+        return True
+    for f in dataclasses.fields(expr):
+        v = getattr(expr, f.name)
+        if isinstance(v, t.Node) and not isinstance(v, (t.Query,)):
+            if _contains_subquery(v):
+                return True
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node) and not isinstance(x, t.Query):
+                    if _contains_subquery(x):
+                        return True
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if (
+                            isinstance(y, t.Node)
+                            and not isinstance(y, t.Query)
+                            and _contains_subquery(y)
+                        ):
+                            return True
+    return False
+
+
+def split_conjuncts(expr: Optional[t.Node]) -> List[t.Node]:
+    if expr is None:
+        return []
+    if isinstance(expr, t.LogicalOp) and expr.op == "and":
+        out = []
+        for x in expr.terms:
+            out.extend(split_conjuncts(x))
+        return out
+    return [expr]
+
+
+def extract_common_or_conjuncts(conjuncts: List[t.Node]) -> List[t.Node]:
+    """Factor conjuncts common to every OR disjunct up to the top level
+    (reference ExtractCommonPredicatesExpressionRewriter): Q19's
+    `(p=l and A...) or (p=l and B...)` exposes the p=l join key."""
+    out: List[t.Node] = []
+    for c in conjuncts:
+        if isinstance(c, t.LogicalOp) and c.op == "or":
+            dis = [split_conjuncts(d) for d in c.terms]
+            common = [x for x in dis[0] if all(x in d for d in dis[1:])]
+            if common:
+                out.extend(common)
+                rest_terms = []
+                degenerate = False
+                for d in dis:
+                    rem = [x for x in d if x not in common]
+                    if not rem:
+                        degenerate = True
+                        break
+                    rest_terms.append(
+                        rem[0] if len(rem) == 1 else t.LogicalOp("and", tuple(rem))
+                    )
+                if not degenerate:
+                    out.append(t.LogicalOp("or", tuple(rest_terms)))
+                continue
+        out.append(c)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FROM clause: relation pool + join graph assembly
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PoolItem:
+    plan: RelationPlan
+    channels: set
+    estimate: float
+
+
+class FromPlanner:
+    """Flattens the FROM clause into a relation pool + join edges, classifies
+    WHERE conjuncts, and assembles a greedy join order (reference
+    ReorderJoins, radically simplified: sizes from catalog row counts,
+    filters assumed selective)."""
+
+    def __init__(self, planner: Planner, outer, ctes):
+        self.p = planner
+        self.outer = outer
+        self.ctes = ctes
+        self.pool: List[PoolItem] = []
+        self.subquery_conjuncts: List[t.Node] = []
+        self._pending_on: List[t.Node] = []
+
+    def add_relation(self, rel: t.Node):
+        if isinstance(rel, t.Join) and rel.kind in ("cross", "inner"):
+            self.add_relation(rel.left)
+            self.add_relation(rel.right)
+            if rel.condition is not None:
+                self._pending_on.extend(split_conjuncts(rel.condition))
+            if rel.using:
+                raise PlanningError("USING joins not yet supported")
+            return
+        if isinstance(rel, t.Join):
+            item = self._plan_outer_join(rel)
+            self.pool.append(item)
+            return
+        rp = self.p.plan_relation(rel, self.outer, self.ctes)
+        est = self._estimate(rp.node)
+        self.pool.append(PoolItem(rp, {f.channel for f in rp.scope.fields}, est))
+
+    def _plan_outer_join(self, rel: t.Join) -> PoolItem:
+        kind = rel.kind
+        if kind == "right":
+            rel = t.Join("left", rel.right, rel.left, rel.condition, rel.using)
+            kind = "left"
+        if kind == "full":
+            raise PlanningError("FULL OUTER JOIN not yet supported")
+        left = self.p.plan_relation(rel.left, self.outer, self.ctes)
+        right = self.p.plan_relation(rel.right, self.outer, self.ctes)
+        combined = Scope(left.scope.fields + right.scope.fields)
+        ctx = SelectContext(self.p, [combined], self.outer, self.ctes, None)
+        left_chs = {f.channel for f in left.scope.fields}
+        right_chs = {f.channel for f in right.scope.fields}
+        lkeys, rkeys, residual = [], [], []
+        rfilters = []
+        for conj in split_conjuncts(rel.condition):
+            e = ctx.translate(conj)
+            refs: set = set()
+            collect_channels(e, refs)
+            if (
+                isinstance(e, ir.Call)
+                and e.name == "eq"
+                and refs & left_chs
+                and refs & right_chs
+            ):
+                a, b = e.args
+                ra: set = set()
+                collect_channels(a, ra)
+                rb: set = set()
+                collect_channels(b, rb)
+                if ra <= left_chs and rb <= right_chs:
+                    lkeys.append(a)
+                    rkeys.append(b)
+                    continue
+                if rb <= left_chs and ra <= right_chs:
+                    lkeys.append(b)
+                    rkeys.append(a)
+                    continue
+            if refs <= right_chs:
+                rfilters.append(e)  # safe to push below a left join
+            else:
+                residual.append(e)
+        rnode = right.node
+        if rfilters:
+            rnode = N.Filter(rnode, ir.and_(*rfilters) if len(rfilters) > 1 else rfilters[0])
+        if not lkeys:
+            raise PlanningError("outer join requires at least one equi condition")
+        res = None
+        if residual:
+            res = ir.and_(*residual) if len(residual) > 1 else residual[0]
+        unique = _build_side_unique(rnode, rkeys, self.p.catalog)
+        node = N.Join(
+            "left", left.node, rnode, tuple(lkeys), tuple(rkeys), res, unique
+        )
+        rp = RelationPlan(node, combined)
+        return PoolItem(
+            rp,
+            left_chs | right_chs,
+            max(self._estimate(left.node), self._estimate(rnode)),
+        )
+
+    def _estimate(self, node: N.PlanNode) -> float:
+        if isinstance(node, N.TableScan):
+            try:
+                return float(self.p.catalog.row_count(node.table))
+            except Exception:
+                return 1e6
+        if isinstance(node, N.Filter):
+            return 0.2 * self._estimate(node.child)
+        if isinstance(node, N.Aggregate):
+            return max(1.0, 0.1 * self._estimate(node.child))
+        if isinstance(node, (N.Distinct,)):
+            return 0.5 * self._estimate(node.child)
+        if isinstance(node, N.Join):
+            return max(self._estimate(node.left), self._estimate(node.right))
+        if isinstance(node, (N.TopN, N.Limit)):
+            return float(node.count)
+        if node.children:
+            return max(self._estimate(c) for c in node.children)
+        return 1e6
+
+    def assemble(self, where: Optional[t.Node]) -> Tuple[N.PlanNode, Scope]:
+        if not self.pool:
+            raise PlanningError("SELECT without FROM not yet supported")
+
+        combined = Scope([f for it in self.pool for f in it.plan.scope.fields])
+        combined_chs = {f.channel for f in combined.fields}
+        ctx = SelectContext(self.p, [combined], self.outer, self.ctes, None)
+
+        conjuncts = extract_common_or_conjuncts(
+            self._pending_on + split_conjuncts(where)
+        )
+        edges: List[Tuple[int, int, ir.RowExpression, ir.RowExpression]] = []
+        residuals: List[Tuple[set, ir.RowExpression]] = []
+        for conj in conjuncts:
+            if _contains_subquery(conj):
+                self.subquery_conjuncts.append(conj)
+                continue
+            e = ctx.translate(conj)
+            refs: set = set()
+            collect_channels(e, refs)
+            outer_chs = refs - combined_chs
+            if outer_chs:
+                # correlated conjunct: record on the enclosing subquery
+                # collector and keep it OUT of the local plan
+                self._record_correlation(e, refs, combined_chs)
+                continue
+            owners = {
+                i for i, it in enumerate(self.pool) if refs & it.channels
+            }
+            if len(owners) == 1:
+                (i,) = owners
+                it = self.pool[i]
+                it.plan = RelationPlan(
+                    N.Filter(it.plan.node, e), it.plan.scope
+                )
+                it.estimate *= _selectivity(e)
+                continue
+            if len(owners) == 2 and isinstance(e, ir.Call) and e.name == "eq":
+                a, b = e.args
+                ra: set = set()
+                collect_channels(a, ra)
+                rb: set = set()
+                collect_channels(b, rb)
+                ia = {i for i, it in enumerate(self.pool) if ra & it.channels}
+                ib = {i for i, it in enumerate(self.pool) if rb & it.channels}
+                if len(ia) == 1 and len(ib) == 1 and ia != ib:
+                    edges.append((next(iter(ia)), next(iter(ib)), a, b))
+                    continue
+            residuals.append((owners, e))
+
+        # greedy assembly
+        n_items = len(self.pool)
+        if n_items == 1:
+            plan = self.pool[0].plan.node
+            for owners, e in residuals:
+                plan = N.Filter(plan, e)
+            return plan, combined
+
+        remaining = set(range(n_items))
+        start = min(remaining, key=lambda i: self.pool[i].estimate)
+        joined = {start}
+        remaining.discard(start)
+        plan = self.pool[start].plan.node
+        est = self.pool[start].estimate
+        applied_res: set = set()
+
+        while remaining:
+            # candidates connected by an edge
+            cand = set()
+            for (i, j, _, _) in edges:
+                if i in joined and j in remaining:
+                    cand.add(j)
+                if j in joined and i in remaining:
+                    cand.add(i)
+            if cand:
+                nxt = min(cand, key=lambda i: self.pool[i].estimate)
+            else:
+                nxt = min(remaining, key=lambda i: self.pool[i].estimate)
+            lkeys, rkeys = [], []
+            for (i, j, a, b) in edges:
+                if i in joined and j == nxt:
+                    lkeys.append(a)
+                    rkeys.append(b)
+                elif j in joined and i == nxt:
+                    lkeys.append(b)
+                    rkeys.append(a)
+            rnode = self.pool[nxt].plan.node
+            unique = _build_side_unique(rnode, rkeys, self.p.catalog)
+            plan = N.Join(
+                "inner",
+                plan,
+                rnode,
+                tuple(lkeys),
+                tuple(rkeys),
+                None,
+                unique,
+            )
+            joined.add(nxt)
+            remaining.discard(nxt)
+            est = max(est, self.pool[nxt].estimate)
+            # apply residuals that became fully available
+            joined_channels = set()
+            for i in joined:
+                joined_channels |= self.pool[i].channels
+            for k, (owners, e) in enumerate(residuals):
+                if k in applied_res:
+                    continue
+                if owners <= joined:
+                    plan = N.Filter(plan, e)
+                    applied_res.add(k)
+        for k, (owners, e) in enumerate(residuals):
+            if k not in applied_res:
+                plan = N.Filter(plan, e)
+        return plan, combined
+
+    def _record_correlation(self, e: ir.RowExpression, refs: set, inner_chs: set):
+        """Route a conjunct referencing outer channels to the enclosing
+        CorrelationCollector: equality pairs become decorrelation keys,
+        anything else a residual (used by EXISTS semi-joins)."""
+        coll = self.outer
+        if not isinstance(coll, CorrelationCollector):
+            raise PlanningError(
+                "correlated reference not supported in this context"
+            )
+        if isinstance(e, ir.Call) and e.name == "eq":
+            a, b = e.args
+            ra: set = set()
+            collect_channels(a, ra)
+            rb: set = set()
+            collect_channels(b, rb)
+            if ra <= inner_chs and not (rb & inner_chs) and isinstance(a, ir.ColumnRef):
+                coll.pairs.append((a, b))
+                return
+            if rb <= inner_chs and not (ra & inner_chs) and isinstance(b, ir.ColumnRef):
+                coll.pairs.append((b, a))
+                return
+        coll.residuals.append(e)
+
+
+def _selectivity(e: ir.RowExpression) -> float:
+    if isinstance(e, ir.Call):
+        if e.name == "eq":
+            return 0.05
+        if e.name in ("lt", "le", "gt", "ge", "between"):
+            return 0.35
+        if e.name == "like":
+            return 0.1
+        if e.name == "in":
+            return 0.2
+        if e.name == "and":
+            s = 1.0
+            for a in e.args:
+                s *= _selectivity(a)
+            return s
+    return 0.5
+
+
+def _scan_under_filters(node: N.PlanNode) -> Optional[N.TableScan]:
+    while isinstance(node, N.Filter):
+        node = node.child
+    return node if isinstance(node, N.TableScan) else None
+
+
+def _build_side_unique(node: N.PlanNode, keys, catalog: Catalog) -> bool:
+    """True if the join keys form a unique key of the build side."""
+    scan = _scan_under_filters(node)
+    if scan is None:
+        if isinstance(node, N.Aggregate):
+            # grouped output is unique on its group channels
+            key_chs = {k.name for k in keys if isinstance(k, ir.ColumnRef)}
+            return set(node.group_names) <= key_chs and len(keys) == len(
+                node.group_names
+            )
+        return False
+    cols = []
+    for k in keys:
+        if not isinstance(k, ir.ColumnRef):
+            return False
+        for ch, src, _ in scan.columns:
+            if ch == k.name:
+                cols.append(src)
+                break
+    key_set = set(cols)
+    for uniq in catalog.unique_columns(scan.table):
+        if set(uniq) <= key_set:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# expression translation
+# ---------------------------------------------------------------------------
+
+
+class PlanHolder:
+    def __init__(self, plan: N.PlanNode):
+        self.plan = plan
+
+
+class SelectContext:
+    """Translates AST expressions to RowExpressions against a scope chain.
+    Mutates `holder.plan` when subqueries require joins/applies. Records
+    outer-scope references for correlation detection."""
+
+    def __init__(
+        self,
+        planner: Planner,
+        scopes: List[Scope],
+        outer: Optional["SelectContext"],
+        ctes,
+        holder: Optional[PlanHolder],
+        agg_map: Optional[Dict] = None,
+    ):
+        self.p = planner
+        self.scopes = scopes
+        self.outer = outer
+        self.ctes = ctes
+        self.holder = holder
+        self.agg_map = agg_map or {}
+        self.outer_refs: List[ir.ColumnRef] = []
+
+    # -- scope chain resolution --
+    def resolve(self, parts) -> Tuple[FieldRef, bool]:
+        for s in self.scopes:
+            f = s.resolve(parts)
+            if f is not None:
+                return f, False
+        if self.outer is not None:
+            f, _ = self.outer.resolve(parts)
+            return f, True
+        raise PlanningError(f"cannot resolve column {'.'.join(parts)!r}")
+
+    def translate(self, ast: t.Node) -> ir.RowExpression:
+        e = self._tr(ast)
+        return e
+
+    def _tr(self, ast: t.Node) -> ir.RowExpression:
+        if ast in self.agg_map:
+            ch, typ = self.agg_map[ast]
+            return ir.ColumnRef(ch, typ)
+        if isinstance(ast, t.Identifier):
+            f, is_outer = self.resolve(ast.parts)
+            ref = ir.ColumnRef(f.channel, f.type)
+            if is_outer:
+                self.outer_refs.append(ref)
+            return ref
+        if isinstance(ast, t.NumberLiteral):
+            return _number_literal(ast.text)
+        if isinstance(ast, t.StringLiteral):
+            return ir.Literal(ast.value, T.VARCHAR)
+        if isinstance(ast, t.BooleanLiteral):
+            return ir.Literal(ast.value, T.BOOLEAN)
+        if isinstance(ast, t.NullLiteral):
+            return ir.Literal(None, T.UNKNOWN)
+        if isinstance(ast, t.DateLiteral):
+            return ir.Literal(ast.value, T.DATE)
+        if isinstance(ast, t.IntervalLiteral):
+            n = int(ast.value) * (-1 if ast.negative else 1)
+            if ast.unit in ("year", "month"):
+                months = n * (12 if ast.unit == "year" else 1)
+                return ir.Literal(months, T.INTERVAL_YEAR_MONTH)
+            if ast.unit == "day":
+                return ir.Literal(n, T.INTERVAL_DAY)
+            raise PlanningError(f"interval unit {ast.unit} not supported")
+        if isinstance(ast, t.UnaryOp):
+            v = self._tr(ast.operand)
+            if ast.op == "-":
+                return ir.Call("negate", (v,), v.type)
+            return v
+        if isinstance(ast, t.BinaryOp):
+            if isinstance(ast.right, t.ScalarSubquery):
+                right = self._scalar_subquery(ast.right)
+            else:
+                right = self._tr(ast.right)
+            if isinstance(ast.left, t.ScalarSubquery):
+                left = self._scalar_subquery(ast.left)
+            else:
+                left = self._tr(ast.left)
+            fn = _BINOP_FN[ast.op]
+            if ast.op in _CMP_OPS:
+                return ir.Call(fn, (left, right), T.BOOLEAN)
+            return ir.Call(
+                fn, (left, right), _infer(fn, (left.type, right.type))
+            )
+        if isinstance(ast, t.LogicalOp):
+            return ir.Call(ast.op, tuple(self._tr(x) for x in ast.terms), T.BOOLEAN)
+        if isinstance(ast, t.NotOp):
+            if isinstance(ast.operand, t.Exists):
+                return self._exists(ast.operand, negate=True)
+            if isinstance(ast.operand, t.InSubquery):
+                return self._in_subquery(ast.operand, negate=True)
+            return ir.not_(self._tr(ast.operand))
+        if isinstance(ast, t.IsNull):
+            inner = self._tr(ast.operand)
+            e = ir.is_null(inner)
+            return ir.not_(e) if ast.negated else e
+        if isinstance(ast, t.Between):
+            e = ir.between(self._tr(ast.value), self._tr(ast.low), self._tr(ast.high))
+            return ir.not_(e) if ast.negated else e
+        if isinstance(ast, t.InList):
+            v = self._tr(ast.value)
+            opts = tuple(self._tr(o) for o in ast.options)
+            e = ir.Call("in", (v,) + opts, T.BOOLEAN)
+            return ir.not_(e) if ast.negated else e
+        if isinstance(ast, t.Like):
+            v = self._tr(ast.value)
+            pat = self._tr(ast.pattern)
+            args = (v, pat)
+            if ast.escape is not None:
+                args = args + (self._tr(ast.escape),)
+            e = ir.Call("like", args, T.BOOLEAN)
+            return ir.not_(e) if ast.negated else e
+        if isinstance(ast, t.Case):
+            return self._case(ast)
+        if isinstance(ast, t.Cast):
+            v = self._tr(ast.operand)
+            to = T.parse_type(ast.type_name)
+            return ir.cast(v, to)
+        if isinstance(ast, t.Extract):
+            v = self._tr(ast.operand)
+            if ast.field not in ("year", "month", "day", "quarter"):
+                raise PlanningError(f"extract({ast.field}) not supported")
+            return ir.Call(ast.field, (v,), T.BIGINT)
+        if isinstance(ast, t.FunctionCall):
+            return self._function(ast)
+        if isinstance(ast, t.ScalarSubquery):
+            return self._scalar_subquery(ast)
+        if isinstance(ast, t.Exists):
+            return self._exists(ast, negate=False)
+        if isinstance(ast, t.InSubquery):
+            return self._in_subquery(ast, negate=ast.negated)
+        raise PlanningError(f"unsupported expression {type(ast).__name__}")
+
+    def _case(self, ast: t.Case) -> ir.RowExpression:
+        whens = []
+        for cond, val in ast.whens:
+            if ast.operand is not None:
+                c = ir.Call(
+                    "eq", (self._tr(ast.operand), self._tr(cond)), T.BOOLEAN
+                )
+            else:
+                c = self._tr(cond)
+            whens.append((c, self._tr(val)))
+        else_ = self._tr(ast.else_) if ast.else_ is not None else ir.Literal(None, T.UNKNOWN)
+        out_t = else_.type
+        for _, v in whens:
+            out_t = T.common_super_type(out_t, v.type)
+        args = []
+        for c, v in whens:
+            args += [c, v]
+        args.append(else_)
+        return ir.Call("case", tuple(args), out_t)
+
+    def _function(self, ast: t.FunctionCall) -> ir.RowExpression:
+        name = ast.name
+        if name in AGG_FUNCS:
+            raise PlanningError(
+                f"aggregate {name} in invalid context (window functions later)"
+            )
+        args = tuple(self._tr(a) for a in ast.args)
+        if name == "ceiling":
+            name = "ceil"
+        if name not in FUNCTIONS:
+            raise PlanningError(f"unknown function {name!r}")
+        return ir.Call(name, args, _infer(name, tuple(a.type for a in args)))
+
+    # -- subqueries --
+    def _plan_sub(self, q: t.Query):
+        sub_planner_ctx = SelectContext(self.p, self.scopes, self.outer, self.ctes, None)
+        rp = self.p.plan_query(q, sub_planner_ctx, self.ctes)
+        return rp, sub_planner_ctx
+
+    def _require_holder(self):
+        if self.holder is None:
+            raise PlanningError("subquery not allowed in this context")
+
+    def _scalar_subquery(self, ast: t.ScalarSubquery) -> ir.RowExpression:
+        self._require_holder()
+        sub = SubqueryPlanner(self.p, self, self.ctes)
+        return sub.plan_scalar(ast.query, self.holder)
+
+    def _exists(self, ast: t.Exists, negate: bool) -> Optional[ir.RowExpression]:
+        self._require_holder()
+        sub = SubqueryPlanner(self.p, self, self.ctes)
+        sub.plan_exists(ast.query, self.holder, anti=negate)
+        return None  # applied as a SemiJoin on the holder
+
+    def _in_subquery(self, ast: t.InSubquery, negate: bool) -> Optional[ir.RowExpression]:
+        self._require_holder()
+        value = self._tr(ast.value)
+        sub = SubqueryPlanner(self.p, self, self.ctes)
+        sub.plan_in(ast.query, value, self.holder, anti=negate)
+        return None
+
+    def translate_conjunct_or_apply(self, conj) -> Optional[ir.RowExpression]:
+        return self.translate(conj)
+
+
+def _number_literal(text: str) -> ir.Literal:
+    if "e" in text.lower():
+        return ir.Literal(float(text), T.DOUBLE)
+    if "." in text:
+        frac = text.split(".")[1]
+        scale = len(frac)
+        return ir.Literal(float(text), T.DecimalType(18, scale))
+    return ir.Literal(int(text), T.BIGINT)
+
+
+def _infer(fn: str, arg_types) -> T.Type:
+    from ..expr.functions import infer_call_type
+
+    return infer_call_type(fn, tuple(arg_types))
+
+
+# ---------------------------------------------------------------------------
+# subquery planning / decorrelation
+# ---------------------------------------------------------------------------
+
+
+class SubqueryPlanner:
+    """Plans subqueries appearing in expressions, decorrelating the
+    canonical TPC-H patterns (see module docstring)."""
+
+    def __init__(self, planner: Planner, parent_ctx: SelectContext, ctes):
+        self.p = planner
+        self.parent = parent_ctx
+        self.ctes = ctes
+
+    def _plan_with_correlation(self, q: t.Query):
+        """Plan `q` with the parent select as outer scope. Returns
+        (RelationPlan, correlations) where correlations are
+        (inner ColumnRef, outer RowExpression) equality pairs removed from
+        the subquery plan, plus residual correlated predicates."""
+        outer_ctx = self.parent
+        collector = CorrelationCollector(outer_ctx)
+        rp = self.p.plan_query(q, collector, self.ctes)
+        return rp, collector
+
+    def plan_scalar(self, q: t.Query, holder: PlanHolder) -> ir.RowExpression:
+        rp, corr = self._plan_with_correlation(q)
+        if len(rp.node.fields) != 1:
+            raise PlanningError("scalar subquery must return one column")
+        if corr.residuals:
+            raise PlanningError(
+                "correlated scalar subquery with non-equality correlation"
+            )
+        if not corr.pairs:
+            holder.plan = N.ScalarApply(holder.plan, rp.node)
+            (name, typ) = rp.node.fields[0]
+            return ir.ColumnRef(name, typ)
+        # correlated scalar aggregate -> group by correlation keys + left join
+        node = rp.node
+        out_name, out_type = node.fields[0]
+        node, group_refs = _regroup_for_correlation(node, corr.pairs)
+        holder.plan = N.Join(
+            "left",
+            holder.plan,
+            node,
+            tuple(outer for (_inner, outer) in corr.pairs),
+            tuple(group_refs),
+            None,
+            True,
+        )
+        return ir.ColumnRef(out_name, out_type)
+
+    def plan_exists(self, q: t.Query, holder: PlanHolder, anti: bool):
+        rp, corr = self._plan_with_correlation(q)
+        if not corr.pairs:
+            raise PlanningError("uncorrelated EXISTS not yet supported")
+        residual = None
+        if corr.residuals:
+            residual = (
+                ir.and_(*corr.residuals)
+                if len(corr.residuals) > 1
+                else corr.residuals[0]
+            )
+        # the EXISTS select list is irrelevant; the source plan must expose
+        # the correlation-key channels (and residual's inner channels), which
+        # the subquery's final Project may have dropped — e.g.
+        # `exists (select 1 from ...)`
+        needed = {inner.name for (inner, _o) in corr.pairs}
+        if residual is not None:
+            res_chs: set = set()
+            collect_channels(residual, res_chs)
+            # residuals mix probe- and source-side channels; only the ones
+            # not provided by the probe plan must come from the source
+            needed |= res_chs - set(holder.plan.field_names())
+        source = _ensure_channels(rp.node, needed)
+        holder.plan = N.SemiJoin(
+            holder.plan,
+            source,
+            tuple(outer for (_inner, outer) in corr.pairs),
+            tuple(inner for (inner, _outer) in corr.pairs),
+            anti=anti,
+            residual=residual,
+        )
+
+    def plan_in(self, q: t.Query, value: ir.RowExpression, holder: PlanHolder, anti: bool):
+        rp, corr = self._plan_with_correlation(q)
+        if corr.pairs or corr.residuals:
+            raise PlanningError("correlated IN subquery not yet supported")
+        if len(rp.node.fields) != 1:
+            raise PlanningError("IN subquery must return one column")
+        (name, typ) = rp.node.fields[0]
+        holder.plan = N.SemiJoin(
+            holder.plan,
+            rp.node,
+            (value,),
+            (ir.ColumnRef(name, typ),),
+            anti=anti,
+        )
+
+
+def _ensure_channels(node: N.PlanNode, needed: set) -> N.PlanNode:
+    """Make sure `needed` channels appear in the node's output, widening a
+    top Project (under optional Distinct/Limit wrappers) that dropped them.
+    The EXISTS rewrite only cares about existence, so for a bare Project we
+    can equivalently use its child."""
+    missing = needed - set(node.field_names())
+    if not missing:
+        return node
+    if isinstance(node, N.Project):
+        child_have = set(node.child.field_names())
+        if missing <= child_have:
+            extra = tuple(
+                ir.ColumnRef(ch, node.child.field_type(ch)) for ch in sorted(missing)
+            )
+            return N.Project(
+                node.child,
+                node.exprs + extra,
+                node.names + tuple(sorted(missing)),
+            )
+        return _ensure_channels(node.child, needed)
+    if isinstance(node, (N.Distinct, N.Limit)):
+        # existence is unchanged by dedup/limit's column set; recurse
+        inner = _ensure_channels(node.children[0], needed)
+        return inner
+    raise PlanningError(
+        f"EXISTS subquery does not expose correlation columns {sorted(missing)}"
+    )
+
+
+def _regroup_for_correlation(node: N.PlanNode, pairs):
+    """Rewrite a global-aggregate subquery plan into a grouped one over the
+    correlation keys (reference
+    TransformCorrelatedScalarAggregationToJoin.java). `pairs` items are
+    (inner ColumnRef, outer expr); inner refs must be available below the
+    Aggregate."""
+    proj = None
+    ag = node
+    if isinstance(ag, N.Project):
+        proj, ag = ag, ag.child
+    if not isinstance(ag, N.Aggregate) or ag.group_exprs:
+        raise PlanningError(
+            "correlated scalar subquery must be a single aggregate"
+        )
+    group_refs = tuple(inner for (inner, _outer) in pairs)
+    group_names = tuple(r.name for r in group_refs)
+    new_ag = N.Aggregate(ag.child, group_refs, group_names, ag.aggs)
+    if proj is not None:
+        new_node: N.PlanNode = N.Project(
+            new_ag,
+            proj.exprs + group_refs,
+            proj.names + group_names,
+        )
+    else:
+        new_node = new_ag
+    return new_node, group_refs
+
+
+class CorrelationCollector(SelectContext):
+    """Acts as the 'outer context' for a subquery plan: resolves outer
+    columns through the true parent and records correlation predicates.
+
+    The subquery's FromPlanner classifies each WHERE conjunct; conjuncts
+    referencing outer channels surface here via resolve(). The planner's
+    conjunct classification calls back into `note_correlated` through
+    translate when a conjunct mixes scopes.
+    """
+
+    def __init__(self, parent: SelectContext):
+        super().__init__(
+            parent.p, parent.scopes, parent.outer, parent.ctes, None
+        )
+        self.pairs: List[Tuple[ir.ColumnRef, ir.RowExpression]] = []
+        self.residuals: List[ir.RowExpression] = []

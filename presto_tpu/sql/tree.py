@@ -1,0 +1,275 @@
+"""SQL AST nodes.
+
+Compact equivalent of the reference's ~170 classes under
+presto-parser/src/main/java/com/facebook/presto/sql/tree/ — one frozen
+dataclass per construct, only the analytic-SELECT surface. Every node is
+hashable so analysis results can be keyed on nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    pass
+
+
+# -- expressions ------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Node):
+    """Column reference, possibly qualified: parts = ('t', 'c') or ('c',)."""
+
+    parts: Tuple[str, ...]
+
+    @property
+    def name(self) -> str:
+        return self.parts[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLiteral(Node):
+    text: str  # original text; analyzer decides integer/decimal/double
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLiteral(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanLiteral(Node):
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLiteral(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLiteral(Node):
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampLiteral(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLiteral(Node):
+    value: str  # e.g. '3'
+    unit: str  # day | month | year | hour | minute | second
+    negative: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # '-' | '+'
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str  # + - * / % || and comparisons = <> < <= > >=
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalOp(Node):
+    op: str  # and | or
+    terms: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class NotOp(Node):
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Node):
+    operand: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    options: Tuple[Node, ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Node):
+    value: Node
+    pattern: Node
+    escape: Optional[Node] = None
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionCall(Node):
+    name: str  # lowercase
+    args: Tuple[Node, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+    window: Optional["WindowSpec"] = None
+    filter: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec(Node):
+    partition_by: Tuple[Node, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    frame: Optional[Tuple[str, str, str]] = None  # (type, start, end)
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Node):
+    operand: Optional[Node]  # simple CASE operand or None for searched
+    whens: Tuple[Tuple[Node, Node], ...]
+    else_: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Node):
+    operand: Node
+    type_name: str
+    try_cast: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Node):
+    field: str  # year | quarter | month | day | ...
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Node):
+    qualifier: Optional[str] = None  # t.* has qualifier 't'
+
+
+# -- relations --------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Table(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRelation(Node):
+    query: "Query"
+    alias: str
+    column_aliases: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(Node):
+    kind: str  # inner | left | right | full | cross
+    left: Node
+    right: Node
+    condition: Optional[Node] = None  # ON expr
+    using: Tuple[str, ...] = ()
+
+
+# -- query structure --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Select(Node):
+    items: Tuple[Node, ...]  # SelectItem | Star
+    from_: Optional[Node]  # relation tree or None (SELECT 1)
+    where: Optional[Node] = None
+    group_by: Tuple[Node, ...] = ()
+    having: Optional[Node] = None
+    distinct: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class WithItem(Node):
+    name: str
+    query: "Query"
+    column_aliases: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Node):
+    """Full query: [WITH ...] body [ORDER BY ...] [LIMIT n]."""
+
+    body: Node  # Select | SetOperation
+    with_items: Tuple[WithItem, ...] = ()
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOperation(Node):
+    op: str  # union | union_all | intersect | except
+    left: Node  # Select | SetOperation
+    right: Node
+
+
+# -- statements beyond SELECT ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    query: Query
+    analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowColumns(Node):
+    table: str

@@ -1,0 +1,10 @@
+"""Testing utilities: the SQL correctness oracle + assertion helpers.
+
+Equivalent of the reference's presto-tests harness: QueryAssertions runs
+each query on Presto AND on H2 and diffs results
+(presto-tests/.../QueryAssertions.java:94-116, H2QueryRunner). Here the
+oracle is SQLite (in stdlib), with a small dialect transpiler for the
+date/interval/extract constructs SQLite lacks.
+"""
+
+from .oracle import SqliteOracle, assert_same_results  # noqa: F401

@@ -1,0 +1,271 @@
+"""SQLite correctness oracle.
+
+Loads generated TPC-H tables into an in-memory SQLite database (dates as
+ISO text, decimals as REAL) and runs a lightly transpiled version of each
+query. Results are compared with type-aware tolerances: decimal columns
+allow half-ulp-of-scale slack (our engine rounds HALF_UP in scaled ints,
+SQLite computes in binary floats), doubles compare relatively, everything
+else exactly.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+import sqlite3
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..connectors import tpch
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def _decode_column(col: tpch.Column) -> list:
+    if isinstance(col.type, T.VarcharType):
+        d = col.dictionary
+        codes = col.data.tolist()
+        if d is None:
+            return codes
+        cache: Dict[int, str] = {}
+        out = []
+        for c in codes:
+            s = cache.get(c)
+            if s is None:
+                s = d[c]
+                cache[c] = s
+            out.append(s)
+        return out
+    if isinstance(col.type, T.DateType):
+        base = datetime.date(1970, 1, 1)
+        return [
+            (base + datetime.timedelta(days=int(v))).isoformat()
+            for v in col.data.tolist()
+        ]
+    if isinstance(col.type, T.DecimalType):
+        s = 10**col.type.scale
+        return [v / s for v in col.data.tolist()]
+    return col.data.tolist()
+
+
+_INDEXES = {
+    "lineitem": ["l_orderkey", "l_partkey", "l_suppkey", "l_shipdate"],
+    "orders": ["o_orderkey", "o_custkey", "o_orderdate"],
+    "customer": ["c_custkey", "c_nationkey"],
+    "part": ["p_partkey"],
+    "partsupp": ["ps_partkey", "ps_suppkey"],
+    "supplier": ["s_suppkey", "s_nationkey"],
+    "nation": ["n_nationkey", "n_regionkey"],
+    "region": ["r_regionkey"],
+}
+
+
+class SqliteOracle:
+    def __init__(self, sf: float = 0.01, tables: Optional[Sequence[str]] = None):
+        self.conn = sqlite3.connect(":memory:")
+        for name in tables or tpch.TABLE_NAMES:
+            t = tpch.table(name, sf)
+            cols = list(t.columns.keys())
+            self.conn.execute(
+                f"CREATE TABLE {name} ({', '.join(cols)})"
+            )
+            data = [_decode_column(c) for c in t.columns.values()]
+            rows = list(zip(*data))
+            self.conn.executemany(
+                f"INSERT INTO {name} VALUES ({', '.join('?' * len(cols))})",
+                rows,
+            )
+            for c in _INDEXES.get(name, []):
+                self.conn.execute(f"CREATE INDEX idx_{name}_{c} ON {name}({c})")
+        self.conn.commit()
+
+    def query(self, sql: str) -> List[tuple]:
+        cur = self.conn.execute(transpile(sql))
+        return [tuple(r) for r in cur.fetchall()]
+
+
+# ---------------------------------------------------------------------------
+# dialect transpiler (TPC-H constructs SQLite lacks)
+# ---------------------------------------------------------------------------
+
+_DATE_ARith = re.compile(
+    r"date\s*'(\d{4}-\d{2}-\d{2})'\s*([+-])\s*interval\s*'(\d+)'\s*(day|month|year)",
+    re.IGNORECASE,
+)
+_DATE_LIT = re.compile(r"date\s*'(\d{4}-\d{2}-\d{2})'", re.IGNORECASE)
+_EXTRACT = re.compile(r"extract\s*\(\s*(year|month|day)\s+from\s+", re.IGNORECASE)
+_SUBSTRING = re.compile(
+    r"substring\s*\(\s*([A-Za-z_][\w.]*)\s+from\s+(\d+)\s+for\s+(\d+)\s*\)",
+    re.IGNORECASE,
+)
+
+_FMT = {"year": "%Y", "month": "%m", "day": "%d"}
+
+
+def _add_interval(date_str: str, sign: str, n: int, unit: str) -> str:
+    d = datetime.date.fromisoformat(date_str)
+    k = -n if sign == "-" else n
+    if unit == "day":
+        d = d + datetime.timedelta(days=k)
+    elif unit == "month":
+        m = d.month - 1 + k
+        d = d.replace(year=d.year + m // 12, month=m % 12 + 1)
+    else:
+        d = d.replace(year=d.year + k)
+    return d.isoformat()
+
+
+# constant decimal arithmetic folded exactly: SQLite evaluates 0.06 + 0.01
+# in binary floats (0.069999...), silently corrupting decimal-boundary
+# predicates like Q6's BETWEEN. Both operands must be literals and the
+# expression must sit right after a token that makes precedence unambiguous.
+_CONST_FOLD = re.compile(
+    r"(\(|=|<|>|,|\bbetween\b|\band\b|\bthen\b|\belse\b|\bwhen\b)"
+    r"(\s*)(\d+(?:\.\d+)?)\s*([-+*/])\s*(\d+(?:\.\d+)?)",
+    re.IGNORECASE,
+)
+
+_DERIVED_ALIAS = re.compile(r"\)\s*as\s+(\w+)\s*\(([\w\s,]*)\)", re.IGNORECASE)
+
+
+def _fold_constants(sql: str) -> str:
+    from decimal import Decimal
+
+    def fold(m):
+        a, op, b = Decimal(m.group(3)), m.group(4), Decimal(m.group(5))
+        v = {
+            "+": a + b,
+            "-": a - b,
+            "*": a * b,
+            "/": a / b if b != 0 else None,
+        }[op]
+        if v is None:
+            return m.group(0)
+        return f"{m.group(1)}{m.group(2)}{v}"
+
+    prev = None
+    while prev != sql:
+        prev = sql
+        sql = _CONST_FOLD.sub(fold, sql)
+    return sql
+
+
+def transpile(sql: str) -> str:
+    def arith(m):
+        return "'" + _add_interval(
+            m.group(1), m.group(2), int(m.group(3)), m.group(4).lower()
+        ) + "'"
+
+    out = _DATE_ARith.sub(arith, sql)
+    out = _DATE_LIT.sub(lambda m: f"'{m.group(1)}'", out)
+    out = _fold_constants(out)
+    # SQLite lacks derived column aliases `AS t (c1, c2)` — rely on inner
+    # select aliases matching instead
+    out = _DERIVED_ALIAS.sub(lambda m: f") as {m.group(1)}", out)
+
+    # extract(year from X) -> cast(strftime('%Y', X) as integer); need to
+    # find the matching close paren
+    while True:
+        m = _EXTRACT.search(out)
+        if not m:
+            break
+        start = m.end()
+        depth = 1
+        i = start
+        while depth > 0:
+            if out[i] == "(":
+                depth += 1
+            elif out[i] == ")":
+                depth -= 1
+            i += 1
+        inner = out[start : i - 1]
+        field = m.group(1).lower()
+        repl = f"cast(strftime('{_FMT[field]}', {inner}) as integer)"
+        out = out[: m.start()] + repl + out[i:]
+
+    out = _SUBSTRING.sub(lambda m: f"substr({m.group(1)}, {m.group(2)}, {m.group(3)})", out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# result comparison
+# ---------------------------------------------------------------------------
+
+
+def _canon(v):
+    import decimal
+
+    if v is None:
+        return None
+    if isinstance(v, decimal.Decimal):
+        return float(v)
+    if isinstance(v, np.datetime64):
+        return str(v)[:10]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    return v
+
+
+def _sort_key(row):
+    out = []
+    for v in row:
+        if v is None:
+            out.append((0, ""))
+        elif isinstance(v, (int, float)):
+            out.append((1, round(float(v), 4)))
+        else:
+            out.append((2, str(v)))
+    return tuple(out)
+
+
+def _value_close(a, b, tol: float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        a, b = float(a), float(b)
+        return abs(a - b) <= max(tol, 1e-9 * max(abs(a), abs(b)))
+    return a == b
+
+
+def assert_same_results(
+    ours: List[tuple],
+    oracle: List[tuple],
+    types: Optional[Sequence[T.Type]] = None,
+    ordered: bool = False,
+):
+    """Diff engine results against the oracle (reference
+    QueryAssertions.assertEqualsIgnoreOrder semantics + tolerance)."""
+    a = [tuple(_canon(v) for v in r) for r in ours]
+    b = [tuple(_canon(v) for v in r) for r in oracle]
+    if not ordered:
+        a = sorted(a, key=_sort_key)
+        b = sorted(b, key=_sort_key)
+    assert len(a) == len(b), f"row count {len(a)} != oracle {len(b)}\nours[:5]={a[:5]}\noracle[:5]={b[:5]}"
+    tols = []
+    ncols = len(a[0]) if a else 0
+    for i in range(ncols):
+        tol = 1e-9
+        if types is not None and i < len(types):
+            ty = types[i]
+            if isinstance(ty, T.DecimalType):
+                tol = 0.5 * 10 ** (-ty.scale) + 1e-9
+            elif T.is_floating(ty):
+                tol = 1e-6
+        else:
+            tol = 1e-6
+        tols.append(tol)
+    for ri, (ra, rb) in enumerate(zip(a, b)):
+        for ci, (va, vb) in enumerate(zip(ra, rb)):
+            assert _value_close(va, vb, tols[ci] if ci < len(tols) else 1e-6), (
+                f"row {ri} col {ci}: {va!r} != oracle {vb!r}\n"
+                f"ours: {ra}\noracle: {rb}"
+            )

@@ -1,0 +1,38 @@
+"""TPC-H Q1-Q22 end-to-end vs the SQLite oracle.
+
+The reference's AbstractTestQueries pattern (presto-tests/.../
+AbstractTestQueries.java — same SQL on the engine and on H2, diff results)
+instantiated for the embedded tpch catalog at SF 0.01."""
+
+import pytest
+
+from presto_tpu.benchmark.tpch_sql import QUERIES
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+from presto_tpu.testing.oracle import SqliteOracle, assert_same_results
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(TpchCatalog(sf=SF))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle(sf=SF)
+
+
+def run_query(session, oracle, qid):
+    sql = QUERIES[qid]
+    result = session.query(sql)
+    expected = oracle.query(sql)
+    types = [b.type for b in result.page.blocks]
+    assert_same_results(result.rows(), expected, types, ordered=False)
+    assert result.row_count() > 0 or len(expected) == 0
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpch_query(session, oracle, qid):
+    run_query(session, oracle, qid)
