@@ -60,9 +60,24 @@ def _unify_block_dictionaries(blocks):
 
 
 class Executor:
-    def __init__(self, catalog, shrink: bool = True):
+    def __init__(self, catalog, shrink: bool = True, jit: bool = True):
         self.catalog = catalog
         self.shrink = shrink
+        self.jit = jit
+        # (plan node, static params) -> jitted kernel; the analog of the
+        # reference caching compiled PageProcessors per plan
+        # (LocalExecutionPlanner compiles once, Drivers reuse)
+        self._kernels: Dict = {}
+
+    def _kernel(self, key, make_fn):
+        """Compile-once cache for per-node kernels. jax.jit retraces per
+        input shape bucket automatically; `key` carries the static config
+        (the node itself plus capacity-like ints)."""
+        fn = self._kernels.get(key)
+        if fn is None:
+            fn = jax.jit(make_fn()) if self.jit else make_fn()
+            self._kernels[key] = fn
+        return fn
 
     # -- public --
     def run(self, node: N.PlanNode) -> Page:
@@ -105,11 +120,15 @@ class Executor:
     # -- stateless row ops --
     def _run_filter(self, node: N.Filter) -> Page:
         page = self._run(node.child)
-        return self._shrink(filter_page(page, node.predicate))
+        fn = self._kernel(node, lambda: lambda p: filter_page(p, node.predicate))
+        return self._shrink(fn(page))
 
     def _run_project(self, node: N.Project) -> Page:
         page = self._run(node.child)
-        return project_page(page, node.exprs, node.names)
+        fn = self._kernel(
+            node, lambda: lambda p: project_page(p, node.exprs, node.names)
+        )
+        return fn(page)
 
     def _run_output(self, node: N.Output) -> Page:
         page = self._run(node.child)
@@ -120,15 +139,21 @@ class Executor:
     def _run_aggregate(self, node: N.Aggregate) -> Page:
         page = self._run(node.child)
         if not node.group_exprs:
-            return global_aggregate(page, node.aggs)
+            fn = self._kernel(node, lambda: lambda p: global_aggregate(p, node.aggs))
+            return fn(page)
         # groups <= live rows; guess low and retry with the true group count
         # (returned regardless of the bound) on overflow — the adaptive-
         # capacity pattern used by all static-shape operators here
         max_groups = round_capacity(min(max(int(page.count), 1), 1 << 16))
         while True:
-            out = grouped_aggregate_sorted(
-                page, node.group_exprs, node.group_names, node.aggs, max_groups
+            mg = max_groups
+            fn = self._kernel(
+                (node, mg),
+                lambda: lambda p: grouped_aggregate_sorted(
+                    p, node.group_exprs, node.group_names, node.aggs, mg
+                ),
             )
+            out = fn(page)
             true_groups = int(out.count)
             if true_groups <= max_groups:
                 break
@@ -137,8 +162,8 @@ class Executor:
 
     def _run_distinct(self, node: N.Distinct) -> Page:
         page = self._run(node.child)
-        out = distinct_page(page, page.capacity)
-        return self._shrink(out)
+        fn = self._kernel(node, lambda: lambda p: distinct_page(p, p.capacity))
+        return self._shrink(fn(page))
 
     # -- joins --
     def _run_join(self, node: N.Join) -> Page:
@@ -146,15 +171,18 @@ class Executor:
         right = self._run(node.right)
         right_names = right.names
         if node.unique_build:
-            bs = build(right, node.right_keys)
-            out = join_n1(
-                left,
-                bs,
-                node.left_keys,
-                right_names,
-                right_names,
-                kind=node.kind,
+            fn = self._kernel(
+                (node, "n1"),
+                lambda: lambda l, r: join_n1(
+                    l,
+                    build(r, node.right_keys),
+                    node.left_keys,
+                    right_names,
+                    right_names,
+                    kind=node.kind,
+                ),
             )
+            out = fn(left, right)
             if node.residual is not None:
                 if node.kind != "inner":
                     raise ExecutionError(
@@ -163,18 +191,22 @@ class Executor:
                 out = filter_page(out, node.residual)
             return self._shrink(out)
         # general 1:N expansion with adaptive capacity retry
-        bs = build(right, node.right_keys)
         cap = round_capacity(max(int(left.count), 1))
         while True:
-            out, overflow = join_expand(
-                left,
-                bs,
-                node.left_keys,
-                left.names,
-                [(n, n) for n in right_names],
-                out_capacity=cap,
-                kind=node.kind,
+            c = cap
+            fn = self._kernel(
+                (node, "expand", c),
+                lambda: lambda l, r: join_expand(
+                    l,
+                    build(r, node.right_keys),
+                    node.left_keys,
+                    l.names,
+                    [(n, n) for n in right_names],
+                    out_capacity=c,
+                    kind=node.kind,
+                ),
             )
+            out, overflow = fn(left, right)
             if int(overflow) == 0:
                 break
             cap = round_capacity(cap + int(overflow))
@@ -287,12 +319,30 @@ class Executor:
             names.append(fname)
         return Page(tuple(blocks), tuple(names), page.count)
 
+    def _run_window(self, node: N.Window) -> Page:
+        from ..ops.window import window_op
+
+        page = self._run(node.child)
+        fn = self._kernel(
+            node,
+            lambda: lambda p: window_op(
+                p, node.partition_exprs, node.order_keys, node.funcs
+            ),
+        )
+        return fn(page)
+
     # -- ordering / limits --
     def _run_sort(self, node: N.Sort) -> Page:
-        return sort_page(self._run(node.child), node.keys)
+        page = self._run(node.child)
+        fn = self._kernel(node, lambda: lambda p: sort_page(p, node.keys))
+        return fn(page)
 
     def _run_topn(self, node: N.TopN) -> Page:
-        return top_n(self._run(node.child), node.keys, node.count)
+        page = self._run(node.child)
+        fn = self._kernel(
+            node, lambda: lambda p: top_n(p, node.keys, node.count)
+        )
+        return fn(page)
 
     def _run_limit(self, node: N.Limit) -> Page:
         return self._shrink(limit_page(self._run(node.child), node.count))

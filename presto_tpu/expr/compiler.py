@@ -133,10 +133,10 @@ def _literal_val(expr: Literal, cap: int) -> Val:
         )
     if isinstance(t, T.VarcharType):
         did = intern_dictionary((expr.value,))
-        return Val(jnp.zeros(cap, jnp.int32), None, t, did)
+        return Val(jnp.zeros(cap, jnp.int32), None, t, did, literal=expr.value)
     if isinstance(t, T.DateType) and isinstance(expr.value, str):
         days = dt.parse_date_literal(expr.value)
-        return Val(jnp.full(cap, days, jnp.int32), None, t)
+        return Val(jnp.full(cap, days, jnp.int32), None, t, literal=days)
     if isinstance(t, T.DecimalType):
         # any numeric literal -> scaled int in the decimal's units
         from decimal import Decimal
@@ -144,8 +144,10 @@ def _literal_val(expr: Literal, cap: int) -> Val:
         scaled = int(
             (Decimal(str(expr.value)) * (10**t.scale)).to_integral_value()
         )
-        return Val(jnp.full(cap, scaled, jnp.int64), None, t)
-    return Val(jnp.full(cap, expr.value, t.storage_dtype), None, t)
+        return Val(jnp.full(cap, scaled, jnp.int64), None, t, literal=expr.value)
+    return Val(
+        jnp.full(cap, expr.value, t.storage_dtype), None, t, literal=expr.value
+    )
 
 
 def _kleene_and(vals: Sequence[Val]) -> Val:

@@ -35,12 +35,18 @@ from . import datetime_kernels as dt
 
 @dataclasses.dataclass
 class Val:
-    """A vectorized SQL value during expression tracing."""
+    """A vectorized SQL value during expression tracing.
+
+    `literal` holds the Python value when this Val came from a constant —
+    functions with literal-only arguments (LIKE patterns, substr positions)
+    must read it instead of peeking device data, which would fail under a
+    jit trace."""
 
     data: jnp.ndarray
     valid: Optional[jnp.ndarray]  # None = no nulls
     type: T.Type
     dict_id: Optional[int] = None
+    literal: object = None
 
     @property
     def dictionary(self) -> Optional[Tuple[str, ...]]:
@@ -477,12 +483,18 @@ def _round_infer(ts):
     return a
 
 
+def _require_literal(v: Val, what: str):
+    if v.literal is None:
+        raise NotImplementedError(f"{what} requires a literal argument")
+    return v.literal
+
+
 @register("round", _round_infer)
 def _round(a: Val, *rest, out_type: T.Type) -> Val:
     ndigits = 0
     if rest:
         (nd,) = rest
-        ndigits = int(np.asarray(nd.data).reshape(-1)[0])  # literal only
+        ndigits = int(_require_literal(nd, "round precision"))
     if T.is_floating(a.type):
         f = 10.0**ndigits
         return Val(_round_half_away(a.data * f) / f, a.valid, T.DOUBLE)
@@ -570,8 +582,8 @@ def _length(a: Val, out_type: T.Type) -> Val:
 
 @register("substr", _varchar_infer)
 def _substr(a: Val, start: Val, *rest, out_type: T.Type) -> Val:
-    s0 = int(np.asarray(start.data).reshape(-1)[0])  # literal positions only
-    ln = int(np.asarray(rest[0].data).reshape(-1)[0]) if rest else None
+    s0 = int(_require_literal(start, "substr start"))
+    ln = int(_require_literal(rest[0], "substr length")) if rest else None
 
     def f(s: str) -> str:
         i = s0 - 1 if s0 > 0 else len(s) + s0
@@ -627,17 +639,17 @@ def like_pattern_to_regex(pattern: str, escape: Optional[str] = None) -> "re.Pat
 
 @register("like", _bool_infer)
 def _like(a: Val, pattern: Val, *rest, out_type: T.Type) -> Val:
-    pat = pattern.dictionary[int(np.asarray(pattern.data).reshape(-1)[0])]
+    pat = _require_literal(pattern, "LIKE pattern")
     esc = None
     if rest:
-        esc = rest[0].dictionary[int(np.asarray(rest[0].data).reshape(-1)[0])]
+        esc = _require_literal(rest[0], "LIKE escape")
     rx = like_pattern_to_regex(pat, esc)
     return _dict_predicate(a, lambda s: rx.fullmatch(s) is not None)
 
 
 @register("strpos", _bigint_infer)
 def _strpos(a: Val, needle: Val, out_type: T.Type) -> Val:
-    n = needle.dictionary[int(np.asarray(needle.data).reshape(-1)[0])]
+    n = _require_literal(needle, "strpos needle")
     d = a.dictionary or ()
     table = jnp.asarray(np.array([s.find(n) + 1 for s in d], np.int64))
     return Val(table[a.data], a.valid, T.BIGINT)

@@ -171,6 +171,28 @@ class ScalarApply(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Window(PlanNode):
+    """Window functions over one (partition, order) spec (reference
+    WindowNode). Output = child fields + one field per function; rows come
+    out sorted by (partition, order)."""
+
+    child: PlanNode
+    partition_exprs: Tuple[RowExpression, ...]
+    order_keys: Tuple[SortKey, ...]
+    funcs: Tuple[object, ...]  # ops.window.WindowFunc
+
+    @property
+    def fields(self):
+        return self.child.fields + tuple(
+            (f.name, f.output_type) for f in self.funcs
+        )
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Sort(PlanNode):
     child: PlanNode
     keys: Tuple[SortKey, ...]

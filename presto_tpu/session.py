@@ -45,7 +45,9 @@ class Session:
         scope = rp.scope
         channels = tuple(f.channel for f in scope.fields)
         titles = tuple(f.name for f in scope.fields)
-        return N.Output(rp.node, channels, titles)
+        from .plan.optimizer import optimize
+
+        return optimize(N.Output(rp.node, channels, titles))
 
     def explain(self, sql: str) -> str:
         return N.plan_tree_str(self.plan(sql))

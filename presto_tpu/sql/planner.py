@@ -350,6 +350,19 @@ class Planner:
             pred = sctx.translate(sel.having)
             holder.plan = N.Filter(holder.plan, pred)
 
+        # window functions: computed after WHERE/GROUP BY/HAVING, before the
+        # final projection (reference WindowNode placement in LogicalPlanner)
+        window_calls: List[t.FunctionCall] = []
+        for item in items:
+            _collect_windows(item.expr, window_calls)
+        if window_calls:
+            if agg_calls or sel.group_by:
+                raise PlanningError(
+                    "window functions over aggregated queries not yet supported"
+                )
+            win_map = self._plan_windows(window_calls, sctx, holder)
+            sctx.agg_map.update(win_map)
+
         # final projection
         out_exprs: List[ir.RowExpression] = []
         out_names: List[str] = []
@@ -380,6 +393,78 @@ class Planner:
             else:
                 out.append(item)
         return out
+
+    def _plan_windows(self, calls, sctx, holder) -> Dict:
+        """Group window calls by spec, append one Window node per spec."""
+        from ..ops.window import AGGREGATE, OFFSET, RANKING, VALUE, WindowFunc
+
+        win_map: Dict[t.Node, Tuple[str, T.Type]] = {}
+        by_spec: Dict[t.WindowSpec, List[t.FunctionCall]] = {}
+        for c in calls:
+            by_spec.setdefault(c.window, []).append(c)
+        for spec, group in by_spec.items():
+            part = tuple(sctx.translate(p) for p in spec.partition_by)
+            order = tuple(
+                SortKey(sctx.translate(si.expr), si.ascending, si.nulls_first)
+                for si in spec.order_by
+            )
+            running_default = bool(spec.order_by)
+            if spec.frame is not None:
+                ftype, fstart, fend = spec.frame
+                if fstart != "unbounded preceding" or fend not in (
+                    "current row",
+                    "unbounded following",
+                ):
+                    raise PlanningError(
+                        f"window frame {spec.frame} not yet supported"
+                    )
+                running_default = fend == "current row"
+            funcs = []
+            for c in group:
+                if c in win_map:
+                    continue
+                name = c.name
+                ch = self.channel(name)
+                if name in ("row_number", "rank", "dense_rank"):
+                    wf = WindowFunc(name, None, ch, T.BIGINT)
+                elif name in ("percent_rank", "cume_dist"):
+                    wf = WindowFunc(name, None, ch, T.DOUBLE)
+                elif name == "ntile":
+                    n = c.args[0]
+                    if not isinstance(n, t.NumberLiteral):
+                        raise PlanningError("ntile requires a literal count")
+                    wf = WindowFunc(name, None, ch, T.BIGINT, offset=int(n.text))
+                elif name in OFFSET:
+                    inp = sctx.translate(c.args[0])
+                    off = 1
+                    if len(c.args) > 1:
+                        if not isinstance(c.args[1], t.NumberLiteral):
+                            raise PlanningError(f"{name} offset must be literal")
+                        off = int(c.args[1].text)
+                    if len(c.args) > 2:
+                        raise PlanningError(f"{name} default value not yet supported")
+                    wf = WindowFunc(name, inp, ch, inp.type, offset=off)
+                elif name in VALUE:
+                    inp = sctx.translate(c.args[0])
+                    wf = WindowFunc(name, inp, ch, inp.type)
+                elif name in AGGREGATE:
+                    if c.is_star:
+                        inp = None
+                        func = "count"
+                        out_t = T.BIGINT
+                    else:
+                        inp = sctx.translate(c.args[0])
+                        func = "count" if name == "count" else name
+                        out_t = AggSpec.infer_output_type(func, inp.type)
+                    wf = WindowFunc(
+                        func, inp, ch, out_t, running=running_default
+                    )
+                else:
+                    raise PlanningError(f"unknown window function {name!r}")
+                funcs.append(wf)
+                win_map[c] = (ch, wf.output_type)
+            holder.plan = N.Window(holder.plan, part, order, tuple(funcs))
+        return win_map
 
     def _plan_aggregates(self, agg_calls, sctx) -> Tuple[List[AggSpec], Dict]:
         aggs: List[AggSpec] = []
@@ -459,6 +544,23 @@ def _derive_name(expr: t.Node) -> Optional[str]:
     if isinstance(expr, t.FunctionCall):
         return expr.name
     return None
+
+
+def _collect_windows(expr: t.Node, out: List[t.FunctionCall]):
+    """Find window function calls (FunctionCall with an OVER clause)."""
+    if isinstance(expr, t.FunctionCall) and expr.window is not None:
+        out.append(expr)
+        return
+    if isinstance(expr, (t.ScalarSubquery, t.InSubquery, t.Exists)):
+        return
+    for f in dataclasses.fields(expr):
+        v = getattr(expr, f.name)
+        if isinstance(v, t.Node):
+            _collect_windows(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node):
+                    _collect_windows(x, out)
 
 
 def _collect_aggregates(expr: t.Node, out: List[t.FunctionCall]):

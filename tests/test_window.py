@@ -1,0 +1,129 @@
+"""Window functions vs the SQLite oracle (reference TestWindowOperator +
+AbstractTestWindowQueries pattern)."""
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+from presto_tpu.testing.oracle import SqliteOracle, assert_same_results
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(TpchCatalog(sf=SF))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle(sf=SF, tables=["orders", "customer", "supplier", "nation"])
+
+
+def check(session, oracle, sql):
+    ours = session.query(sql)
+    expected = oracle.query(sql)
+    types = [b.type for b in ours.page.blocks]
+    assert_same_results(ours.rows(), expected, types)
+
+
+RANKING_SQL = """
+select o_custkey, o_orderkey,
+       row_number() over (partition by o_custkey order by o_orderdate, o_orderkey) as rn,
+       rank() over (partition by o_custkey order by o_orderdate) as rk,
+       dense_rank() over (partition by o_custkey order by o_orderdate) as drk
+from orders where o_custkey < 50
+"""
+
+
+def test_ranking_functions(session, oracle):
+    check(session, oracle, RANKING_SQL)
+
+
+def test_partition_aggregate(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey, o_custkey,
+               sum(o_totalprice) over (partition by o_custkey) as tot,
+               count(*) over (partition by o_custkey) as cnt,
+               min(o_totalprice) over (partition by o_custkey) as mn,
+               max(o_totalprice) over (partition by o_custkey) as mx
+        from orders where o_custkey < 100
+        """,
+    )
+
+
+def test_running_sum(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               sum(o_totalprice) over (partition by o_custkey
+                                       order by o_orderkey) as running
+        from orders where o_custkey < 100
+        """,
+    )
+
+
+def test_running_min_max(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               min(o_totalprice) over (partition by o_custkey order by o_orderkey) as rmn,
+               max(o_totalprice) over (partition by o_custkey order by o_orderkey) as rmx
+        from orders where o_custkey < 100
+        """,
+    )
+
+
+def test_lag_lead(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               lag(o_orderkey) over (partition by o_custkey order by o_orderkey) as prev_k,
+               lead(o_orderkey, 2) over (partition by o_custkey order by o_orderkey) as next2
+        from orders where o_custkey < 100
+        """,
+    )
+
+
+def test_first_value(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               first_value(o_orderdate) over (partition by o_custkey
+                                              order by o_orderkey) as first_d
+        from orders where o_custkey < 100
+        """,
+    )
+
+
+def test_ntile_global_window(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey, ntile(4) over (order by o_orderkey) as q
+        from orders where o_custkey < 40
+        """,
+    )
+
+
+def test_rank_no_partition(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select s_suppkey, rank() over (order by s_nationkey) as rk
+        from supplier
+        """,
+    )
