@@ -12,12 +12,16 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
-# splitmix64 constants; arithmetic in uint64 wraps mod 2^64
-_C1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_C2 = jnp.uint64(0x94D049BB133111EB)
-_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
-_NULL_HASH = jnp.uint64(0x9AE16A3B2F90404F)
+# splitmix64 constants; arithmetic in uint64 wraps mod 2^64.
+# numpy scalars, NOT jnp arrays: creating a device array at module import
+# would force JAX backend initialization during `import presto_tpu`, which
+# wedges driver entry points before they can select a platform.
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_NULL_HASH = np.uint64(0x9AE16A3B2F90404F)
 
 
 def mix64(x):

@@ -30,6 +30,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import types as T
 from ..expr.compiler import evaluate
@@ -37,7 +38,8 @@ from ..expr.functions import Val, and_valid
 from ..page import Block, Page
 from .hashing import hash_rows
 
-MAX_HASH = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+# numpy scalar (not a device array) so importing this module does no device work
+MAX_HASH = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 @dataclasses.dataclass
