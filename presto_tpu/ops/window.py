@@ -52,7 +52,11 @@ class WindowFunc:
 
 
 def _sort_for_window(page: Page, partition_exprs, order_keys: Sequence[SortKey]):
-    """Permutation ordering rows by (partition hash, order keys); dead last."""
+    """Permutation ordering rows by (partition hash, raw partition keys,
+    order keys); dead last. The raw keys are stable tie-break passes after
+    the hash so two distinct partition values that collide in the 64-bit
+    hash still cluster contiguously — _partition_bounds detects boundaries
+    by value change and would otherwise fragment both partitions."""
     from .sort import sort_permutation
 
     perm = sort_permutation(page, order_keys) if order_keys else jnp.argsort(
@@ -60,6 +64,10 @@ def _sort_for_window(page: Page, partition_exprs, order_keys: Sequence[SortKey])
     )
     if partition_exprs:
         pkeys = [evaluate(e, page) for e in partition_exprs]
+        for v in pkeys:  # least-significant tie-breaks first (stable sorts)
+            perm = perm[jnp.argsort(v.data[perm], stable=True)]
+            if v.valid is not None:
+                perm = perm[jnp.argsort(v.valid[perm], stable=True)]
         h = hash_rows(pkeys)
         hp = h[perm]
         order = jnp.argsort(hp, stable=True)

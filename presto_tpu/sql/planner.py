@@ -190,10 +190,15 @@ class Planner:
             if f is not None:
                 return ir.ColumnRef(f.channel, f.type)
         if isinstance(ast, t.NumberLiteral) and "." not in ast.text:
-            idx = int(ast.text) - 1
-            f = scope.fields[idx]
+            idx = int(ast.text)
+            if not 1 <= idx <= len(scope.fields):
+                raise PlanningError(
+                    f"ORDER BY position {idx} is not in select list "
+                    f"(1..{len(scope.fields)})"
+                )
+            f = scope.fields[idx - 1]
             return ir.ColumnRef(f.channel, f.type)
-        ctx = SelectContext(self, [scope], outer, ctes, plan_holder=None)
+        ctx = SelectContext(self, [scope], outer, ctes, None)
         return ctx.translate(ast)
 
     def plan_set_op(self, op: t.SetOperation, outer, ctes) -> RelationPlan:
@@ -315,7 +320,13 @@ class Planner:
         if sel.group_by or agg_calls:
             for g in sel.group_by:
                 if isinstance(g, t.NumberLiteral) and "." not in g.text:
-                    item = items[int(g.text) - 1]
+                    idx = int(g.text)
+                    if not 1 <= idx <= len(items):
+                        raise PlanningError(
+                            f"GROUP BY position {idx} is not in select list "
+                            f"(1..{len(items)})"
+                        )
+                    item = items[idx - 1]
                     e = sctx.translate(item.expr)
                 else:
                     e = sctx.translate(g)
@@ -424,6 +435,10 @@ class Planner:
                 if c in win_map:
                     continue
                 name = c.name
+                if c.filter is not None and name not in AGGREGATE:
+                    raise PlanningError(
+                        f"FILTER is not supported for window function {name!r}"
+                    )
                 ch = self.channel(name)
                 if name in ("row_number", "rank", "dense_rank"):
                     wf = WindowFunc(name, None, ch, T.BIGINT)
@@ -448,12 +463,32 @@ class Planner:
                     inp = sctx.translate(c.args[0])
                     wf = WindowFunc(name, inp, ch, inp.type)
                 elif name in AGGREGATE:
+                    wfilt = None
+                    if c.filter is not None:
+                        wfilt = sctx.translate(c.filter)
+                        if wfilt is None or wfilt.type != T.BOOLEAN:
+                            raise PlanningError(
+                                "FILTER (WHERE ...) must be boolean"
+                            )
                     if c.is_star:
-                        inp = None
                         func = "count"
                         out_t = T.BIGINT
+                        if wfilt is not None:
+                            inp = ir.Call(
+                                "if",
+                                (wfilt, ir.lit(1), ir.Literal(None, T.BIGINT)),
+                                T.BIGINT,
+                            )
+                        else:
+                            inp = None
                     else:
                         inp = sctx.translate(c.args[0])
+                        if wfilt is not None:
+                            inp = ir.Call(
+                                "if",
+                                (wfilt, inp, ir.Literal(None, inp.type)),
+                                inp.type,
+                            )
                         func = "count" if name == "count" else name
                         out_t = AggSpec.infer_output_type(func, inp.type)
                     wf = WindowFunc(
@@ -476,13 +511,31 @@ class Planner:
             fname = call.name
             if fname not in AGG_FUNCS:
                 raise PlanningError(f"unsupported aggregate {fname!r}")
+            # agg(x) FILTER (WHERE p) masks the input to NULL where p is not
+            # true (reference: AggregationNode mask channels); NULL inputs
+            # never contribute, which is exactly FILTER's semantics.
+            filt = None
+            if call.filter is not None:
+                filt = sctx.translate(call.filter)
+                if filt is None or filt.type != T.BOOLEAN:
+                    raise PlanningError("FILTER (WHERE ...) must be boolean")
             if call.is_star:
-                spec = AggSpec(
-                    "count_star", None, self.channel("count"), T.BIGINT
-                )
+                if filt is not None:
+                    inp = ir.Call(
+                        "if",
+                        (filt, ir.lit(1), ir.Literal(None, T.BIGINT)),
+                        T.BIGINT,
+                    )
+                    spec = AggSpec("count", inp, self.channel("count"), T.BIGINT)
+                else:
+                    spec = AggSpec(
+                        "count_star", None, self.channel("count"), T.BIGINT
+                    )
             else:
                 (arg,) = call.args
                 e = sctx.translate(arg)
+                if filt is not None:
+                    e = ir.Call("if", (filt, e, ir.Literal(None, e.type)), e.type)
                 func = "count" if fname == "count" else fname
                 out_t = AggSpec.infer_output_type(func, e.type)
                 spec = AggSpec(func, e, self.channel(fname), out_t)
@@ -561,6 +614,29 @@ def _collect_windows(expr: t.Node, out: List[t.FunctionCall]):
             for x in v:
                 if isinstance(x, t.Node):
                     _collect_windows(x, out)
+
+
+def _contains_subquery_pred(expr: t.Node) -> bool:
+    """True if expr contains an EXISTS / IN-subquery predicate (these can only
+    be planned as top-level WHERE conjuncts — they mutate the plan with a
+    SemiJoin). Does not descend into nested subqueries' own bodies."""
+    if isinstance(expr, (t.Exists, t.InSubquery)):
+        return True
+    if isinstance(expr, t.ScalarSubquery):
+        return False
+    for f in dataclasses.fields(expr):
+        v = getattr(expr, f.name)
+        if isinstance(v, t.Node) and _contains_subquery_pred(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node) and _contains_subquery_pred(x):
+                    return True
+                if isinstance(x, tuple):  # e.g. Case.whens: ((cond, val), ...)
+                    for y in x:
+                        if isinstance(y, t.Node) and _contains_subquery_pred(y):
+                            return True
+    return False
 
 
 def _collect_aggregates(expr: t.Node, out: List[t.FunctionCall]):
@@ -1065,12 +1141,31 @@ class SelectContext:
                 fn, (left, right), _infer(fn, (left.type, right.type))
             )
         if isinstance(ast, t.LogicalOp):
-            return ir.Call(ast.op, tuple(self._tr(x) for x in ast.terms), T.BOOLEAN)
+            # EXISTS/IN translate by mutating the plan with a SemiJoin and
+            # returning None — only valid as top-level WHERE conjuncts.
+            # Detect them in non-conjunct position BEFORE mutating the plan.
+            if ast.op == "or" and any(
+                _contains_subquery_pred(x) for x in ast.terms
+            ):
+                raise PlanningError(
+                    "EXISTS/IN subquery under OR is not supported"
+                )
+            terms = tuple(self._tr(x) for x in ast.terms)
+            if any(x is None for x in terms):
+                raise PlanningError(
+                    "EXISTS/IN subquery in this position is not supported"
+                )
+            return ir.Call(ast.op, terms, T.BOOLEAN)
         if isinstance(ast, t.NotOp):
             if isinstance(ast.operand, t.Exists):
                 return self._exists(ast.operand, negate=True)
             if isinstance(ast.operand, t.InSubquery):
                 return self._in_subquery(ast.operand, negate=True)
+            if _contains_subquery_pred(ast.operand):
+                raise PlanningError(
+                    "EXISTS/IN subquery under NOT is only supported directly "
+                    "(NOT EXISTS / NOT IN)"
+                )
             return ir.not_(self._tr(ast.operand))
         if isinstance(ast, t.IsNull):
             inner = self._tr(ast.operand)
@@ -1097,6 +1192,13 @@ class SelectContext:
         if isinstance(ast, t.Cast):
             v = self._tr(ast.operand)
             to = T.parse_type(ast.type_name)
+            if ast.try_cast and to != v.type:
+                # TRY_CAST returns NULL on conversion failure; translating it
+                # as a plain cast would silently drop that semantic.
+                raise PlanningError(
+                    f"TRY_CAST({v.type.display()} AS {to.display()}) "
+                    "not yet supported"
+                )
             return ir.cast(v, to)
         if isinstance(ast, t.Extract):
             v = self._tr(ast.operand)

@@ -89,3 +89,91 @@ def test_case_and_arithmetic(session):
         "from nation"
     ).rows()
     assert rows[0][0] == 5
+
+
+# -- advisor findings (round 1) --------------------------------------------
+
+
+def test_aggregate_filter_clause(session):
+    rows = session.query(
+        "select sum(n_nationkey) filter (where n_regionkey = 0) as s, "
+        "count(*) filter (where n_regionkey = 0) as c, "
+        "count(*) as total from nation"
+    ).rows()
+    # africa nations: regionkey 0 — compare against explicit CASE form
+    expect = session.query(
+        "select sum(case when n_regionkey = 0 then n_nationkey end) as s, "
+        "sum(case when n_regionkey = 0 then 1 else 0 end) as c, "
+        "count(*) as total from nation"
+    ).rows()
+    assert rows == expect
+    assert rows[0][2] == 25
+
+
+def test_aggregate_filter_grouped(session):
+    rows = session.query(
+        "select n_regionkey, avg(n_nationkey) filter (where n_nationkey > 10) as a "
+        "from nation group by n_regionkey order by n_regionkey"
+    ).rows()
+    assert len(rows) == 5  # groups with no qualifying rows yield NULL avg
+
+
+def test_order_by_ordinal_out_of_range(session):
+    from presto_tpu.sql.planner import PlanningError
+
+    # (-1 parses as unary minus -> constant sort expression, which is legal)
+    for bad in ("0", "99"):
+        with pytest.raises((PlanningError, SqlParseError)):
+            session.query(f"select n_name from nation order by {bad}")
+
+
+def test_exists_under_or_rejected(session):
+    from presto_tpu.sql.planner import PlanningError
+
+    with pytest.raises(PlanningError, match="OR"):
+        session.query(
+            "select count(*) as c from orders where exists "
+            "(select 1 from lineitem where l_orderkey = o_orderkey) "
+            "or o_orderkey = 1"
+        )
+
+
+def test_try_cast_rejected_until_supported(session):
+    from presto_tpu.sql.planner import PlanningError
+
+    with pytest.raises(PlanningError, match="TRY_CAST"):
+        session.query("select try_cast(n_name as bigint) as v from nation")
+
+
+def test_window_aggregate_filter(session):
+    rows = session.query(
+        "select n_nationkey, sum(n_nationkey) "
+        "filter (where n_nationkey > 10) over (partition by n_regionkey) as s "
+        "from nation order by n_nationkey"
+    ).rows()
+    expect = session.query(
+        "select n_nationkey, sum(case when n_nationkey > 10 then n_nationkey end) "
+        "over (partition by n_regionkey) as s from nation order by n_nationkey"
+    ).rows()
+    assert rows == expect
+
+
+def test_group_by_ordinal_out_of_range(session):
+    from presto_tpu.sql.planner import PlanningError
+
+    for bad in ("0", "99"):
+        with pytest.raises(PlanningError, match="GROUP BY position"):
+            session.query(
+                f"select count(*) as c, n_regionkey from nation group by {bad}"
+            )
+
+
+def test_exists_in_case_under_or_rejected(session):
+    from presto_tpu.sql.planner import PlanningError
+
+    with pytest.raises(PlanningError):
+        session.query(
+            "select count(*) as c from orders where o_orderkey = 1 or "
+            "(case when exists (select 1 from lineitem "
+            "where l_orderkey = o_orderkey) then true else false end)"
+        )
