@@ -1,0 +1,574 @@
+"""Distributed plan executor: fragmented plans over the device mesh.
+
+Re-designed equivalent of the reference's distributed execution stack —
+SqlQueryScheduler wiring stages to remote tasks (execution/scheduler/
+SqlQueryScheduler.java:112), exchange producers/consumers (execution/buffer/,
+operator/ExchangeClient.java) — collapsed TPU-first:
+
+* A "stage" is a shard_map'd SPMD program over the worker mesh axis; every
+  worker runs the same static-shape kernel on its shard of each Page.
+* Exchanges are collectives: `repartition` = shuffle_write + lax.all_to_all
+  (rides ICI), `gather`/`replicate` = device-global compaction (XLA inserts
+  the all_gathers) — no serde, no HTTP, pages never leave HBM.
+* The host drives adaptive capacity retry BETWEEN stages using per-shard
+  live counts/overflow scalars — the static-shape replacement for the
+  reference's grow-as-you-go pages and output-buffer backpressure.
+
+The executor walks ONE physical tree (plan/fragment.py) and keeps every
+subtree either sharded (SPage) or single/replicated (plain Page). All
+relational kernels are the same ones the single-node Executor runs — a
+sharded stage is literally the local kernel wrapped in shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import types as T
+from ..expr import ir
+from ..ops.aggregate import (
+    apply_avg_post,
+    global_aggregate,
+    grouped_aggregate_sorted,
+)
+from ..ops.filter import compact, filter_page
+from ..ops.join import build, join_expand, join_n1
+from ..ops.sort import distinct_page, limit_page, top_n
+from ..expr.compiler import project_page
+from ..page import Block, Page, round_capacity
+from ..parallel.exchange import exchange_by_hash
+from ..parallel.mesh import (
+    WORKER_AXIS,
+    page_from_arrays,
+    page_schema,
+    page_to_arrays,
+    shard_rows,
+)
+from ..plan import nodes as N
+from ..plan.fragment import AggFinalize, Exchange
+from .executor import ExecutionError, Executor
+
+
+@dataclasses.dataclass
+class SPage:
+    """Host handle to a mesh-sharded page: global arrays whose leading dim is
+    n_shards * shard_capacity (shard i owns the contiguous chunk
+    [i*cap, (i+1)*cap)), plus per-shard live counts. The device-resident
+    analog of a stage's partitioned output buffers."""
+
+    leaves: Tuple[jax.Array, ...]
+    schema: tuple  # parallel.mesh.Schema
+    counts: jax.Array  # (n_shards,) int32
+    n_shards: int
+
+    @property
+    def shard_capacity(self) -> int:
+        return self.leaves[0].shape[0] // self.n_shards
+
+    def max_count(self) -> int:
+        return int(jnp.max(self.counts))
+
+    def total_count(self) -> int:
+        return int(jnp.sum(self.counts))
+
+
+class DistributedExecutor:
+    """Executes a fragmented plan over `mesh`'s worker axis. Single/\
+replicated subtrees delegate to the single-node Executor."""
+
+    def __init__(self, catalog, mesh, axis: str = WORKER_AXIS):
+        self.catalog = catalog
+        self.mesh = mesh
+        self.axis = axis
+        self.n = mesh.shape[axis]
+        self.local = Executor(catalog)
+        self._steps: Dict = {}
+
+    # -- public --
+
+    def run(self, root: N.PlanNode) -> Page:
+        out = self._run(root)
+        if isinstance(out, SPage):  # fragmenter gathers, but be safe
+            out = self.to_single(out)
+        return out
+
+    # -- sharded step machinery --
+
+    def _compile_step(self, cache_key, make_local, spages: Sequence[SPage],
+                      rep_pages: Sequence[Page], n_extra: int):
+        """Compile (or fetch) a shard_map'd stage.
+
+        make_local(*local_pages, *rep_pages) -> Page | (Page, *extra_scalars).
+        Returns (compiled_fn, out_schema). compiled_fn(leaves_tuples,
+        counts_tuple, rep_pages) -> (out_leaves, out_counts, extra_vectors).
+        """
+        in_schemas = [sp.schema for sp in spages]
+        rep_key = tuple((page_schema(rp), rp.capacity) for rp in rep_pages)
+        key = (
+            cache_key,
+            tuple(in_schemas),
+            tuple(sp.shard_capacity for sp in spages),
+            rep_key,
+            n_extra,
+        )
+        hit = self._steps.get(key)
+        if hit is not None:
+            return hit
+
+        schema_box = {}
+
+        def step(leaves_tuples, counts, reps):
+            locals_ = [
+                page_from_arrays(lv, sch, cnt[0])
+                for lv, sch, cnt in zip(leaves_tuples, in_schemas, counts)
+            ]
+            out = make_local(*locals_, *reps)
+            extras = ()
+            if isinstance(out, tuple):
+                out, *extras = out
+            schema_box["out"] = page_schema(out)
+            return (
+                page_to_arrays(out),
+                out.count.reshape(1),
+                tuple(jnp.asarray(e).reshape(1) for e in extras),
+            )
+
+        smapped = jax.shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis), P()),
+            out_specs=P(self.axis),
+            check_vma=False,
+        )
+        fn = jax.jit(smapped)
+
+        # one abstract trace to learn the output schema without running
+        # (global shapes — shard_map needs the mesh context for collectives)
+        leaf_structs = tuple(
+            tuple(
+                jax.ShapeDtypeStruct(l.shape, l.dtype) for l in sp.leaves
+            )
+            for sp in spages
+        )
+        count_structs = tuple(
+            jax.ShapeDtypeStruct((self.n,), jnp.int32) for _ in in_schemas
+        )
+        jax.eval_shape(fn, leaf_structs, count_structs, tuple(rep_pages))
+        out_schema = schema_box["out"]
+
+        self._steps[key] = (fn, out_schema)
+        return fn, out_schema
+
+    def _apply(self, cache_key, make_local, spages: Sequence[SPage],
+               rep_pages: Sequence[Page] = (), n_extra: int = 0):
+        """Run a local kernel as one SPMD stage over the mesh.
+
+        Returns (SPage, extra_vectors) where each extra is an (n_shards,)
+        array of per-shard scalars (overflow counts etc.)."""
+        fn, out_schema = self._compile_step(
+            cache_key, make_local, spages, rep_pages, n_extra
+        )
+        out_leaves, out_counts, extras = fn(
+            tuple(sp.leaves for sp in spages),
+            tuple(sp.counts for sp in spages),
+            tuple(rep_pages),
+        )
+        sp = SPage(tuple(out_leaves), out_schema, out_counts, self.n)
+        return sp, tuple(extras)
+
+    # -- SPage <-> Page --
+
+    def from_page(self, page: Page) -> SPage:
+        """Contiguous row shards (leaf split assignment)."""
+        padded, counts = shard_rows(page, self.n)
+        return SPage(
+            page_to_arrays(padded), page_schema(padded), counts, self.n
+        )
+
+    def to_single(self, sp: SPage) -> Page:
+        """Collect all shards' live rows into one compacted Page (the root
+        stage output buffer; XLA inserts the cross-device gathers)."""
+        cap = sp.shard_capacity
+        key = ("to_single", sp.schema, cap, self.n)
+        fn = self._steps.get(key)
+        if fn is None:
+
+            def collect(leaves, counts):
+                # count = full capacity: every position participates, and the
+                # occupancy mask alone decides liveness (compact intersects
+                # with live_mask, so a smaller count would drop real rows)
+                page = page_from_arrays(
+                    leaves, sp.schema, self.n * cap
+                )
+                occ = (
+                    jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]
+                ).reshape(-1)
+                return compact(page, occ)
+
+            fn = jax.jit(collect)
+            self._steps[key] = fn
+        out = fn(sp.leaves, sp.counts)
+        return self.local._shrink(out)
+
+    def _shrink_sp(self, sp: SPage) -> SPage:
+        """Slice every shard down to the live-count bucket (bounded
+        recompilation, like Executor._shrink but uniform across shards)."""
+        cap = sp.shard_capacity
+        new_cap = round_capacity(max(sp.max_count(), 1))
+        if new_cap >= cap:
+            return sp
+        key = ("shrink", sp.schema, cap, new_cap, self.n)
+        fn = self._steps.get(key)
+        if fn is None:
+
+            def shrink(leaves):
+                return tuple(
+                    l.reshape((self.n, cap) + l.shape[1:])[:, :new_cap]
+                    .reshape((self.n * new_cap,) + l.shape[1:])
+                    for l in leaves
+                )
+
+            fn = jax.jit(shrink)
+            self._steps[key] = fn
+        return SPage(fn(sp.leaves), sp.schema, sp.counts, self.n)
+
+    # -- dispatch --
+
+    def _run(self, node: N.PlanNode):
+        m = getattr(self, f"_d_{type(node).__name__.lower()}", None)
+        if m is not None:
+            return m(node)
+        # nodes without a distributed handler run single-node
+        pages = []
+        for c in node.children:
+            v = self._run(c)
+            if isinstance(v, SPage):
+                raise ExecutionError(
+                    f"{type(node).__name__} got sharded input but has no "
+                    "distributed handler (fragmenter should have gathered)"
+                )
+            pages.append(v)
+        return self.local.exec_node(node, *pages)
+
+    # -- exchanges --
+
+    def _d_exchange(self, node: Exchange):
+        child = self._run(node.child)
+        if node.kind in ("gather", "replicate"):
+            return self.to_single(child) if isinstance(child, SPage) else child
+        if node.kind == "repartition":
+            if not isinstance(child, SPage):
+                return child  # single data is trivially co-located
+            return self._repartition(child, node.keys)
+        raise ExecutionError(f"unknown exchange kind {node.kind!r}")
+
+    def _repartition(self, sp: SPage, keys) -> SPage:
+        cap = sp.shard_capacity
+        n = self.n
+        axis = self.axis
+
+        def local(p: Page):
+            # part_capacity = sender shard capacity -> overflow-free by
+            # construction (a sender cannot emit more rows than it holds)
+            recv, dropped = exchange_by_hash(p, keys, axis, n, cap)
+            return recv, dropped
+
+        out, (dropped,) = self._apply(
+            ("repartition", tuple(keys)), local, [sp], n_extra=1
+        )
+        if int(jnp.sum(dropped)) != 0:  # cannot happen; fail loudly if it does
+            raise ExecutionError("exchange dropped rows")
+        return self._shrink_sp(out)
+
+    # -- leaves --
+
+    def _d_tablescan(self, node: N.TableScan):
+        return self.from_page(self.local.exec_node(node))
+
+    # -- stateless row ops --
+
+    def _unary(self, node, key, local_fn, shrink: bool = False):
+        """Common unary-node shape: sharded input -> one SPMD stage;
+        single input -> delegate to the single-node executor."""
+        c = self._run(node.child)
+        if not isinstance(c, SPage):
+            return self.local.exec_node(node, c)
+        out, _ = self._apply(key, local_fn, [c])
+        return self._shrink_sp(out) if shrink else out
+
+    def _d_filter(self, node: N.Filter):
+        return self._unary(
+            node,
+            ("filter", node),
+            lambda p: filter_page(p, node.predicate),
+            shrink=True,
+        )
+
+    def _d_project(self, node: N.Project):
+        return self._unary(
+            node,
+            ("project", node),
+            lambda p: project_page(p, node.exprs, node.names),
+        )
+
+    # -- aggregation --
+
+    def _d_aggregate(self, node: N.Aggregate):
+        c = self._run(node.child)
+        if not isinstance(c, SPage):
+            return self.local.exec_node(node, c)
+        if not node.group_exprs:
+            out, _ = self._apply(
+                ("gagg", node), lambda p: global_aggregate(p, node.aggs), [c]
+            )
+            return out
+        max_groups = round_capacity(min(max(c.max_count(), 1), 1 << 16))
+        while True:
+            mg = max_groups
+            out, _ = self._apply(
+                ("agg", node, mg),
+                lambda p: grouped_aggregate_sorted(
+                    p, node.group_exprs, node.group_names, node.aggs, mg
+                ),
+                [c],
+            )
+            true_groups = out.max_count()
+            if true_groups <= max_groups:
+                break
+            max_groups = round_capacity(true_groups)
+        return self._shrink_sp(out)
+
+    def _d_aggfinalize(self, node: AggFinalize):
+        return self._unary(
+            node,
+            ("aggfin", node),
+            lambda p: apply_avg_post(p, node.aggs, node.post),
+        )
+
+    def _d_distinct(self, node: N.Distinct):
+        return self._unary(
+            node,
+            ("distinct", node),
+            lambda p: distinct_page(p, p.capacity),
+            shrink=True,
+        )
+
+    # -- joins --
+
+    def _d_join(self, node: N.Join):
+        left = self._run(node.left)
+        right = self._run(node.right)
+        if not isinstance(left, SPage):
+            if isinstance(right, SPage):
+                right = self.to_single(right)
+            return self.local.exec_node(node, left, right)
+
+        right_sp: Optional[SPage] = right if isinstance(right, SPage) else None
+        right_names = tuple(n for n, _ in node.right.fields)
+
+        def make_n1(l: Page, r: Page) -> Page:
+            return join_n1(
+                l,
+                build(r, node.right_keys),
+                node.left_keys,
+                right_names,
+                right_names,
+                kind=node.kind,
+            )
+
+        if node.unique_build:
+            ins, reps = self._join_inputs(left, right_sp, right)
+            out, _ = self._apply((node, "n1"), make_n1, ins, reps)
+            if node.residual is not None:
+                if node.kind != "inner":
+                    raise ExecutionError("residual on outer join not yet supported")
+                out, _ = self._apply(
+                    (node, "resid"),
+                    lambda p: filter_page(p, node.residual),
+                    [out],
+                )
+            return self._shrink_sp(out)
+
+        cap = round_capacity(max(left.max_count(), 1))
+        while True:
+            c = cap
+
+            def make_expand(l: Page, r: Page):
+                return join_expand(
+                    l,
+                    build(r, node.right_keys),
+                    node.left_keys,
+                    l.names,
+                    [(nm, nm) for nm in right_names],
+                    out_capacity=c,
+                    kind=node.kind,
+                )
+
+            ins, reps = self._join_inputs(left, right_sp, right)
+            out, (overflow,) = self._apply(
+                (node, "expand", c), make_expand, ins, reps, n_extra=1
+            )
+            ov = int(jnp.max(overflow))
+            if ov == 0:
+                break
+            cap = round_capacity(cap + ov)
+        if node.residual is not None:
+            if node.kind != "inner":
+                raise ExecutionError("residual on outer join not yet supported")
+            out, _ = self._apply(
+                (node, "resid2"),
+                lambda p: filter_page(p, node.residual),
+                [out],
+            )
+        return self._shrink_sp(out)
+
+    @staticmethod
+    def _join_inputs(left: SPage, right_sp: Optional[SPage], right):
+        if right_sp is not None:
+            return [left, right_sp], []
+        return [left], [right]
+
+    def _d_semijoin(self, node: N.SemiJoin):
+        probe = self._run(node.child)
+        source = self._run(node.source)
+        if not isinstance(probe, SPage):
+            if isinstance(source, SPage):
+                source = self.to_single(source)
+            return self.local.exec_node(node, probe, source)
+        source_sp = source if isinstance(source, SPage) else None
+
+        if node.residual is None:
+
+            def local(p: Page, s: Page) -> Page:
+                bs = build(s, node.source_keys)
+                return join_n1(
+                    p,
+                    bs,
+                    node.probe_keys,
+                    [],
+                    [],
+                    kind="anti" if node.anti else "semi",
+                )
+
+            ins, reps = self._join_inputs(probe, source_sp, source)
+            out, _ = self._apply((node, "semi"), local, ins, reps)
+            return self._shrink_sp(out)
+
+        # residual EXISTS: expand on equi keys, filter residual, keep probe
+        # rows whose (per-shard) row id survived — all local to one shard
+        # because the source side is replicated.
+        if source_sp is not None:
+            source = self.to_single(source_sp)
+            source_sp = None
+        rid = "$rid_d"
+        rid_t = T.BIGINT
+        needed = self.local._residual_channels(node.residual)
+        cap = round_capacity(max(probe.max_count(), 1))
+        while True:
+            c = cap
+
+            def local(p: Page, s: Page):
+                p2 = self.local._with_row_id(p, rid)
+                bs = build(s, node.source_keys)
+                probe_out = [rid] + [nm for nm in p.names if nm in needed]
+                build_out = [(nm, nm) for nm in s.names if nm in needed]
+                expanded, overflow = join_expand(
+                    p2,
+                    bs,
+                    node.probe_keys,
+                    probe_out,
+                    build_out,
+                    out_capacity=c,
+                    kind="inner",
+                )
+                matched = filter_page(expanded, node.residual)
+                bs2 = build(matched, (ir.ColumnRef(rid, rid_t),))
+                out = join_n1(
+                    p2,
+                    bs2,
+                    (ir.ColumnRef(rid, rid_t),),
+                    [],
+                    [],
+                    kind="anti" if node.anti else "semi",
+                )
+                blocks = tuple(
+                    b for b, nm in zip(out.blocks, out.names) if nm != rid
+                )
+                names = tuple(nm for nm in out.names if nm != rid)
+                return Page(blocks, names, out.count), overflow
+
+            out, (overflow,) = self._apply(
+                (node, "semiresid", c), local, [probe], [source], n_extra=1
+            )
+            ov = int(jnp.max(overflow))
+            if ov == 0:
+                break
+            cap = round_capacity(cap + ov)
+        return self._shrink_sp(out)
+
+    def _d_scalarapply(self, node: N.ScalarApply):
+        child = self._run(node.child)
+        sub = self._run(node.subquery)
+        if isinstance(sub, SPage):
+            sub = self.to_single(sub)
+        if not isinstance(child, SPage):
+            return self.local.exec_node(node, child, sub)
+        n_sub = int(sub.count)  # host-side check; the broadcast is pure
+        if n_sub > 1:
+            raise ExecutionError("scalar subquery returned more than one row")
+
+        def local(p: Page, s: Page) -> Page:
+            cap = p.capacity
+            blocks = list(p.blocks)
+            names = list(p.names)
+            for b, (fname, _ftype) in zip(s.blocks, node.subquery.fields):
+                if n_sub == 0:
+                    data = jnp.zeros((cap,), b.data.dtype)
+                    valid = jnp.zeros((cap,), jnp.bool_)
+                else:
+                    data = jnp.broadcast_to(b.data[0], (cap,))
+                    valid = (
+                        None
+                        if b.valid is None
+                        else jnp.broadcast_to(b.valid[0], (cap,))
+                    )
+                blocks.append(Block(data, b.type, valid, b.dict_id))
+                names.append(fname)
+            return Page(tuple(blocks), tuple(names), p.count)
+
+        out, _ = self._apply((node, "sapply", n_sub == 0), local, [child], [sub])
+        return out
+
+    # -- windows / ordering --
+
+    def _d_window(self, node: N.Window):
+        from ..ops.window import window_op
+
+        return self._unary(
+            node,
+            ("window", node),
+            lambda p: window_op(
+                p, node.partition_exprs, node.order_keys, node.funcs
+            ),
+        )
+
+    def _d_topn(self, node: N.TopN):
+        return self._unary(
+            node,
+            ("topn", node),
+            lambda p: top_n(p, node.keys, node.count),
+            shrink=True,
+        )
+
+    def _d_limit(self, node: N.Limit):
+        return self._unary(
+            node,
+            ("limit", node),
+            lambda p: limit_page(p, node.count),
+            shrink=True,
+        )

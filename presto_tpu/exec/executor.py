@@ -89,8 +89,14 @@ class Executor:
 
     # -- dispatch --
     def _run(self, node: N.PlanNode) -> Page:
-        method = getattr(self, f"_run_{type(node).__name__.lower()}")
-        return method(node)
+        pages = [self._run(c) for c in node.children]
+        return self.exec_node(node, *pages)
+
+    def exec_node(self, node: N.PlanNode, *pages: Page) -> Page:
+        """Apply one plan node to already-materialized input pages — the
+        unit the distributed executor and the streaming driver both reuse."""
+        method = getattr(self, f"_exec_{type(node).__name__.lower()}")
+        return method(node, *pages)
 
     def _shrink(self, page: Page) -> Page:
         """Slice page capacity down to the live row count's bucket."""
@@ -107,8 +113,17 @@ class Executor:
             blocks.append(Block(data, b.type, valid, b.dict_id))
         return Page(tuple(blocks), page.names, page.count)
 
+    # -- physical nodes (fragmented plans executed single-node) --
+    def _exec_exchange(self, node, page: Page) -> Page:
+        return page  # all exchange kinds are identities on a single worker
+
+    def _exec_aggfinalize(self, node, page: Page) -> Page:
+        from ..ops.aggregate import apply_avg_post
+
+        return apply_avg_post(page, node.aggs, node.post)
+
     # -- leaf --
-    def _run_tablescan(self, node: N.TableScan) -> Page:
+    def _exec_tablescan(self, node: N.TableScan) -> Page:
         src = self.catalog.page(node.table)
         blocks = []
         names = []
@@ -118,26 +133,22 @@ class Executor:
         return Page(tuple(blocks), tuple(names), src.count)
 
     # -- stateless row ops --
-    def _run_filter(self, node: N.Filter) -> Page:
-        page = self._run(node.child)
+    def _exec_filter(self, node: N.Filter, page: Page) -> Page:
         fn = self._kernel(node, lambda: lambda p: filter_page(p, node.predicate))
         return self._shrink(fn(page))
 
-    def _run_project(self, node: N.Project) -> Page:
-        page = self._run(node.child)
+    def _exec_project(self, node: N.Project, page: Page) -> Page:
         fn = self._kernel(
             node, lambda: lambda p: project_page(p, node.exprs, node.names)
         )
         return fn(page)
 
-    def _run_output(self, node: N.Output) -> Page:
-        page = self._run(node.child)
+    def _exec_output(self, node: N.Output, page: Page) -> Page:
         blocks = tuple(page.block(c) for c in node.channels)
         return Page(blocks, tuple(node.titles), page.count)
 
     # -- aggregation --
-    def _run_aggregate(self, node: N.Aggregate) -> Page:
-        page = self._run(node.child)
+    def _exec_aggregate(self, node: N.Aggregate, page: Page) -> Page:
         if not node.group_exprs:
             fn = self._kernel(node, lambda: lambda p: global_aggregate(p, node.aggs))
             return fn(page)
@@ -160,15 +171,12 @@ class Executor:
             max_groups = round_capacity(true_groups)
         return self._shrink(out)
 
-    def _run_distinct(self, node: N.Distinct) -> Page:
-        page = self._run(node.child)
+    def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:
         fn = self._kernel(node, lambda: lambda p: distinct_page(p, p.capacity))
         return self._shrink(fn(page))
 
     # -- joins --
-    def _run_join(self, node: N.Join) -> Page:
-        left = self._run(node.left)
-        right = self._run(node.right)
+    def _exec_join(self, node: N.Join, left: Page, right: Page) -> Page:
         right_names = right.names
         if node.unique_build:
             fn = self._kernel(
@@ -216,9 +224,7 @@ class Executor:
             out = filter_page(out, node.residual)
         return self._shrink(out)
 
-    def _run_semijoin(self, node: N.SemiJoin) -> Page:
-        probe = self._run(node.child)
-        source = self._run(node.source)
+    def _exec_semijoin(self, node: N.SemiJoin, probe: Page, source: Page) -> Page:
         if node.residual is None:
             bs = build(source, node.source_keys)
             out = join_n1(
@@ -296,9 +302,7 @@ class Executor:
         walk(e)
         return out
 
-    def _run_scalarapply(self, node: N.ScalarApply) -> Page:
-        page = self._run(node.child)
-        sub = self._run(node.subquery)
+    def _exec_scalarapply(self, node: N.ScalarApply, page: Page, sub: Page) -> Page:
         n = int(sub.count)
         if n > 1:
             raise ExecutionError("scalar subquery returned more than one row")
@@ -319,10 +323,9 @@ class Executor:
             names.append(fname)
         return Page(tuple(blocks), tuple(names), page.count)
 
-    def _run_window(self, node: N.Window) -> Page:
+    def _exec_window(self, node: N.Window, page: Page) -> Page:
         from ..ops.window import window_op
 
-        page = self._run(node.child)
         fn = self._kernel(
             node,
             lambda: lambda p: window_op(
@@ -332,23 +335,20 @@ class Executor:
         return fn(page)
 
     # -- ordering / limits --
-    def _run_sort(self, node: N.Sort) -> Page:
-        page = self._run(node.child)
+    def _exec_sort(self, node: N.Sort, page: Page) -> Page:
         fn = self._kernel(node, lambda: lambda p: sort_page(p, node.keys))
         return fn(page)
 
-    def _run_topn(self, node: N.TopN) -> Page:
-        page = self._run(node.child)
+    def _exec_topn(self, node: N.TopN, page: Page) -> Page:
         fn = self._kernel(
             node, lambda: lambda p: top_n(p, node.keys, node.count)
         )
         return fn(page)
 
-    def _run_limit(self, node: N.Limit) -> Page:
-        return self._shrink(limit_page(self._run(node.child), node.count))
+    def _exec_limit(self, node: N.Limit, page: Page) -> Page:
+        return self._shrink(limit_page(page, node.count))
 
-    def _run_union(self, node: N.Union) -> Page:
-        pages = [self._run(c) for c in node.inputs]
+    def _exec_union(self, node: N.Union, *pages: Page) -> Page:
         first = pages[0]
         total_cap = sum(p.capacity for p in pages)
         blocks = []

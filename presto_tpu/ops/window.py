@@ -65,7 +65,12 @@ def _sort_for_window(page: Page, partition_exprs, order_keys: Sequence[SortKey])
     if partition_exprs:
         pkeys = [evaluate(e, page) for e in partition_exprs]
         for v in pkeys:  # least-significant tie-breaks first (stable sorts)
-            perm = perm[jnp.argsort(v.data[perm], stable=True)]
+            d = v.data
+            if v.valid is not None:
+                # canonicalize NULL slots: their storage is garbage and must
+                # not reorder rows within an all-NULL partition
+                d = jnp.where(v.valid, d, jnp.zeros_like(d))
+            perm = perm[jnp.argsort(d[perm], stable=True)]
             if v.valid is not None:
                 perm = perm[jnp.argsort(v.valid[perm], stable=True)]
         h = hash_rows(pkeys)

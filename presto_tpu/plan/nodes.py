@@ -318,6 +318,9 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f" [{node.count}]"
     elif isinstance(node, Output):
         detail = f" [{', '.join(node.titles)}]"
+    if name == "Exchange":
+        keys = ", ".join(str(k) for k in node.keys)
+        detail = f" [{node.kind}]" + (f" [{keys}]" if keys else "")
     lines = [f"{pad}- {name}{detail}"]
     for c in node.children:
         lines.append(plan_tree_str(c, indent + 1))

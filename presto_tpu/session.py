@@ -30,9 +30,21 @@ class QueryResult:
 
 
 class Session:
-    def __init__(self, catalog):
+    """mesh=None runs single-device; passing a jax.sharding.Mesh fragments
+    every plan (plan/fragment.py) and executes it distributed over the
+    mesh's worker axis (exec/dist.py) — the analog of LocalQueryRunner vs
+    DistributedQueryRunner (presto-tests/.../DistributedQueryRunner.java:75)."""
+
+    def __init__(self, catalog, mesh=None, broadcast_threshold: int = 1_000_000):
         self.catalog = catalog
-        self.executor = Executor(catalog)
+        self.mesh = mesh
+        self.broadcast_threshold = broadcast_threshold
+        if mesh is not None:
+            from .exec.dist import DistributedExecutor
+
+            self.executor = DistributedExecutor(catalog, mesh)
+        else:
+            self.executor = Executor(catalog)
 
     def plan(self, sql: str) -> N.PlanNode:
         ast = parse(sql)
@@ -47,7 +59,14 @@ class Session:
         titles = tuple(f.name for f in scope.fields)
         from .plan.optimizer import optimize
 
-        return optimize(N.Output(rp.node, channels, titles))
+        node = optimize(N.Output(rp.node, channels, titles))
+        if self.mesh is not None:
+            from .plan.fragment import fragment_plan
+
+            node = fragment_plan(
+                node, self.catalog, self.broadcast_threshold
+            )
+        return node
 
     def explain(self, sql: str) -> str:
         return N.plan_tree_str(self.plan(sql))

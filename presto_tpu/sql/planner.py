@@ -1089,6 +1089,10 @@ class SelectContext:
         raise PlanningError(f"cannot resolve column {'.'.join(parts)!r}")
 
     def translate(self, ast: t.Node) -> ir.RowExpression:
+        # EXISTS/IN predicates plan as SemiJoins and are only legal when the
+        # whole translated expression is one WHERE/HAVING conjunct: the root
+        # node itself, or directly under a root-level NOT.
+        self._conjunct_root = ast
         e = self._tr(ast)
         return e
 
@@ -1263,14 +1267,31 @@ class SelectContext:
         sub = SubqueryPlanner(self.p, self, self.ctes)
         return sub.plan_scalar(ast.query, self.holder)
 
+    def _require_conjunct_position(self, ast: t.Node):
+        """EXISTS/IN mutate the plan (SemiJoin) and return None — legal only
+        when the expression being translated IS this predicate (optionally
+        under a root-level NOT). Anywhere deeper (CASE, function args, ...)
+        the None would corrupt the expression tree."""
+        root = getattr(self, "_conjunct_root", None)
+        if ast is root:
+            return
+        if isinstance(root, t.NotOp) and ast is root.operand:
+            return
+        raise PlanningError(
+            "EXISTS/IN subquery is only supported as a top-level "
+            "WHERE/HAVING conjunct"
+        )
+
     def _exists(self, ast: t.Exists, negate: bool) -> Optional[ir.RowExpression]:
         self._require_holder()
+        self._require_conjunct_position(ast)
         sub = SubqueryPlanner(self.p, self, self.ctes)
         sub.plan_exists(ast.query, self.holder, anti=negate)
         return None  # applied as a SemiJoin on the holder
 
     def _in_subquery(self, ast: t.InSubquery, negate: bool) -> Optional[ir.RowExpression]:
         self._require_holder()
+        self._require_conjunct_position(ast)
         value = self._tr(ast.value)
         sub = SubqueryPlanner(self.p, self, self.ctes)
         sub.plan_in(ast.query, value, self.holder, anti=negate)

@@ -177,3 +177,28 @@ def test_exists_in_case_under_or_rejected(session):
             "(case when exists (select 1 from lineitem "
             "where l_orderkey = o_orderkey) then true else false end)"
         )
+
+
+def test_window_all_null_partition_order(session):
+    # all rows share one NULL partition; garbage in the NULL slots must not
+    # perturb ordering by the ORDER BY key
+    rows = session.query(
+        "select n_name, row_number() over (partition by "
+        "n_nationkey + (case when n_name = 'zzz' then 1 end) "
+        "order by n_name) as rn from nation order by n_name"
+    ).rows()
+    names = sorted(n for n, _ in rows)
+    assert [r for _, r in sorted(rows)] == [
+        names.index(n) + 1 for n, _ in sorted(rows)
+    ]
+
+
+def test_exists_inside_case_rejected(session):
+    from presto_tpu.sql.planner import PlanningError
+
+    with pytest.raises(PlanningError, match="conjunct"):
+        session.query(
+            "select count(*) as c from orders where case when exists "
+            "(select 1 from lineitem where l_orderkey = o_orderkey) "
+            "then true else false end"
+        )
