@@ -81,13 +81,15 @@ class DistributedExecutor:
     """Executes a fragmented plan over `mesh`'s worker axis. Single/\
 replicated subtrees delegate to the single-node Executor."""
 
-    def __init__(self, catalog, mesh, axis: str = WORKER_AXIS):
+    def __init__(self, catalog, mesh, axis: str = WORKER_AXIS,
+                 collector=None):
         self.catalog = catalog
         self.mesh = mesh
         self.axis = axis
         self.n = mesh.shape[axis]
-        self.local = Executor(catalog)
+        self.local = Executor(catalog, collector=collector)
         self._steps: Dict = {}
+        self.collector = collector
 
     # -- public --
 
@@ -240,6 +242,34 @@ replicated subtrees delegate to the single-node Executor."""
     # -- dispatch --
 
     def _run(self, node: N.PlanNode):
+        if self.collector is None:
+            return self._run_inner(node)
+        import time
+
+        from .stats import page_device_bytes
+
+        t0 = time.perf_counter()
+        out = self._run_inner(node)
+        if isinstance(out, SPage):
+            rows = out.total_count()  # blocks until shards finish
+            nbytes = sum(l.size * l.dtype.itemsize for l in out.leaves)
+        else:
+            rows = int(out.count)
+            nbytes = page_device_bytes(out)
+        wall = time.perf_counter() - t0
+        # child time is recorded by the recursive call; subtract it so each
+        # node's number is self time (the single-node path measures the same
+        # way because exec_node receives materialized inputs)
+        child_wall = sum(
+            (self.collector.lookup(c) or type("S", (), {"wall_s": 0})).wall_s
+            for c in node.children
+        )
+        self.collector.record(
+            node, max(wall - child_wall, 0.0), 0, rows, nbytes
+        )
+        return out
+
+    def _run_inner(self, node: N.PlanNode):
         m = getattr(self, f"_d_{type(node).__name__.lower()}", None)
         if m is not None:
             return m(node)

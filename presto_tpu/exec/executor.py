@@ -60,7 +60,8 @@ def _unify_block_dictionaries(blocks):
 
 
 class Executor:
-    def __init__(self, catalog, shrink: bool = True, jit: bool = True):
+    def __init__(self, catalog, shrink: bool = True, jit: bool = True,
+                 collector=None):
         self.catalog = catalog
         self.shrink = shrink
         self.jit = jit
@@ -68,6 +69,9 @@ class Executor:
         # reference caching compiled PageProcessors per plan
         # (LocalExecutionPlanner compiles once, Drivers reuse)
         self._kernels: Dict = {}
+        # EXPLAIN ANALYZE support (exec/stats.py); None = no accounting
+        self.collector = collector
+        self._retries = 0  # adaptive-capacity re-runs since last snapshot
 
     def _kernel(self, key, make_fn):
         """Compile-once cache for per-node kernels. jax.jit retraces per
@@ -90,7 +94,23 @@ class Executor:
     # -- dispatch --
     def _run(self, node: N.PlanNode) -> Page:
         pages = [self._run(c) for c in node.children]
-        return self.exec_node(node, *pages)
+        if self.collector is None:
+            return self.exec_node(node, *pages)
+        import time
+
+        from .stats import page_device_bytes
+
+        rows_in = sum(int(p.count) for p in pages)
+        retries_before = self._retries
+        t0 = time.perf_counter()
+        out = self.exec_node(node, *pages)
+        rows_out = int(out.count)  # blocks until the kernel finishes
+        wall = time.perf_counter() - t0
+        self.collector.record(
+            node, wall, rows_in, rows_out, page_device_bytes(out),
+            self._retries - retries_before,
+        )
+        return out
 
     def exec_node(self, node: N.PlanNode, *pages: Page) -> Page:
         """Apply one plan node to already-materialized input pages — the
@@ -169,6 +189,7 @@ class Executor:
             if true_groups <= max_groups:
                 break
             max_groups = round_capacity(true_groups)
+            self._retries += 1
         return self._shrink(out)
 
     def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:
@@ -218,6 +239,7 @@ class Executor:
             if int(overflow) == 0:
                 break
             cap = round_capacity(cap + int(overflow))
+            self._retries += 1
         if node.residual is not None:
             if node.kind != "inner":
                 raise ExecutionError("residual on outer join not yet supported")
@@ -258,6 +280,7 @@ class Executor:
             if int(overflow) == 0:
                 break
             cap = round_capacity(cap + int(overflow))
+            self._retries += 1
         matched = filter_page(expanded, node.residual)
         matched = self._shrink(matched)
         rid_type = T.BIGINT

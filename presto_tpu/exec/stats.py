@@ -1,0 +1,98 @@
+"""Per-operator execution statistics — the EXPLAIN ANALYZE substrate.
+
+Re-designed equivalent of the reference's operator stats tree
+(presto-main/.../operator/OperatorStats.java, DriverStats, TaskStats rolled
+into QueryStats) and ExplainAnalyzeContext
+(presto-main/.../execution/ExplainAnalyzeContext.java). TPU-first
+differences: the unit of accounting is a plan-node *kernel dispatch* (one
+jitted XLA program) rather than a Java operator's addInput/getOutput calls,
+and the memory number is the device-resident bytes of the node's output
+page — the HBM footprint XLA must hold live between stages.
+
+Wall time per node includes host sync (`block_until_ready` on the output
+count), so the first call also includes XLA compile time; `calls` lets the
+reader separate warm-up from steady state, and `retries` counts adaptive
+capacity re-executions (the static-shape analog of the reference's page
+growth, which its stats never see).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class NodeStats:
+    calls: int = 0
+    wall_s: float = 0.0
+    rows_in: int = 0
+    rows_out: int = 0
+    retries: int = 0
+    out_bytes: int = 0  # device bytes of the node's output page (last call)
+
+    def line(self) -> str:
+        ms = self.wall_s * 1e3
+        parts = [
+            f"{ms:,.1f}ms",
+            f"in {self.rows_in:,} rows",
+            f"out {self.rows_out:,} rows",
+            f"{_fmt_bytes(self.out_bytes)}",
+        ]
+        if self.calls != 1:
+            parts.append(f"{self.calls} calls")
+        if self.retries:
+            parts.append(f"{self.retries} retries")
+        return "[" + ", ".join(parts) + "]"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "kB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def page_device_bytes(page) -> int:
+    """Device-resident bytes of a Page's blocks (data + validity masks)."""
+    total = 0
+    for b in page.blocks:
+        total += b.data.size * b.data.dtype.itemsize
+        if b.valid is not None:
+            total += b.valid.size * b.valid.dtype.itemsize
+    return total
+
+
+class StatsCollector:
+    """Collects per-node stats keyed by plan-node identity (two structurally
+    equal nodes at different tree positions stay distinct)."""
+
+    def __init__(self):
+        self.by_node: Dict[int, NodeStats] = {}
+        self.peak_bytes: int = 0  # high-water of summed live output bytes
+
+    def stats_for(self, node) -> NodeStats:
+        s = self.by_node.get(id(node))
+        if s is None:
+            s = NodeStats()
+            self.by_node[id(node)] = s
+        return s
+
+    def record(self, node, wall_s: float, rows_in: int, rows_out: int,
+               out_bytes: int, retries: int = 0) -> None:
+        s = self.stats_for(node)
+        s.calls += 1
+        s.wall_s += wall_s
+        s.rows_in += rows_in
+        s.rows_out += rows_out
+        s.retries += retries
+        s.out_bytes = out_bytes
+        live = sum(st.out_bytes for st in self.by_node.values())
+        self.peak_bytes = max(self.peak_bytes, live)
+
+    def lookup(self, node) -> Optional[NodeStats]:
+        return self.by_node.get(id(node))
+
+    def total_wall_s(self) -> float:
+        return sum(s.wall_s for s in self.by_node.values())

@@ -285,8 +285,11 @@ class Output(PlanNode):
         return (self.child,)
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style rendering (reference sql/planner/planPrinter)."""
+def plan_tree_str(node: PlanNode, indent: int = 0, collector=None) -> str:
+    """EXPLAIN-style rendering (reference sql/planner/planPrinter). With a
+    StatsCollector (exec/stats.py) this is the EXPLAIN ANALYZE view — per-
+    operator wall/rows/bytes/retries (reference ExplainAnalyzeContext +
+    PlanNodeStatsSummarizer)."""
     pad = "  " * indent
     name = type(node).__name__
     detail = ""
@@ -321,7 +324,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
     if name == "Exchange":
         keys = ", ".join(str(k) for k in node.keys)
         detail = f" [{node.kind}]" + (f" [{keys}]" if keys else "")
-    lines = [f"{pad}- {name}{detail}"]
+    stat = ""
+    if collector is not None:
+        s = collector.lookup(node)
+        if s is not None:
+            stat = " " + s.line()
+    lines = [f"{pad}- {name}{detail}{stat}"]
     for c in node.children:
-        lines.append(plan_tree_str(c, indent + 1))
+        lines.append(plan_tree_str(c, indent + 1, collector))
     return "\n".join(lines)

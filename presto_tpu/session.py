@@ -77,8 +77,36 @@ class Session:
         if isinstance(ast, t.Explain):
             from .page import Page
 
-            lines = N.plan_tree_str(node).split("\n")
+            if ast.analyze:
+                lines = self.explain_analyze_plan(node).split("\n")
+            else:
+                lines = N.plan_tree_str(node).split("\n")
             pg = Page.from_dict({"Query Plan": lines})
             return QueryResult(pg, ("Query Plan",))
         page = self.executor.run(node)
         return QueryResult(page, node.titles)
+
+    def explain_analyze_plan(self, node: N.PlanNode) -> str:
+        """Execute the plan with per-operator accounting and render the
+        annotated tree (reference EXPLAIN ANALYZE via ExplainAnalyzeOperator,
+        presto-main/.../execution/ExplainAnalyzeContext.java)."""
+        from .exec.stats import StatsCollector
+
+        collector = StatsCollector()
+        if self.mesh is not None:
+            from .exec.dist import DistributedExecutor
+
+            ex = DistributedExecutor(self.catalog, self.mesh, collector=collector)
+        else:
+            ex = Executor(self.catalog, collector=collector)
+        ex.run(node)
+        tree = N.plan_tree_str(node, collector=collector)
+        total_ms = collector.total_wall_s() * 1e3
+        peak = collector.peak_bytes / (1024 * 1024)
+        return (
+            f"{tree}\n"
+            f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
+        )
+
+    def explain_analyze(self, sql: str) -> str:
+        return self.explain_analyze_plan(self.plan(sql))

@@ -1,0 +1,60 @@
+"""EXPLAIN / EXPLAIN ANALYZE (reference ExplainAnalyzeContext,
+presto-main/.../execution/ExplainAnalyzeContext.java and the operator stats
+tree OperatorStats.java)."""
+
+import re
+
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+
+Q3 = (
+    "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue, "
+    "o_orderdate, o_shippriority "
+    "from customer, orders, lineitem "
+    "where c_mktsegment = 'BUILDING' and c_custkey = o_custkey "
+    "and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15' "
+    "and l_shipdate > date '1995-03-15' "
+    "group by l_orderkey, o_orderdate, o_shippriority "
+    "order by revenue desc, o_orderdate limit 10"
+)
+
+
+def _session():
+    return Session(TpchCatalog(sf=0.01))
+
+
+def test_explain_renders_plan():
+    s = _session()
+    text = s.explain(Q3)
+    assert "TableScan" in text and "Join" in text and "Aggregate" in text
+
+
+def test_explain_statement_returns_plan_rows():
+    s = _session()
+    res = s.query("explain " + Q3)
+    lines = [r[0] for r in res.rows()]
+    assert any("Join" in ln for ln in lines)
+    # no timing annotations without ANALYZE
+    assert not any("ms," in ln for ln in lines)
+
+
+def test_explain_analyze_q3_per_operator_breakdown():
+    s = _session()
+    text = s.explain_analyze(Q3)
+    lines = text.split("\n")
+    # every operator row carries wall time, rows in/out, and bytes
+    op_lines = [ln for ln in lines if ln.strip().startswith("-") and "--" not in ln]
+    assert len(op_lines) >= 5
+    for ln in op_lines:
+        assert re.search(r"\[[\d,.]+ms, in [\d,]+ rows, out [\d,]+ rows", ln), ln
+    # scans see the base tables; the aggregate output is bounded by limit 10
+    scan = next(ln for ln in lines if "TableScan lineitem" in ln)
+    assert re.search(r"out [\d,]{3,} rows", scan)
+    assert "total" in lines[-1] and "peak live output" in lines[-1]
+
+
+def test_explain_analyze_statement():
+    s = _session()
+    res = s.query("explain analyze " + Q3)
+    lines = [r[0] for r in res.rows()]
+    assert any("ms," in ln for ln in lines)
