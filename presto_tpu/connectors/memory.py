@@ -1,0 +1,69 @@
+"""In-memory connector: query device Pages registered at runtime.
+
+Re-designed equivalent of the reference's memory connector
+(presto-memory/src/main/java/com/facebook/presto/plugin/memory/ —
+MemoryPagesStore holding pages per table, MemoryMetadata). Here a table IS
+a device-resident Page, so scans are free and tests/notebooks can query
+arbitrary arrays with zero I/O.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..page import Page
+from ..sql.planner import Catalog
+
+
+class MemoryCatalog(Catalog):
+    """tables: {name: Page}; unique: {table: [key column sets]} lets the
+    planner use n:1 joins (the analog of declared primary keys)."""
+
+    name = "memory"
+
+    def __init__(
+        self,
+        tables: Dict[str, Page],
+        unique: Optional[Dict[str, List[Tuple[str, ...]]]] = None,
+    ):
+        self.tables = dict(tables)
+        self.unique = unique or {}
+
+    def add(self, name: str, page: Page) -> None:
+        self.tables[name] = page
+
+    def table_names(self) -> List[str]:
+        return list(self.tables)
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        page = self.tables[table]
+        return {n: b.type for n, b in zip(page.names, page.blocks)}
+
+    def row_count(self, table: str) -> int:
+        return int(self.tables[table].count)
+
+    def unique_columns(self, table: str) -> List[Tuple[str, ...]]:
+        return self.unique.get(table, [])
+
+    def page(self, table: str) -> Page:
+        return self.tables[table]
+
+    def scan(self, table: str, start: int, stop: int, pad_to=None) -> Page:
+        """Batched read path: slice of the stored page (device-side slice —
+        the table already lives in HBM for this connector)."""
+        from ..page import Block, _pad_block
+
+        src = self.tables[table]
+        n = int(src.count)
+        stop = min(stop, n)
+        count = max(stop - start, 0)
+        blocks = []
+        for b in src.blocks:
+            data = b.data[start:stop]
+            valid = None if b.valid is None else b.valid[start:stop]
+            blk = Block(data, b.type, valid, b.dict_id)
+            if pad_to is not None and pad_to > count:
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, src.names, count=count)

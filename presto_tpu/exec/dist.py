@@ -558,10 +558,12 @@ replicated subtrees delegate to the single-node Executor."""
             names = list(p.names)
             for b, (fname, _ftype) in zip(s.blocks, node.subquery.fields):
                 if n_sub == 0:
-                    data = jnp.zeros((cap,), b.data.dtype)
+                    data = jnp.zeros((cap,) + b.data.shape[1:], b.data.dtype)
                     valid = jnp.zeros((cap,), jnp.bool_)
                 else:
-                    data = jnp.broadcast_to(b.data[0], (cap,))
+                    data = jnp.broadcast_to(
+                        b.data[0], (cap,) + b.data.shape[1:]
+                    )
                     valid = (
                         None
                         if b.valid is None

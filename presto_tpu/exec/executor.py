@@ -38,27 +38,6 @@ class ExecutionError(RuntimeError):
     pass
 
 
-def _unify_block_dictionaries(blocks):
-    """Remap same-column blocks from different inputs onto one merged
-    dictionary (UNION of varchar columns born with different dictionaries)."""
-    dict_ids = {b.dict_id for b in blocks}
-    if len(dict_ids) == 1:
-        return blocks, blocks[0].dict_id
-    from ..page import dictionary_by_id, intern_dictionary
-    import numpy as np
-
-    merged = tuple(sorted({s for b in blocks for s in (b.dictionary or ())}))
-    index = {s: i for i, s in enumerate(merged)}
-    did = intern_dictionary(merged)
-    out = []
-    for b in blocks:
-        d = b.dictionary or ()
-        mapping = jnp.asarray(np.array([index[s] for s in d], np.int32))
-        data = mapping[b.data] if len(d) else b.data
-        out.append(Block(data, b.type, b.valid, did))
-    return out, did
-
-
 class Executor:
     def __init__(self, catalog, shrink: bool = True, jit: bool = True,
                  collector=None):
@@ -198,6 +177,10 @@ class Executor:
 
     # -- joins --
     def _exec_join(self, node: N.Join, left: Page, right: Page) -> Page:
+        if node.kind == "full" or (
+            node.kind != "inner" and node.residual is not None
+        ):
+            return self._exec_outer_join(node, left, right)
         right_names = right.names
         if node.unique_build:
             fn = self._kernel(
@@ -245,6 +228,92 @@ class Executor:
                 raise ExecutionError("residual on outer join not yet supported")
             out = filter_page(out, node.residual)
         return self._shrink(out)
+
+    def _exec_outer_join(self, node: N.Join, left: Page, right: Page) -> Page:
+        """LEFT join with a residual ON filter, and FULL OUTER join.
+
+        Composition (reference handles these inside LookupJoinOperator +
+        OuterLookupSource; here they compose from the same primitive
+        kernels): inner-expand on the equi keys, apply the residual, then
+        null-extend the probe rows (and for FULL the build rows) whose row
+        id has no surviving match."""
+        from ..ops.union import concat_pages, extend_with_nulls
+
+        full = node.kind == "full"
+        taken = set(left.names) | set(right.names)
+        i = 0
+        while f"$ridL{i}" in taken or f"$ridR{i}" in taken:
+            i += 1
+        rid_l, rid_r = f"$ridL{i}", f"$ridR{i}"
+        left2 = self._with_row_id(left, rid_l)
+        right2 = self._with_row_id(right, rid_r)
+        rid_t = T.BIGINT
+
+        bs = build(right2, node.right_keys)
+        probe_out = list(left.names) + [rid_l]
+        build_out = [(n, n) for n in right.names] + [(rid_r, rid_r)]
+        cap = round_capacity(max(int(left.count), 1))
+        while True:
+            expanded, overflow = join_expand(
+                left2,
+                bs,
+                node.left_keys,
+                probe_out,
+                build_out,
+                out_capacity=cap,
+                kind="inner",
+            )
+            if int(overflow) == 0:
+                break
+            cap = round_capacity(cap + int(overflow))
+            self._retries += 1
+        matched = (
+            filter_page(expanded, node.residual)
+            if node.residual is not None
+            else expanded
+        )
+        matched = self._shrink(matched)
+
+        def drop(page: Page, names) -> Page:
+            keep = [
+                (b, n)
+                for b, n in zip(page.blocks, page.names)
+                if n not in names
+            ]
+            return Page(
+                tuple(b for b, _ in keep), tuple(n for _, n in keep), page.count
+            )
+
+        parts = [drop(matched, {rid_l, rid_r})]
+
+        # probe rows with no surviving match -> null build columns
+        bs_l = build(matched, (ir.ColumnRef(rid_l, rid_t),))
+        left_un = join_n1(
+            left2, bs_l, (ir.ColumnRef(rid_l, rid_t),), [], [], kind="anti"
+        )
+        parts.append(
+            extend_with_nulls(
+                drop(left_un, {rid_l}),
+                right.names,
+                [b.type for b in right.blocks],
+                [b.dict_id for b in right.blocks],
+            )
+        )
+        if full:
+            bs_r = build(matched, (ir.ColumnRef(rid_r, rid_t),))
+            right_un = join_n1(
+                right2, bs_r, (ir.ColumnRef(rid_r, rid_t),), [], [], kind="anti"
+            )
+            parts.append(
+                extend_with_nulls(
+                    drop(right_un, {rid_r}),
+                    left.names,
+                    [b.type for b in left.blocks],
+                    [b.dict_id for b in left.blocks],
+                    prepend=True,
+                )
+            )
+        return self._shrink(concat_pages(parts))
 
     def _exec_semijoin(self, node: N.SemiJoin, probe: Page, source: Page) -> Page:
         if node.residual is None:
@@ -334,10 +403,10 @@ class Executor:
         names = list(page.names)
         for b, (fname, ftype) in zip(sub.blocks, node.subquery.fields):
             if n == 0:
-                data = jnp.zeros((cap,), b.data.dtype)
+                data = jnp.zeros((cap,) + b.data.shape[1:], b.data.dtype)
                 valid = jnp.zeros((cap,), jnp.bool_)
             else:
-                data = jnp.broadcast_to(b.data[0], (cap,))
+                data = jnp.broadcast_to(b.data[0], (cap,) + b.data.shape[1:])
                 if b.valid is None:
                     valid = None
                 else:
@@ -372,34 +441,7 @@ class Executor:
         return self._shrink(limit_page(page, node.count))
 
     def _exec_union(self, node: N.Union, *pages: Page) -> Page:
-        first = pages[0]
-        total_cap = sum(p.capacity for p in pages)
-        blocks = []
-        for i, name in enumerate(first.names):
-            col_blocks = [p.blocks[i] for p in pages]
-            col_blocks, dict_id = _unify_block_dictionaries(col_blocks)
-            datas = []
-            valids = []
-            any_valid = any(b.valid is not None for b in col_blocks)
-            for p, b in zip(pages, col_blocks):
-                datas.append(b.data.astype(first.blocks[i].data.dtype))
-                if any_valid:
-                    valids.append(
-                        b.valid
-                        if b.valid is not None
-                        else jnp.ones((p.capacity,), jnp.bool_)
-                    )
-            data = jnp.concatenate(datas)
-            valid = jnp.concatenate(valids) if any_valid else None
-            blocks.append(
-                Block(data, first.blocks[i].type, valid, dict_id)
-            )
-        occ_parts = [
-            jnp.arange(p.capacity, dtype=jnp.int32) < p.count for p in pages
-        ]
-        occ = jnp.concatenate(occ_parts)
-        out = Page(tuple(blocks), first.names, jnp.asarray(total_cap, jnp.int32))
-        out = compact(out, occ)
-        if node.distinct:
-            out = distinct_page(out, out.capacity)
-        return self._shrink(out)
+        from ..ops.union import concat_pages
+
+        # positional union: output schema/names follow the first branch
+        return self._shrink(concat_pages(pages, distinct=node.distinct))

@@ -215,11 +215,32 @@ def _cast_val(v: Val, to: T.Type) -> Val:
         if isinstance(frm, T.VarcharType):
             return Val(v.data, v.valid, to, v.dict_id)
         raise NotImplementedError(f"cast {frm} -> varchar")
+    frm_long = isinstance(frm, T.DecimalType) and frm.is_long
     if isinstance(to, T.DoubleType) or isinstance(to, T.RealType):
         s = frm.scale if isinstance(frm, T.DecimalType) else 0
-        d = v.data.astype(to.storage_dtype)
+        if frm_long:
+            from ..ops import decimal128 as d128
+
+            d = d128.to_float64(v.data).astype(to.storage_dtype)
+        else:
+            d = v.data.astype(to.storage_dtype)
         return Val(d / (10**s) if s else d, v.valid, to)
     if isinstance(to, T.DecimalType):
+        if to.is_long:
+            from .functions import _to_lanes
+
+            if T.is_floating(frm):
+                from ..ops import decimal128 as d128
+                from .functions import _round_half_away
+
+                d = _round_half_away(v.data * (10**to.scale)).astype(jnp.int64)
+                return Val(d128.from_int64(d), v.valid, to)
+            return Val(_to_lanes(v, to.scale), v.valid, to)
+        if frm_long:
+            from ..ops import decimal128 as d128
+
+            lanes = d128.rescale(v.data, to.scale - frm.scale)
+            return Val(d128.to_int64(lanes), v.valid, to)
         if isinstance(frm, T.DecimalType):
             return Val(
                 _rescale_int(v.data, frm.scale, to.scale), v.valid, to
@@ -231,6 +252,11 @@ def _cast_val(v: Val, to: T.Type) -> Val:
             return Val(d, v.valid, to)
         return Val(v.data.astype(jnp.int64) * (10**to.scale), v.valid, to)
     if T.is_integral(to):
+        if frm_long:
+            from ..ops import decimal128 as d128
+
+            lanes = d128.rescale(v.data, -frm.scale)
+            return Val(d128.to_int64(lanes).astype(to.storage_dtype), v.valid, to)
         if isinstance(frm, T.DecimalType):
             d = _rescale_int(v.data, frm.scale, 0)
             return Val(d.astype(to.storage_dtype), v.valid, to)

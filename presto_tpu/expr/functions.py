@@ -125,16 +125,20 @@ def _arith_infer(op):
         if da or db:
             sa = a.scale if da else 0
             sb = b.scale if db else 0
+            # precision 38 when either side is long (reference
+            # DecimalOperators: decimal(38) arithmetic stays decimal(38))
+            long = (da and a.precision > 18) or (db and b.precision > 18)
+            p = 38 if long else 18
             if op in ("add", "subtract"):
-                return T.DecimalType(18, max(sa, sb))
+                return T.DecimalType(p, max(sa, sb))
             if op == "multiply":
-                return T.DecimalType(18, min(sa + sb, 18))
+                return T.DecimalType(p, min(sa + sb, 18))
             if op == "divide":
                 # reference: decimal division stays decimal
                 # (DecimalOperators.java); scale = max(sa, sb) after rescale
-                return T.DecimalType(18, max(sa, sb, 6))
+                return T.DecimalType(p, max(sa, sb, 6))
             if op == "modulus":
-                return T.DecimalType(18, max(sa, sb))
+                return T.DecimalType(p, max(sa, sb))
         # integral
         return T.common_super_type(a, b)
 
@@ -191,15 +195,26 @@ def _round_half_away(x):
 
 def _to_double(v: Val):
     s = _scale_of(v.type)
-    d = v.data.astype(jnp.float64)
+    if v.data.ndim == 2:  # long-decimal lanes
+        from ..ops import decimal128 as d128
+
+        d = d128.to_float64(v.data)
+    else:
+        d = v.data.astype(jnp.float64)
     return d / (10**s) if s else d
 
 
 def _numeric_align(a: Val, b: Val, out_type: T.Type):
-    """Bring both operands into the output type's representation."""
+    """Bring both operands into the output type's representation.
+    Long-decimal outputs align as lane pairs (exact int128-style path)."""
     if isinstance(out_type, T.DoubleType) or isinstance(out_type, T.RealType):
         return _to_double(a), _to_double(b)
     if isinstance(out_type, T.DecimalType):
+        if out_type.is_long:
+            return (
+                _to_lanes(a, out_type.scale),
+                _to_lanes(b, out_type.scale),
+            )
         return (
             _rescale(a.data.astype(jnp.int64), _scale_of(a.type), out_type.scale),
             _rescale(b.data.astype(jnp.int64), _scale_of(b.type), out_type.scale),
@@ -224,6 +239,10 @@ def _add(a: Val, b: Val, out_type: T.Type) -> Val:
             data = (date.data.astype(jnp.int64) + delta.data).astype(jnp.int32)
         return Val(data, valid, T.DATE)
     x, y = _numeric_align(a, b, out_type)
+    if _is_long_dec(out_type):
+        from ..ops import decimal128 as d128
+
+        return Val(d128.dadd(x, y), valid, out_type)
     return Val(x + y, valid, out_type)
 
 
@@ -239,6 +258,10 @@ def _subtract(a: Val, b: Val, out_type: T.Type) -> Val:
     if isinstance(a.type, T.DateType) and isinstance(b.type, T.DateType):
         return Val(a.data.astype(jnp.int64) - b.data.astype(jnp.int64), valid, T.BIGINT)
     x, y = _numeric_align(a, b, out_type)
+    if _is_long_dec(out_type):
+        from ..ops import decimal128 as d128
+
+        return Val(d128.dsub(x, y), valid, out_type)
     return Val(x - y, valid, out_type)
 
 
@@ -246,12 +269,35 @@ def _subtract(a: Val, b: Val, out_type: T.Type) -> Val:
 def _multiply(a: Val, b: Val, out_type: T.Type) -> Val:
     valid = and_valid(a.valid, b.valid)
     if isinstance(out_type, T.DecimalType):
+        have = _scale_of(a.type) + _scale_of(b.type)
+        if out_type.is_long:
+            from ..ops import decimal128 as d128
+
+            # one side long x one side int64-representable: exact limb
+            # multiply, then rescale lanes (both-long multiply exceeds the
+            # 2^95 contract and the narrow side is always p<=18 in plans)
+            wide, narrow = (a, b) if a.data.ndim == 2 else (b, a)
+            if wide.data.ndim != 2:  # both short but long result type
+                lanes = d128.dmul_int64(
+                    d128.from_int64(a.data.astype(jnp.int64)),
+                    b.data.astype(jnp.int64),
+                )
+            else:
+                if narrow.data.ndim == 2:
+                    raise NotImplementedError(
+                        "multiply of two long decimals is not supported"
+                    )
+                lanes = d128.dmul_int64(
+                    wide.data, narrow.data.astype(jnp.int64)
+                )
+            return Val(
+                d128.rescale(lanes, out_type.scale - have), valid, out_type
+            )
         # scales add under multiplication: compute in raw units then the
         # result scale is sa+sb == out_type.scale (capped by inference)
         x = a.data.astype(jnp.int64)
         y = b.data.astype(jnp.int64)
         raw = x * y
-        have = _scale_of(a.type) + _scale_of(b.type)
         return Val(_rescale(raw, have, out_type.scale), valid, out_type)
     x, y = _numeric_align(a, b, out_type)
     return Val(x * y, valid, out_type)
@@ -262,6 +308,20 @@ def _divide(a: Val, b: Val, out_type: T.Type) -> Val:
     valid = and_valid(a.valid, b.valid)
     if isinstance(out_type, T.DecimalType):
         xs, ys = _scale_of(a.type), _scale_of(b.type)
+        if out_type.is_long:
+            from ..ops import decimal128 as d128
+
+            x = _to_lanes(a, out_type.scale + ys)
+            if b.data.ndim == 2:
+                # long divisor: narrow to int64 raw units (exact while the
+                # divisor magnitude < 2^63 — decimal ratios like Q8's market
+                # share; quotients of larger divisors exceed no int64 anyway)
+                y = d128.to_int64(b.data)
+            else:
+                y = b.data.astype(jnp.int64)
+            q = d128.ddiv_wide(x, y)  # handles the full int64 divisor range
+            valid = and_valid(valid, y != 0)
+            return Val(d128.from_int64(q), valid, out_type)
         # scale numerator so raw-int division yields out_type.scale
         x = _rescale(a.data.astype(jnp.int64), xs, out_type.scale + ys)
         y = b.data.astype(jnp.int64)
@@ -292,6 +352,10 @@ def _modulus(a: Val, b: Val, out_type: T.Type) -> Val:
 
 @register("negate", _same_as_first)
 def _negate(a: Val, out_type: T.Type) -> Val:
+    if a.data.ndim == 2:
+        from ..ops import decimal128 as d128
+
+        return Val(d128.dneg(a.data), a.valid, out_type)
     return Val(-a.data, a.valid, out_type)
 
 
@@ -351,7 +415,48 @@ def _literal_cmp_fastpath(name: str, a: Val, b: Val):
     return _LITERAL_CMP[name](col_v.data, jnp.int32(bl), jnp.int32(br))
 
 
+def _is_long_dec(t: T.Type) -> bool:
+    return isinstance(t, T.DecimalType) and t.precision > 18
+
+
+def _to_lanes(v: Val, to_scale: int):
+    """Any numeric Val -> two-lane representation at `to_scale`
+    (ops/decimal128.py). Integral/short-decimal operands widen first so the
+    rescale itself cannot overflow int64."""
+    from ..ops import decimal128 as d128
+
+    s = _scale_of(v.type)
+    lanes = v.data if v.data.ndim == 2 else d128.from_int64(
+        v.data.astype(jnp.int64)
+    )
+    return d128.rescale(lanes, to_scale - s)
+
+
+def _compare_long(name: str, a: Val, b: Val):
+    from ..ops import decimal128 as d128
+
+    s = max(_scale_of(a.type), _scale_of(b.type))
+    x, y = _to_lanes(a, s), _to_lanes(b, s)
+    if name == "eq":
+        return d128.dcmp_eq(x, y)
+    if name == "ne":
+        return ~d128.dcmp_eq(x, y)
+    if name == "lt":
+        return d128.dcmp_lt(x, y)
+    if name == "gt":
+        return d128.dcmp_lt(y, x)
+    if name == "le":
+        return ~d128.dcmp_lt(y, x)
+    if name == "ge":
+        return ~d128.dcmp_lt(x, y)
+    raise KeyError(name)
+
+
 def _compare(op, a: Val, b: Val, name: str = ""):
+    if _is_long_dec(a.type) or _is_long_dec(b.type):
+        if T.is_floating(a.type) or T.is_floating(b.type):
+            return op(_to_double(a), _to_double(b))
+        return _compare_long(name, a, b)
     if isinstance(a.type, T.VarcharType) and isinstance(b.type, T.VarcharType):
         if name in _LITERAL_CMP and (
             len(a.dictionary or ()) == 1 or len(b.dictionary or ()) == 1
@@ -427,6 +532,10 @@ _cmp_factory("ge", lambda x, y: x >= y)
 
 @register("abs", _same_as_first)
 def _abs(a: Val, out_type: T.Type) -> Val:
+    if a.data.ndim == 2:
+        from ..ops import decimal128 as d128
+
+        return Val(d128.dabs(a.data), a.valid, out_type)
     return Val(jnp.abs(a.data), a.valid, out_type)
 
 
@@ -653,3 +762,568 @@ def _strpos(a: Val, needle: Val, out_type: T.Type) -> Val:
     d = a.dictionary or ()
     table = jnp.asarray(np.array([s.find(n) + 1 for s in d], np.int64))
     return Val(table[a.data], a.valid, T.BIGINT)
+
+
+# ---------------------------------------------------------------------------
+# math scalars, batch 2 (reference operator/scalar/MathFunctions.java)
+# ---------------------------------------------------------------------------
+
+
+def _unary_double(name, fn, domain=None):
+    @register(name, _double_infer)
+    def _f(a: Val, out_type: T.Type) -> Val:
+        x = _to_double(a)
+        data = fn(x)
+        valid = a.valid
+        if domain is not None:
+            valid = and_valid(valid, domain(x))
+        return Val(data, valid, T.DOUBLE)
+
+    return _f
+
+
+_unary_double("log10", jnp.log10, domain=lambda x: x > 0)
+_unary_double("log2", jnp.log2, domain=lambda x: x > 0)
+_unary_double("cbrt", jnp.cbrt)
+_unary_double("degrees", jnp.degrees)
+_unary_double("radians", jnp.radians)
+_unary_double("sin", jnp.sin)
+_unary_double("cos", jnp.cos)
+_unary_double("tan", jnp.tan)
+_unary_double("asin", jnp.arcsin, domain=lambda x: jnp.abs(x) <= 1)
+_unary_double("acos", jnp.arccos, domain=lambda x: jnp.abs(x) <= 1)
+_unary_double("atan", jnp.arctan)
+_unary_double("sinh", jnp.sinh)
+_unary_double("cosh", jnp.cosh)
+_unary_double("tanh", jnp.tanh)
+
+
+@register("atan2", _double_infer)
+def _atan2(a: Val, b: Val, out_type: T.Type) -> Val:
+    return Val(
+        jnp.arctan2(_to_double(a), _to_double(b)),
+        and_valid(a.valid, b.valid),
+        T.DOUBLE,
+    )
+
+
+@register("log", _double_infer)
+def _log(a: Val, b: Val, out_type: T.Type) -> Val:
+    """log(base, x) (reference MathFunctions.log)."""
+    base = _to_double(a)
+    x = _to_double(b)
+    data = jnp.log(x) / jnp.log(base)
+    ok = (x > 0) & (base > 0) & (base != 1)
+    return Val(data, and_valid(a.valid, b.valid, ok), T.DOUBLE)
+
+
+@register("sign", _same_as_first)
+def _sign(a: Val, out_type: T.Type) -> Val:
+    if a.data.ndim == 2:
+        from ..ops import decimal128 as d128
+
+        sg = d128.dsign(a.data)
+        return Val(d128.from_int64(sg * 10**out_type.scale), a.valid, out_type)
+    if isinstance(out_type, T.DecimalType):
+        data = jnp.sign(a.data) * (10**out_type.scale)
+        return Val(data.astype(jnp.int64), a.valid, out_type)
+    return Val(jnp.sign(a.data), a.valid, out_type)
+
+
+@register("mod", _arith_infer("modulus"))
+def _mod(a: Val, b: Val, out_type: T.Type) -> Val:
+    return FUNCTIONS["modulus"].impl(a, b, out_type=out_type)
+
+
+def _truncate_infer(ts):
+    return ts[0]
+
+
+@register("truncate", _truncate_infer)
+def _truncate(a: Val, out_type: T.Type) -> Val:
+    """Truncate toward zero (reference MathFunctions.truncate)."""
+    if T.is_floating(a.type):
+        return Val(jnp.trunc(a.data), a.valid, out_type)
+    if isinstance(a.type, T.DecimalType):
+        s = a.type.scale
+        if s == 0:
+            return a
+        if a.data.ndim == 2:  # long decimal: lane-exact trunc to scale 0
+            from ..ops import decimal128 as d128
+
+            neg = a.data[..., 0] < 0
+            mag = d128.dabs(a.data)
+            p = s
+            while p > 0:
+                step = min(p, 9)
+                mag, _ = d128._divmod_nonneg(mag, jnp.int64(10**step))
+                p -= step
+            mag = d128.rescale(mag, s)
+            data = jnp.where(neg[..., None], d128.dneg(mag), mag)
+            return Val(data, a.valid, out_type)
+        p = 10**s
+        data = (jnp.abs(a.data) // p) * p * jnp.sign(a.data)
+        return Val(data, a.valid, out_type)
+    return a
+
+
+@register("width_bucket", _bigint_infer)
+def _width_bucket(x: Val, lo: Val, hi: Val, n: Val, out_type: T.Type) -> Val:
+    xv, lov, hiv = _to_double(x), _to_double(lo), _to_double(hi)
+    nv = n.data.astype(jnp.int64)
+    frac = (xv - lov) / (hiv - lov)
+    b = jnp.floor(frac * nv.astype(jnp.float64)).astype(jnp.int64) + 1
+    b = jnp.clip(b, 0, nv + 1)
+    return Val(b, and_valid(x.valid, lo.valid, hi.valid, n.valid), T.BIGINT)
+
+
+@register("is_nan", _bool_infer)
+def _is_nan(a: Val, out_type: T.Type) -> Val:
+    return Val(jnp.isnan(_to_double(a)), a.valid, T.BOOLEAN)
+
+
+@register("is_finite", _bool_infer)
+def _is_finite(a: Val, out_type: T.Type) -> Val:
+    return Val(jnp.isfinite(_to_double(a)), a.valid, T.BOOLEAN)
+
+
+@register("is_infinite", _bool_infer)
+def _is_infinite(a: Val, out_type: T.Type) -> Val:
+    return Val(jnp.isinf(_to_double(a)), a.valid, T.BOOLEAN)
+
+
+def _nary_common_infer(ts):
+    out = ts[0]
+    for t2 in ts[1:]:
+        out = T.common_super_type(out, t2)
+    return out
+
+
+def _minmax_nary(name, op, want_larger: bool):
+    @register(name, _nary_common_infer)
+    def _f(*vals, out_type: T.Type) -> Val:
+        # NULL-propagating (reference greatest/least return NULL on any NULL)
+        valid = and_valid(*[v.valid for v in vals])
+        if isinstance(out_type, T.VarcharType):
+            acc = vals[0]
+            for v in vals[1:]:
+                require_sorted_dict(acc, name)
+                require_sorted_dict(v, name)
+                xa, xb, did = unify_dictionaries(acc, v)
+                acc = Val(op(xa, xb), None, out_type, did)
+            return Val(acc.data, valid, out_type, acc.dict_id)
+        if isinstance(out_type, T.DecimalType) and out_type.is_long:
+            from ..ops import decimal128 as d128
+
+            acc = _to_lanes(vals[0], out_type.scale)
+            for v in vals[1:]:
+                c = _to_lanes(v, out_type.scale)
+                take = d128.dcmp_lt(acc, c) if want_larger else d128.dcmp_lt(c, acc)
+                acc = jnp.where(take[..., None], c, acc)
+            return Val(acc, valid, out_type)
+        from .compiler import _cast_val
+
+        cs = [_cast_val(v, out_type) for v in vals]
+        data = cs[0].data
+        for c in cs[1:]:
+            data = op(data, c.data)
+        return Val(data, valid, out_type)
+
+    return _f
+
+
+_minmax_nary("greatest", jnp.maximum, True)
+_minmax_nary("least", jnp.minimum, False)
+
+
+# -- bitwise (reference operator/scalar/BitwiseFunctions.java) --------------
+
+
+def _bitwise(name, fn):
+    @register(name, _bigint_infer)
+    def _f(a: Val, b: Val, out_type: T.Type) -> Val:
+        x = a.data.astype(jnp.int64)
+        y = b.data.astype(jnp.int64)
+        return Val(fn(x, y), and_valid(a.valid, b.valid), T.BIGINT)
+
+    return _f
+
+
+_bitwise("bitwise_and", lambda x, y: x & y)
+_bitwise("bitwise_or", lambda x, y: x | y)
+_bitwise("bitwise_xor", lambda x, y: x ^ y)
+_bitwise("bitwise_left_shift", lambda x, y: x << y)
+_bitwise("bitwise_right_shift", lambda x, y: (x.view(jnp.uint64) >> y.view(jnp.uint64)).view(jnp.int64))
+_bitwise("bitwise_arithmetic_shift_right", lambda x, y: x >> y)
+
+
+@register("bitwise_not", _bigint_infer)
+def _bitwise_not(a: Val, out_type: T.Type) -> Val:
+    return Val(~a.data.astype(jnp.int64), a.valid, T.BIGINT)
+
+
+@register("bit_count", _bigint_infer)
+def _bit_count(a: Val, b: Val, out_type: T.Type) -> Val:
+    """bit_count(x, bits) (reference BitwiseFunctions.bitCount)."""
+    bits = int(_require_literal(b, "bit_count bits"))
+    x = a.data.astype(jnp.int64)
+    if bits < 64:
+        mask = (np.int64(1) << bits) - 1
+        x = x & mask
+    cnt = jnp.bitwise_count(x.view(jnp.uint64)).astype(jnp.int64)
+    return Val(cnt, a.valid, T.BIGINT)
+
+
+# ---------------------------------------------------------------------------
+# string scalars, batch 2 (reference operator/scalar/StringFunctions.java)
+# ---------------------------------------------------------------------------
+
+
+def _dict_str_fn(name, fn):
+    @register(name, _varchar_infer)
+    def _f(a: Val, *rest, out_type: T.Type) -> Val:
+        lits = [_require_literal(r, f"{name} argument") for r in rest]
+        return _dict_transform(a, lambda s: fn(s, *lits))
+
+    return _f
+
+
+_dict_str_fn("reverse", lambda s: s[::-1])
+_dict_str_fn("ltrim", lambda s, *a: s.lstrip(*a))
+_dict_str_fn("rtrim", lambda s, *a: s.rstrip(*a))
+_dict_str_fn("replace", lambda s, old, new="": s.replace(old, new))
+_dict_str_fn(
+    "lpad",
+    lambda s, n, pad=" ": s[: int(n)]
+    if len(s) >= int(n)
+    else (pad * int(n))[: int(n) - len(s)] + s,
+)
+_dict_str_fn(
+    "rpad",
+    lambda s, n, pad=" ": s[: int(n)]
+    if len(s) >= int(n)
+    else s + (pad * int(n))[: int(n) - len(s)],
+)
+_dict_str_fn(
+    "split_part",
+    lambda s, delim, idx: (
+        s.split(delim)[int(idx) - 1] if 0 < int(idx) <= len(s.split(delim)) else ""
+    ),
+)
+
+
+@register("starts_with", _bool_infer)
+def _starts_with(a: Val, prefix: Val, out_type: T.Type) -> Val:
+    p = _require_literal(prefix, "starts_with prefix")
+    return _dict_predicate(a, lambda s: s.startswith(p))
+
+
+@register("ends_with", _bool_infer)
+def _ends_with(a: Val, suffix: Val, out_type: T.Type) -> Val:
+    p = _require_literal(suffix, "ends_with suffix")
+    return _dict_predicate(a, lambda s: s.endswith(p))
+
+
+@register("codepoint", _bigint_infer)
+def _codepoint(a: Val, out_type: T.Type) -> Val:
+    d = a.dictionary or ()
+    table = jnp.asarray(
+        np.array([ord(s[0]) if s else 0 for s in d], np.int64)
+    )
+    return Val(table[a.data], a.valid, T.BIGINT)
+
+
+@register("chr", _varchar_infer)
+def _chr(a: Val, out_type: T.Type) -> Val:
+    n = int(_require_literal(a, "chr codepoint"))
+    d = (chr(n),)
+    return Val(
+        jnp.zeros_like(a.data, dtype=jnp.int32), a.valid, T.VARCHAR,
+        intern_dictionary(d),
+    )
+
+
+@register("levenshtein_distance", _bigint_infer)
+def _levenshtein(a: Val, b: Val, out_type: T.Type) -> Val:
+    target = _require_literal(b, "levenshtein_distance target")
+
+    def lev(s: str) -> int:
+        prev = list(range(len(target) + 1))
+        for i, cs in enumerate(s, 1):
+            cur = [i]
+            for j, ct in enumerate(target, 1):
+                cur.append(
+                    min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (cs != ct))
+                )
+            prev = cur
+        return prev[-1]
+
+    d = a.dictionary or ()
+    table = jnp.asarray(np.array([lev(s) for s in d], np.int64))
+    return Val(table[a.data], a.valid, T.BIGINT)
+
+
+@register("hamming_distance", _bigint_infer)
+def _hamming(a: Val, b: Val, out_type: T.Type) -> Val:
+    target = _require_literal(b, "hamming_distance target")
+
+    def ham(s: str) -> int:
+        if len(s) != len(target):
+            return -1  # reference raises; NULL-out below
+        return sum(x != y for x, y in zip(s, target))
+
+    d = a.dictionary or ()
+    arr = np.array([ham(s) for s in d], np.int64)
+    table = jnp.asarray(arr)
+    got = table[a.data]
+    return Val(got, and_valid(a.valid, got >= 0), T.BIGINT)
+
+
+# -- regexp (reference operator/scalar/Re2JRegexpFunctions / joni) ----------
+
+
+@register("regexp_like", _bool_infer)
+def _regexp_like(a: Val, pattern: Val, out_type: T.Type) -> Val:
+    pat = re.compile(_require_literal(pattern, "regexp pattern"))
+    return _dict_predicate(a, lambda s: pat.search(s) is not None)
+
+
+@register("regexp_replace", _varchar_infer)
+def _regexp_replace(a: Val, pattern: Val, *rest, out_type: T.Type) -> Val:
+    pat = re.compile(_require_literal(pattern, "regexp pattern"))
+    repl = _require_literal(rest[0], "replacement") if rest else ""
+    repl = re.sub(r"\$(\d+)", r"\\\1", repl)  # $1 -> \1 group syntax
+    return _dict_transform(a, lambda s: pat.sub(repl, s))
+
+
+@register("regexp_extract", _varchar_infer)
+def _regexp_extract(a: Val, pattern: Val, *rest, out_type: T.Type) -> Val:
+    pat = re.compile(_require_literal(pattern, "regexp pattern"))
+    group = int(_require_literal(rest[0], "group")) if rest else 0
+    d = a.dictionary or ()
+    results = []
+    nulls = []
+    for s in d:
+        m = pat.search(s)
+        g = None if m is None else m.group(group)
+        if g is None:  # no match, or the group didn't participate
+            results.append("")
+            nulls.append(True)
+        else:
+            results.append(g)
+            nulls.append(False)
+    new_dict = tuple(sorted(set(results)))
+    index = {s: i for i, s in enumerate(new_dict)}
+    mapping = jnp.asarray(np.array([index[r] for r in results], np.int32))
+    nullmap = jnp.asarray(np.array(nulls, np.bool_))
+    valid = and_valid(a.valid, ~nullmap[a.data])
+    return Val(mapping[a.data], valid, T.VARCHAR, intern_dictionary(new_dict))
+
+
+@register("regexp_count", _bigint_infer)
+def _regexp_count(a: Val, pattern: Val, out_type: T.Type) -> Val:
+    pat = re.compile(_require_literal(pattern, "regexp pattern"))
+    d = a.dictionary or ()
+    table = jnp.asarray(
+        np.array([len(pat.findall(s)) for s in d], np.int64)
+    )
+    return Val(table[a.data], a.valid, T.BIGINT)
+
+
+# ---------------------------------------------------------------------------
+# datetime scalars, batch 2 (reference operator/scalar/DateTimeFunctions.java)
+# ---------------------------------------------------------------------------
+
+
+@register("day_of_week", _bigint_infer)
+def _day_of_week(a: Val, out_type: T.Type) -> Val:
+    # ISO: Monday=1..Sunday=7; 1970-01-01 was a Thursday (=4)
+    dow = (a.data.astype(jnp.int64) + 3) % 7 + 1
+    return Val(dow, a.valid, T.BIGINT)
+
+
+@register("dow", _bigint_infer)
+def _dow(a: Val, out_type: T.Type) -> Val:
+    return FUNCTIONS["day_of_week"].impl(a, out_type=out_type)
+
+
+@register("day_of_year", _bigint_infer)
+def _day_of_year(a: Val, out_type: T.Type) -> Val:
+    days = a.data.astype(jnp.int64)
+    y, _, _ = dt.days_to_civil(days)
+    jan1 = dt.civil_to_days(y, jnp.ones_like(y), jnp.ones_like(y))
+    return Val(days - jan1 + 1, a.valid, T.BIGINT)
+
+
+@register("doy", _bigint_infer)
+def _doy(a: Val, out_type: T.Type) -> Val:
+    return FUNCTIONS["day_of_year"].impl(a, out_type=out_type)
+
+
+@register("week", _bigint_infer)
+def _week(a: Val, out_type: T.Type) -> Val:
+    """ISO-8601 week number (reference DateTimeFunctions.weekFromDate)."""
+    days = a.data.astype(jnp.int64)
+    # ISO week = week containing the first Thursday of the year.
+    # thursday of this date's week:
+    thursday = days - ((days + 3) % 7) + 3
+    y, _, _ = dt.days_to_civil(thursday)
+    jan1 = dt.civil_to_days(y, jnp.ones_like(y), jnp.ones_like(y))
+    week = (thursday - jan1) // 7 + 1
+    return Val(week, a.valid, T.BIGINT)
+
+
+def _date_infer(ts):
+    return T.DATE
+
+
+@register("last_day_of_month", _date_infer)
+def _last_day_of_month_fn(a: Val, out_type: T.Type) -> Val:
+    days = a.data.astype(jnp.int64)
+    y, m, _ = dt.days_to_civil(days)
+    ld = dt.last_day_of_month(y, m)
+    out = dt.civil_to_days(y, m, ld)
+    return Val(out.astype(jnp.int32), a.valid, T.DATE)
+
+
+_TS_US = 1_000_000
+
+
+@register("hour", _bigint_infer)
+def _hour(a: Val, out_type: T.Type) -> Val:
+    if isinstance(a.type, T.DateType):
+        return Val(jnp.zeros_like(a.data, dtype=jnp.int64), a.valid, T.BIGINT)
+    us = a.data.astype(jnp.int64)
+    return Val((us // (3600 * _TS_US)) % 24, a.valid, T.BIGINT)
+
+
+@register("minute", _bigint_infer)
+def _minute(a: Val, out_type: T.Type) -> Val:
+    if isinstance(a.type, T.DateType):
+        return Val(jnp.zeros_like(a.data, dtype=jnp.int64), a.valid, T.BIGINT)
+    us = a.data.astype(jnp.int64)
+    return Val((us // (60 * _TS_US)) % 60, a.valid, T.BIGINT)
+
+
+@register("second", _bigint_infer)
+def _second(a: Val, out_type: T.Type) -> Val:
+    if isinstance(a.type, T.DateType):
+        return Val(jnp.zeros_like(a.data, dtype=jnp.int64), a.valid, T.BIGINT)
+    us = a.data.astype(jnp.int64)
+    return Val((us // _TS_US) % 60, a.valid, T.BIGINT)
+
+
+@register("millisecond", _bigint_infer)
+def _millisecond(a: Val, out_type: T.Type) -> Val:
+    us = a.data.astype(jnp.int64)
+    return Val((us // 1000) % 1000, a.valid, T.BIGINT)
+
+
+def _datetrunc_infer(ts):
+    return ts[1]
+
+
+@register("date_trunc", _datetrunc_infer)
+def _date_trunc(unit: Val, a: Val, out_type: T.Type) -> Val:
+    u = _require_literal(unit, "date_trunc unit").lower()
+    if isinstance(a.type, T.TimestampType):
+        us = a.data.astype(jnp.int64)
+        per = {
+            "second": _TS_US,
+            "minute": 60 * _TS_US,
+            "hour": 3600 * _TS_US,
+            "day": 86400 * _TS_US,
+        }.get(u)
+        if per is None:
+            raise NotImplementedError(f"date_trunc({u!r}) on timestamp")
+        return Val((us // per) * per, a.valid, a.type)
+    days = a.data.astype(jnp.int64)
+    y, m, d = dt.days_to_civil(days)
+    one = jnp.ones_like(y)
+    if u == "day":
+        out = days
+    elif u == "week":
+        out = days - (days + 3) % 7  # back to Monday
+    elif u == "month":
+        out = dt.civil_to_days(y, m, one)
+    elif u == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        out = dt.civil_to_days(y, qm, one)
+    elif u == "year":
+        out = dt.civil_to_days(y, one, one)
+    else:
+        raise NotImplementedError(f"date_trunc unit {u!r}")
+    return Val(out.astype(jnp.int32), a.valid, T.DATE)
+
+
+@register("date_add", _datetrunc_infer)
+def _date_add(unit: Val, n: Val, a: Val, out_type: T.Type) -> Val:
+    u = _require_literal(unit, "date_add unit").lower()
+    amount = n.data.astype(jnp.int64)
+    if isinstance(a.type, T.TimestampType):
+        per = {
+            "second": _TS_US,
+            "minute": 60 * _TS_US,
+            "hour": 3600 * _TS_US,
+            "day": 86400 * _TS_US,
+            "week": 7 * 86400 * _TS_US,
+        }.get(u)
+        if per is None:
+            raise NotImplementedError(f"date_add({u!r}) on timestamp")
+        return Val(a.data + amount * per, and_valid(a.valid, n.valid), a.type)
+    days = a.data.astype(jnp.int64)
+    if u == "day":
+        out = days + amount
+    elif u == "week":
+        out = days + 7 * amount
+    elif u == "month":
+        out = dt.add_months(days, amount)
+    elif u == "quarter":
+        out = dt.add_months(days, 3 * amount)
+    elif u == "year":
+        out = dt.add_months(days, 12 * amount)
+    else:
+        raise NotImplementedError(f"date_add unit {u!r}")
+    return Val(out.astype(jnp.int32), and_valid(a.valid, n.valid), T.DATE)
+
+
+@register("date_diff", _bigint_infer)
+def _date_diff(unit: Val, a: Val, b: Val, out_type: T.Type) -> Val:
+    u = _require_literal(unit, "date_diff unit").lower()
+    valid = and_valid(a.valid, b.valid)
+    if isinstance(a.type, T.TimestampType) or isinstance(b.type, T.TimestampType):
+        per = {
+            "second": _TS_US,
+            "minute": 60 * _TS_US,
+            "hour": 3600 * _TS_US,
+            "day": 86400 * _TS_US,
+            "week": 7 * 86400 * _TS_US,
+        }.get(u)
+        if per is None:
+            raise NotImplementedError(f"date_diff({u!r}) on timestamp")
+        delta = b.data - a.data
+        # truncate toward zero (reference DateTimeFunctions.diff semantics)
+        return Val(jnp.sign(delta) * (jnp.abs(delta) // per), valid, T.BIGINT)
+    d1 = a.data.astype(jnp.int64)
+    d2 = b.data.astype(jnp.int64)
+    if u == "day":
+        out = d2 - d1
+    elif u == "week":
+        out = jnp.sign(d2 - d1) * (jnp.abs(d2 - d1) // 7)
+    elif u in ("month", "quarter", "year"):
+        y1, m1, dd1 = dt.days_to_civil(d1)
+        y2, m2, dd2 = dt.days_to_civil(d2)
+        months = (y2 - y1) * 12 + (m2 - m1)
+        # partial months don't count (reference: diffMonth truncates)
+        months = months - jnp.where(
+            (d2 >= d1) & (dd2 < dd1), 1, 0
+        ) + jnp.where((d2 < d1) & (dd2 > dd1), 1, 0)
+        if u == "month":
+            out = months
+        elif u == "quarter":
+            out = months // 3
+        else:
+            out = months // 12
+    else:
+        raise NotImplementedError(f"date_diff unit {u!r}")
+    return Val(out, valid, T.BIGINT)

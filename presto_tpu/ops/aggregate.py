@@ -40,7 +40,7 @@ from ..expr.functions import Val
 from ..page import Block, Page
 from .hashing import hash_rows
 
-SUPPORTED = ("count", "count_star", "sum", "min", "max", "avg")
+SUPPORTED = ("count", "count_star", "sum", "min", "max", "avg", "checksum")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,13 +54,15 @@ class AggSpec:
 
     @staticmethod
     def infer_output_type(func: str, input_type: Optional[T.Type]) -> T.Type:
-        if func in ("count", "count_star"):
+        if func in ("count", "count_star", "checksum"):
             return T.BIGINT
         if func in ("min", "max"):
             return input_type
         if func == "sum":
             if isinstance(input_type, T.DecimalType):
-                return T.DecimalType(18, input_type.scale)
+                # long decimal result (reference: sum(decimal) -> decimal(38,s),
+                # DecimalSumAggregation) — two int64 lanes, ops/decimal128.py
+                return T.DecimalType(38, input_type.scale)
             if T.is_floating(input_type):
                 return T.DOUBLE
             return T.BIGINT
@@ -87,22 +89,53 @@ def _max_identity(dtype):
     return jnp.asarray(jnp.iinfo(dtype).min, dtype)
 
 
-def _segment_reduce(func, data, valid, gid, num_segments):
-    """One aggregate over dense group ids; returns (values, group_has_value)."""
+def _segment_reduce(func, data, valid, gid, num_segments, wide: bool = False):
+    """One aggregate over dense group ids; returns (values, group_has_value).
+
+    wide=True accumulates sums in two int64 lanes (ops/decimal128.py) —
+    exact beyond int64, the reference's decimal(38) sum path. Lane-shaped
+    inputs (data.ndim == 2, partial sums being re-aggregated) stay wide."""
+    from . import decimal128 as d128
+
     contributes = valid
     if func in ("count", "count_star"):
         ones = contributes.astype(jnp.int64)
         return jax.ops.segment_sum(ones, gid, num_segments), None
+    if func == "checksum":
+        # order-independent wrapping sum of row hashes (reference
+        # ChecksumAggregationFunction uses XOR; a mod-2^64 sum has the same
+        # order/partition invariance and segments natively). Inputs arrive
+        # pre-hashed by _eval_inputs; NULL rows contribute the null hash.
+        x = jnp.where(contributes, data, jnp.zeros_like(data))
+        return jax.ops.segment_sum(x, gid, num_segments), None
     masked_count = jax.ops.segment_sum(
         contributes.astype(jnp.int64), gid, num_segments
     )
     has = masked_count > 0
+    lanes_in = data.ndim == 2
     if func in ("sum", "avg"):
-        contrib = jnp.where(contributes, data, jnp.zeros_like(data))
-        s = jax.ops.segment_sum(contrib, gid, num_segments)
+        if lanes_in or (wide and jnp.issubdtype(data.dtype, jnp.integer)):
+            lanes = data if lanes_in else d128.from_int64(data)
+            lanes = jnp.where(contributes[:, None], lanes, 0)
+            s = d128.segment_sum_wide(lanes, gid, num_segments)
+        else:
+            contrib = jnp.where(contributes, data, jnp.zeros_like(data))
+            s = jax.ops.segment_sum(contrib, gid, num_segments)
         if func == "sum":
             return s, has
         return (s, masked_count), has
+    if lanes_in:  # min/max over long decimal lanes: lexicographic two-pass
+        ident_hi = (
+            _min_identity(data.dtype) if func == "min" else _max_identity(data.dtype)
+        )
+        hi = jnp.where(contributes, data[:, 0], ident_hi)
+        lo = jnp.where(contributes, data[:, 1], ident_hi)
+        seg = jax.ops.segment_min if func == "min" else jax.ops.segment_max
+        best_hi = seg(hi, gid, num_segments)
+        on_best = contributes & (data[:, 0] == best_hi[gid])
+        lo2 = jnp.where(on_best, lo, ident_hi)
+        best_lo = seg(lo2, gid, num_segments)
+        return jnp.stack([best_hi, best_lo], axis=-1), has
     if func == "min":
         contrib = jnp.where(contributes, data, _min_identity(data.dtype))
         return jax.ops.segment_min(contrib, gid, num_segments), has
@@ -116,8 +149,23 @@ def avg_from_sum_count(s, cnt, output_type: T.Type, input_type: Optional[T.Type]
     """Finalize avg from (sum, count): decimal HALF_UP in scaled units, else
     double division (descaling decimal inputs). Shared by the single-node
     finalizer and the distributed post-exchange step so semantics can never
-    diverge between them."""
+    diverge between them. Wide (two-lane) sums divide exactly via
+    ops/decimal128.py (counts < 2^31, the per-chip row bound)."""
+    from . import decimal128 as d128
+
     safe = jnp.maximum(cnt, 1)
+    if s.ndim == 2:  # exact long-decimal intermediate
+        if isinstance(output_type, T.DecimalType) and output_type.is_long:
+            q = d128.ddiv_int64_half_up(s, safe)
+            return d128.from_int64(q)
+        if isinstance(output_type, T.DecimalType):
+            return d128.ddiv_int64_half_up(s, safe).astype(
+                output_type.storage_dtype
+            )
+        sd = d128.to_float64(s)
+        if input_type is not None and isinstance(input_type, T.DecimalType):
+            sd = sd / (10**input_type.scale)
+        return (sd / safe).astype(output_type.storage_dtype)
     if isinstance(output_type, T.DecimalType):
         data = jnp.sign(s) * ((2 * jnp.abs(s) + safe) // (2 * safe))
     else:
@@ -155,6 +203,41 @@ def _eval_inputs(page: Page, group_exprs, aggs):
                 from ..expr.functions import require_sorted_dict
 
                 require_sorted_dict(v, f"{a.func} aggregate")
+            if a.func == "checksum":
+                # pre-hash: checksum aggregates row hashes, nulls included.
+                # Varchar hashes the STRING VALUES (host-hashed dictionary
+                # table), not codes — equal data must checksum equal under
+                # any dictionary (reference ChecksumAggregationFunction
+                # hashes the value bytes).
+                from .hashing import hash_column
+
+                if isinstance(v.type, T.VarcharType):
+                    import hashlib
+
+                    import numpy as np
+
+                    d = v.dictionary or ()
+                    table = jnp.asarray(
+                        np.array(
+                            [
+                                int.from_bytes(
+                                    hashlib.blake2b(
+                                        s.encode(), digest_size=8
+                                    ).digest(),
+                                    "little",
+                                )
+                                for s in d
+                            ],
+                            np.uint64,
+                        ).view(np.int64)
+                    )
+                    hv = table[v.data]
+                    if v.valid is not None:
+                        hv = jnp.where(v.valid, hv, jnp.int64(0x9AE16A3B))
+                    v = Val(hv, None, T.BIGINT)
+                else:
+                    h = hash_column(v.data, v.valid).view(jnp.int64)
+                    v = Val(h, None, T.BIGINT)
             ins.append(v)
     return keys, ins
 
@@ -165,6 +248,25 @@ def _agg_contributes(v: Optional[Val], live):
     if v.valid is None:
         return live
     return live & v.valid
+
+
+def _wide_for(spec: AggSpec, v: Optional[Val]) -> bool:
+    """Exact two-lane accumulation for decimal sums/averages (the decimal(38)
+    path); float sums stay float, bigint sums keep int64 + its SQL overflow."""
+    return (
+        v is not None
+        and isinstance(v.type, T.DecimalType)
+        and spec.func in ("sum", "avg")
+    )
+
+
+def _neq_adjacent(d):
+    """Adjacent-row inequality with a leading True; lane columns (n, 2)
+    differ if any lane differs."""
+    neq = d[1:] != d[:-1]
+    if neq.ndim == 2:
+        neq = neq.any(axis=-1)
+    return jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
 
 
 # ---------------------------------------------------------------------------
@@ -239,7 +341,8 @@ def grouped_aggregate_direct(
         if data is None:
             data = jnp.zeros(live.shape, jnp.int64)
         raw, has = _segment_reduce(
-            spec.func, data, contributes, gid, num_groups + 1
+            spec.func, data, contributes, gid, num_groups + 1,
+            wide=_wide_for(spec, v),
         )
         raw = jax.tree_util.tree_map(lambda x: x[:num_groups], raw)
         has = None if has is None else has[:num_groups]
@@ -287,8 +390,7 @@ def grouped_aggregate_sorted(
     # run boundaries on actual key values (collision-proof)
     boundary = jnp.zeros(page.capacity, jnp.bool_).at[0].set(True)
     for v in keys_s:
-        d = v.data
-        neq = jnp.concatenate([jnp.ones((1,), jnp.bool_), d[1:] != d[:-1]])
+        neq = _neq_adjacent(v.data)
         if v.valid is not None:
             vd = v.valid
             neq = neq | jnp.concatenate(
@@ -332,7 +434,10 @@ def grouped_aggregate_sorted(
             valid_s = None if v.valid is None else v.valid[order]
             contributes = live_s if valid_s is None else (live_s & valid_s)
             in_t = v.type
-        raw, has = _segment_reduce(spec.func, data_s, contributes, gid_s, max_groups + 1)
+        raw, has = _segment_reduce(
+            spec.func, data_s, contributes, gid_s, max_groups + 1,
+            wide=_wide_for(spec, v),
+        )
         raw = jax.tree_util.tree_map(lambda x: x[:max_groups], raw)
         has = None if has is None else has[:max_groups]
         did = None if v is None else v.dict_id
@@ -373,7 +478,7 @@ def decompose_partial(aggs: Sequence[AggSpec]):
 
     partial, final, post = [], [], []
     for a in aggs:
-        if a.func in ("count", "count_star"):
+        if a.func in ("count", "count_star", "checksum"):
             partial.append(a)
             final.append(AggSpec("sum", ColumnRef(a.name, T.BIGINT), a.name, T.BIGINT))
         elif a.func in ("sum", "min", "max"):
@@ -432,7 +537,9 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec]) -> Page:
         contributes = _agg_contributes(v, live)
         data = jnp.zeros(page.capacity, jnp.int64) if v is None else v.data
         gid = jnp.zeros(page.capacity, jnp.int32)
-        raw, has = _segment_reduce(spec.func, data, contributes, gid, 1)
+        raw, has = _segment_reduce(
+            spec.func, data, contributes, gid, 1, wide=_wide_for(spec, v)
+        )
         in_t = None if v is None else v.type
         did = None if v is None else v.dict_id
         blocks.append(_finalize(spec, raw, has, in_t, did))

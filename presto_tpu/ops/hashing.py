@@ -32,7 +32,14 @@ def mix64(x):
 
 
 def hash_column(data, valid: Optional[jnp.ndarray] = None):
-    """64-bit hash of one column's storage values (any int/float/bool dtype)."""
+    """64-bit hash of one column's storage values (any int/float/bool dtype).
+    Multi-lane columns (long decimal, (n, 2) lanes) hash-combine per lane."""
+    if data.ndim == 2:
+        hs = [hash_column(data[:, i]) for i in range(data.shape[1])]
+        h = combine_hashes(hs)
+        if valid is not None:
+            h = jnp.where(valid, h, _NULL_HASH)
+        return h
     if jnp.issubdtype(data.dtype, jnp.floating):
         # canonicalize -0.0 == 0.0 before bitcasting
         data = jnp.where(data == 0, jnp.zeros_like(data), data)

@@ -94,6 +94,8 @@ def _keys_equal(bs: BuildSide, probe_keys: Sequence[Val], build_rows):
             part = pd_ == bd2
         else:
             part = pv.data == bd
+            if part.ndim == 2:  # long-decimal lanes: all lanes must match
+                part = part.all(axis=-1)
         if pv.valid is not None:
             part = part & pv.valid
         if bv.valid is not None:

@@ -46,13 +46,25 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
         data = v.data[perm]
         if jnp.issubdtype(data.dtype, jnp.bool_):
             data = data.astype(jnp.int32)
-        if not k.ascending:
-            if jnp.issubdtype(data.dtype, jnp.floating):
-                data = -data
-            else:
-                data = -data.astype(jnp.int64)
-        order = jnp.argsort(data, stable=True)
-        perm = perm[order]
+        if data.ndim == 2:
+            # long-decimal lanes (hi, lo): two stable passes compose into
+            # lexicographic (hi, lo) order == numeric order (lo >= 0)
+            lo = data[:, 1]
+            hi = data[:, 0]
+            if not k.ascending:
+                lo, hi = -lo, -hi
+            order = jnp.argsort(lo, stable=True)
+            perm = perm[order]
+            order = jnp.argsort(hi[order], stable=True)
+            perm = perm[order]
+        else:
+            if not k.ascending:
+                if jnp.issubdtype(data.dtype, jnp.floating):
+                    data = -data
+                else:
+                    data = -data.astype(jnp.int64)
+            order = jnp.argsort(data, stable=True)
+            perm = perm[order]
         if v.valid is not None:
             # nulls to the requested end: a second stable sort on the null
             # flag composes into (null_flag, value) lexicographic order

@@ -1,0 +1,102 @@
+"""Page concatenation + null-extension helpers.
+
+The page-level machinery behind UNION (reference UnionNode / exchange
+unioning) and outer-join null extension (reference LookupJoinOperator's
+probe-side rows with null build channels). Kept kernel-level so the
+single-node executor, the outer-join composition, and the streaming driver
+all share one implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..page import Block, Page
+from .filter import compact
+
+
+def unify_block_dictionaries(blocks):
+    """Remap same-column blocks from different inputs onto one merged
+    dictionary (UNION of varchar columns born with different dictionaries)."""
+    dict_ids = {b.dict_id for b in blocks}
+    if len(dict_ids) == 1:
+        return blocks, blocks[0].dict_id
+    import numpy as np
+
+    from ..page import intern_dictionary
+
+    merged = tuple(sorted({s for b in blocks for s in (b.dictionary or ())}))
+    index = {s: i for i, s in enumerate(merged)}
+    did = intern_dictionary(merged)
+    out = []
+    for b in blocks:
+        d = b.dictionary or ()
+        mapping = jnp.asarray(np.array([index[s] for s in d], np.int32))
+        data = mapping[b.data] if len(d) else b.data
+        out.append(Block(data, b.type, b.valid, did))
+    return out, did
+
+
+def concat_pages(pages: Sequence[Page], distinct: bool = False) -> Page:
+    """Stack pages row-wise (same schema), compacting live rows to the front.
+    Output capacity = sum of input capacities."""
+    first = pages[0]
+    total_cap = sum(p.capacity for p in pages)
+    blocks = []
+    for i, _name in enumerate(first.names):
+        col_blocks = [p.blocks[i] for p in pages]
+        col_blocks, dict_id = unify_block_dictionaries(col_blocks)
+        datas = []
+        valids = []
+        any_valid = any(b.valid is not None for b in col_blocks)
+        for p, b in zip(pages, col_blocks):
+            datas.append(b.data.astype(first.blocks[i].data.dtype))
+            if any_valid:
+                valids.append(
+                    b.valid
+                    if b.valid is not None
+                    else jnp.ones((p.capacity,), jnp.bool_)
+                )
+        data = jnp.concatenate(datas)
+        valid = jnp.concatenate(valids) if any_valid else None
+        blocks.append(Block(data, first.blocks[i].type, valid, dict_id))
+    occ_parts = [
+        jnp.arange(p.capacity, dtype=jnp.int32) < p.count for p in pages
+    ]
+    occ = jnp.concatenate(occ_parts)
+    out = Page(tuple(blocks), first.names, jnp.asarray(total_cap, jnp.int32))
+    out = compact(out, occ)
+    if distinct:
+        from .sort import distinct_page
+
+        out = distinct_page(out, out.capacity)
+    return out
+
+
+def null_block(typ: T.Type, capacity: int, dict_id: Optional[int] = None) -> Block:
+    """An all-NULL column of `typ` (outer-join null extension)."""
+    lanes = getattr(typ, "lanes", 1)
+    shape = (capacity,) if lanes == 1 else (capacity, lanes)
+    return Block(
+        jnp.zeros(shape, typ.storage_dtype),
+        typ,
+        jnp.zeros((capacity,), jnp.bool_),
+        dict_id,
+    )
+
+
+def extend_with_nulls(page: Page, names, types, dict_ids, prepend: bool = False) -> Page:
+    """Add all-NULL columns (the missing side of an outer join)."""
+    extra = tuple(
+        null_block(t, page.capacity, d) for t, d in zip(types, dict_ids)
+    )
+    if prepend:
+        blocks = extra + tuple(page.blocks)
+        all_names = tuple(names) + page.names
+    else:
+        blocks = tuple(page.blocks) + extra
+        all_names = page.names + tuple(names)
+    return Page(blocks, all_names, page.count)

@@ -37,8 +37,28 @@ from .sort import SortKey, apply_permutation
 
 RANKING = {"row_number", "rank", "dense_rank", "ntile", "percent_rank", "cume_dist"}
 OFFSET = {"lag", "lead"}
-VALUE = {"first_value", "last_value"}
+VALUE = {"first_value", "last_value", "nth_value"}
 AGGREGATE = {"sum", "avg", "min", "max", "count"}
+
+# frame bound kinds (reference operator/window/FrameInfo.java BoundType)
+UNB_PRECEDING = "unbounded_preceding"
+PRECEDING = "preceding"
+CURRENT = "current"
+FOLLOWING = "following"
+UNB_FOLLOWING = "unbounded_following"
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    """Window frame (reference FrameInfo): mode 'rows' or 'range';
+    offsets are row counts (rows mode) or order-key deltas in storage units
+    (range mode — requires exactly one numeric order key)."""
+
+    mode: str  # 'rows' | 'range'
+    start_kind: str = UNB_PRECEDING
+    start_offset: int = 0
+    end_kind: str = CURRENT
+    end_offset: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,8 +67,10 @@ class WindowFunc:
     input: Optional[object]  # RowExpression (None for row_number etc.)
     name: str
     output_type: T.Type
-    offset: int = 1  # lag/lead distance; ntile bucket count
+    offset: int = 1  # lag/lead distance; ntile bucket count; nth_value n
     running: bool = False  # cumulative frame (UNBOUNDED PRECEDING..CURRENT)
+    frame: Optional[Frame] = None  # explicit frame; None = SQL default
+    default: Optional[object] = None  # lag/lead default RowExpression
 
 
 def _sort_for_window(page: Page, partition_exprs, order_keys: Sequence[SortKey]):
@@ -89,9 +111,10 @@ def _partition_bounds(page: Page, partition_exprs, perm):
     live_s = page.live_mask()[perm]
     boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
     for e in partition_exprs:
+        from .aggregate import _neq_adjacent
+
         v = evaluate(e, page)
-        d = v.data[perm]
-        neq = jnp.concatenate([jnp.ones((1,), jnp.bool_), d[1:] != d[:-1]])
+        neq = _neq_adjacent(v.data[perm])
         if v.valid is not None:
             vd = v.valid[perm]
             neq = neq | jnp.concatenate(
@@ -117,9 +140,10 @@ def _peer_bounds(page: Page, order_keys: Sequence[SortKey], perm, boundary):
     cap = page.capacity
     peer = boundary
     for k in order_keys:
+        from .aggregate import _neq_adjacent
+
         v = evaluate(k.expr, page)
-        d = v.data[perm]
-        neq = jnp.concatenate([jnp.ones((1,), jnp.bool_), d[1:] != d[:-1]])
+        neq = _neq_adjacent(v.data[perm])
         if v.valid is not None:
             vd = v.valid[perm]
             neq = neq | jnp.concatenate(
@@ -143,10 +167,59 @@ def window_op(
     boundary, pid, start, part_size, live_s = _partition_bounds(
         page, partition_exprs, perm
     )
-    peer = None
-    if any(f.func in ("rank", "dense_rank", "percent_rank", "cume_dist") for f in funcs):
+    need_peer = any(
+        f.func in ("rank", "dense_rank", "percent_rank", "cume_dist")
+        or (f.func in AGGREGATE | VALUE and order_keys)
+        for f in funcs
+    )
+    peer = peer_start = next_peer = None
+    if need_peer:
         peer = _peer_bounds(page, order_keys, perm, boundary)
         peer_start = jax.lax.cummax(jnp.where(peer, idx, 0))
+        next_peer = _next_peer_start(peer, cap)
+
+    # single numeric order key in sorted layout (RANGE offset frames)
+    order_vals = None
+    if len(order_keys) == 1:
+        k = order_keys[0]
+        ov = evaluate(k.expr, page)
+        if not isinstance(ov.type, T.VarcharType):
+            order_vals = (
+                ov.data[perm],
+                None if ov.valid is None else ov.valid[perm],
+                k.ascending,
+            )
+
+    frame_cache = {}
+
+    def bounds_for(frame: Frame):
+        hit = frame_cache.get(frame)
+        if hit is None:
+            needs_key = frame.mode == "range" and any(
+                kind in (PRECEDING, FOLLOWING)
+                for kind in (frame.start_kind, frame.end_kind)
+            )
+            if needs_key and order_vals is None:
+                raise NotImplementedError(
+                    "RANGE offset frames require exactly one numeric "
+                    "ORDER BY key"
+                )
+            ps = peer_start if peer_start is not None else start
+            np_ = next_peer if next_peer is not None else start + part_size
+            hit = _frame_bounds(
+                frame, idx, start, part_size, ps, np_, order_vals, cap
+            )
+            frame_cache[frame] = hit
+        return hit
+
+    def effective_frame(f: WindowFunc) -> Optional[Frame]:
+        if f.frame is not None:
+            return f.frame
+        if order_keys:
+            # SQL default: RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+            # (peer-inclusive — ties aggregate together)
+            return Frame("range", UNB_PRECEDING, 0, CURRENT, 0)
+        return None  # whole partition
 
     blocks = list(sorted_page.blocks)
     names = list(sorted_page.names)
@@ -197,26 +270,232 @@ def window_op(
             valid = same_part
             if v.valid is not None:
                 valid = valid & v.valid[src_c]
+            if f.default is not None:  # lag(x, n, default)
+                dv = evaluate(f.default, sorted_page)
+                mask = same_part if data.ndim == 1 else same_part[:, None]
+                data = jnp.where(mask, data, dv.data)
+                dvalid = (
+                    jnp.ones(cap, jnp.bool_) if dv.valid is None else dv.valid
+                )
+                vvalid = (
+                    jnp.ones(cap, jnp.bool_)
+                    if v.valid is None
+                    else v.valid[src_c]
+                )
+                valid = jnp.where(same_part, vvalid, dvalid)
         elif f.func in VALUE:
             v = evaluate(f.input, sorted_page)
-            if f.func == "first_value":
-                pos = start
+            frame = effective_frame(f)
+            if frame is None:
+                lo, hi = start, start + part_size - 1
             else:
-                # whole-partition frame (SQL's default running frame makes
-                # last_value ≡ current peer end, which surprises everyone;
-                # reference users override the frame anyway)
-                pos = start + part_size - 1
+                lo, hi = bounds_for(frame)
+            if f.func == "first_value":
+                pos = lo
+            elif f.func == "last_value":
+                pos = hi
+            else:  # nth_value(x, n): n-th row of the frame, 1-based
+                pos = lo + jnp.int32(f.offset - 1)
+            in_frame = (pos >= lo) & (pos <= hi) & (lo <= hi)
             pos_c = jnp.clip(pos, 0, cap - 1)
             data = v.data[pos_c]
-            valid = None if v.valid is None else v.valid[pos_c]
+            valid = in_frame
+            if v.valid is not None:
+                valid = valid & v.valid[pos_c]
         elif f.func in AGGREGATE:
-            data, valid = self_agg(f, sorted_page, pid, start, idx, cap, live_s)
+            frame = effective_frame(f)
+            if frame is None:
+                data, valid = self_agg(
+                    f, sorted_page, pid, start, idx, cap, live_s
+                )
+            else:
+                v = None
+                if f.input is None:
+                    contrib = live_s
+                    data_in = jnp.ones(cap, jnp.int64)
+                else:
+                    v = evaluate(f.input, sorted_page)
+                    contrib = (
+                        live_s if v.valid is None else (live_s & v.valid)
+                    )
+                    data_in = v.data
+                lo, hi = bounds_for(frame)
+                data, valid = _frame_agg(f, v, data_in, contrib, lo, hi, cap)
         else:
             raise KeyError(f"unsupported window function {f.func!r}")
         blocks.append(Block(data, f.output_type, valid))
         names.append(f.name)
 
     return Page(tuple(blocks), tuple(names), page.count)
+
+
+def _part_search(keys, pstart, pend_plus1, target, strict: bool, asc: bool):
+    """Vectorized per-row binary search inside each partition's sorted run.
+
+    Returns the smallest j in [pstart, pend_plus1] such that
+      asc,  strict=False:  keys[j] >= target      (lower bound)
+      asc,  strict=True:   keys[j] >  target      (upper bound)
+      desc: comparisons flipped (runs are descending).
+    35 fixed iterations (static under jit) cover any int32 capacity."""
+    lo = pstart.astype(jnp.int32)
+    hi = pend_plus1.astype(jnp.int32)
+    n = keys.shape[0]
+    for _ in range(35):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        kv = keys[jnp.clip(mid, 0, n - 1)]
+        if asc:
+            go_right = (kv <= target) if strict else (kv < target)
+        else:
+            go_right = (kv >= target) if strict else (kv > target)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def _frame_bounds(
+    frame: Frame,
+    idx,
+    start,
+    part_size,
+    peer_start,
+    next_peer,
+    order_vals,
+    cap,
+):
+    """Per-row inclusive [lo, hi] frame bounds in sorted coordinates.
+
+    RANGE mode requires exactly one numeric order key (order_vals =
+    (data, valid_or_None, ascending)); CURRENT bounds in RANGE mode are
+    peer-group bounds (SQL standard; reference RANGE frames)."""
+    pend = start + part_size - 1
+    if frame.mode == "rows":
+
+        def bound(kind, off, is_start):
+            if kind == UNB_PRECEDING:
+                return start
+            if kind == UNB_FOLLOWING:
+                return pend
+            if kind == CURRENT:
+                return idx
+            d = jnp.int32(off)
+            return idx - d if kind == PRECEDING else idx + d
+
+        lo = bound(frame.start_kind, frame.start_offset, True)
+        hi = bound(frame.end_kind, frame.end_offset, False)
+    else:  # range
+        data, kvalid, asc = order_vals
+        knull = (
+            jnp.zeros(cap, jnp.bool_) if kvalid is None else ~kvalid
+        )
+
+        def bound(kind, off, is_start):
+            if kind == UNB_PRECEDING:
+                return start
+            if kind == UNB_FOLLOWING:
+                return pend
+            if kind == CURRENT:
+                return peer_start if is_start else next_peer - 1
+            delta = jnp.asarray(off, data.dtype)
+            target = data - delta if kind == PRECEDING else data + delta
+            if not asc:  # descending runs: preceding means larger values
+                target = data + delta if kind == PRECEDING else data - delta
+            if is_start:
+                j = _part_search(data, start, pend + 1, target, False, asc)
+            else:
+                j = _part_search(data, start, pend + 1, target, True, asc) - 1
+            # rows with NULL keys frame over their null peer group
+            return jnp.where(knull, peer_start if is_start else next_peer - 1, j)
+
+        lo = bound(frame.start_kind, frame.start_offset, True)
+        hi = bound(frame.end_kind, frame.end_offset, False)
+    lo = jnp.maximum(lo, start)
+    hi = jnp.minimum(hi, pend)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _log2_floor(n):
+    """floor(log2(n)) for int32 n >= 1 without float rounding hazards."""
+    x = n.astype(jnp.int32)
+    r = jnp.zeros_like(x)
+    for shift in (16, 8, 4, 2, 1):
+        big = x >= (1 << shift)
+        r = r + jnp.where(big, shift, 0)
+        x = jnp.where(big, x >> shift, x)
+    return r
+
+
+def _range_minmax(x, lo, hi, kind: str, ident):
+    """min/max over inclusive [lo, hi] via a sparse table (log-doubling):
+    O(n log n) build, O(1) per query — the static-shape answer to
+    arbitrary per-row frames."""
+    cap = x.shape[0]
+    op = jnp.minimum if kind == "min" else jnp.maximum
+    levels = [x]
+    j = 0
+    while (1 << (j + 1)) <= cap:
+        prev = levels[-1]
+        shift = 1 << j
+        shifted = jnp.concatenate(
+            [prev[shift:], jnp.full((shift,), ident, prev.dtype)]
+        )
+        levels.append(op(prev, shifted))
+        j += 1
+    M = jnp.stack(levels)  # (L, cap): M[j, i] covers [i, i + 2^j - 1]
+    length = jnp.maximum(hi - lo + 1, 1)
+    lv = _log2_floor(length)
+    span = (jnp.int32(1) << lv).astype(jnp.int32)
+    flat = M.reshape(-1)
+    i1 = jnp.clip(lv * cap + lo, 0, flat.shape[0] - 1)
+    i2 = jnp.clip(lv * cap + hi - span + 1, 0, flat.shape[0] - 1)
+    return op(flat[i1], flat[i2])
+
+
+def _frame_agg(f: WindowFunc, v, data_in, contrib, lo, hi, cap):
+    """sum/avg/min/max/count over per-row [lo, hi] frames via exclusive
+    prefix sums (and a sparse table for min/max)."""
+    from . import decimal128 as d128
+    from .aggregate import _max_identity, _min_identity
+
+    empty = lo > hi
+    hi_c = jnp.clip(hi, 0, cap - 1)
+    cnt_pre = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(contrib.astype(jnp.int64))]
+    )
+    cnt = jnp.where(empty, 0, cnt_pre[hi_c + 1] - cnt_pre[jnp.minimum(lo, cap - 1)])
+    if f.func == "count":
+        return cnt, None
+    wide = f.func in ("sum", "avg") and (
+        data_in.ndim == 2
+        or (v is not None and isinstance(v.type, T.DecimalType))
+    )
+    if f.func in ("sum", "avg"):
+        if wide:
+            lanes = data_in if data_in.ndim == 2 else d128.from_int64(data_in)
+            x = jnp.where(contrib[:, None], lanes, 0)
+            pre = jnp.concatenate(
+                [jnp.zeros((1, 2), jnp.int64), d128.cumsum_wide(x)]
+            )
+            s = d128.dsub(pre[hi_c + 1], pre[jnp.minimum(lo, cap - 1)])
+            s = jnp.where(empty[:, None], 0, s)
+        else:
+            x = jnp.where(contrib, data_in, jnp.zeros_like(data_in))
+            pre = jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)])
+            s = jnp.where(empty, 0, pre[hi_c + 1] - pre[jnp.minimum(lo, cap - 1)])
+        if f.func == "sum":
+            return s, cnt > 0
+        return _avg(s, cnt, f, v), cnt > 0
+    # min/max
+    if data_in.ndim == 2:
+        raise NotImplementedError("framed min/max over long decimal")
+    ident = (
+        _min_identity(data_in.dtype)
+        if f.func == "min"
+        else _max_identity(data_in.dtype)
+    )
+    x = jnp.where(contrib, data_in, ident)
+    s = _range_minmax(x, jnp.minimum(lo, cap - 1), hi_c, f.func, ident)
+    return s, cnt > 0
 
 
 def _next_peer_start(peer, cap):
@@ -230,6 +509,8 @@ def _next_peer_start(peer, cap):
 
 def self_agg(f: WindowFunc, sorted_page: Page, pid, start, idx, cap, live_s):
     """sum/avg/min/max/count OVER (whole partition or running frame)."""
+    from . import decimal128 as d128
+
     if f.input is None:  # count(*)
         v = None
         contrib = live_s
@@ -238,13 +519,24 @@ def self_agg(f: WindowFunc, sorted_page: Page, pid, start, idx, cap, live_s):
         v = evaluate(f.input, sorted_page)
         contrib = live_s if v.valid is None else (live_s & v.valid)
         data_in = v.data
+    # exact two-lane accumulation for decimal sums/avgs (decimal(38) path)
+    wide = f.func in ("sum", "avg") and (
+        data_in.ndim == 2
+        or (v is not None and isinstance(v.type, T.DecimalType))
+    )
     if f.running:
         if f.func in ("sum", "avg", "count"):
-            x = jnp.where(contrib, data_in, jnp.zeros_like(data_in))
-            c = jnp.cumsum(x)
-            # rebase: exclusive cumsum at the partition start
-            base = _gather_at(c - x, start)
-            run = c - base
+            if wide:
+                lanes = data_in if data_in.ndim == 2 else d128.from_int64(data_in)
+                x = jnp.where(contrib[:, None], lanes, 0)
+                c = d128.cumsum_wide(x)
+                run = d128.dsub(c, _gather_at(d128.dsub(c, x), start))
+            else:
+                x = jnp.where(contrib, data_in, jnp.zeros_like(data_in))
+                c = jnp.cumsum(x)
+                # rebase: exclusive cumsum at the partition start
+                base = _gather_at(c - x, start)
+                run = c - base
             cnt_arr = jnp.cumsum(contrib.astype(jnp.int64))
             cnt = cnt_arr - _gather_at(cnt_arr - contrib.astype(jnp.int64), start)
             if f.func == "count":
@@ -273,10 +565,17 @@ def self_agg(f: WindowFunc, sorted_page: Page, pid, start, idx, cap, live_s):
     if f.func == "count":
         out = jax.ops.segment_sum(contrib.astype(jnp.int64), pid, num_seg)
         return out[jnp.minimum(pid, cap)], None
-    x = jnp.where(contrib, data_in, jnp.zeros_like(data_in))
     cnt = jax.ops.segment_sum(contrib.astype(jnp.int64), pid, num_seg)[
         jnp.minimum(pid, cap)
     ]
+    if f.func in ("sum", "avg") and wide:
+        lanes = data_in if data_in.ndim == 2 else d128.from_int64(data_in)
+        x = jnp.where(contrib[:, None], lanes, 0)
+        s = d128.segment_sum_wide(x, pid, num_seg)[jnp.minimum(pid, cap)]
+        if f.func == "sum":
+            return s, cnt > 0
+        return _avg(s, cnt, f, v), cnt > 0
+    x = jnp.where(contrib, data_in, jnp.zeros_like(data_in))
     if f.func == "sum":
         s = jax.ops.segment_sum(x, pid, num_seg)[jnp.minimum(pid, cap)]
         return s, cnt > 0

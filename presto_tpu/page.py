@@ -297,7 +297,9 @@ def _infer_type(arr: np.ndarray) -> T.Type:
 def _pad_block(b: Block, capacity: int) -> Block:
     n = b.capacity
     pad = capacity - n
-    data = jnp.concatenate([b.data, jnp.zeros((pad,), b.data.dtype)])
+    data = jnp.concatenate(
+        [b.data, jnp.zeros((pad,) + b.data.shape[1:], b.data.dtype)]
+    )
     valid = None
     if b.valid is not None:
         valid = jnp.concatenate([b.valid, jnp.zeros((pad,), jnp.bool_)])

@@ -63,9 +63,9 @@ def shuffle_write(
 
     blocks = []
     for b in page.blocks:
-        data = jnp.zeros((total,), b.data.dtype).at[dest].set(
-            b.data[order], mode="drop"
-        )
+        data = jnp.zeros((total,) + b.data.shape[1:], b.data.dtype).at[
+            dest
+        ].set(b.data[order], mode="drop")
         valid = None
         if b.valid is not None:
             valid = jnp.zeros((total,), jnp.bool_).at[dest].set(

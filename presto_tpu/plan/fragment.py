@@ -210,6 +210,18 @@ class Fragmenter:
     def _v_join(self, node: N.Join):
         left, ldist = self._visit(node.left)
         right, rdist = self._visit(node.right)
+        if node.kind == "full" or (
+            node.kind != "inner" and node.residual is not None
+        ):
+            # multi-kernel outer composition (Executor._exec_outer_join)
+            # runs single-node: null-extension of the build side cannot be
+            # decided per shard under replication
+            left = self._gather(left, ldist)
+            right = self._gather(right, rdist)
+            return (
+                dataclasses.replace(node, left=left, right=right),
+                Partitioning(SINGLE),
+            )
         if not ldist.is_sharded and not rdist.is_sharded:
             return (
                 dataclasses.replace(node, left=left, right=right),

@@ -36,7 +36,15 @@ from ..ops.sort import SortKey
 from ..plan import nodes as N
 from . import tree as t
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+AGG_FUNCS = {"count", "sum", "avg", "min", "max", "checksum"}
+
+# aggregates planned by rewriting onto the core set (reference: many of
+# operator/aggregation/*'s 100+ functions decompose into sum/count states)
+REWRITE_AGG_FUNCS = {
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "count_if", "bool_and", "bool_or", "every", "arbitrary",
+    "geometric_mean", "covar_samp", "covar_pop", "corr",
+}
 
 _BINOP_FN = {
     "+": "add",
@@ -405,6 +413,58 @@ class Planner:
                 out.append(item)
         return out
 
+    @staticmethod
+    def _translate_frame(frame_spec, order):
+        """(type, start, end) strings -> ops.window.Frame. RANGE offsets are
+        scaled into the single order key's storage units (reference
+        FrameInfo + RANGE frame value coercion)."""
+        import decimal as _dec
+
+        from ..ops.window import (
+            CURRENT,
+            FOLLOWING,
+            PRECEDING,
+            UNB_FOLLOWING,
+            UNB_PRECEDING,
+            Frame,
+        )
+
+        ftype, fstart, fend = frame_spec
+
+        def key_unit(text: str):
+            if ftype == "rows":
+                v = int(text)
+                if v < 0:
+                    raise PlanningError("frame offset must be non-negative")
+                return v
+            if not order:
+                raise PlanningError("RANGE offset frame requires ORDER BY")
+            kt = order[0].expr.type
+            if isinstance(kt, T.DecimalType):
+                return int(_dec.Decimal(text).scaleb(kt.scale))
+            if T.is_floating(kt):
+                return float(text)
+            return int(text)
+
+        def bound(s: str):
+            if s == "unbounded preceding":
+                return UNB_PRECEDING, 0
+            if s == "unbounded following":
+                return UNB_FOLLOWING, 0
+            if s == "current row":
+                return CURRENT, 0
+            num, _, kind = s.rpartition(" ")
+            return (
+                PRECEDING if kind == "preceding" else FOLLOWING,
+                key_unit(num),
+            )
+
+        sk, so = bound(fstart)
+        ek, eo = bound(fend)
+        if sk == UNB_FOLLOWING or ek == UNB_PRECEDING:
+            raise PlanningError(f"invalid window frame {frame_spec}")
+        return Frame(ftype, sk, so, ek, eo)
+
     def _plan_windows(self, calls, sctx, holder) -> Dict:
         """Group window calls by spec, append one Window node per spec."""
         from ..ops.window import AGGREGATE, OFFSET, RANKING, VALUE, WindowFunc
@@ -420,16 +480,9 @@ class Planner:
                 for si in spec.order_by
             )
             running_default = bool(spec.order_by)
+            frame_obj = None
             if spec.frame is not None:
-                ftype, fstart, fend = spec.frame
-                if fstart != "unbounded preceding" or fend not in (
-                    "current row",
-                    "unbounded following",
-                ):
-                    raise PlanningError(
-                        f"window frame {spec.frame} not yet supported"
-                    )
-                running_default = fend == "current row"
+                frame_obj = self._translate_frame(spec.frame, order)
             funcs = []
             for c in group:
                 if c in win_map:
@@ -456,12 +509,30 @@ class Planner:
                         if not isinstance(c.args[1], t.NumberLiteral):
                             raise PlanningError(f"{name} offset must be literal")
                         off = int(c.args[1].text)
+                    default = None
                     if len(c.args) > 2:
-                        raise PlanningError(f"{name} default value not yet supported")
-                    wf = WindowFunc(name, inp, ch, inp.type, offset=off)
+                        default = sctx.translate(c.args[2])
+                        if default.type != inp.type:
+                            default = ir.cast(default, inp.type)
+                    wf = WindowFunc(
+                        name, inp, ch, inp.type, offset=off, default=default
+                    )
                 elif name in VALUE:
                     inp = sctx.translate(c.args[0])
-                    wf = WindowFunc(name, inp, ch, inp.type)
+                    off = 1
+                    if name == "nth_value":
+                        if len(c.args) < 2 or not isinstance(
+                            c.args[1], t.NumberLiteral
+                        ):
+                            raise PlanningError(
+                                "nth_value requires a literal position"
+                            )
+                        off = int(c.args[1].text)
+                        if off < 1:
+                            raise PlanningError("nth_value position must be >= 1")
+                    wf = WindowFunc(
+                        name, inp, ch, inp.type, offset=off, frame=frame_obj
+                    )
                 elif name in AGGREGATE:
                     wfilt = None
                     if c.filter is not None:
@@ -492,7 +563,8 @@ class Planner:
                         func = "count" if name == "count" else name
                         out_t = AggSpec.infer_output_type(func, inp.type)
                     wf = WindowFunc(
-                        func, inp, ch, out_t, running=running_default
+                        func, inp, ch, out_t, running=running_default,
+                        frame=frame_obj,
                     )
                 else:
                     raise PlanningError(f"unknown window function {name!r}")
@@ -509,6 +581,9 @@ class Planner:
             if call in agg_map:
                 continue
             fname = call.name
+            if fname in REWRITE_AGG_FUNCS:
+                agg_map[call] = self._rewrite_aggregate(call, sctx, aggs)
+                continue
             if fname not in AGG_FUNCS:
                 raise PlanningError(f"unsupported aggregate {fname!r}")
             # agg(x) FILTER (WHERE p) masks the input to NULL where p is not
@@ -544,6 +619,123 @@ class Planner:
             aggs.append(spec)
             agg_map[call] = (spec.name, spec.output_type)
         return aggs, agg_map
+
+    def _rewrite_aggregate(self, call, sctx, aggs) -> ir.RowExpression:
+        """Plan a derived aggregate as core aggregates + a post-formula
+        (the reference compiles each as its own Accumulator,
+        operator/aggregation/ — here the sum/count states are first-class
+        aggregate columns and the finalizer is ordinary expression code that
+        fuses into the post-aggregation projection)."""
+        D = T.DOUBLE
+        fname = call.name
+        filt = None
+        if call.filter is not None:
+            filt = sctx.translate(call.filter)
+            if filt is None or filt.type != T.BOOLEAN:
+                raise PlanningError("FILTER (WHERE ...) must be boolean")
+
+        def masked(e):
+            if filt is None:
+                return e
+            return ir.Call("if", (filt, e, ir.Literal(None, e.type)), e.type)
+
+        def emit(func, e, base):
+            out_t = AggSpec.infer_output_type(func, None if e is None else e.type)
+            sp = AggSpec(func, e, self.channel(base), out_t)
+            aggs.append(sp)
+            return ir.ColumnRef(sp.name, out_t)
+
+        def c(name, *args, typ=D):
+            return ir.Call(name, tuple(args), typ)
+
+        def dlit(x):
+            return ir.Literal(float(x), D)
+
+        def null_if_under(n_ref, minimum, value):
+            cond = c("gt", n_ref, ir.Literal(minimum - 1, T.BIGINT), typ=T.BOOLEAN)
+            return ir.Call("if", (cond, value, ir.Literal(None, D)), D)
+
+        def moments(arg_ast):
+            x = masked(ir.cast(sctx.translate(arg_ast), D))
+            s = emit("sum", x, "sum")
+            ss = emit("sum", c("multiply", x, x), "sumsq")
+            n = emit("count", x, "cnt")
+            nd = ir.cast(n, D)
+            num = c(
+                "greatest",
+                c("subtract", ss, c("divide", c("multiply", s, s), nd)),
+                dlit(0.0),
+            )
+            return n, nd, num
+
+        if fname in ("stddev", "stddev_samp", "variance", "var_samp"):
+            n, nd, num = moments(call.args[0])
+            var = c("divide", num, c("subtract", nd, dlit(1.0)))
+            out = var if fname in ("variance", "var_samp") else c("sqrt", var)
+            return null_if_under(n, 2, out)
+        if fname in ("stddev_pop", "var_pop"):
+            n, nd, num = moments(call.args[0])
+            var = c("divide", num, nd)
+            out = var if fname == "var_pop" else c("sqrt", var)
+            return null_if_under(n, 1, out)
+        if fname == "count_if":
+            p = sctx.translate(call.args[0])
+            inp = masked(
+                ir.Call("if", (p, ir.lit(1), ir.Literal(None, T.BIGINT)), T.BIGINT)
+            )
+            return emit("count", inp, "count_if")
+        if fname in ("bool_and", "every"):
+            return emit("min", masked(sctx.translate(call.args[0])), "bool_and")
+        if fname == "bool_or":
+            return emit("max", masked(sctx.translate(call.args[0])), "bool_or")
+        if fname == "arbitrary":
+            return emit("min", masked(sctx.translate(call.args[0])), "arbitrary")
+        if fname == "geometric_mean":
+            xd = masked(ir.cast(sctx.translate(call.args[0]), D))
+            a = emit("avg", c("ln", xd), "geomean")
+            return c("exp", a)
+        if fname in ("covar_samp", "covar_pop", "corr"):
+            x0 = ir.cast(sctx.translate(call.args[0]), D)
+            y0 = ir.cast(sctx.translate(call.args[1]), D)
+            both = c(
+                "and",
+                c("is_not_null", x0, typ=T.BOOLEAN),
+                c("is_not_null", y0, typ=T.BOOLEAN),
+                typ=T.BOOLEAN,
+            )
+            x = masked(ir.Call("if", (both, x0, ir.Literal(None, D)), D))
+            y = masked(ir.Call("if", (both, y0, ir.Literal(None, D)), D))
+            sx = emit("sum", x, "sx")
+            sy = emit("sum", y, "sy")
+            sxy = emit("sum", c("multiply", x, y), "sxy")
+            n = emit("count", x, "cnt")
+            nd = ir.cast(n, D)
+            cov_num = c("subtract", sxy, c("divide", c("multiply", sx, sy), nd))
+            if fname == "covar_pop":
+                return null_if_under(n, 1, c("divide", cov_num, nd))
+            if fname == "covar_samp":
+                return null_if_under(
+                    n, 2, c("divide", cov_num, c("subtract", nd, dlit(1.0)))
+                )
+            sxx = emit("sum", c("multiply", x, x), "sxx")
+            syy = emit("sum", c("multiply", y, y), "syy")
+            vx = c(
+                "greatest",
+                c("subtract", sxx, c("divide", c("multiply", sx, sx), nd)),
+                dlit(0.0),
+            )
+            vy = c(
+                "greatest",
+                c("subtract", syy, c("divide", c("multiply", sy, sy), nd)),
+                dlit(0.0),
+            )
+            denom = c("sqrt", c("multiply", vx, vy))
+            corr = c("divide", cov_num, denom)
+            cond = c("gt", denom, dlit(0.0), typ=T.BOOLEAN)
+            return null_if_under(
+                n, 2, ir.Call("if", (cond, corr, ir.Literal(None, D)), D)
+            )
+        raise PlanningError(f"unsupported aggregate {fname!r}")
 
     def _build_aggregate(self, child, group_exprs, group_names, aggs):
         """Build the Aggregate node, rewriting distinct aggregates as
@@ -642,7 +834,9 @@ def _contains_subquery_pred(expr: t.Node) -> bool:
 def _collect_aggregates(expr: t.Node, out: List[t.FunctionCall]):
     """Find aggregate function calls (not descending into subqueries)."""
     if isinstance(expr, t.FunctionCall):
-        if expr.name in AGG_FUNCS and expr.window is None:
+        if (
+            expr.name in AGG_FUNCS or expr.name in REWRITE_AGG_FUNCS
+        ) and expr.window is None:
             out.append(expr)
             return  # aggregates cannot nest
     if isinstance(expr, (t.ScalarSubquery, t.InSubquery, t.Exists)):
@@ -780,8 +974,6 @@ class FromPlanner:
         if kind == "right":
             rel = t.Join("left", rel.right, rel.left, rel.condition, rel.using)
             kind = "left"
-        if kind == "full":
-            raise PlanningError("FULL OUTER JOIN not yet supported")
         left = self.p.plan_relation(rel.left, self.outer, self.ctes)
         right = self.p.plan_relation(rel.right, self.outer, self.ctes)
         combined = Scope(left.scope.fields + right.scope.fields)
@@ -813,9 +1005,11 @@ class FromPlanner:
                     lkeys.append(b)
                     rkeys.append(a)
                     continue
-            if refs <= right_chs:
+            if kind == "left" and refs <= right_chs:
                 rfilters.append(e)  # safe to push below a left join
             else:
+                # full-outer: one-sided ON filters stay residual (pushing
+                # them below would drop the side's unmatched rows)
                 residual.append(e)
         rnode = right.node
         if rfilters:
@@ -827,7 +1021,7 @@ class FromPlanner:
             res = ir.and_(*residual) if len(residual) > 1 else residual[0]
         unique = _build_side_unique(rnode, rkeys, self.p.catalog)
         node = N.Join(
-            "left", left.node, rnode, tuple(lkeys), tuple(rkeys), res, unique
+            kind, left.node, rnode, tuple(lkeys), tuple(rkeys), res, unique
         )
         rp = RelationPlan(node, combined)
         return PoolItem(
@@ -1098,7 +1292,10 @@ class SelectContext:
 
     def _tr(self, ast: t.Node) -> ir.RowExpression:
         if ast in self.agg_map:
-            ch, typ = self.agg_map[ast]
+            v = self.agg_map[ast]
+            if isinstance(v, ir.RowExpression):
+                return v  # composite rewrite (stddev & co) over agg channels
+            ch, typ = v
             return ir.ColumnRef(ch, typ)
         if isinstance(ast, t.Identifier):
             f, is_outer = self.resolve(ast.parts)
@@ -1241,7 +1438,7 @@ class SelectContext:
 
     def _function(self, ast: t.FunctionCall) -> ir.RowExpression:
         name = ast.name
-        if name in AGG_FUNCS:
+        if name in AGG_FUNCS or name in REWRITE_AGG_FUNCS:
             raise PlanningError(
                 f"aggregate {name} in invalid context (window functions later)"
             )

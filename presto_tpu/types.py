@@ -164,9 +164,12 @@ class TimestampType(FixedWidthType):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(FixedWidthType):
-    """Short decimal: int64 scaled by 10**scale (reference DecimalType.java).
+    """Decimal as scaled integers (reference DecimalType.java/Decimals.java).
 
-    precision<=18 only for now; long decimal (int128) is a later milestone.
+    precision <= 18 ("short"): one int64 scaled by 10**scale.
+    precision  > 18 ("long"):  TWO int64 lanes per row — block data has
+    shape (capacity, 2), value = hi*2**32 + lo (ops/decimal128.py), the
+    TPU-native stand-in for the reference's UnscaledDecimal128Arithmetic.
     """
 
     precision: int = 18
@@ -174,14 +177,22 @@ class DecimalType(FixedWidthType):
     name: ClassVar[str] = "decimal"
 
     def __post_init__(self):
-        if not (1 <= self.precision <= 18):
+        if not (1 <= self.precision <= 38):
             raise ValueError(f"unsupported decimal precision {self.precision}")
         if not (0 <= self.scale <= self.precision):
             raise ValueError(f"bad decimal scale {self.scale}")
 
     @property
+    def is_long(self) -> bool:
+        return self.precision > 18
+
+    @property
     def storage_dtype(self):
         return jnp.int64
+
+    @property
+    def lanes(self) -> int:
+        return 2 if self.is_long else 1
 
     def display(self) -> str:
         return f"decimal({self.precision},{self.scale})"
@@ -189,7 +200,11 @@ class DecimalType(FixedWidthType):
     def to_python(self, storage_value, dictionary=None):
         import decimal as _dec
 
-        v = int(storage_value)
+        if self.is_long:
+            hi, lo = (int(x) for x in storage_value)
+            v = hi * (1 << 32) + lo
+        else:
+            v = int(storage_value)
         if self.scale == 0:
             return v
         return _dec.Decimal(v).scaleb(-self.scale)
@@ -354,12 +369,12 @@ def common_super_type(a: Type, b: Type) -> Type:
     if (is_floating(a) and is_numeric(b)) or (is_floating(b) and is_numeric(a)):
         return DOUBLE
     if isinstance(a, DecimalType) and is_integral(b):
-        return DecimalType(18, a.scale)
+        return DecimalType(38 if a.is_long else 18, a.scale)
     if isinstance(b, DecimalType) and is_integral(a):
-        return DecimalType(18, b.scale)
+        return DecimalType(38 if b.is_long else 18, b.scale)
     if isinstance(a, DecimalType) and isinstance(b, DecimalType):
         scale = max(a.scale, b.scale)
-        return DecimalType(18, scale)
+        return DecimalType(38 if (a.is_long or b.is_long) else 18, scale)
     if is_string(a) and is_string(b):
         return VARCHAR
     raise TypeError(f"no common type for {a} and {b}")

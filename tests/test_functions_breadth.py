@@ -1,0 +1,291 @@
+"""Scalar/aggregate function breadth (reference FunctionRegistry.java:360 +
+operator/scalar/, operator/aggregation/). Scalar behavior checks against the
+SQLite oracle where SQLite agrees with the reference; statistics aggregates
+check against numpy since SQLite lacks them."""
+
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+from presto_tpu.testing.oracle import SqliteOracle, assert_same_results
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(TpchCatalog(sf=SF))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle(sf=SF, tables=["orders", "customer", "nation", "lineitem"])
+
+
+def check(session, oracle, sql):
+    ours = session.query(sql)
+    expected = oracle.query(sql)
+    types = [b.type for b in ours.page.blocks]
+    assert_same_results(ours.rows(), expected, types)
+
+
+def test_math_batch(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               sign(o_totalprice) s, log10(o_totalprice) l10,
+               log2(o_totalprice) l2, sin(o_orderkey) sn, cos(o_orderkey) cs,
+               atan(o_orderkey) at, degrees(1.0) deg, radians(90.0) rad,
+               mod(o_orderkey, 7) m
+        from orders where o_custkey < 50
+        """,
+    )
+
+
+def test_trig_inverse_and_log(session):
+    rows = session.query(
+        "select asin(0.5) a, acos(0.5) b, atan2(1.0, 1.0) c, log(2.0, 8.0) d,"
+        " cbrt(27.0) e, tanh(0.0) f, is_nan(0e0/0e0) g, is_finite(1.0) h"
+        " from nation where n_nationkey = 0"
+    ).rows()
+    a, b, c, d, e, f, g, h = rows[0]
+    assert abs(a - math.asin(0.5)) < 1e-12
+    assert abs(b - math.acos(0.5)) < 1e-12
+    assert abs(c - math.pi / 4) < 1e-12
+    assert abs(d - 3.0) < 1e-12
+    assert abs(e - 3.0) < 1e-12
+    assert f == 0.0 and g is True and h is True
+
+
+def test_greatest_least_width_bucket(session):
+    rows = session.query(
+        "select n_nationkey nk, greatest(n_nationkey, 10) g,"
+        " least(n_nationkey, 10) l,"
+        " width_bucket(cast(n_nationkey as double), 0.0, 25.0, 5) wb"
+        " from nation order by nk limit 3"
+    ).rows()
+    assert rows[0] == (0, 10, 0, 1)
+    assert rows[1] == (1, 10, 1, 1)
+
+
+def test_bitwise(session):
+    rows = session.query(
+        "select bitwise_and(n_nationkey, 6) a, bitwise_or(n_nationkey, 1) o,"
+        " bitwise_xor(n_nationkey, 255) x, bitwise_not(n_nationkey) nt,"
+        " bitwise_left_shift(n_nationkey, 2) ls,"
+        " bit_count(n_nationkey, 64) bc"
+        " from nation where n_nationkey = 5"
+    ).rows()
+    assert rows[0] == (4, 5, 250, -6, 20, 2)
+
+
+def test_string_batch(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select n_name, replace(n_name, 'A', '#') r, ltrim(n_name) lt,
+               rtrim(n_name) rt, upper(n_name) u
+        from nation
+        """,
+    )
+
+
+def test_string_pads_and_parts(session):
+    rows = session.query(
+        "select lpad(n_name, 12, '*') lp, rpad(n_name, 12, '.') rp,"
+        " reverse(n_name) rv, split_part(n_comment, ' ', 1) sp,"
+        " starts_with(n_name, 'A') sw, codepoint(n_name) cp"
+        " from nation where n_nationkey = 0"
+    ).rows()
+    lp, rp, rv, sp, sw, cp = rows[0]
+    assert lp == "*****ALGERIA" and rp == "ALGERIA....."
+    assert rv == "AIREGLA" and sw is True and cp == ord("A")
+
+
+def test_regexp_functions(session):
+    rows = session.query(
+        "select n_name, regexp_like(n_name, '^A') rl,"
+        " regexp_replace(n_name, '[AEIOU]', '_') rr,"
+        " regexp_extract(n_name, '([A-Z]+)IA$', 1) re,"
+        " regexp_count(n_name, 'A') rc"
+        " from nation where n_nationkey < 3 order by n_name"
+    ).rows()
+    name, rl, rr, rex, rc = rows[0]
+    assert name == "ALGERIA" and rl is True
+    assert rr == "_LG_R__" and rex == "ALGER" and rc == 2
+
+
+def test_datetime_batch(session):
+    rows = session.query(
+        "select day_of_week(o_orderdate) dw, day_of_year(o_orderdate) dy,"
+        " week(o_orderdate) wk, last_day_of_month(o_orderdate) ld,"
+        " date_trunc('month', o_orderdate) dtm,"
+        " date_trunc('year', o_orderdate) dty,"
+        " date_add('month', 2, o_orderdate) da,"
+        " date_diff('day', o_orderdate, date '1998-01-01') dd"
+        " from orders where o_orderkey = 1"
+    ).rows()
+    import datetime as pydt
+
+    dw, dy, wk, ld, dtm, dty, da, dd = rows[0]
+    # o_orderdate for key 1 is deterministic from the generator; derive it
+    base = session.query(
+        "select o_orderdate from orders where o_orderkey = 1"
+    ).rows()[0][0]
+    d = pydt.date.fromisoformat(str(base))
+    assert dw == d.isoweekday()
+    assert dy == d.timetuple().tm_yday
+    assert wk == d.isocalendar()[1]
+    assert str(dtm) == d.replace(day=1).isoformat()
+    assert str(dty) == d.replace(month=1, day=1).isoformat()
+    assert dd == (pydt.date(1998, 1, 1) - d).days
+
+
+def _numbers_catalog():
+    rng = np.random.default_rng(3)
+    x = rng.normal(100.0, 15.0, 500)
+    y = 3.0 * x + rng.normal(0.0, 5.0, 500)
+    g = np.arange(500) % 3
+    page = Page.from_dict(
+        {"g": g.astype(np.int64), "x": x, "y": y}
+    )
+    return MemoryCatalog({"t": page}), x, y, g
+
+
+def test_statistical_aggregates():
+    cat, x, y, g = _numbers_catalog()
+    s = Session(cat)
+    [(sd, sdp, var, varp, cv, cvp, cr)] = s.query(
+        "select stddev(x), stddev_pop(x), variance(x), var_pop(x),"
+        " covar_samp(x, y), covar_pop(x, y), corr(x, y) from t"
+    ).rows()
+    assert abs(sd - np.std(x, ddof=1)) < 1e-8
+    assert abs(sdp - np.std(x)) < 1e-8
+    assert abs(var - np.var(x, ddof=1)) < 1e-6
+    assert abs(varp - np.var(x)) < 1e-6
+    assert abs(cv - np.cov(x, y, ddof=1)[0, 1]) < 1e-6
+    assert abs(cvp - np.cov(x, y, ddof=0)[0, 1]) < 1e-6
+    assert abs(cr - np.corrcoef(x, y)[0, 1]) < 1e-10
+
+
+def test_statistical_aggregates_grouped():
+    cat, x, y, g = _numbers_catalog()
+    s = Session(cat)
+    rows = s.query(
+        "select g, stddev(x), corr(x, y) from t group by g order by g"
+    ).rows()
+    for gid, sd, cr in rows:
+        xs, ys = x[g == gid], y[g == gid]
+        assert abs(sd - np.std(xs, ddof=1)) < 1e-8
+        assert abs(cr - np.corrcoef(xs, ys)[0, 1]) < 1e-10
+
+
+def test_bool_count_if_geomean_arbitrary():
+    page = Page.from_dict(
+        {
+            "g": np.array([0, 0, 1, 1], np.int64),
+            "b": np.array([True, False, True, True]),
+            "v": np.array([1.0, 4.0, 2.0, 8.0]),
+        }
+    )
+    s = Session(MemoryCatalog({"t": page}))
+    rows = s.query(
+        "select g, bool_and(b), bool_or(b), every(b), count_if(b),"
+        " geometric_mean(v), arbitrary(g) from t group by g order by g"
+    ).rows()
+    assert rows[0][:5] == (0, False, True, False, 1)
+    assert abs(rows[0][5] - 2.0) < 1e-12
+    assert rows[1][:5] == (1, True, True, True, 2)
+    assert abs(rows[1][5] - 4.0) < 1e-12
+
+
+def test_checksum_order_independent():
+    a = Page.from_dict({"v": np.array([3, 1, 2, 5], np.int64)})
+    b = Page.from_dict({"v": np.array([5, 2, 1, 3], np.int64)})
+    sa = Session(MemoryCatalog({"t": a}))
+    sb = Session(MemoryCatalog({"t": b}))
+    [(ca,)] = sa.query("select checksum(v) from t").rows()
+    [(cb,)] = sb.query("select checksum(v) from t").rows()
+    assert ca == cb and ca != 0
+    c = Page.from_dict({"v": np.array([3, 1, 2, 4], np.int64)})
+    [(cc,)] = Session(MemoryCatalog({"t": c})).query(
+        "select checksum(v) from t"
+    ).rows()
+    assert cc != ca
+
+
+def test_greatest_least_varchar_keeps_strings():
+    rows = Session(TpchCatalog(sf=0.002)).query(
+        "select n_name, greatest(n_name, 'MOROCCO') g,"
+        " least(n_name, 'MOROCCO') l"
+        " from nation where n_nationkey < 2 order by n_name"
+    ).rows()
+    rows = [r[1:] for r in rows]
+    assert rows[0] == ("MOROCCO", "ALGERIA")
+    assert rows[1] == ("MOROCCO", "ARGENTINA")
+
+
+def test_date_diff_truncates_toward_zero():
+    s = Session(TpchCatalog(sf=0.002))
+    [(a, b)] = s.query(
+        "select date_diff('week', date '2020-01-04', date '2020-01-01') a,"
+        " date_diff('week', date '2020-01-01', date '2020-01-04') b"
+        " from nation where n_nationkey = 0"
+    ).rows()
+    assert a == 0 and b == 0
+
+
+def test_regexp_extract_nonparticipating_group_is_null():
+    s = Session(TpchCatalog(sf=0.002))
+    [(v,)] = s.query(
+        "select regexp_extract(n_name, '(X)?(A)', 1) from nation"
+        " where n_nationkey = 0"
+    ).rows()
+    assert v is None
+
+
+def test_checksum_varchar_dictionary_independent():
+    a = Page.from_dict({"v": ["b", "a", "c"]})
+    # same strings, different dictionary (superset) and code assignment
+    from presto_tpu.page import Block
+    import jax.numpy as jnp
+
+    big_dict = ("X", "a", "b", "c")
+    codes = np.array([2, 1, 3], np.int32)
+    blk = Block.from_numpy(codes, a.blocks[0].type, dictionary=big_dict)
+    b = Page.from_blocks([blk], ["v"], count=3)
+    [(ca,)] = Session(MemoryCatalog({"t": a})).query(
+        "select checksum(v) from t"
+    ).rows()
+    [(cb,)] = Session(MemoryCatalog({"t": b})).query(
+        "select checksum(v) from t"
+    ).rows()
+    assert ca == cb
+
+
+def test_truncate_long_decimal_lanes():
+    import decimal as _dec
+
+    typ = __import__("presto_tpu").types.DecimalType(38, 3)
+    import jax.numpy as jnp
+
+    from presto_tpu.page import Block
+
+    raw = 1 << 40  # 1099511627.776 at scale 3
+    lanes = jnp.stack(
+        [jnp.asarray([raw >> 32], jnp.int64), jnp.asarray([raw & 0xFFFFFFFF], jnp.int64)],
+        axis=-1,
+    )
+    page = Page.from_blocks([Block(lanes, typ)], ["x"], count=1)
+    [(v,)] = Session(MemoryCatalog({"t": page})).query(
+        "select truncate(x) from t"
+    ).rows()
+    assert v == _dec.Decimal("1099511627.000")

@@ -1,0 +1,227 @@
+"""General window frames + outer joins vs the SQLite oracle
+(reference operator/window/FrameInfo.java — ROWS/RANGE BETWEEN bounds —
+and LookupJoinOperators full/right outer + residual-on-outer support)."""
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+from presto_tpu.testing.oracle import SqliteOracle, assert_same_results
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(TpchCatalog(sf=SF))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle(
+        sf=SF, tables=["orders", "customer", "lineitem", "nation", "supplier"]
+    )
+
+
+def check(session, oracle, sql):
+    ours = session.query(sql)
+    expected = oracle.query(sql)
+    types = [b.type for b in ours.page.blocks]
+    assert_same_results(ours.rows(), expected, types)
+
+
+# -- ROWS frames -------------------------------------------------------------
+
+
+def test_rows_sliding_sum_avg_count(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               sum(o_totalprice) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between 2 preceding and 1 following) as s,
+               count(*) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between 2 preceding and 1 following) as c,
+               avg(o_totalprice) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between 2 preceding and current row) as a
+        from orders where o_custkey < 120
+        """,
+    )
+
+
+def test_rows_min_max_sliding(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               min(o_totalprice) over (order by o_orderkey
+                   rows between 3 preceding and 3 following) as mn,
+               max(o_totalprice) over (order by o_orderkey
+                   rows between 3 preceding and 3 following) as mx
+        from orders where o_custkey < 120
+        """,
+    )
+
+
+def test_rows_unbounded_following(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               sum(o_totalprice) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between current row and unbounded following) as tail
+        from orders where o_custkey < 120
+        """,
+    )
+
+
+def test_rows_empty_frame_is_null(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               sum(o_totalprice) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between 3 following and 2 following) as s
+        from orders where o_custkey < 60
+        """,
+    )
+
+
+# -- RANGE frames ------------------------------------------------------------
+
+
+def test_range_value_offsets(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               count(*) over (order by o_orderkey
+                   range between 100 preceding and 100 following) as near,
+               sum(o_totalprice) over (order by o_orderkey
+                   range between 1000 preceding and current row) as s
+        from orders where o_custkey < 120
+        """,
+    )
+
+
+def test_range_default_frame_peers(session, oracle):
+    # ties on o_orderdate: the default RANGE frame includes the whole peer
+    # group, not just the prefix up to the current row
+    check(
+        session,
+        oracle,
+        """
+        select o_custkey,
+               sum(o_totalprice) over (partition by o_custkey
+                   order by o_orderdate) as s
+        from orders where o_custkey < 200
+        """,
+    )
+
+
+# -- value functions over frames --------------------------------------------
+
+
+def test_first_last_nth_value_frames(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               first_value(o_totalprice) over (partition by o_custkey
+                   order by o_orderkey) as fv,
+               last_value(o_totalprice) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between unbounded preceding and unbounded following) as lv,
+               nth_value(o_totalprice, 2) over (partition by o_custkey
+                   order by o_orderkey
+                   rows between unbounded preceding and unbounded following) as nv
+        from orders where o_custkey < 120
+        """,
+    )
+
+
+def test_lag_lead_default(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select o_orderkey,
+               lag(o_totalprice, 1, 0) over (partition by o_custkey
+                   order by o_orderkey) as lg,
+               lead(o_totalprice, 2, -1) over (partition by o_custkey
+                   order by o_orderkey) as ld
+        from orders where o_custkey < 120
+        """,
+    )
+
+
+# -- right/full outer joins --------------------------------------------------
+
+
+def test_right_outer_join(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select c_custkey, c_name, o_orderkey
+        from orders right outer join customer on o_custkey = c_custkey
+        where c_custkey < 200
+        order by c_custkey, o_orderkey
+        """,
+    )
+
+
+def test_full_outer_join(session, oracle):
+    # split customers so both sides have unmatched rows
+    check(
+        session,
+        oracle,
+        """
+        select a.c_custkey as k1, b.c_custkey as k2
+        from (select c_custkey from customer where c_custkey < 100) a
+        full outer join
+             (select c_custkey from customer where c_custkey >= 50
+              and c_custkey < 150) b
+        on a.c_custkey = b.c_custkey
+        order by k1, k2
+        """,
+    )
+
+
+def test_left_join_with_residual(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select c_custkey, o_orderkey
+        from customer left join orders
+          on c_custkey = o_custkey and o_totalprice > 150000
+        where c_custkey < 150
+        order by c_custkey, o_orderkey
+        """,
+    )
+
+
+def test_full_join_with_residual(session, oracle):
+    check(
+        session,
+        oracle,
+        """
+        select c_custkey, o_orderkey
+        from customer full outer join orders
+          on c_custkey = o_custkey and o_totalprice > 150000
+        where c_custkey < 100 or c_custkey is null
+        order by c_custkey, o_orderkey
+        """,
+    )
