@@ -567,6 +567,7 @@ class TpchCatalog:
     def __init__(self, sf: float = 1.0):
         self.sf = sf
         self._pages: Dict[str, "Page"] = {}
+        self._tables: Dict[str, Table] = {}
 
     def table_names(self):
         return list(TABLE_NAMES)
@@ -587,6 +588,22 @@ class TpchCatalog:
         plan channels). Cached: repeated queries reuse device arrays."""
         pg = self._pages.get(tname)
         if pg is None:
-            pg = table(tname, self.sf).to_page()
+            pg = self.host_table(tname).to_page()
             self._pages[tname] = pg
         return pg
+
+    def host_table(self, tname: str) -> Table:
+        """Host-resident (numpy) table, cached — the streaming scan source
+        (reference ConnectorPageSource: data stays off-device until a split
+        batch is requested)."""
+        tb = self._tables.get(tname)
+        if tb is None:
+            tb = table(tname, self.sf)
+            self._tables[tname] = tb
+        return tb
+
+    def scan(self, tname: str, start: int, stop: int, pad_to=None) -> "Page":
+        """One batch of rows [start, stop) as a device Page — the split/
+        morsel read path (reference BackgroundHiveSplitLoader splits +
+        ConnectorPageSource.getNextPage)."""
+        return self.host_table(tname).to_page(start, stop, pad_to=pad_to)

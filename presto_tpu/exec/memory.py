@@ -1,0 +1,45 @@
+"""Device-memory accounting for query execution.
+
+Re-designed equivalent of the reference's node memory management
+(presto-main/.../memory/MemoryPool.java:43 reserve/reserveRevocable,
+presto-memory-context/ hierarchical contexts). TPU-first reduction: one
+pool per query tracking HBM-resident page bytes; "revocable" memory is the
+streaming driver's build/accumulator state, which it can offload to host
+RAM (exec/stream.py) — the disk-spill analog from SURVEY §5.
+
+Enforcement is cooperative: kernels are static-shape, so the driver checks
+the budget BEFORE materializing (reserve raises MemoryExceededError and the
+caller switches to a bounded strategy — smaller batches or chunked build
+execution), instead of the reference's blocking futures."""
+
+from __future__ import annotations
+
+
+class MemoryExceededError(RuntimeError):
+    """Query exceeded its device-memory budget (reference
+    ExceededMemoryLimitException)."""
+
+
+class MemoryPool:
+    def __init__(self, max_bytes: int | None = None, name: str = "query"):
+        self.max_bytes = max_bytes
+        self.name = name
+        self.reserved = 0
+        self.peak = 0
+
+    def can_reserve(self, nbytes: int) -> bool:
+        return self.max_bytes is None or self.reserved + nbytes <= self.max_bytes
+
+    def reserve(self, nbytes: int, what: str = "") -> int:
+        if not self.can_reserve(nbytes):
+            raise MemoryExceededError(
+                f"{self.name}: reserving {nbytes:,}B for {what or 'pages'} "
+                f"exceeds budget ({self.reserved:,}B reserved of "
+                f"{self.max_bytes:,}B)"
+            )
+        self.reserved += nbytes
+        self.peak = max(self.peak, self.reserved)
+        return nbytes
+
+    def free(self, nbytes: int) -> None:
+        self.reserved = max(0, self.reserved - nbytes)

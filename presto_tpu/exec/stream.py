@@ -1,0 +1,467 @@
+"""Streaming (paged) plan execution with a device-memory budget.
+
+Re-designed equivalent of the reference's worker streaming pipeline — the
+Driver hot loop pulling pages operator-to-operator
+(presto-main/.../operator/Driver.java:347-430), split/morsel scans
+(SourcePartitionedScheduler + ConnectorPageSource), and the revocable-
+memory/spill machinery (memory/MemoryPool.java:43,
+operator/HashBuilderOperator.java:155-180 SPILLING_INPUT states,
+spiller/). TPU-first redesign:
+
+* A "page" is a fixed-capacity device batch (static shapes -> one compiled
+  kernel chain reused for every batch); the host driver loop streams leaf
+  batches through stateless kernels into accumulating sinks.
+* Aggregations accumulate PARTIAL states on device and merge periodically —
+  the same partial/final decomposition the distributed path uses
+  (ops/aggregate.decompose_partial), so a base table is never resident.
+* Join build sides materialize on device under a MemoryPool budget; when
+  the budget would be exceeded they *offload to host RAM* (the disk-spill
+  analog, SURVEY §5 "long-context analog") and INNER joins run
+  chunk-by-chunk against re-streamed probes — the reference's grouped /
+  bucket-wise execution (Lifespan + PipelineExecutionStrategy.GROUPED).
+* Sinks short-circuit where the reference would (LIMIT stops the scan).
+
+Everything falls back to the materializing Executor for node shapes that
+need whole inputs (windows, full-outer composition, sorts beyond budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..expr import ir
+from ..ops.aggregate import (
+    apply_avg_post,
+    decompose_partial,
+    global_aggregate,
+    grouped_aggregate_sorted,
+)
+from ..ops.filter import filter_page
+from ..ops.join import build, join_expand, join_n1
+from ..ops.sort import distinct_page, limit_page, sort_page, top_n
+from ..ops.union import concat_pages
+from ..page import Block, Page, round_capacity
+from ..plan import nodes as N
+from .executor import ExecutionError, Executor
+from .memory import MemoryExceededError, MemoryPool
+from .stats import page_device_bytes
+
+
+@dataclasses.dataclass
+class HostTable:
+    """Host-RAM offloaded rows (the spill-file analog): numpy columns +
+    schema, uploadable chunk-by-chunk."""
+
+    names: Tuple[str, ...]
+    types: tuple
+    dict_ids: tuple
+    columns: List[np.ndarray]
+    valids: List[Optional[np.ndarray]]
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def row_bytes(self) -> int:
+        if not self.num_rows:
+            return 0
+        per = sum(c.dtype.itemsize * (c.size // len(c)) for c in self.columns)
+        per += sum(1 for v in self.valids if v is not None)
+        return per
+
+    def slice_page(self, start: int, stop: int, pad_to=None) -> Page:
+        blocks = []
+        for c, v, t2, d in zip(self.columns, self.valids, self.types, self.dict_ids):
+            data = jnp.asarray(c[start:stop])
+            valid = None if v is None else jnp.asarray(v[start:stop])
+            blk = Block(data, t2, valid, d)
+            if pad_to is not None and pad_to > stop - start:
+                from ..page import _pad_block
+
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, self.names, count=stop - start)
+
+    @staticmethod
+    def from_pages(pages: List[Page]) -> "HostTable":
+        first = pages[0]
+        cols: List[np.ndarray] = []
+        valids: List[Optional[np.ndarray]] = []
+        for i, b in enumerate(first.blocks):
+            parts = []
+            vparts = []
+            any_valid = any(p.blocks[i].valid is not None for p in pages)
+            for p in pages:
+                n = int(p.count)
+                pb = p.blocks[i]
+                parts.append(np.asarray(pb.data[:n]))
+                if any_valid:
+                    vparts.append(
+                        np.asarray(pb.valid[:n])
+                        if pb.valid is not None
+                        else np.ones((n,), np.bool_)
+                    )
+            cols.append(np.concatenate(parts) if parts else np.empty((0,)))
+            valids.append(np.concatenate(vparts) if any_valid else None)
+        return HostTable(
+            first.names,
+            tuple(b.type for b in first.blocks),
+            tuple(b.dict_id for b in first.blocks),
+            cols,
+            valids,
+        )
+
+    def append_page(self, page: Page) -> None:
+        other = HostTable.from_pages([page])
+        for i in range(len(self.columns)):
+            self.columns[i] = np.concatenate([self.columns[i], other.columns[i]])
+            a, b = self.valids[i], other.valids[i]
+            if a is None and b is None:
+                continue
+            if a is None:
+                a = np.ones((len(self.columns[i]) - len(other.columns[i]),), np.bool_)
+            if b is None:
+                b = np.ones((other.num_rows,), np.bool_)
+            self.valids[i] = np.concatenate([a, b])
+
+
+class StreamingExecutor:
+    """Host driver loop over device page batches (reference Driver +
+    TaskExecutor collapsed: one Python loop, kernels stay on device)."""
+
+    def __init__(
+        self,
+        catalog,
+        batch_rows: int = 1 << 20,
+        memory_budget: Optional[int] = None,
+        collector=None,
+    ):
+        self.catalog = catalog
+        self.batch_rows = batch_rows
+        self.pool = MemoryPool(memory_budget)
+        self.local = Executor(catalog, collector=collector)
+        self.collector = collector
+
+    # -- public --
+
+    def run(self, node: N.PlanNode) -> Page:
+        out = self._run(node)
+        return out
+
+    def rows(self, node: N.PlanNode) -> List[tuple]:
+        return self.run(node).to_pylist()
+
+    # -- top-level dispatch: sinks consume streams --
+
+    def _run(self, node: N.PlanNode) -> Page:
+        if isinstance(node, N.Output):
+            return self.local.exec_node(node, self._run(node.child))
+        if isinstance(node, N.Aggregate):
+            return self._sink_aggregate(node)
+        if isinstance(node, N.Distinct):
+            return self._sink_distinct(node)
+        if isinstance(node, N.TopN):
+            return self._sink_topn(node)
+        if isinstance(node, N.Limit):
+            return self._sink_limit(node)
+        if isinstance(node, N.Sort):
+            return self._sink_sort(node)
+        # everything else: materialize the stream
+        return self._materialize(node)
+
+    def _materialize(self, node: N.PlanNode) -> Page:
+        pages = [p for p in self.stream(node) if int(p.count) > 0]
+        if not pages:
+            # empty result with the right schema: run an empty batch through
+            return next(self.stream(node))
+        if len(pages) == 1:
+            return pages[0]
+        return concat_pages(pages)
+
+    # -- streaming core: generator of batches per node -----------------------
+
+    def stream(self, node: N.PlanNode) -> Iterator[Page]:
+        if isinstance(node, N.TableScan):
+            yield from self._stream_scan(node)
+        elif isinstance(node, (N.Filter, N.Project)):
+            for batch in self.stream(node.child):
+                yield self.local.exec_node(node, batch)
+        elif isinstance(node, N.Join) and node.kind in ("inner", "left") and not (
+            node.kind == "left" and node.residual is not None
+        ):
+            yield from self._stream_join(node)
+        elif isinstance(node, N.SemiJoin) and node.residual is None:
+            yield from self._stream_semijoin(node)
+        elif isinstance(node, N.ScalarApply):
+            sub = self._run(node.subquery)
+            for batch in self.stream(node.child):
+                yield self.local.exec_node(node, batch, sub)
+        elif isinstance(node, N.Union) and not node.distinct:
+            first_names = None
+            for child in node.children:
+                for batch in self.stream(child):
+                    if first_names is None:
+                        first_names = batch.names
+                    yield Page(batch.blocks, first_names, batch.count)
+        else:
+            # window / outer compositions / distinct-union / exchanges:
+            # materialize the subtree with the classic executor (its inputs
+            # still stream where they can, via _run recursion)
+            yield self._exec_fallback(node)
+
+    def _exec_fallback(self, node: N.PlanNode) -> Page:
+        pages = [self._run(c) for c in node.children]
+        return self.local.exec_node(node, *pages)
+
+    def _stream_scan(self, node: N.TableScan) -> Iterator[Page]:
+        # row_count is a planner ESTIMATE (statistics); drive the scan off
+        # the actual batches until a short batch marks the end of the table
+        est = self.catalog.row_count(node.table)
+        B = self.batch_rows
+        scan = getattr(self.catalog, "scan", None)
+        if scan is None or est <= B // 2:
+            src = self.catalog.page(node.table)
+            yield self._rename_scan(node, src)
+            return
+        start = 0
+        while True:
+            src = scan(node.table, start, start + B, pad_to=B)
+            n = int(src.count)
+            if n > 0 or start == 0:
+                yield self._rename_scan(node, src)
+            if n < B:
+                return
+            start += B
+
+    @staticmethod
+    def _rename_scan(node: N.TableScan, src: Page) -> Page:
+        blocks, names = [], []
+        for ch, col, _typ in node.columns:
+            blocks.append(src.block(col))
+            names.append(ch)
+        return Page(tuple(blocks), tuple(names), src.count)
+
+    # -- joins ----------------------------------------------------------------
+
+    def _collect_side(self, node: N.PlanNode):
+        """Materialize a build side on device within budget; offload to host
+        when the budget runs out (HashBuilderOperator's revoke-to-spill)."""
+        batches: List[Page] = []
+        held = 0
+        host: Optional[HostTable] = None
+        for b in self.stream(node):
+            if int(b.count) == 0:
+                if not batches and host is None:
+                    batches.append(b)  # keep schema carrier
+                continue
+            nb = page_device_bytes(b)
+            if host is None and self.pool.can_reserve(nb + held):
+                batches.append(b)
+                held += nb
+            else:
+                if host is None:
+                    host = HostTable.from_pages(batches) if batches else None
+                    batches = []
+                    held = 0
+                if host is None:
+                    host = HostTable.from_pages([b])
+                else:
+                    host.append_page(b)
+        if host is not None:
+            return "host", host
+        self.pool.reserve(held, "join build side")
+        page = batches[0] if len(batches) == 1 else concat_pages(batches)
+        return "device", (page, held)
+
+    def _stream_join(self, node: N.Join) -> Iterator[Page]:
+        kind, side = self._collect_side(node.right)
+        right_names = tuple(n for n, _ in node.right.fields)
+        if kind == "device":
+            right_page, held = side
+            try:
+                yield from self._probe_stream(node, right_page, right_names)
+            finally:
+                self.pool.free(held)
+            return
+        # host-offloaded build: chunked (grouped) execution — INNER only
+        if node.kind != "inner":
+            raise MemoryExceededError(
+                "outer join build side exceeds the device budget "
+                "(chunked execution covers inner joins)"
+            )
+        host: HostTable = side
+        budget = self.pool.max_bytes or (1 << 62)
+        share = max(budget // 4, 1)
+        rows_per_chunk = max(int(share // max(host.row_bytes, 1)), 1)
+        for start in range(0, max(host.num_rows, 1), rows_per_chunk):
+            stop = min(start + rows_per_chunk, host.num_rows)
+            chunk = host.slice_page(start, stop)
+            nb = page_device_bytes(chunk)
+            self.pool.reserve(nb, "join build chunk")
+            try:
+                yield from self._probe_stream(node, chunk, right_names)
+            finally:
+                self.pool.free(nb)
+
+    def _probe_stream(
+        self, node: N.Join, right_page: Page, right_names
+    ) -> Iterator[Page]:
+        bs = build(right_page, node.right_keys)
+        for batch in self.stream(node.left):
+            if node.unique_build:
+                out = join_n1(
+                    batch, bs, node.left_keys, right_names, right_names,
+                    kind=node.kind,
+                )
+            else:
+                cap = round_capacity(max(int(batch.count), 1))
+                while True:
+                    out, overflow = join_expand(
+                        batch,
+                        bs,
+                        node.left_keys,
+                        batch.names,
+                        [(nm, nm) for nm in right_names],
+                        out_capacity=cap,
+                        kind=node.kind,
+                    )
+                    if int(overflow) == 0:
+                        break
+                    cap = round_capacity(cap + int(overflow))
+            if node.residual is not None:
+                out = filter_page(out, node.residual)
+            yield self.local._shrink(out)
+
+    def _stream_semijoin(self, node: N.SemiJoin) -> Iterator[Page]:
+        source = self._run(node.source)
+        held = self.pool.reserve(page_device_bytes(source), "semijoin source")
+        try:
+            bs = build(source, node.source_keys)
+            for batch in self.stream(node.child):
+                out = join_n1(
+                    batch, bs, node.probe_keys, [], [],
+                    kind="anti" if node.anti else "semi",
+                )
+                yield self.local._shrink(out)
+        finally:
+            self.pool.free(held)
+
+    # -- sinks ----------------------------------------------------------------
+
+    def _sink_aggregate(self, node: N.Aggregate) -> Page:
+        partial, final, post = decompose_partial(node.aggs)
+        if not node.group_exprs:
+            partials: List[Page] = []
+            for batch in self.stream(node.child):
+                partials.append(global_aggregate(batch, partial))
+            acc = concat_pages(partials)
+            out = global_aggregate(acc, self._final_over_columns(final))
+            return apply_avg_post(out, node.aggs, post)
+
+        group_refs = tuple(
+            ir.ColumnRef(nm, e.type)
+            for nm, e in zip(node.group_names, node.group_exprs)
+        )
+        state: Optional[Page] = None
+        state_held = 0
+        merge_rows = max(self.batch_rows // 2, 1 << 14)
+        pending: List[Page] = []
+        pending_rows = 0
+
+        def merge(parts: List[Page], bound: int) -> Page:
+            acc = parts[0] if len(parts) == 1 else concat_pages(parts)
+            mg = round_capacity(min(max(bound, 1), 1 << 22))
+            while True:
+                out = grouped_aggregate_sorted(
+                    acc, group_refs, node.group_names,
+                    self._final_over_columns(final), mg,
+                )
+                true_groups = int(out.count)
+                if true_groups <= mg:
+                    break
+                mg = round_capacity(true_groups)
+            return self.local._shrink(out)
+
+        for batch in self.stream(node.child):
+            mg = round_capacity(min(max(int(batch.count), 1), 1 << 16))
+            while True:
+                part = grouped_aggregate_sorted(
+                    batch, node.group_exprs, node.group_names, partial, mg
+                )
+                if int(part.count) <= mg:
+                    break
+                mg = round_capacity(int(part.count))
+            part = self.local._shrink(part)
+            pending.append(part)
+            pending_rows += int(part.count)
+            if pending_rows >= merge_rows:
+                parts = ([state] if state is not None else []) + pending
+                new_state = merge(parts, pending_rows + int(state.count if state is not None else 0))
+                self.pool.free(state_held)
+                state_held = self.pool.reserve(
+                    page_device_bytes(new_state), "aggregation state"
+                )
+                state = new_state
+                pending = []
+                pending_rows = 0
+        parts = ([state] if state is not None else []) + pending
+        if not parts:
+            # no input batches at all: synthesize an empty aggregation
+            empty = next(self.stream(node.child))
+            return self.local.exec_node(node, empty)
+        out = merge(parts, pending_rows + int(state.count if state is not None else 0))
+        self.pool.free(state_held)
+        return apply_avg_post(out, node.aggs, post)
+
+    @staticmethod
+    def _final_over_columns(final):
+        return tuple(final)
+
+    def _sink_distinct(self, node: N.Distinct) -> Page:
+        state: Optional[Page] = None
+        for batch in self.stream(node.child):
+            d = distinct_page(batch, batch.capacity)
+            if state is None:
+                state = d
+            else:
+                merged = concat_pages([state, d])
+                state = distinct_page(merged, merged.capacity)
+            state = self.local._shrink(state)
+        return state if state is not None else next(self.stream(node.child))
+
+    def _sink_topn(self, node: N.TopN) -> Page:
+        state: Optional[Page] = None
+        for batch in self.stream(node.child):
+            t = top_n(batch, node.keys, node.count)
+            if state is None:
+                state = t
+            else:
+                state = top_n(concat_pages([state, t]), node.keys, node.count)
+        return state if state is not None else next(self.stream(node.child))
+
+    def _sink_limit(self, node: N.Limit) -> Page:
+        got: List[Page] = []
+        rows = 0
+        for batch in self.stream(node.child):
+            got.append(batch)
+            rows += int(batch.count)
+            if rows >= node.count:
+                break  # short-circuit: stop pulling the scan
+        if not got:
+            got = [next(self.stream(node.child))]
+        acc = got[0] if len(got) == 1 else concat_pages(got)
+        return self.local._shrink(limit_page(acc, node.count))
+
+    def _sink_sort(self, node: N.Sort) -> Page:
+        acc = self._materialize(node.child)
+        self.pool.reserve(page_device_bytes(acc), "sort input")
+        try:
+            return sort_page(acc, node.keys)
+        finally:
+            self.pool.free(page_device_bytes(acc))

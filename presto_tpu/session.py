@@ -35,7 +35,15 @@ class Session:
     mesh's worker axis (exec/dist.py) — the analog of LocalQueryRunner vs
     DistributedQueryRunner (presto-tests/.../DistributedQueryRunner.java:75)."""
 
-    def __init__(self, catalog, mesh=None, broadcast_threshold: int = 1_000_000):
+    def __init__(
+        self,
+        catalog,
+        mesh=None,
+        broadcast_threshold: int = 1_000_000,
+        streaming: bool = False,
+        batch_rows: int = 1 << 20,
+        memory_budget=None,
+    ):
         self.catalog = catalog
         self.mesh = mesh
         self.broadcast_threshold = broadcast_threshold
@@ -43,8 +51,17 @@ class Session:
             from .exec.dist import DistributedExecutor
 
             self.executor = DistributedExecutor(catalog, mesh)
+        elif streaming:
+            from .exec.stream import StreamingExecutor
+
+            self.executor = StreamingExecutor(
+                catalog, batch_rows=batch_rows, memory_budget=memory_budget
+            )
         else:
             self.executor = Executor(catalog)
+        self.streaming = streaming
+        self.batch_rows = batch_rows
+        self.memory_budget = memory_budget
 
     def plan(self, sql: str) -> N.PlanNode:
         ast = parse(sql)
@@ -97,6 +114,18 @@ class Session:
             from .exec.dist import DistributedExecutor
 
             ex = DistributedExecutor(self.catalog, self.mesh, collector=collector)
+        elif self.streaming:
+            # profile the SAME engine the session runs: streamed batches
+            # under the session's memory budget (per-node stats cover the
+            # kernels the streaming driver delegates to the local executor)
+            from .exec.stream import StreamingExecutor
+
+            ex = StreamingExecutor(
+                self.catalog,
+                batch_rows=self.batch_rows,
+                memory_budget=self.memory_budget,
+                collector=collector,
+            )
         else:
             ex = Executor(self.catalog, collector=collector)
         ex.run(node)

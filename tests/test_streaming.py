@@ -1,0 +1,107 @@
+"""Streaming/paged execution + memory budget (reference Driver.java:347-430
+page loop, MemoryPool.java:43 accounting, HashBuilderOperator spill states,
+grouped execution). Streaming results must match the materializing executor
+exactly; budgets must bound device-resident bytes and trigger host offload
++ chunked joins instead of failing."""
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.exec.memory import MemoryExceededError
+from presto_tpu.session import Session
+
+SF = 0.01
+BATCH = 512  # tiny batches so every query crosses many batch boundaries
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return TpchCatalog(sf=SF)
+
+
+@pytest.fixture(scope="module")
+def plain(catalog):
+    return Session(catalog)
+
+
+def _streaming(catalog, **kw):
+    kw.setdefault("batch_rows", BATCH)
+    return Session(catalog, streaming=True, **kw)
+
+
+QUERIES = {
+    "q1_shape": (
+        "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
+        "sum(l_extendedprice * (1 - l_discount)) as rev, "
+        "avg(l_extendedprice) as avg_price, count(*) as n "
+        "from lineitem where l_shipdate <= date '1998-09-02' "
+        "group by l_returnflag, l_linestatus "
+        "order by l_returnflag, l_linestatus"
+    ),
+    "q6_shape": (
+        "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+        "where l_shipdate >= date '1994-01-01' "
+        "and l_shipdate < date '1995-01-01' "
+        "and l_discount between 0.05 and 0.07 and l_quantity < 24"
+    ),
+    "q3_shape": (
+        "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev "
+        "from customer, orders, lineitem "
+        "where c_mktsegment = 'BUILDING' and c_custkey = o_custkey "
+        "and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15' "
+        "group by l_orderkey order by rev desc limit 10"
+    ),
+    "semijoin": (
+        "select count(*) c from orders where o_custkey in "
+        "(select c_custkey from customer where c_acctbal > 0)"
+    ),
+    "distinct": "select distinct l_returnflag, l_linestatus from lineitem",
+    "topn": (
+        "select o_orderkey, o_totalprice from orders "
+        "order by o_totalprice desc limit 7"
+    ),
+    "limit": "select l_orderkey from lineitem limit 25",
+    "left_join": (
+        "select c_custkey, count(o_orderkey) n from customer "
+        "left join orders on c_custkey = o_custkey "
+        "group by c_custkey order by c_custkey limit 20"
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_streaming_matches_materializing(catalog, plain, name):
+    sql = QUERIES[name]
+    s = _streaming(catalog)
+    got = s.query(sql).rows()
+    want = plain.query(sql).rows()
+    if "limit" == name:  # LIMIT without ORDER BY: row set is unordered
+        assert len(got) == len(want)
+        return
+    if "order by" not in sql:
+        got, want = sorted(got), sorted(want)
+    assert got == want
+
+
+def test_aggregation_state_stays_bounded(catalog, plain):
+    # Q1 shape under a budget far below the base table's device footprint:
+    # partial aggregation keeps only group-state resident
+    s = _streaming(catalog, memory_budget=24 << 20)
+    sql = QUERIES["q1_shape"]
+    assert s.query(sql).rows() == plain.query(sql).rows()
+    assert s.executor.pool.peak <= 24 << 20
+
+
+def test_join_build_offloads_and_chunks(catalog, plain):
+    # budget below the orders build-side bytes: the build offloads to host
+    # RAM and the inner join runs chunk-by-chunk against re-streamed probes
+    sql = QUERIES["q3_shape"]
+    s = _streaming(catalog, memory_budget=2 << 20)
+    assert s.query(sql).rows() == plain.query(sql).rows()
+    assert s.executor.pool.peak <= 2 << 20
+
+
+def test_outer_join_over_budget_raises(catalog):
+    s = _streaming(catalog, memory_budget=64 << 10)
+    with pytest.raises(MemoryExceededError):
+        s.query(QUERIES["left_join"]).rows()
