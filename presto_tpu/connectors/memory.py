@@ -13,10 +13,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import types as T
 from ..page import Page
-from ..sql.planner import Catalog
+from .spi import Connector
 
 
-class MemoryCatalog(Catalog):
+class MemoryCatalog(Connector):
     """tables: {name: Page}; unique: {table: [key column sets]} lets the
     planner use n:1 joins (the analog of declared primary keys)."""
 
@@ -47,23 +47,6 @@ class MemoryCatalog(Catalog):
         return self.unique.get(table, [])
 
     def page(self, table: str) -> Page:
+        # scan() and exact_row_count() come from the Connector base: the
+        # default device-side slicing IS this connector's batched read path
         return self.tables[table]
-
-    def scan(self, table: str, start: int, stop: int, pad_to=None) -> Page:
-        """Batched read path: slice of the stored page (device-side slice —
-        the table already lives in HBM for this connector)."""
-        from ..page import Block, _pad_block
-
-        src = self.tables[table]
-        n = int(src.count)
-        stop = min(stop, n)
-        count = max(stop - start, 0)
-        blocks = []
-        for b in src.blocks:
-            data = b.data[start:stop]
-            valid = None if b.valid is None else b.valid[start:stop]
-            blk = Block(data, b.type, valid, b.dict_id)
-            if pad_to is not None and pad_to > count:
-                blk = _pad_block(blk, pad_to)
-            blocks.append(blk)
-        return Page.from_blocks(blocks, src.names, count=count)

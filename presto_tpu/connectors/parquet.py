@@ -1,0 +1,325 @@
+"""Parquet connector: columnar files -> device Pages.
+
+Re-designed equivalent of the reference's Parquet reader stack
+(presto-parquet/ ParquetReader + column readers, wired through
+presto-hive's HivePageSourceProvider) collapsed TPU-first: pyarrow does
+the host-side decode (decompression, encodings), this connector maps
+arrow buffers onto the engine's device Block layout —
+
+  int/float/bool/date/timestamp -> storage arrays, zero-copy where arrow
+  allows; validity bitmaps -> bool masks
+  decimal(p<=18)  -> int64 scaled units
+  decimal(p>18)   -> two int64 lanes (ops/decimal128.py layout)
+  string          -> int32 codes over a file-level sorted dictionary
+                     (built once per column, cached — the engine's
+                     DictionaryBlock-only string representation)
+
+Pushdown (reference TupleDomain row-group pruning): `scan(...)` maps a row
+range onto parquet row groups, skips groups whose min/max statistics
+refute the predicate hint, and reads only the requested columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page, _pad_block
+from .spi import Connector, Predicate
+
+
+def _arrow_to_type(at) -> T.Type:
+    import pyarrow as pa
+
+    if pa.types.is_dictionary(at):
+        at = at.value_type
+    if pa.types.is_int64(at):
+        return T.BIGINT
+    if pa.types.is_int32(at):
+        return T.INTEGER
+    if pa.types.is_int16(at):
+        return T.SMALLINT
+    if pa.types.is_int8(at):
+        return T.TINYINT
+    if pa.types.is_float64(at):
+        return T.DOUBLE
+    if pa.types.is_float32(at):
+        return T.REAL
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_date32(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_decimal(at):
+        return T.DecimalType(at.precision, at.scale)
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.VARCHAR
+    raise NotImplementedError(f"unsupported parquet type {at}")
+
+
+def _decimal_ints(arr) -> np.ndarray:
+    """Arrow decimal128 column -> numpy int128 pair (hi, lo_unsigned) of
+    the 2^64-radix little-endian storage."""
+    import pyarrow as pa
+
+    combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    parts = combined.chunks if isinstance(combined, pa.ChunkedArray) else [combined]
+    his, los = [], []
+    for chunk in parts:
+        buf = chunk.buffers()[1]
+        raw = np.frombuffer(buf, dtype=np.uint64)
+        off = chunk.offset
+        lo = raw[0::2][off : off + len(chunk)]
+        hi = raw[1::2][off : off + len(chunk)].view(np.int64)
+        his.append(hi)
+        los.append(lo)
+    return np.concatenate(his), np.concatenate(los)
+
+
+class ParquetCatalog(Connector):
+    """tables: {name: parquet file path}."""
+
+    name = "parquet"
+
+    def __init__(self, tables: Dict[str, str],
+                 unique: Optional[Dict[str, list]] = None):
+        import pyarrow.parquet as pq
+
+        self.paths = dict(tables)
+        self.unique = unique or {}
+        self._files: Dict[str, object] = {}
+        self._dicts: Dict[Tuple[str, str], tuple] = {}
+        self._pq = pq
+
+    # -- metadata --
+
+    def _file(self, table: str):
+        f = self._files.get(table)
+        if f is None:
+            f = self._pq.ParquetFile(self.paths[table])
+            self._files[table] = f
+        return f
+
+    def table_names(self) -> List[str]:
+        return list(self.paths)
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        sch = self._file(table).schema_arrow
+        return {f.name: _arrow_to_type(f.type) for f in sch}
+
+    def row_count(self, table: str) -> int:
+        return self._file(table).metadata.num_rows
+
+    def exact_row_count(self, table: str) -> int:
+        return self._file(table).metadata.num_rows
+
+    def unique_columns(self, table: str):
+        return self.unique.get(table, [])
+
+    # -- string dictionaries (file-level, sorted, cached) --
+
+    def _dictionary(self, table: str, column: str):
+        """(sorted tuple, numpy object array of the same entries) — the
+        array form feeds vectorized np.searchsorted encodes per batch."""
+        key = (table, column)
+        d = self._dicts.get(key)
+        if d is None:
+            col = self._file(table).read(columns=[column]).column(0)
+            import pyarrow.compute as pc
+
+            uniq = pc.unique(
+                col.cast(col.type.value_type)
+                if hasattr(col.type, "value_type")
+                else col
+            )
+            entries = tuple(sorted(s for s in uniq.to_pylist() if s is not None))
+            d = (entries, np.array(entries, dtype=object))
+            self._dicts[key] = d
+        return d
+
+    # -- data --
+
+    def page(self, table: str) -> Page:
+        n = self.row_count(table)
+        return self.scan(table, 0, n)
+
+    def scan(
+        self,
+        table: str,
+        start: int,
+        stop: int,
+        pad_to: Optional[int] = None,
+        columns: Optional[List[str]] = None,
+        predicate: Optional[Predicate] = None,
+    ) -> Page:
+        pf = self._file(table)
+        md = pf.metadata
+        stop = min(stop, md.num_rows)
+        count = max(stop - start, 0)
+        names = columns or [f.name for f in pf.schema_arrow]
+
+        # map [start, stop) onto row groups; prune by statistics
+        groups = []
+        offset = 0
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            g_start, g_stop = offset, offset + rg.num_rows
+            offset = g_stop
+            if g_stop <= start or g_start >= stop:
+                continue
+            if predicate and self._refuted(rg, pf, predicate):
+                continue
+            groups.append((g, g_start))
+
+        if not groups:
+            tb = pf.schema_arrow.empty_table().select(names)
+            return self._to_page(table, tb, names, 0, pad_to)
+
+        tb = pf.read_row_groups([g for g, _ in groups], columns=names)
+        # slice the requested range out of the concatenated kept groups.
+        # With pruning, skipped groups shift positions; deliver whatever
+        # kept rows fall in [start, stop) of the ORIGINAL coordinates by
+        # assembling per-group slices.
+        import pyarrow as pa
+
+        pieces = []
+        pos = 0
+        for (g, g_start) in groups:
+            g_rows = md.row_group(g).num_rows
+            lo = max(start - g_start, 0)
+            hi = min(stop - g_start, g_rows)
+            if hi > lo:
+                pieces.append(tb.slice(pos + lo, hi - lo))
+            pos += g_rows
+        tb = pa.concat_tables(pieces) if pieces else tb.slice(0, 0)
+        return self._to_page(table, tb, names, tb.num_rows, pad_to)
+
+    @staticmethod
+    def _refuted(rg, pf, predicate: Predicate) -> bool:
+        """True if the row group's min/max statistics refute ANY conjunct
+        (reference TupleDomainParquetPredicate.matches)."""
+        stats_by_col = {}
+        for i in range(rg.num_columns):
+            c = rg.column(i)
+            if c.statistics is not None and c.statistics.has_min_max:
+                stats_by_col[c.path_in_schema] = c.statistics
+        for col, op, value in predicate:
+            st = stats_by_col.get(col)
+            if st is None:
+                continue
+            mn, mx = st.min, st.max
+            try:
+                if op == "eq" and (value < mn or value > mx):
+                    return True
+                if op in ("lt",) and mn >= value:
+                    return True
+                if op in ("le",) and mn > value:
+                    return True
+                if op in ("gt",) and mx <= value:
+                    return True
+                if op in ("ge",) and mx < value:
+                    return True
+            except TypeError:
+                continue  # incomparable statistics: keep the group
+        return False
+
+    def _to_page(self, table, tb, names, count, pad_to) -> Page:
+        import pyarrow as pa
+
+        blocks = []
+        for name in names:
+            col = tb.column(name)
+            typ = _arrow_to_type(col.type)
+            valid = None
+            if col.null_count:
+                valid = ~np.asarray(col.is_null().combine_chunks())
+            dict_id = None
+            if isinstance(typ, T.VarcharType):
+                d, d_arr = self._dictionary(table, name)
+                arr = col.combine_chunks()
+                if pa.types.is_dictionary(arr.type):
+                    arr = arr.cast(arr.type.value_type)
+                vals = np.asarray(arr.to_pandas(), dtype=object)
+                if valid is not None and len(d):
+                    vals = np.where(valid, vals, d[0])
+                # dictionary is sorted: one vectorized binary search encodes
+                data = np.searchsorted(d_arr, vals).astype(np.int32)
+                blk = Block.from_numpy(data, typ, valid, dictionary=d)
+            elif isinstance(typ, T.DecimalType):
+                hi64, lo64 = _decimal_ints(col)
+                if typ.is_long:
+                    # 2^64-radix -> engine 2^32-radix lanes
+                    our_hi = (hi64 << 32) | (lo64 >> 32).astype(np.int64)
+                    our_lo = (lo64 & np.uint64(0xFFFFFFFF)).astype(np.int64)
+                    data = np.stack([our_hi, our_lo], axis=-1)
+                else:
+                    data = lo64.view(np.int64)
+                blk = Block.from_numpy(data, typ, valid)
+            elif isinstance(typ, T.TimestampType):
+                us = col.cast(pa.timestamp("us")).combine_chunks()
+                data = np.asarray(us.cast(pa.int64()))
+                blk = Block.from_numpy(data, typ, valid)
+            else:
+                arr = col.combine_chunks()
+                if pa.types.is_dictionary(arr.type):
+                    arr = arr.cast(arr.type.value_type)
+                if isinstance(typ, T.DateType):
+                    data = np.asarray(arr.cast(pa.int32()))
+                else:
+                    data = np.asarray(arr, dtype=typ.storage_dtype)
+                blk = Block.from_numpy(data, typ, valid)
+            if pad_to is not None and pad_to > count:
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, names, count=count)
+
+
+def write_table_parquet(page_or_table, path: str, row_group_size: int = 1 << 17):
+    """Write engine data back to parquet (test fixture + the seed of a
+    writer path; reference presto-hive ParquetPageSink analog)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    page = page_or_table
+    n = int(page.count)
+    cols = {}
+    for name, b in zip(page.names, page.blocks):
+        valid = None if b.valid is None else np.asarray(b.valid[:n])
+        if isinstance(b.type, T.VarcharType):
+            d = b.dictionary or ()
+            codes = np.asarray(b.data[:n])
+            vals = [
+                None if (valid is not None and not valid[i]) else d[int(codes[i])]
+                for i in range(n)
+            ]
+            cols[name] = pa.array(vals, type=pa.string())
+        elif isinstance(b.type, T.DecimalType):
+            import decimal as _dec
+
+            raw = np.asarray(b.data[:n])
+            out = []
+            for i in range(n):
+                if valid is not None and not valid[i]:
+                    out.append(None)
+                    continue
+                if b.type.is_long:
+                    v = int(raw[i][0]) * (1 << 32) + int(raw[i][1])
+                else:
+                    v = int(raw[i])
+                out.append(_dec.Decimal(v).scaleb(-b.type.scale))
+            cols[name] = pa.array(
+                out, type=pa.decimal128(b.type.precision, b.type.scale)
+            )
+        elif isinstance(b.type, T.DateType):
+            arr = np.asarray(b.data[:n])
+            mask = None if valid is None else ~valid
+            cols[name] = pa.array(arr, type=pa.date32(), mask=mask)
+        else:
+            arr = np.asarray(b.data[:n])
+            mask = None if valid is None else ~valid
+            cols[name] = pa.array(arr, mask=mask)
+    pq.write_table(pa.table(cols), path, row_group_size=row_group_size)

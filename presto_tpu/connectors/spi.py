@@ -1,0 +1,83 @@
+"""Connector SPI — the contract between the engine and data sources.
+
+Re-designed equivalent of the reference's connector SPI
+(presto-spi/src/main/java/com/facebook/presto/spi/connector/ —
+ConnectorMetadata, ConnectorSplitManager, ConnectorPageSource). TPU-first
+reduction: a connector is ONE object serving both metadata and device
+Pages; the "split" is a row range [start, stop) of a table (the morsel the
+streaming driver schedules), and predicate/column pushdown arrives as
+plain arguments instead of TupleDomain objects.
+
+Metadata methods (planner-facing, reference ConnectorMetadata):
+  table_names() -> [str]
+  schema(table) -> {column: Type}
+  row_count(table) -> int                  # statistics estimate
+  unique_columns(table) -> [tuple]         # declared keys (n:1 joins)
+
+Data methods (executor-facing, reference ConnectorPageSource):
+  page(table) -> Page                      # whole table, device-resident
+  exact_row_count(table) -> int            # TRUE row count (not the
+      row_count estimate). Required for predicate pruning: the streaming
+      driver otherwise detects end-of-table by a short batch, which a
+      pruned batch would fake — without exact_row_count the engine drops
+      the pruning hint entirely.
+  scan(table, start, stop, pad_to=None, columns=None, predicate=None)
+      -> Page                              # one batched split; MUST clamp
+      stop to the true row count and may over-deliver rows that fail
+      `predicate` (it is a pruning hint, not a filter — the engine always
+      re-applies the real Filter)
+
+`predicate` is a list of (column, op, value) conjuncts with op in
+{'lt','le','gt','ge','eq'} and `value` in storage units — enough to prune
+row groups / partitions by min-max statistics (reference
+TupleDomainOrcPredicate / Parquet predicate pushdown).
+
+The base class supplies scan() by slicing page() so minimal connectors
+only implement metadata + page().
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .. import types as T
+from ..page import Block, Page, _pad_block
+from ..sql.planner import Catalog
+
+Predicate = List[Tuple[str, str, object]]
+
+
+class Connector(Catalog):
+    """Base connector: metadata protocol from planner.Catalog + default
+    batched scan over the materialized page."""
+
+    def page(self, table: str) -> Page:
+        raise NotImplementedError
+
+    def exact_row_count(self, table: str) -> int:
+        return int(self.page(table).count)
+
+    def scan(
+        self,
+        table: str,
+        start: int,
+        stop: int,
+        pad_to: Optional[int] = None,
+        columns: Optional[List[str]] = None,
+        predicate: Optional[Predicate] = None,
+    ) -> Page:
+        src = self.page(table)
+        n = int(src.count)
+        stop = min(stop, n)
+        count = max(stop - start, 0)
+        names = list(src.names) if columns is None else list(columns)
+        blocks = []
+        for name in names:
+            b = src.block(name)
+            data = b.data[start:stop]
+            valid = None if b.valid is None else b.valid[start:stop]
+            blk = Block(data, b.type, valid, b.dict_id)
+            if pad_to is not None and pad_to > count:
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, names, count=count)

@@ -602,8 +602,16 @@ class TpchCatalog:
             self._tables[tname] = tb
         return tb
 
-    def scan(self, tname: str, start: int, stop: int, pad_to=None) -> "Page":
+    def exact_row_count(self, tname: str) -> int:
+        return self.host_table(tname).num_rows
+
+    def scan(self, tname: str, start: int, stop: int, pad_to=None,
+             columns=None, predicate=None) -> "Page":
         """One batch of rows [start, stop) as a device Page — the split/
         morsel read path (reference BackgroundHiveSplitLoader splits +
-        ConnectorPageSource.getNextPage)."""
-        return self.host_table(tname).to_page(start, stop, pad_to=pad_to)
+        ConnectorPageSource.getNextPage). Honors column pushdown; the
+        in-memory generator has no row-group statistics to prune by."""
+        tb = self.host_table(tname)
+        if columns is not None:
+            tb = Table(tb.name, {c: tb.columns[c] for c in columns})
+        return tb.to_page(start, stop, pad_to=pad_to)

@@ -90,21 +90,28 @@ class HostTable:
 
     @staticmethod
     def from_pages(pages: List[Page]) -> "HostTable":
+        from ..ops.union import unify_block_dictionaries
+
         first = pages[0]
         cols: List[np.ndarray] = []
         valids: List[Optional[np.ndarray]] = []
-        for i, b in enumerate(first.blocks):
+        dict_ids: List[Optional[int]] = []
+        for i in range(len(first.blocks)):
+            # unify per-batch dictionaries BEFORE concatenating codes (same
+            # invariant as concat_pages — codes are meaningless across
+            # different dictionaries)
+            blocks, did = unify_block_dictionaries([p.blocks[i] for p in pages])
+            dict_ids.append(did)
             parts = []
             vparts = []
-            any_valid = any(p.blocks[i].valid is not None for p in pages)
-            for p in pages:
+            any_valid = any(b.valid is not None for b in blocks)
+            for p, b in zip(pages, blocks):
                 n = int(p.count)
-                pb = p.blocks[i]
-                parts.append(np.asarray(pb.data[:n]))
+                parts.append(np.asarray(b.data[:n]))
                 if any_valid:
                     vparts.append(
-                        np.asarray(pb.valid[:n])
-                        if pb.valid is not None
+                        np.asarray(b.valid[:n])
+                        if b.valid is not None
                         else np.ones((n,), np.bool_)
                     )
             cols.append(np.concatenate(parts) if parts else np.empty((0,)))
@@ -112,23 +119,110 @@ class HostTable:
         return HostTable(
             first.names,
             tuple(b.type for b in first.blocks),
-            tuple(b.dict_id for b in first.blocks),
+            tuple(dict_ids),
             cols,
             valids,
         )
 
     def append_page(self, page: Page) -> None:
+        from ..page import dictionary_by_id, intern_dictionary
+
         other = HostTable.from_pages([page])
+        dict_ids = list(self.dict_ids)
         for i in range(len(self.columns)):
-            self.columns[i] = np.concatenate([self.columns[i], other.columns[i]])
+            a_id, b_id = dict_ids[i], other.dict_ids[i]
+            b_col = other.columns[i]
+            if a_id != b_id:
+                # host-side dictionary unification: remap BOTH code arrays
+                # onto the merged sorted dictionary
+                da = dictionary_by_id(a_id) if a_id is not None else ()
+                db = dictionary_by_id(b_id) if b_id is not None else ()
+                merged = tuple(sorted(set(da) | set(db)))
+                index = {s: j for j, s in enumerate(merged)}
+                map_a = np.array([index[s] for s in da], np.int32)
+                map_b = np.array([index[s] for s in db], np.int32)
+                if len(da):
+                    self.columns[i] = map_a[self.columns[i]]
+                if len(db):
+                    b_col = map_b[b_col]
+                dict_ids[i] = intern_dictionary(merged)
+            self.columns[i] = np.concatenate([self.columns[i], b_col])
             a, b = self.valids[i], other.valids[i]
             if a is None and b is None:
                 continue
             if a is None:
-                a = np.ones((len(self.columns[i]) - len(other.columns[i]),), np.bool_)
+                a = np.ones((len(self.columns[i]) - len(b_col),), np.bool_)
             if b is None:
                 b = np.ones((other.num_rows,), np.bool_)
             self.valids[i] = np.concatenate([a, b])
+        self.dict_ids = tuple(dict_ids)
+
+
+def _pushdown_hints(predicate, scan_node: N.TableScan):
+    """Extract (source_column, op, python_value) pruning hints from simple
+    conjuncts over scanned columns (the TupleDomain-lite of the SPI)."""
+    import datetime as pydt
+    import decimal as pydec
+
+    to_source = {ch: col for ch, col, _ in scan_node.columns}
+    types = {ch: typ for ch, _, typ in scan_node.columns}
+    conjuncts: List = []
+
+    def split(e):
+        if isinstance(e, ir.Call) and e.name == "and":
+            for a in e.args:
+                split(a)
+        else:
+            conjuncts.append(e)
+
+    split(predicate)
+    flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+    hints = []
+
+    def value_for(ch, lit):
+        typ = types.get(ch)
+        v = lit.value
+        if v is None:
+            return None
+        if isinstance(typ, T.DateType):
+            if isinstance(v, str):
+                return pydt.date.fromisoformat(v)
+            return pydt.date(1970, 1, 1) + pydt.timedelta(days=int(v))
+        if isinstance(typ, T.DecimalType):
+            # literal carries the LOGICAL value (planner _number_literal);
+            # Decimal statistics compare fine against float in Python
+            return float(v) if not isinstance(v, pydec.Decimal) else v
+        if isinstance(typ, T.VarcharType):
+            return v if isinstance(v, str) else None
+        return v
+
+    for e in conjuncts:
+        if not isinstance(e, ir.Call):
+            continue
+        if e.name == "between" and isinstance(e.args[0], ir.ColumnRef):
+            col, lo, hi = e.args
+            if col.name in to_source and isinstance(lo, ir.Literal) and isinstance(hi, ir.Literal):
+                vlo, vhi = value_for(col.name, lo), value_for(col.name, hi)
+                if vlo is not None:
+                    hints.append((to_source[col.name], "ge", vlo))
+                if vhi is not None:
+                    hints.append((to_source[col.name], "le", vhi))
+            continue
+        if e.name not in flip:
+            continue
+        a, b = e.args
+        if isinstance(a, ir.ColumnRef) and isinstance(b, ir.Literal):
+            col, lit, op = a, b, e.name
+        elif isinstance(b, ir.ColumnRef) and isinstance(a, ir.Literal):
+            col, lit, op = b, a, flip[e.name]
+        else:
+            continue
+        if col.name not in to_source:
+            continue
+        v = value_for(col.name, lit)
+        if v is not None:
+            hints.append((to_source[col.name], op, v))
+    return hints or None
 
 
 class StreamingExecutor:
@@ -176,10 +270,15 @@ class StreamingExecutor:
         return self._materialize(node)
 
     def _materialize(self, node: N.PlanNode) -> Page:
-        pages = [p for p in self.stream(node) if int(p.count) > 0]
+        pages: List[Page] = []
+        first: Optional[Page] = None
+        for p in self.stream(node):
+            if first is None:
+                first = p  # schema carrier for the all-empty case
+            if int(p.count) > 0:
+                pages.append(p)
         if not pages:
-            # empty result with the right schema: run an empty batch through
-            return next(self.stream(node))
+            return first
         if len(pages) == 1:
             return pages[0]
         return concat_pages(pages)
@@ -189,6 +288,13 @@ class StreamingExecutor:
     def stream(self, node: N.PlanNode) -> Iterator[Page]:
         if isinstance(node, N.TableScan):
             yield from self._stream_scan(node)
+        elif isinstance(node, N.Filter) and isinstance(node.child, N.TableScan):
+            # predicate pushdown hint: simple conjuncts prune row groups /
+            # partitions at the connector (reference TupleDomain pushdown);
+            # the real filter kernel still runs on every delivered batch
+            hints = _pushdown_hints(node.predicate, node.child)
+            for batch in self._stream_scan(node.child, predicate=hints):
+                yield self.local.exec_node(node, batch)
         elif isinstance(node, (N.Filter, N.Project)):
             for batch in self.stream(node.child):
                 yield self.local.exec_node(node, batch)
@@ -219,25 +325,41 @@ class StreamingExecutor:
         pages = [self._run(c) for c in node.children]
         return self.local.exec_node(node, *pages)
 
-    def _stream_scan(self, node: N.TableScan) -> Iterator[Page]:
+    def _stream_scan(self, node: N.TableScan, predicate=None) -> Iterator[Page]:
         # row_count is a planner ESTIMATE (statistics); drive the scan off
         # the actual batches until a short batch marks the end of the table
         est = self.catalog.row_count(node.table)
         B = self.batch_rows
         scan = getattr(self.catalog, "scan", None)
-        if scan is None or est <= B // 2:
+        if scan is None or (est <= B // 2 and not predicate):
             src = self.catalog.page(node.table)
             yield self._rename_scan(node, src)
             return
+        cols = [col for _, col, _ in node.columns]
+        exact = getattr(self.catalog, "exact_row_count", None)
+        total = exact(node.table) if exact is not None else None
+        if total is None:
+            # without an exact row count the short-batch heuristic is the
+            # only end-of-table signal, and pruning may shorten any batch —
+            # drop the (optional) hint rather than risk dropped rows
+            predicate = None
         start = 0
         while True:
-            src = scan(node.table, start, start + B, pad_to=B)
+            src = scan(
+                node.table, start, start + B, pad_to=B,
+                columns=cols, predicate=predicate,
+            )
             n = int(src.count)
             if n > 0 or start == 0:
                 yield self._rename_scan(node, src)
-            if n < B:
-                return
             start += B
+            if total is not None:
+                if start >= total:
+                    return
+            elif n < B:
+                # short batch marks the table end — only valid without
+                # pruning (predicate hints can legally shorten any batch)
+                return
 
     @staticmethod
     def _rename_scan(node: N.TableScan, src: Page) -> Page:
@@ -297,7 +419,10 @@ class StreamingExecutor:
             )
         host: HostTable = side
         budget = self.pool.max_bytes or (1 << 62)
-        share = max(budget // 4, 1)
+        # size chunks from the budget REMAINING after state already held
+        # (aggregation state, other build sides), not the full budget
+        remaining = max(budget - self.pool.reserved, 1)
+        share = max(remaining // 2, 1)
         rows_per_chunk = max(int(share // max(host.row_bytes, 1)), 1)
         for start in range(0, max(host.num_rows, 1), rows_per_chunk):
             stop = min(start + rows_per_chunk, host.num_rows)
@@ -361,7 +486,7 @@ class StreamingExecutor:
             for batch in self.stream(node.child):
                 partials.append(global_aggregate(batch, partial))
             acc = concat_pages(partials)
-            out = global_aggregate(acc, self._final_over_columns(final))
+            out = global_aggregate(acc, final)
             return apply_avg_post(out, node.aggs, post)
 
         group_refs = tuple(
@@ -379,8 +504,7 @@ class StreamingExecutor:
             mg = round_capacity(min(max(bound, 1), 1 << 22))
             while True:
                 out = grouped_aggregate_sorted(
-                    acc, group_refs, node.group_names,
-                    self._final_over_columns(final), mg,
+                    acc, group_refs, node.group_names, final, mg
                 )
                 true_groups = int(out.count)
                 if true_groups <= mg:
@@ -410,18 +534,11 @@ class StreamingExecutor:
                 state = new_state
                 pending = []
                 pending_rows = 0
+        # stream() always yields at least one batch, so parts is non-empty
         parts = ([state] if state is not None else []) + pending
-        if not parts:
-            # no input batches at all: synthesize an empty aggregation
-            empty = next(self.stream(node.child))
-            return self.local.exec_node(node, empty)
         out = merge(parts, pending_rows + int(state.count if state is not None else 0))
         self.pool.free(state_held)
         return apply_avg_post(out, node.aggs, post)
-
-    @staticmethod
-    def _final_over_columns(final):
-        return tuple(final)
 
     def _sink_distinct(self, node: N.Distinct) -> Page:
         state: Optional[Page] = None

@@ -105,3 +105,32 @@ def test_outer_join_over_budget_raises(catalog):
     s = _streaming(catalog, memory_budget=64 << 10)
     with pytest.raises(MemoryExceededError):
         s.query(QUERIES["left_join"]).rows()
+
+
+def test_host_offload_unifies_dictionaries():
+    # build side = UNION ALL of tables with DIFFERENT string dictionaries;
+    # tiny budget forces host offload, which must unify codes (not
+    # concatenate raw ints across dictionaries)
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+
+    left = Page.from_dict(
+        {"k": np.arange(64, dtype=np.int64), "name": ["x", "y"] * 32}
+    )
+    r1 = Page.from_dict(
+        {"rk": np.arange(0, 32, dtype=np.int64), "tag": ["aa", "bb"] * 16}
+    )
+    r2 = Page.from_dict(
+        {"rk": np.arange(32, 64, dtype=np.int64), "tag": ["cc", "bb"] * 16}
+    )
+    cat = MemoryCatalog({"l": left, "r1": r1, "r2": r2})
+    sql = (
+        "select k, tag from l join "
+        "(select rk, tag from r1 union all select rk, tag from r2) r "
+        "on k = rk order by k"
+    )
+    want = Session(cat).query(sql).rows()
+    got = Session(cat, streaming=True, batch_rows=16, memory_budget=4 << 10).query(sql).rows()
+    assert got == want
