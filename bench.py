@@ -107,11 +107,58 @@ def numpy_q1_baseline(t):
     return (cnt, *sums, avg_qty, avg_price, avg_disc, order)
 
 
+def _chained_device_time(jax, query_fn, page, col_name: str, runs: int) -> float:
+    """Honest per-run seconds: each run's input depends on the previous
+    run's output, and the chain ends in one host transfer.
+
+    `block_until_ready` through the axon tunnel returns at enqueue, so
+    naive per-run timing measures dispatch latency (we measured 0.2ms for
+    a kernel whose true runtime was 1.1s). A data-dependency chain forces
+    serial execution; the final int() forces completion of the whole chain;
+    the one ~70ms transfer round-trip amortizes across `runs`."""
+    import jax.numpy as jnp
+
+    from presto_tpu.page import Block, Page
+
+    idx = page.names.index(col_name)
+
+    def chained(p, seed):
+        b0 = p.blocks[idx]
+        data = b0.data.at[0].add(seed * 0)  # no-op that depends on seed
+        blocks = list(p.blocks)
+        blocks[idx] = Block(data, b0.type, b0.valid, b0.dict_id)
+        out = query_fn(Page(tuple(blocks), p.names, p.count))
+        # consume EVERY output column — anything unread would be
+        # dead-code-eliminated out of the measurement by XLA
+        acc = jnp.int64(0)
+        for b in out.blocks:
+            acc = acc + jnp.sum(b.data[0].astype(jnp.int64))
+        return acc
+
+    f = jax.jit(chained)
+    s = f(page, jnp.int64(0))
+    int(s)  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = jnp.int64(0)
+        for _ in range(runs):
+            s = f(page, s)
+        int(s)
+        best = min(best, (time.perf_counter() - t0) / runs)
+    return best
+
+
 def main():
     jax = _init_backend()
 
     import presto_tpu  # noqa: F401
-    from presto_tpu.benchmark.handcoded import lineitem_q1_page, q1_local
+    from presto_tpu.benchmark.handcoded import (
+        lineitem_q1_page,
+        lineitem_q6_page,
+        q1_local,
+        q6_local,
+    )
     from presto_tpu.connectors import tpch
 
     t = tpch.table("lineitem", SF)
@@ -124,18 +171,45 @@ def main():
     cpu_s = time.perf_counter() - t0
     cpu_rows_per_s = n_rows / cpu_s
 
-    # device pipeline
     page = lineitem_q1_page(SF)
-    fn = jax.jit(q1_local)
-    out = fn(page)
-    jax.block_until_ready(out)  # compile + warm
-    times = []
-    for _ in range(RUNS):
+    q1_s = _chained_device_time(jax, q1_local, page, "l_quantity", RUNS)
+    rows_per_s = n_rows / q1_s
+
+    details = {
+        "q1_hand_ms": round(q1_s * 1e3, 2),
+        "cpu_q1_rows_per_s": round(cpu_rows_per_s),
+    }
+    try:
+        p6 = lineitem_q6_page(SF)
+        q6_s = _chained_device_time(jax, q6_local, p6, "l_quantity", RUNS)
+        details["q6_hand_ms"] = round(q6_s * 1e3, 2)
+        details["q6_rows_per_s"] = round(n_rows / q6_s)
+    except Exception as e:  # noqa: BLE001 - suite entries are best-effort
+        details["q6_error"] = repr(e)[:200]
+
+    # SQL path (parse -> plan -> execute, end-to-end wall incl. host syncs)
+    try:
+        from presto_tpu.connectors.tpch import TpchCatalog
+        from presto_tpu.session import Session
+
+        cat = TpchCatalog(sf=SF)
+        sess = Session(cat)
+        q3 = (
+            "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev, "
+            "o_orderdate, o_shippriority "
+            "from customer, orders, lineitem "
+            "where c_mktsegment = 'BUILDING' and c_custkey = o_custkey "
+            "and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15' "
+            "and l_shipdate > date '1995-03-15' "
+            "group by l_orderkey, o_orderdate, o_shippriority "
+            "order by rev desc, o_orderdate limit 10"
+        )
+        sess.query(q3).rows()  # warm (compile + caches)
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(page))
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    rows_per_s = n_rows / best
+        sess.query(q3).rows()
+        details["q3_sql_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    except Exception as e:  # noqa: BLE001
+        details["q3_error"] = repr(e)[:200]
 
     result = {
         "metric": f"tpch_q1_sf{SF:g}_rows_per_sec",
@@ -146,7 +220,7 @@ def main():
     print(json.dumps(result))
     print(
         f"# device={jax.devices()[0].platform} rows={n_rows} "
-        f"best={best*1e3:.2f}ms cpu_baseline={cpu_rows_per_s:.3g} rows/s",
+        f"details={json.dumps(details)}",
         file=sys.stderr,
     )
 

@@ -95,10 +95,14 @@ def q1_aggs():
 
 
 def q1_local(page: Page) -> Page:
-    """Single-chip Q1: fused filter → direct grouped aggregation → sort.
+    """Single-chip Q1: filter fused as an aggregation mask (no compaction —
+    masked reductions run at memory bandwidth; compaction would cost a full
+    sort+gather of the table) → direct grouped aggregation → sort.
     Jittable end-to-end (Pages are pytrees)."""
-    f = filter_page(page, Q1_PREDICATE)
-    out = grouped_aggregate_direct(f, Q1_GROUPS, Q1_GROUP_NAMES, q1_aggs(), Q1_DOMAINS)
+    out = grouped_aggregate_direct(
+        page, Q1_GROUPS, Q1_GROUP_NAMES, q1_aggs(), Q1_DOMAINS,
+        pre_mask=Q1_PREDICATE,
+    )
     return sort_page(
         out,
         (
@@ -164,9 +168,8 @@ def q6_local(page: Page) -> Page:
     revenue = ir.binary(
         "multiply", col("l_extendedprice", DEC12_2), col("l_discount", DEC4_2)
     )
-    f = filter_page(page, Q6_PREDICATE)
     return global_aggregate(
-        f,
+        page,
         (
             AggSpec(
                 "sum",
@@ -175,4 +178,87 @@ def q6_local(page: Page) -> Page:
                 AggSpec.infer_output_type("sum", revenue.type),
             ),
         ),
+        pre_mask=Q6_PREDICATE,
     )
+
+
+def q1_local_pallas(page: Page) -> Page:
+    """Q1 via the hand-written single-pass Pallas kernel
+    (ops/pallas_agg.py) — the custom-kernel analog of the reference's
+    hand-coded benchmarks. Produces the same Page as q1_local; group ids
+    are emitted in (returnflag, linestatus) order so no final sort is
+    needed."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..expr import datetime_kernels as dtk
+    from ..ops import decimal128 as d128
+    from ..ops.aggregate import avg_from_sum_count
+    from ..ops.filter import compact
+    from ..ops.pallas_agg import BLK_ROWS, combine, q1_partial_sums
+
+    def col32(name):
+        return page.block(name).data.astype(jnp.int32)
+
+    n = page.capacity
+    padded = -(-n // BLK_ROWS) * BLK_ROWS
+
+    def pad(x):
+        return jnp.pad(x, (0, padded - n)) if padded != n else x
+
+    cutoff = jnp.int32(dtk.parse_date_literal("1998-09-02"))
+    partials = q1_partial_sums(
+        pad(col32("l_quantity")),
+        pad(col32("l_extendedprice")),
+        pad(col32("l_discount")),
+        pad(col32("l_tax")),
+        pad(col32("l_returnflag")),
+        pad(col32("l_linestatus")),
+        pad(col32("l_shipdate")),
+        page.count.astype(jnp.int32),
+        cutoff,
+    )
+    s = combine(partials)
+
+    rf_b = page.block("l_returnflag")
+    ls_b = page.block("l_linestatus")
+    cnt = s["count"]
+    DEC38_2 = T.DecimalType(38, 2)
+    DEC38_4 = T.DecimalType(38, 4)
+    DEC38_6 = T.DecimalType(38, 6)
+    blocks = [
+        Block(jnp.asarray([0, 0, 1, 1, 2, 2], jnp.int32), T.VARCHAR,
+              None, rf_b.dict_id),
+        Block(jnp.asarray([0, 1, 0, 1, 0, 1], jnp.int32), T.VARCHAR,
+              None, ls_b.dict_id),
+        Block(d128.from_int64(s["sum_qty"]), DEC38_2, cnt > 0),
+        Block(d128.from_int64(s["sum_price"]), DEC38_2, cnt > 0),
+        Block(s["sum_disc_price"], DEC38_4, cnt > 0),
+        Block(s["sum_charge"], DEC38_6, cnt > 0),
+        Block(
+            avg_from_sum_count(
+                d128.from_int64(s["sum_qty"]), cnt, DEC12_2, DEC12_2
+            ),
+            DEC12_2, cnt > 0,
+        ),
+        Block(
+            avg_from_sum_count(
+                d128.from_int64(s["sum_price"]), cnt, DEC12_2, DEC12_2
+            ),
+            DEC12_2, cnt > 0,
+        ),
+        Block(
+            avg_from_sum_count(
+                d128.from_int64(s["sum_disc"]), cnt, DEC4_2, DEC4_2
+            ),
+            DEC4_2, cnt > 0,
+        ),
+        Block(cnt, T.BIGINT, None),
+    ]
+    names = (
+        "l_returnflag", "l_linestatus", "sum_qty", "sum_base_price",
+        "sum_disc_price", "sum_charge", "avg_qty", "avg_price",
+        "avg_disc", "count_order",
+    )
+    out = Page.from_blocks(blocks, names, count=6)
+    return compact(out, cnt > 0)

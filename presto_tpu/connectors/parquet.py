@@ -159,7 +159,6 @@ class ParquetCatalog(Connector):
         pf = self._file(table)
         md = pf.metadata
         stop = min(stop, md.num_rows)
-        count = max(stop - start, 0)
         names = columns or [f.name for f in pf.schema_arrow]
 
         # map [start, stop) onto row groups; prune by statistics
@@ -243,7 +242,7 @@ class ParquetCatalog(Connector):
                 arr = col.combine_chunks()
                 if pa.types.is_dictionary(arr.type):
                     arr = arr.cast(arr.type.value_type)
-                vals = np.asarray(arr.to_pandas(), dtype=object)
+                vals = np.asarray(arr.to_pylist(), dtype=object)
                 if valid is not None and len(d):
                     vals = np.where(valid, vals, d[0])
                 # dictionary is sorted: one vectorized binary search encodes

@@ -28,9 +28,12 @@ Data methods (executor-facing, reference ConnectorPageSource):
       re-applies the real Filter)
 
 `predicate` is a list of (column, op, value) conjuncts with op in
-{'lt','le','gt','ge','eq'} and `value` in storage units — enough to prune
-row groups / partitions by min-max statistics (reference
-TupleDomainOrcPredicate / Parquet predicate pushdown).
+{'lt','le','gt','ge','eq'} and `value` a LOGICAL Python value
+(datetime.date for DATE, float/Decimal for decimals, str for varchar,
+int for integers — matching what file-format statistics expose, NOT the
+engine's scaled storage units) — enough to prune row groups / partitions
+by min-max statistics (reference TupleDomainOrcPredicate / Parquet
+predicate pushdown).
 
 The base class supplies scan() by slicing page() so minimal connectors
 only implement metadata + page().

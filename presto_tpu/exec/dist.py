@@ -354,7 +354,9 @@ replicated subtrees delegate to the single-node Executor."""
             return self.local.exec_node(node, c)
         if not node.group_exprs:
             out, _ = self._apply(
-                ("gagg", node), lambda p: global_aggregate(p, node.aggs), [c]
+                ("gagg", node),
+                lambda p: global_aggregate(p, node.aggs, node.mask),
+                [c],
             )
             return out
         max_groups = round_capacity(min(max(c.max_count(), 1), 1 << 16))
@@ -363,7 +365,8 @@ replicated subtrees delegate to the single-node Executor."""
             out, _ = self._apply(
                 ("agg", node, mg),
                 lambda p: grouped_aggregate_sorted(
-                    p, node.group_exprs, node.group_names, node.aggs, mg
+                    p, node.group_exprs, node.group_names, node.aggs, mg,
+                    node.mask,
                 ),
                 [c],
             )

@@ -149,7 +149,10 @@ class Executor:
     # -- aggregation --
     def _exec_aggregate(self, node: N.Aggregate, page: Page) -> Page:
         if not node.group_exprs:
-            fn = self._kernel(node, lambda: lambda p: global_aggregate(p, node.aggs))
+            fn = self._kernel(
+                node,
+                lambda: lambda p: global_aggregate(p, node.aggs, node.mask),
+            )
             return fn(page)
         # groups <= live rows; guess low and retry with the true group count
         # (returned regardless of the bound) on overflow — the adaptive-
@@ -160,7 +163,8 @@ class Executor:
             fn = self._kernel(
                 (node, mg),
                 lambda: lambda p: grouped_aggregate_sorted(
-                    p, node.group_exprs, node.group_names, node.aggs, mg
+                    p, node.group_exprs, node.group_names, node.aggs, mg,
+                    node.mask,
                 ),
             )
             out = fn(page)

@@ -479,12 +479,21 @@ class StreamingExecutor:
 
     # -- sinks ----------------------------------------------------------------
 
+    def _agg_input_stream(self, node: N.Aggregate) -> Iterator[Page]:
+        """Child batches for a (possibly filter-fused) aggregation; a fused
+        mask over a direct table scan still pushes pruning hints down."""
+        if node.mask is not None and isinstance(node.child, N.TableScan):
+            return self._stream_scan(
+                node.child, predicate=_pushdown_hints(node.mask, node.child)
+            )
+        return self.stream(node.child)
+
     def _sink_aggregate(self, node: N.Aggregate) -> Page:
         partial, final, post = decompose_partial(node.aggs)
         if not node.group_exprs:
             partials: List[Page] = []
-            for batch in self.stream(node.child):
-                partials.append(global_aggregate(batch, partial))
+            for batch in self._agg_input_stream(node):
+                partials.append(global_aggregate(batch, partial, node.mask))
             acc = concat_pages(partials)
             out = global_aggregate(acc, final)
             return apply_avg_post(out, node.aggs, post)
@@ -512,11 +521,12 @@ class StreamingExecutor:
                 mg = round_capacity(true_groups)
             return self.local._shrink(out)
 
-        for batch in self.stream(node.child):
+        for batch in self._agg_input_stream(node):
             mg = round_capacity(min(max(int(batch.count), 1), 1 << 16))
             while True:
                 part = grouped_aggregate_sorted(
-                    batch, node.group_exprs, node.group_names, partial, mg
+                    batch, node.group_exprs, node.group_names, partial, mg,
+                    node.mask,
                 )
                 if int(part.count) <= mg:
                     break

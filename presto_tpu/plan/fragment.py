@@ -170,7 +170,10 @@ class Fragmenter:
         child, dist = self._visit(node.child)
         if not dist.is_sharded:
             return (
-                N.Aggregate(child, node.group_exprs, node.group_names, node.aggs),
+                N.Aggregate(
+                    child, node.group_exprs, node.group_names, node.aggs,
+                    node.mask,
+                ),
                 Partitioning(SINGLE),
             )
         try:
@@ -179,11 +182,17 @@ class Fragmenter:
             # non-decomposable aggregate: gather and aggregate on one worker
             child = self._gather(child, dist)
             return (
-                N.Aggregate(child, node.group_exprs, node.group_names, node.aggs),
+                N.Aggregate(
+                    child, node.group_exprs, node.group_names, node.aggs,
+                    node.mask,
+                ),
                 Partitioning(SINGLE),
             )
+        # the fused selection mask applies to the PARTIAL step only — final
+        # aggregation combines already-masked partial rows
         partial = N.Aggregate(
-            child, node.group_exprs, node.group_names, partial_specs
+            child, node.group_exprs, node.group_names, partial_specs,
+            node.mask,
         )
         key_refs = tuple(
             ir.ColumnRef(nm, e.type)

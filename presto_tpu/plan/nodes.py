@@ -94,6 +94,11 @@ class Aggregate(PlanNode):
     group_exprs: Tuple[RowExpression, ...]
     group_names: Tuple[str, ...]
     aggs: Tuple[AggSpec, ...]
+    # fused selection: rows failing `mask` don't contribute and don't form
+    # groups — the executor-level fusion of Filter into aggregation (on TPU
+    # the filter's compaction costs more than masked reductions; see
+    # optimizer.fuse_filter_into_aggregates)
+    mask: Optional[RowExpression] = None
 
     @property
     def fields(self):
@@ -303,6 +308,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0, collector=None) -> str:
         keys = ", ".join(node.group_names)
         aggs = ", ".join(f"{a.name} := {a.func}({a.input})" for a in node.aggs)
         detail = f" [keys: {keys}] [{aggs}]"
+        if node.mask is not None:
+            detail += f" [mask: {node.mask}]"
     elif isinstance(node, Join):
         pairs = ", ".join(
             f"{l} = {r}" for l, r in zip(node.left_keys, node.right_keys)

@@ -63,8 +63,12 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
         for a in keep_aggs:
             if a.input is not None:
                 _expr_channels(a.input, child_needed)
+        if node.mask is not None:
+            _expr_channels(node.mask, child_needed)
         child = prune_columns(node.child, child_needed)
-        return N.Aggregate(child, node.group_exprs, node.group_names, keep_aggs)
+        return N.Aggregate(
+            child, node.group_exprs, node.group_names, keep_aggs, node.mask
+        )
 
     if isinstance(node, N.Join):
         left_have = set(node.left.field_names())
@@ -163,7 +167,40 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
     raise TypeError(f"prune_columns: unhandled node {type(node).__name__}")
 
 
+def fuse_filter_into_aggregates(node: N.PlanNode) -> N.PlanNode:
+    """Aggregate(Filter(x, p)) -> Aggregate(x, mask=p).
+
+    TPU-first rewrite: a standalone filter materializes a compacted page
+    (sort + gathers); aggregation consumes a selection MASK for free inside
+    its fused reduction kernels. The reference's analog is
+    ScanFilterAndProjectOperator fusing the filter into the page processor."""
+    replace = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            nv = fuse_filter_into_aggregates(v)
+            if nv is not v:
+                replace[f.name] = nv
+        elif (
+            isinstance(v, tuple) and v and isinstance(v[0], N.PlanNode)
+        ):
+            nv = tuple(fuse_filter_into_aggregates(c) for c in v)
+            if nv != v:
+                replace[f.name] = nv
+    if replace:
+        node = dataclasses.replace(node, **replace)
+    if (
+        isinstance(node, N.Aggregate)
+        and node.mask is None
+        and isinstance(node.child, N.Filter)
+    ):
+        flt = node.child
+        node = dataclasses.replace(node, child=flt.child, mask=flt.predicate)
+    return node
+
+
 def optimize(root: N.PlanNode) -> N.PlanNode:
+    root = fuse_filter_into_aggregates(root)
     if isinstance(root, N.Output):
         return prune_columns(root, set(root.channels))
     return prune_columns(root, set(root.field_names()))

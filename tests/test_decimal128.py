@@ -195,3 +195,21 @@ def test_sql_sum_group_avg_order_by_long_sum():
         "order by g"
     ).rows()
     assert rows == [(1,), (2,)]
+
+
+def test_global_min_max_long_decimal():
+    typ = T.DecimalType(38, 2)
+    vals = [4 * 10**19, -(3 * 10**19), 7, 0]
+    lanes = jnp.stack(
+        [
+            jnp.asarray([v >> 32 for v in vals], jnp.int64),
+            jnp.asarray([v & 0xFFFFFFFF for v in vals], jnp.int64),
+        ],
+        axis=-1,
+    )
+    page = Page.from_blocks([Block(lanes, typ)], ["x"], count=4)
+    s = Session(MemoryCatalog({"t": page}))
+    [(mn, mx)] = s.query("select min(x), max(x) from t").rows()
+    D = decimal.Decimal
+    assert mn == D(-(3 * 10**19)).scaleb(-2)
+    assert mx == D(4 * 10**19).scaleb(-2)

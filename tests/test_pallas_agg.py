@@ -1,0 +1,29 @@
+"""Hand-written Pallas Q1 kernel vs the XLA composition (exact match).
+
+Runs in interpret mode on the CPU test mesh (the axon TPU tunnel cannot
+execute Mosaic kernels — ops/pallas_agg.py docstring); correctness of the
+limb decomposition and per-block combine is fully exercised either way."""
+
+from presto_tpu.benchmark.handcoded import (
+    lineitem_q1_page,
+    q1_local,
+    q1_local_pallas,
+)
+
+
+def test_pallas_q1_matches_xla():
+    page = lineitem_q1_page(0.01)
+    want = q1_local(page).to_pylist()
+    got = q1_local_pallas(page).to_pylist()
+    assert len(want) == 4
+    assert got == want
+
+
+def test_pallas_q1_partial_batch_boundary():
+    # capacity not a multiple of the block size exercises padding + the
+    # count-based liveness mask
+    page = lineitem_q1_page(0.003)
+    assert page.capacity % 16384 != 0
+    want = q1_local(page).to_pylist()
+    got = q1_local_pallas(page).to_pylist()
+    assert got == want
