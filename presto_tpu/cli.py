@@ -8,6 +8,8 @@ coordinator) arrives with the server layer.
 Usage:
   python -m presto_tpu.cli                 # REPL on tpch sf0.01
   python -m presto_tpu.cli --sf 1 "SELECT ...;"
+  python -m presto_tpu.cli --server http://host:port "SELECT ...;"
+  python -m presto_tpu.cli --serve --port 8080   # start a coordinator
 """
 
 from __future__ import annotations
@@ -43,6 +45,10 @@ def main(argv=None):
     ap.add_argument("query", nargs="?", help="SQL to run (REPL if omitted)")
     ap.add_argument("--sf", type=float, default=0.01, help="TPC-H scale factor")
     ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--server", help="coordinator URI (remote REST mode)")
+    ap.add_argument("--serve", action="store_true",
+                    help="start a coordinator server instead of a REPL")
+    ap.add_argument("--port", type=int, default=8080)
     args = ap.parse_args(argv)
 
     from .connectors.tpch import TpchCatalog
@@ -50,6 +56,59 @@ def main(argv=None):
 
     if args.catalog != "tpch":
         ap.error(f"unknown catalog {args.catalog}")
+
+    if args.serve:
+        from .server import CoordinatorServer
+
+        server = CoordinatorServer(
+            Session(TpchCatalog(sf=args.sf)), port=args.port
+        ).start()
+        print(f"coordinator listening on {server.uri} (tpch sf{args.sf:g})")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return
+
+    if args.server:
+        from .server import Client
+
+        client = Client(args.server)
+
+        def run_remote(sql: str):
+            sql = sql.strip().rstrip(";")
+            if not sql:
+                return
+            t0 = time.perf_counter()
+            cols, rows = client.execute(sql)
+            dt = time.perf_counter() - t0
+            print(_render(rows, [c["name"] for c in cols]))
+            print(f"({len(rows)} rows in {dt:.2f}s)")
+
+        if args.query:
+            run_remote(args.query)
+            return
+        print(f"presto-tpu CLI — remote {args.server}. End statements with ';'.")
+        buf = []
+        while True:
+            try:
+                line = input("presto> " if not buf else "     -> ")
+            except (EOFError, KeyboardInterrupt):
+                print()
+                return
+            if line.strip().lower() in ("quit", "exit"):
+                return
+            buf.append(line)
+            if line.rstrip().endswith(";"):
+                sql = "\n".join(buf)
+                buf = []
+                try:
+                    run_remote(sql)
+                except Exception as e:
+                    print(f"error: {e}", file=sys.stderr)
+        return
+
     session = Session(TpchCatalog(sf=args.sf))
 
     def run_one(sql: str):

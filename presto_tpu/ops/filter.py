@@ -28,7 +28,8 @@ def compact(page: Page, keep: jnp.ndarray) -> Page:
     fastest reorder primitive available."""
     keep = keep & page.live_mask()
     cap = page.capacity
-    count = jnp.sum(keep.astype(jnp.int32))
+    # int32 count invariant (page.py): x64 mode would promote the sum
+    count = jnp.sum(keep.astype(jnp.int32)).astype(jnp.int32)
     perm = jnp.argsort(~keep, stable=True)  # kept rows first, stable
     blocks = []
     for b in page.blocks:

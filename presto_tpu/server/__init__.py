@@ -1,0 +1,27 @@
+"""Server layer: coordinator + workers + REST protocol (SURVEY L2/L3/L11).
+
+Single-node embedding:  CoordinatorServer(Session(...)).start()
+Cluster execution:      WorkerServer(catalog).start() per node,
+                        NodeManager([...uris]) + HttpClusterSession.
+Client:                 Client(coordinator_uri).execute(sql).
+"""
+
+from .client import Client, QueryError
+from .cluster import HttpClusterSession, HttpScheduler, NodeManager, TaskFailure
+from .coordinator import CoordinatorServer
+from .serde import DictionaryCache, deserialize_page, serialize_page
+from .worker import WorkerServer
+
+__all__ = [
+    "Client",
+    "QueryError",
+    "CoordinatorServer",
+    "WorkerServer",
+    "NodeManager",
+    "HttpScheduler",
+    "HttpClusterSession",
+    "TaskFailure",
+    "serialize_page",
+    "deserialize_page",
+    "DictionaryCache",
+]

@@ -1,0 +1,72 @@
+"""REST protocol client.
+
+Re-designed equivalent of the reference's client library
+(presto-client/.../StatementClientV1.java + QueryResults nextUri paging,
+presto-cli's transport): POST the statement, follow nextUri until the
+terminal state, yield rows. stdlib urllib — no dependencies."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Iterator, List, Optional, Tuple
+
+
+class QueryError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(self, server_uri: str, timeout: float = 30.0):
+        self.server = server_uri.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None):
+        import urllib.error
+
+        req = urllib.request.Request(url, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # coordinator errors carry JSON bodies (404 unknown query,
+            # 503 draining) — surface them as QueryError, not HTTPError
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                payload = {"error": str(e)}
+            if isinstance(payload, dict) and "canceled" in payload:
+                return payload
+            raise QueryError(
+                f"{e.code}: {payload.get('error', payload)}"
+            ) from None
+
+    def execute(self, sql: str) -> Tuple[List[dict], List[list]]:
+        """Run to completion; returns (columns, rows)."""
+        cols: List[dict] = []
+        rows: List[list] = []
+        payload = self._request(
+            "POST", f"{self.server}/v1/statement", sql.encode()
+        )
+        while True:
+            if "error" in payload:
+                raise QueryError(str(payload["error"].get("message")))
+            if payload.get("columns"):
+                cols = payload["columns"]
+            rows.extend(payload.get("data", []))
+            nxt = payload.get("nextUri")
+            if nxt is None:
+                return cols, rows
+            payload = self._request("GET", nxt + "?maxWait=5")
+
+    def cancel(self, query_id: str) -> bool:
+        out = self._request(
+            "DELETE", f"{self.server}/v1/statement/{query_id}"
+        )
+        return bool(out.get("canceled"))
+
+    def queries(self) -> List[dict]:
+        return self._request("GET", f"{self.server}/v1/query")
+
+    def node_info(self) -> dict:
+        return self._request("GET", f"{self.server}/v1/info")

@@ -1,0 +1,223 @@
+"""Coordinator HTTP server: the client statement protocol.
+
+Re-designed equivalent of the reference's server layer (SURVEY L2):
+StatementResource (`POST /v1/statement`, server/protocol/
+StatementResource.java:84,128) with QueryResults nextUri paging
+(presto-client/.../QueryResults.java:41), QueryResource listings,
+NodeResource-style /v1/info + /v1/status, and graceful shutdown
+(server/GracefulShutdownHandler.java:43). Python stdlib HTTP (threading
+server) replaces airlift/Jetty — the control plane is latency-bound, not
+throughput-bound; the data plane stays on device.
+
+Protocol (wire-compatible in spirit, JSON):
+  POST /v1/statement            body = SQL   -> QueryResults JSON
+  GET  /v1/statement/{id}/{token}?maxWait=s  -> next QueryResults chunk
+  DELETE /v1/statement/{id}                  -> cancel
+  GET  /v1/query                             -> query list
+  GET  /v1/query/{id}                        -> detail incl. plan
+  GET  /v1/info | /v1/status                 -> node info / liveness
+  PUT  /v1/info/state  body='"SHUTTING_DOWN"'-> graceful shutdown
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .state import FINISHED, QueryManager
+
+PAGE_ROWS = 1000  # rows per QueryResults chunk (client paging)
+VERSION = "presto-tpu/0.2"
+
+
+def _json_default(v):
+    import datetime
+    import decimal
+
+    if isinstance(v, (decimal.Decimal,)):
+        return str(v)
+    if hasattr(v, "item"):
+        return v.item()
+    if isinstance(v, (datetime.date,)):
+        return v.isoformat()
+    return str(v)
+
+
+class CoordinatorServer:
+    """Embeddable coordinator (reference TestingPrestoServer): wraps a
+    Session in a QueryManager and serves the REST protocol."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrent: int = 1):
+        self.manager = QueryManager(session, max_concurrent=max_concurrent)
+        self.started_at = time.time()
+        self.shutting_down = False
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            # -- helpers --
+            def _send(self, code: int, payload, content_type="application/json"):
+                body = (
+                    payload
+                    if isinstance(payload, bytes)
+                    else json.dumps(payload, default=_json_default).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
+            # -- routes --
+            def do_POST(self):
+                if self.path == "/v1/statement":
+                    if outer.shutting_down:
+                        self._send(503, {"error": "shutting down"})
+                        return
+                    sql = self._read_body().decode()
+                    info = outer.manager.submit(sql)
+                    # immediate first response: QUEUED with nextUri
+                    self._send(200, outer._query_results(info, 0))
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                qs = {}
+                if "?" in self.path:
+                    for kv in self.path.split("?", 1)[1].split("&"):
+                        if "=" in kv:
+                            k, v = kv.split("=", 1)
+                            qs[k] = v
+                if parts[:2] == ["v1", "statement"] and len(parts) == 4:
+                    qid, token = parts[2], int(parts[3])
+                    info = outer.manager.get(qid)
+                    if info is None:
+                        self._send(404, {"error": f"unknown query {qid}"})
+                        return
+                    max_wait = float(qs.get("maxWait", 1.0))
+                    if not info.done:
+                        outer.manager.wait(qid, max_wait)
+                    self._send(200, outer._query_results(info, token))
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 2:
+                    self._send(
+                        200,
+                        [outer._query_summary(i) for i in outer.manager.list_queries()],
+                    )
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                    info = outer.manager.get(parts[2])
+                    if info is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    d = outer._query_summary(info)
+                    if info.plan is None and info.error is None:
+                        try:  # lazily rendered on the detail endpoint only
+                            info.plan = outer.manager.session.explain(info.sql)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    d["plan"] = info.plan
+                    d["error"] = info.error
+                    self._send(200, d)
+                    return
+                if parts == ["v1", "info"]:
+                    self._send(
+                        200,
+                        {
+                            "nodeVersion": VERSION,
+                            "coordinator": True,
+                            "uptime_s": round(time.time() - outer.started_at, 1),
+                            "state": "SHUTTING_DOWN"
+                            if outer.shutting_down
+                            else "ACTIVE",
+                        },
+                    )
+                    return
+                if parts == ["v1", "status"]:
+                    self._send(200, {"state": "ACTIVE", "version": VERSION})
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts[:2] == ["v1", "statement"] and len(parts) == 3:
+                    ok = outer.manager.cancel(parts[2])
+                    self._send(200 if ok else 404, {"canceled": ok})
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_PUT(self):
+                if self.path == "/v1/info/state":
+                    body = self._read_body().decode().strip().strip('"')
+                    if body == "SHUTTING_DOWN":
+                        outer.shutting_down = True  # drain: reject new queries
+                        self._send(200, {"state": "SHUTTING_DOWN"})
+                        return
+                self._send(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    # -- protocol payloads --
+
+    def _query_summary(self, info) -> dict:
+        return {
+            "queryId": info.query_id,
+            "state": info.state,
+            "query": info.sql,
+            "elapsed_s": round(
+                (info.finished_at or time.time()) - info.created_at, 3
+            ),
+        }
+
+    def _query_results(self, info, token: int) -> dict:
+        base = f"http://{self.host}:{self.port}"
+        out = {
+            "id": info.query_id,
+            "infoUri": f"{base}/v1/query/{info.query_id}",
+            "stats": {"state": info.state},
+        }
+        if info.state == FINISHED and info.rows is not None:
+            out["columns"] = info.columns
+            start = token * PAGE_ROWS
+            chunk = info.rows[start : start + PAGE_ROWS]
+            out["data"] = [list(r) for r in chunk]
+            if start + PAGE_ROWS < len(info.rows):
+                out["nextUri"] = (
+                    f"{base}/v1/statement/{info.query_id}/{token + 1}"
+                )
+        elif info.done:
+            out["error"] = {"message": info.error or info.state}
+        else:
+            out["nextUri"] = f"{base}/v1/statement/{info.query_id}/{token}"
+        return out
+
+    # -- lifecycle --
+
+    def start(self) -> "CoordinatorServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -1,0 +1,149 @@
+"""Page wire serde: device Pages <-> bytes for cross-process transport.
+
+Re-designed equivalent of the reference's SerializedPage + PagesSerde
+(presto-main/.../execution/buffer/PagesSerde.java:39 — block-encoded
+binary pages with optional LZ4). TPU-first differences: blocks are
+fixed-width numpy arrays, so the encoding is a small JSON header (schema,
+types, dictionary payloads) + raw little-endian column buffers,
+compressed with zlib (the stdlib stand-in for airlift's LZ4 — same
+role, zero new dependencies).
+
+Dictionaries ship WITH the page the first time a (connection, dict_id)
+pair is seen and are referenced by id afterwards — the cross-process
+answer to VERDICT's "dict_ids are process-local" gap. A DictionaryCache
+per connection tracks what the peer already has.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zlib
+from typing import Dict, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page, dictionary_by_id, intern_dictionary
+
+_MAGIC = b"PTP1"
+
+
+def _type_to_wire(t: T.Type) -> str:
+    return t.display()
+
+
+def _type_from_wire(s: str) -> T.Type:
+    return T.parse_type(s)
+
+
+class DictionaryCache:
+    """Tracks which interned dictionaries the peer has already received
+    (sender side) or holds local ids for remote ids (receiver side)."""
+
+    def __init__(self):
+        self.sent: Set[int] = set()
+        self.remote_to_local: Dict[int, int] = {}
+
+
+def serialize_page(
+    page: Page, cache: Optional[DictionaryCache] = None, compress: bool = True
+) -> bytes:
+    """Page -> bytes. Live rows only (the wire never carries dead slots)."""
+    n = int(page.count)
+    cols = []
+    buffers = []
+    dict_payloads = {}
+    for name, b in zip(page.names, page.blocks):
+        data = np.asarray(b.data[:n])
+        valid = None if b.valid is None else np.asarray(b.valid[:n])
+        entry = {
+            "name": name,
+            "type": _type_to_wire(b.type),
+            "dtype": data.dtype.str,
+            "shape": list(data.shape),
+            "valid": valid is not None,
+            "dict_id": b.dict_id,
+        }
+        if b.dict_id is not None:
+            needs = cache is None or b.dict_id not in cache.sent
+            if needs:
+                d = dictionary_by_id(b.dict_id)
+                dict_payloads[str(b.dict_id)] = list(d)
+                if cache is not None:
+                    cache.sent.add(b.dict_id)
+        cols.append(entry)
+        buffers.append(data.tobytes())
+        if valid is not None:
+            buffers.append(valid.tobytes())
+    header = json.dumps(
+        {"count": n, "columns": cols, "dictionaries": dict_payloads}
+    ).encode()
+    body = io.BytesIO()
+    body.write(len(header).to_bytes(4, "little"))
+    body.write(header)
+    for buf in buffers:
+        body.write(len(buf).to_bytes(8, "little"))
+        body.write(buf)
+    raw = body.getvalue()
+    flag = b"\x01" if compress else b"\x00"
+    payload = zlib.compress(raw, 1) if compress else raw
+    return _MAGIC + flag + payload
+
+
+def deserialize_page(
+    data: bytes, cache: Optional[DictionaryCache] = None
+) -> Page:
+    assert data[:4] == _MAGIC, "bad page magic"
+    compressed = data[4:5] == b"\x01"
+    raw = zlib.decompress(data[5:]) if compressed else data[5:]
+    view = memoryview(raw)
+    hlen = int.from_bytes(view[:4], "little")
+    header = json.loads(bytes(view[4 : 4 + hlen]))
+    off = 4 + hlen
+
+    def read_buf():
+        nonlocal off
+        blen = int.from_bytes(view[off : off + 8], "little")
+        off += 8
+        buf = view[off : off + blen]
+        off += blen
+        return buf
+
+    n = header["count"]
+    blocks = []
+    names = []
+    for col in header["columns"]:
+        typ = _type_from_wire(col["type"])
+        arr = np.frombuffer(read_buf(), dtype=np.dtype(col["dtype"]))
+        arr = arr.reshape(col["shape"])
+        valid = None
+        if col["valid"]:
+            valid = np.frombuffer(read_buf(), dtype=np.bool_)
+        dict_id = col["dict_id"]
+        local_dict = None
+        if dict_id is not None:
+            payload = header["dictionaries"].get(str(dict_id))
+            if payload is not None:
+                local = intern_dictionary(tuple(payload))
+                if cache is not None:
+                    cache.remote_to_local[dict_id] = local
+                local_dict = local
+            elif cache is not None:
+                local_dict = cache.remote_to_local[dict_id]
+            else:
+                raise KeyError(
+                    f"dictionary {dict_id} not in payload and no cache"
+                )
+        import jax.numpy as jnp
+
+        blocks.append(
+            Block(
+                jnp.asarray(arr),
+                typ,
+                None if valid is None else jnp.asarray(valid),
+                local_dict,
+            )
+        )
+        names.append(col["name"])
+    return Page.from_blocks(blocks, names, count=n)

@@ -1,0 +1,126 @@
+"""Query manager + state machine.
+
+Re-designed equivalent of the reference's coordinator query tracking:
+SqlQueryManager (execution/SqlQueryManager.java:88), QueryStateMachine and
+the generic listener-based StateMachine (execution/StateMachine.java:44),
+and the /v1/statement paging buffer (server/protocol/Query.java:90,357).
+
+One background executor thread per coordinator drains a submission queue
+(admission control hook — the minimal resource-group analog: a bounded
+number of concurrently RUNNING queries)."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+_TERMINAL = (FINISHED, FAILED, CANCELED)
+
+
+@dataclasses.dataclass
+class QueryInfo:
+    query_id: str
+    sql: str
+    state: str = QUEUED
+    error: Optional[str] = None
+    created_at: float = dataclasses.field(default_factory=time.time)
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    columns: Optional[List[dict]] = None
+    rows: Optional[List[tuple]] = None  # materialized result (root buffer)
+    plan: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in _TERMINAL
+
+
+class QueryManager:
+    """Tracks every query's lifecycle; executes via the supplied session
+    factory on worker threads (max_concurrent = admission control)."""
+
+    def __init__(self, session, max_concurrent: int = 1):
+        self.session = session
+        self.queries: Dict[str, QueryInfo] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[str]" = queue.Queue()
+        self._events: Dict[str, threading.Event] = {}
+        self._workers = [
+            threading.Thread(target=self._run_loop, daemon=True)
+            for _ in range(max_concurrent)
+        ]
+        for w in self._workers:
+            w.start()
+
+    # -- submission / lifecycle --
+
+    def submit(self, sql: str) -> QueryInfo:
+        with self._lock:
+            qid = f"q_{next(self._ids)}"
+            info = QueryInfo(qid, sql)
+            self.queries[qid] = info
+            self._events[qid] = threading.Event()
+        self._queue.put(qid)
+        return info
+
+    def get(self, query_id: str) -> Optional[QueryInfo]:
+        return self.queries.get(query_id)
+
+    def cancel(self, query_id: str) -> bool:
+        info = self.queries.get(query_id)
+        if info is None or info.done:
+            return False
+        # cooperative: QUEUED queries are dropped; RUNNING queries finish
+        # their current kernel then observe the canceled state
+        info.state = CANCELED
+        info.finished_at = time.time()
+        self._events[query_id].set()
+        return True
+
+    def wait(self, query_id: str, timeout: float) -> QueryInfo:
+        """Long-poll support (reference max-wait on statement GETs)."""
+        ev = self._events.get(query_id)
+        if ev is not None:
+            ev.wait(timeout)
+        return self.queries[query_id]
+
+    def list_queries(self) -> List[QueryInfo]:
+        return list(self.queries.values())
+
+    # -- execution --
+
+    def _run_loop(self):
+        while True:
+            qid = self._queue.get()
+            info = self.queries[qid]
+            if info.state != QUEUED:
+                continue  # canceled while queued
+            info.state = RUNNING
+            info.started_at = time.time()
+            try:
+                result = self.session.query(info.sql)
+                info.columns = [
+                    {"name": t, "type": str(b.type)}
+                    for t, b in zip(result.titles, result.page.blocks)
+                ]
+                info.rows = result.rows()
+                if info.state != CANCELED:
+                    info.state = FINISHED
+            except Exception:  # noqa: BLE001 - query failure is data
+                info.error = traceback.format_exc(limit=20)
+                if info.state != CANCELED:
+                    info.state = FAILED
+            info.finished_at = time.time()
+            self._events[qid].set()

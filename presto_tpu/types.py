@@ -334,6 +334,8 @@ def parse_type(text: str) -> Type:
         "timestamp": TIMESTAMP,
         "varchar": VARCHAR,
         "unknown": UNKNOWN,
+        "interval day to second": INTERVAL_DAY,
+        "interval year to month": INTERVAL_YEAR_MONTH,
     }
     if s in simple:
         return simple[s]
