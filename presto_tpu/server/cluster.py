@@ -94,16 +94,32 @@ class HttpScheduler:
     # -- public --
 
     def run(self, root: N.PlanNode):
-        # snapshot membership for the whole query: producer partition
+        # snapshot membership for the whole query (threaded explicitly so
+        # concurrent queries can't clobber each other): producer partition
         # counts must match consumer task counts even if a node fails
         # mid-query (the query then fails on the task, not on skew)
-        self._query_workers = self.nodes.active_workers()
-        if not self._query_workers:
+        workers = self.nodes.active_workers()
+        if not workers:
             raise TaskFailure("no active workers")
-        fragment, specs = self._cut(root)
-        sources = self._resolve_sources(specs, sharded_consumer=False)
-        ex = FragmentExecutor(self.catalog, {}, sources)
-        return ex.run(fragment)
+        all_tasks: List[Tuple[str, str]] = []
+        try:
+            fragment, specs = self._cut(root)
+            sources = self._resolve_sources(
+                specs, False, workers, all_tasks
+            )
+            ex = FragmentExecutor(self.catalog, {}, sources)
+            return ex.run(fragment)
+        finally:
+            # free worker-side output buffers (reference: task results are
+            # acknowledged and deleted after consumption)
+            for uri, task_id in all_tasks:
+                try:
+                    req = urllib.request.Request(
+                        f"{uri}/v1/task/{task_id}", method="DELETE"
+                    )
+                    urllib.request.urlopen(req, timeout=5).read()
+                except Exception:  # noqa: BLE001 - cleanup is best-effort
+                    pass
 
     # -- plan cutting --
 
@@ -143,22 +159,24 @@ class HttpScheduler:
     # -- stage execution --
 
     def _resolve_sources(self, specs, sharded_consumer: bool,
-                         worker_count: int = 0):
+                         workers: List[str], all_tasks):
         """Run producer stages for each exchange; returns either
-        {sid: [pages]} (single consumer) or {sid: fn(worker_idx) -> locations}
-        shaped dicts used when building worker task specs."""
+        {sid: (kind, handles)} (sharded consumer) or {sid: [pages]}
+        (coordinator consumer)."""
         resolved = {}
         for sid, ex in specs.items():
             if ex.kind == "repartition" and sharded_consumer:
                 handles = self._run_sharded_stage(
-                    ex.child, ("hash", ex.keys)
+                    ex.child, ("hash", ex.keys), workers, all_tasks
                 )
                 resolved[sid] = ("repartition", handles)
             else:
                 # gather / replicate — and repartition consumed by the
                 # coordinator itself, which reads everything anyway (hash
                 # partitioning there would just drop partitions != 0)
-                handles = self._run_sharded_stage(ex.child, ("single",))
+                handles = self._run_sharded_stage(
+                    ex.child, ("single",), workers, all_tasks
+                )
                 resolved[sid] = ("gather", handles)
         if sharded_consumer:
             return resolved
@@ -172,11 +190,11 @@ class HttpScheduler:
             out[sid] = pages
         return out
 
-    def _run_sharded_stage(self, node: N.PlanNode, output) -> List[Tuple[str, str]]:
+    def _run_sharded_stage(self, node: N.PlanNode, output,
+                           all_workers: List[str], all_tasks) -> List[Tuple[str, str]]:
         """One task per worker for sharded stages (splits/repartition
         inputs); scan-less single-distribution stages run as ONE task so
         rows are never duplicated. Returns [(worker_uri, task_id)]."""
-        all_workers = self._query_workers
         nw = len(all_workers)
         fragment, specs = self._cut(node)
         sharded = self._has_scan(fragment) or any(
@@ -184,7 +202,7 @@ class HttpScheduler:
         )
         workers = all_workers if sharded else all_workers[:1]
         child_resolved = self._resolve_sources(
-            specs, sharded_consumer=True, worker_count=nw
+            specs, True, all_workers, all_tasks
         )
 
         # row-range splits per scanned table
@@ -226,6 +244,7 @@ class HttpScheduler:
             task_id = f"t_{next(self._task_ids)}"
             self._post_task(uri, task_id, spec)
             handles.append((uri, task_id))
+            all_tasks.append((uri, task_id))
         # surface task failures eagerly (fail the query like the reference)
         for uri, task_id in handles:
             status = self._task_status(uri, task_id)

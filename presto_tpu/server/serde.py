@@ -8,10 +8,12 @@ types, dictionary payloads) + raw little-endian column buffers,
 compressed with zlib (the stdlib stand-in for airlift's LZ4 — same
 role, zero new dependencies).
 
-Dictionaries ship WITH the page the first time a (connection, dict_id)
-pair is seen and are referenced by id afterwards — the cross-process
-answer to VERDICT's "dict_ids are process-local" gap. A DictionaryCache
-per connection tracks what the peer already has.
+Pages on the pull-based exchange path are SELF-CONTAINED: dictionaries
+ship with every page (buffers are produced before their consumers are
+known, so sender-side per-receiver dedup cannot apply there). For
+long-lived point-to-point connections, pass a DictionaryCache on both
+ends: the sender then ships each dictionary once and references it by id
+afterwards — the cross-process answer to dict_ids being process-local.
 """
 
 from __future__ import annotations

@@ -50,9 +50,11 @@ class QueryManager:
     """Tracks every query's lifecycle; executes via the supplied session
     factory on worker threads (max_concurrent = admission control)."""
 
-    def __init__(self, session, max_concurrent: int = 1):
+    def __init__(self, session, max_concurrent: int = 1,
+                 max_history: int = 100):
         self.session = session
         self.queries: Dict[str, QueryInfo] = {}
+        self.max_history = max_history
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._queue: "queue.Queue[str]" = queue.Queue()
@@ -72,16 +74,35 @@ class QueryManager:
             info = QueryInfo(qid, sql)
             self.queries[qid] = info
             self._events[qid] = threading.Event()
+            self._expire_locked()
         self._queue.put(qid)
         return info
+
+    def _expire_locked(self):
+        """Bound coordinator memory: drop the oldest completed queries
+        beyond max_history (reference PurgeQueriesRunnable +
+        query expiration in SqlQueryManager)."""
+        done = [q for q in self.queries.values() if q.done]
+        excess = len(done) - self.max_history
+        if excess > 0:
+            done.sort(key=lambda q: q.finished_at or 0)
+            for q in done[:excess]:
+                self.queries.pop(q.query_id, None)
+                self._events.pop(q.query_id, None)
 
     def get(self, query_id: str) -> Optional[QueryInfo]:
         return self.queries.get(query_id)
 
     def cancel(self, query_id: str) -> bool:
         info = self.queries.get(query_id)
-        if info is None or info.done:
+        if info is None:
             return False
+        if info.done:
+            # DELETE on a finished query purges it (result acknowledged)
+            with self._lock:
+                self.queries.pop(query_id, None)
+                self._events.pop(query_id, None)
+            return True
         # cooperative: QUEUED queries are dropped; RUNNING queries finish
         # their current kernel then observe the canceled state
         info.state = CANCELED

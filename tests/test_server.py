@@ -202,3 +202,31 @@ def test_failure_detection_excludes_dead_worker():
         assert got == want
     finally:
         workers[0].stop()
+
+
+def test_serde_dictionary_cache_ships_once():
+    from presto_tpu.server import DictionaryCache
+
+    page = Page.from_dict({"s": ["x", "y", "x"]})
+    tx, rx = DictionaryCache(), DictionaryCache()
+    first = serialize_page(page, cache=tx)
+    second = serialize_page(page, cache=tx)
+    assert len(second) < len(first) or b"x" not in second
+    a = deserialize_page(first, cache=rx).to_pylist()
+    b = deserialize_page(second, cache=rx).to_pylist()
+    assert a == b == [("x",), ("y",), ("x",)]
+
+
+def test_query_history_bounded_and_delete_purges():
+    from presto_tpu.server.state import QueryManager
+
+    mgr = QueryManager(Session(TpchCatalog(sf=0.002)), max_history=3)
+    ids = []
+    for _ in range(6):
+        info = mgr.submit("select count(*) from region")
+        mgr.wait(info.query_id, 30)
+        ids.append(info.query_id)
+    assert len([q for q in mgr.list_queries() if q.done]) <= 4
+    last = ids[-1]
+    assert mgr.cancel(last) is True  # purge of a finished query
+    assert mgr.get(last) is None
