@@ -57,6 +57,15 @@ def main(argv=None):
     if args.catalog != "tpch":
         ap.error(f"unknown catalog {args.catalog}")
 
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") and not args.server:
+        # the axon sitecustomize overrides the env var before we run;
+        # restore its meaning for every mode that builds a local Session
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     if args.serve:
         from .server import CoordinatorServer
 

@@ -129,15 +129,7 @@ class ParquetCatalog(Connector):
         d = self._dicts.get(key)
         if d is None:
             col = self._file(table).read(columns=[column]).column(0)
-            import pyarrow.compute as pc
-
-            uniq = pc.unique(
-                col.cast(col.type.value_type)
-                if hasattr(col.type, "value_type")
-                else col
-            )
-            entries = tuple(sorted(s for s in uniq.to_pylist() if s is not None))
-            d = (entries, np.array(entries, dtype=object))
+            d = build_sorted_dictionary(col)
             self._dicts[key] = d
         return d
 
@@ -227,63 +219,91 @@ class ParquetCatalog(Connector):
         return False
 
     def _to_page(self, table, tb, names, count, pad_to) -> Page:
-        import pyarrow as pa
+        return arrow_table_to_page(
+            tb, names, count, pad_to,
+            lambda name: self._dictionary(table, name),
+        )
 
-        blocks = []
-        for name in names:
-            col = tb.column(name)
-            typ = _arrow_to_type(col.type)
-            valid = None
-            if col.null_count:
-                valid = ~np.asarray(col.is_null().combine_chunks())
-            dict_id = None
-            if isinstance(typ, T.VarcharType):
-                d, d_arr = self._dictionary(table, name)
-                arr = col.combine_chunks()
-                if pa.types.is_dictionary(arr.type):
-                    arr = arr.cast(arr.type.value_type)
-                vals = np.asarray(arr.to_pylist(), dtype=object)
-                if valid is not None and len(d):
-                    vals = np.where(valid, vals, d[0])
-                # dictionary is sorted: one vectorized binary search encodes
-                data = np.searchsorted(d_arr, vals).astype(np.int32)
-                blk = Block.from_numpy(data, typ, valid, dictionary=d)
-            elif isinstance(typ, T.DecimalType):
-                hi64, lo64 = _decimal_ints(col)
-                if typ.is_long:
-                    # 2^64-radix -> engine 2^32-radix lanes
-                    our_hi = (hi64 << 32) | (lo64 >> 32).astype(np.int64)
-                    our_lo = (lo64 & np.uint64(0xFFFFFFFF)).astype(np.int64)
-                    data = np.stack([our_hi, our_lo], axis=-1)
-                else:
-                    data = lo64.view(np.int64)
-                blk = Block.from_numpy(data, typ, valid)
-            elif isinstance(typ, T.TimestampType):
-                us = col.cast(pa.timestamp("us")).combine_chunks()
-                data = np.asarray(us.cast(pa.int64()))
-                blk = Block.from_numpy(data, typ, valid)
+
+def build_sorted_dictionary(col):
+    """Distinct non-null strings of an arrow column, sorted:
+    (tuple, numpy object array) — shared by the parquet and ORC readers."""
+    import pyarrow.compute as pc
+
+    uniq = pc.unique(
+        col.cast(col.type.value_type)
+        if hasattr(col.type, "value_type")
+        else col
+    )
+    entries = tuple(sorted(s for s in uniq.to_pylist() if s is not None))
+    return entries, np.array(entries, dtype=object)
+
+
+def arrow_table_to_page(tb, names, count, pad_to, dictionary_provider) -> Page:
+    """Arrow table -> engine Page (shared by the parquet and ORC readers).
+    dictionary_provider(column) -> (sorted tuple, numpy object array)."""
+    import pyarrow as pa
+
+    blocks = []
+    for name in names:
+        col = tb.column(name)
+        typ = _arrow_to_type(col.type)
+        valid = None
+        if col.null_count:
+            valid = ~np.asarray(col.is_null().combine_chunks())
+        if isinstance(typ, T.VarcharType):
+            d, d_arr = dictionary_provider(name)
+            arr = col.combine_chunks()
+            if pa.types.is_dictionary(arr.type):
+                arr = arr.cast(arr.type.value_type)
+            vals = np.asarray(arr.to_pylist(), dtype=object)
+            if valid is not None and len(d):
+                vals = np.where(valid, vals, d[0])
+            # dictionary is sorted: one vectorized binary search encodes
+            data = np.searchsorted(d_arr, vals).astype(np.int32)
+            blk = Block.from_numpy(data, typ, valid, dictionary=d)
+        elif isinstance(typ, T.DecimalType):
+            hi64, lo64 = _decimal_ints(col)
+            if typ.is_long:
+                # 2^64-radix -> engine 2^32-radix lanes
+                our_hi = (hi64 << 32) | (lo64 >> 32).astype(np.int64)
+                our_lo = (lo64 & np.uint64(0xFFFFFFFF)).astype(np.int64)
+                data = np.stack([our_hi, our_lo], axis=-1)
             else:
-                arr = col.combine_chunks()
-                if pa.types.is_dictionary(arr.type):
-                    arr = arr.cast(arr.type.value_type)
-                if isinstance(typ, T.DateType):
-                    data = np.asarray(arr.cast(pa.int32()))
-                else:
-                    data = np.asarray(arr, dtype=typ.storage_dtype)
-                blk = Block.from_numpy(data, typ, valid)
-            if pad_to is not None and pad_to > count:
-                blk = _pad_block(blk, pad_to)
-            blocks.append(blk)
-        return Page.from_blocks(blocks, names, count=count)
+                data = lo64.view(np.int64)
+            blk = Block.from_numpy(data, typ, valid)
+        elif isinstance(typ, T.TimestampType):
+            us = col.cast(pa.timestamp("us")).combine_chunks()
+            data = np.asarray(us.cast(pa.int64()))
+            blk = Block.from_numpy(data, typ, valid)
+        else:
+            arr = col.combine_chunks()
+            if pa.types.is_dictionary(arr.type):
+                arr = arr.cast(arr.type.value_type)
+            if isinstance(typ, T.DateType):
+                data = np.asarray(arr.cast(pa.int32()))
+            else:
+                data = np.asarray(arr, dtype=typ.storage_dtype)
+            blk = Block.from_numpy(data, typ, valid)
+        if pad_to is not None and pad_to > count:
+            blk = _pad_block(blk, pad_to)
+        blocks.append(blk)
+    return Page.from_blocks(blocks, names, count=count)
 
 
 def write_table_parquet(page_or_table, path: str, row_group_size: int = 1 << 17):
     """Write engine data back to parquet (test fixture + the seed of a
     writer path; reference presto-hive ParquetPageSink analog)."""
-    import pyarrow as pa
     import pyarrow.parquet as pq
 
-    page = page_or_table
+    pq.write_table(page_to_arrow(page_or_table), path,
+                   row_group_size=row_group_size)
+
+
+def page_to_arrow(page):
+    """Engine Page -> in-memory pyarrow Table (shared by file writers)."""
+    import pyarrow as pa
+
     n = int(page.count)
     cols = {}
     for name, b in zip(page.names, page.blocks):
@@ -321,4 +341,4 @@ def write_table_parquet(page_or_table, path: str, row_group_size: int = 1 << 17)
             arr = np.asarray(b.data[:n])
             mask = None if valid is None else ~valid
             cols[name] = pa.array(arr, mask=mask)
-    pq.write_table(pa.table(cols), path, row_group_size=row_group_size)
+    return pa.table(cols)

@@ -109,7 +109,10 @@ class CoordinatorServer:
                         return
                     max_wait = float(qs.get("maxWait", 1.0))
                     if not info.done:
-                        outer.manager.wait(qid, max_wait)
+                        info = outer.manager.wait(qid, max_wait)
+                        if info is None:  # purged while waiting
+                            self._send(404, {"error": f"query {qid} expired"})
+                            return
                     self._send(200, outer._query_results(info, token))
                     return
                 if parts[:2] == ["v1", "query"] and len(parts) == 2:

@@ -107,15 +107,18 @@ class QueryManager:
         # their current kernel then observe the canceled state
         info.state = CANCELED
         info.finished_at = time.time()
-        self._events[query_id].set()
+        ev = self._events.get(query_id)
+        if ev is not None:
+            ev.set()
         return True
 
-    def wait(self, query_id: str, timeout: float) -> QueryInfo:
-        """Long-poll support (reference max-wait on statement GETs)."""
+    def wait(self, query_id: str, timeout: float) -> Optional[QueryInfo]:
+        """Long-poll support (reference max-wait on statement GETs).
+        None when the query was purged while waiting."""
         ev = self._events.get(query_id)
         if ev is not None:
             ev.wait(timeout)
-        return self.queries[query_id]
+        return self.queries.get(query_id)
 
     def list_queries(self) -> List[QueryInfo]:
         return list(self.queries.values())
@@ -125,9 +128,9 @@ class QueryManager:
     def _run_loop(self):
         while True:
             qid = self._queue.get()
-            info = self.queries[qid]
-            if info.state != QUEUED:
-                continue  # canceled while queued
+            info = self.queries.get(qid)
+            if info is None or info.state != QUEUED:
+                continue  # canceled/purged while queued
             info.state = RUNNING
             info.started_at = time.time()
             try:
@@ -144,4 +147,6 @@ class QueryManager:
                 if info.state != CANCELED:
                     info.state = FAILED
             info.finished_at = time.time()
-            self._events[qid].set()
+            ev = self._events.get(qid)
+            if ev is not None:
+                ev.set()

@@ -138,6 +138,9 @@ class Catalog:
 class RelationPlan:
     node: N.PlanNode
     scope: Scope
+    # pre-projection scope (source columns), when ORDER BY may legally
+    # reference columns that are not in the select list
+    pre_scope: Optional[Scope] = None
 
 
 class Planner:
@@ -171,13 +174,44 @@ class Planner:
         node, scope = rp.node, rp.scope
         if q.order_by:
             keys = []
+            hidden: List[Tuple[ir.RowExpression, str]] = []
             for si in q.order_by:
-                e = self._order_expr(si.expr, scope, outer, ctes, node)
+                try:
+                    e = self._order_expr(si.expr, scope, outer, ctes, node)
+                except PlanningError:
+                    # ORDER BY on a column NOT in the select list: extend
+                    # the projection with a hidden sort channel, drop it
+                    # after sorting (reference: LogicalPlanner orders on
+                    # pre-projection symbols). Ordinals stay strict.
+                    if (
+                        rp.pre_scope is None
+                        or not isinstance(node, N.Project)
+                        or isinstance(si.expr, t.NumberLiteral)
+                    ):
+                        raise
+                    pctx = SelectContext(self, [rp.pre_scope], outer, ctes, None)
+                    e_src = pctx.translate(si.expr)
+                    ch = self.channel("osort")
+                    hidden.append((e_src, ch))
+                    e = ir.ColumnRef(ch, e_src.type)
                 keys.append(SortKey(e, si.ascending, si.nulls_first))
+            if hidden:
+                proj: N.Project = node
+                node = N.Project(
+                    proj.child,
+                    proj.exprs + tuple(e for e, _ in hidden),
+                    proj.names + tuple(ch for _, ch in hidden),
+                )
             if q.limit is not None:
                 node = N.TopN(node, tuple(keys), q.limit)
             else:
                 node = N.Sort(node, tuple(keys))
+            if hidden:  # re-project to the visible columns only
+                node = N.Project(
+                    node,
+                    tuple(ir.ColumnRef(f.channel, f.type) for f in scope.fields),
+                    tuple(f.channel for f in scope.fields),
+                )
         elif q.limit is not None:
             node = N.Limit(node, q.limit)
         return RelationPlan(node, scope)
@@ -398,8 +432,9 @@ class Planner:
             out_fields.append(FieldRef(None, name, ch, e.type))
         node = N.Project(holder.plan, tuple(out_exprs), tuple(out_names))
         if sel.distinct:
-            node = N.Distinct(node)
-        return RelationPlan(node, Scope(out_fields))
+            # SQL: ORDER BY under DISTINCT must use select-list columns
+            return RelationPlan(N.Distinct(node), Scope(out_fields))
+        return RelationPlan(node, Scope(out_fields), pre_scope=sctx.scopes[0])
 
     def _expand_stars(self, items, scope: Scope) -> List[t.SelectItem]:
         out = []

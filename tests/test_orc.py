@@ -1,0 +1,59 @@
+"""ORC connector (reference presto-orc OrcRecordReader; pyarrow decode)."""
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.orc import OrcCatalog, write_table_orc
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+
+SF = 0.002
+TABLES = ["nation", "region", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def catalogs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("orc")
+    tpch = TpchCatalog(sf=SF)
+    paths = {}
+    for t in TABLES:
+        p = str(tmp / f"{t}.orc")
+        write_table_orc(tpch.page(t), p, stripe_size=1 << 14)
+        paths[t] = p
+    unique = {t: tpch.unique_columns(t) for t in TABLES}
+    return tpch, OrcCatalog(paths, unique=unique)
+
+
+def test_schema_and_counts(catalogs):
+    tpch, oc = catalogs
+    for t in TABLES:
+        assert set(oc.schema(t)) == set(tpch.schema(t))
+        assert oc.exact_row_count(t) == int(tpch.page(t).count)
+
+
+QUERIES = [
+    "select n_name, r_name from nation, region where n_regionkey = r_regionkey "
+    "order by n_name",
+    "select l_returnflag, l_linestatus, sum(l_quantity) q, count(*) n "
+    "from lineitem where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus",
+    "select o_orderpriority, sum(o_totalprice) s from orders "
+    "group by o_orderpriority order by o_orderpriority",
+]
+
+
+@pytest.mark.parametrize("i", range(len(QUERIES)))
+def test_queries_match_tpch_connector(catalogs, i):
+    tpch, oc = catalogs
+    sql = QUERIES[i]
+    got = Session(oc).query(sql).rows()
+    want = Session(tpch).query(sql).rows()
+    assert got == want
+
+
+def test_streaming_from_orc(catalogs):
+    tpch, oc = catalogs
+    sql = QUERIES[1]
+    got = Session(oc, streaming=True, batch_rows=512).query(sql).rows()
+    want = Session(tpch).query(sql).rows()
+    assert got == want

@@ -202,3 +202,21 @@ def test_exists_inside_case_rejected(session):
             "(select 1 from lineitem where l_orderkey = o_orderkey) "
             "then true else false end"
         )
+
+
+def test_order_by_non_projected_column(session):
+    rows = session.query(
+        "select n_name from nation where n_regionkey = 1 order by n_nationkey"
+    ).rows()
+    want = session.query(
+        "select n_name, n_nationkey from nation where n_regionkey = 1 "
+        "order by n_nationkey"
+    ).rows()
+    assert rows == [(n,) for n, _ in want]
+    # with LIMIT (TopN path) and an expression over a hidden column
+    rows = session.query(
+        "select n_name from nation order by n_nationkey * -1 limit 3"
+    ).rows()
+    assert [r[0] for r in rows] == [w[0] for w in session.query(
+        "select n_name, n_nationkey from nation order by n_nationkey desc limit 3"
+    ).rows()]
