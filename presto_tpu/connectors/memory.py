@@ -13,10 +13,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import types as T
 from ..page import Page
-from .spi import Connector
+from .spi import WritableConnector
 
 
-class MemoryCatalog(Connector):
+class MemoryCatalog(WritableConnector):
     """tables: {name: Page}; unique: {table: [key column sets]} lets the
     planner use n:1 joins (the analog of declared primary keys)."""
 
@@ -50,3 +50,29 @@ class MemoryCatalog(Connector):
         # scan() and exact_row_count() come from the Connector base: the
         # default device-side slicing IS this connector's batched read path
         return self.tables[table]
+
+    # -- writes (reference MemoryPagesStore.add / MemoryMetadata DDL) --
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        from ..ops.union import empty_page
+
+        self.tables[table] = empty_page(schema)
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        self.tables[table] = page
+
+    def drop_table(self, table: str) -> None:
+        del self.tables[table]
+        self.unique.pop(table, None)
+
+    def append(self, table: str, page: Page) -> None:
+        from ..ops.union import concat_pages
+
+        base = self.tables[table]
+        if int(base.count) == 0:
+            self.tables[table] = page
+        elif int(page.count) > 0:
+            self.tables[table] = concat_pages([base, page])
+
+    def replace(self, table: str, page: Page) -> None:
+        self.tables[table] = page

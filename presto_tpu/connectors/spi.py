@@ -84,3 +84,29 @@ class Connector(Catalog):
                 blk = _pad_block(blk, pad_to)
             blocks.append(blk)
         return Page.from_blocks(blocks, names, count=count)
+
+
+class WriteError(RuntimeError):
+    pass
+
+
+class WritableConnector(Connector):
+    """Write protocol (reference ConnectorPageSink / ConnectorMetadata
+    beginCreateTable/beginInsert, presto-spi/.../spi/ConnectorPageSink.java).
+    The engine's DDL/DML tasks (session.py) call these; read-only
+    connectors simply don't subclass this and get a clean error."""
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        raise NotImplementedError
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        raise NotImplementedError
+
+    def drop_table(self, table: str) -> None:
+        raise NotImplementedError
+
+    def append(self, table: str, page: Page) -> None:
+        raise NotImplementedError
+
+    def replace(self, table: str, page: Page) -> None:
+        raise NotImplementedError

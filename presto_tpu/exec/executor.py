@@ -122,6 +122,12 @@ class Executor:
         return apply_avg_post(page, node.aggs, node.post)
 
     # -- leaf --
+    def _exec_singlerow(self, node: N.SingleRow) -> Page:
+        import numpy as np
+
+        blk = Block.from_numpy(np.zeros(1, dtype=np.int64), T.BIGINT)
+        return Page((blk,), (node.channel,), 1)
+
     def _exec_tablescan(self, node: N.TableScan) -> Page:
         src = self.catalog.page(node.table)
         blocks = []

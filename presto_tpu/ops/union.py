@@ -100,3 +100,17 @@ def extend_with_nulls(page: Page, names, types, dict_ids, prepend: bool = False)
         blocks = tuple(page.blocks) + extra
         all_names = page.names + tuple(names)
     return Page(blocks, all_names, page.count)
+
+
+def empty_page(schema) -> Page:
+    """A zero-row page for `{name: Type}` with 1-slot capacity per column
+    (kernels need >= 1); varchar columns get an empty interned dictionary."""
+    from ..page import intern_dictionary
+
+    blocks = []
+    for _name, typ in schema.items():
+        did = (
+            intern_dictionary(()) if isinstance(typ, T.VarcharType) else None
+        )
+        blocks.append(null_block(typ, 1, did))
+    return Page.from_blocks(blocks, list(schema), count=0)

@@ -153,6 +153,9 @@ class Fragmenter:
     def _v_tablescan(self, node):
         return node, Partitioning(SOURCE)
 
+    def _v_singlerow(self, node):
+        return node, Partitioning(SINGLE)
+
     def _v_filter(self, node):
         child, dist = self._visit(node.child)
         return N.Filter(child, node.predicate), dist

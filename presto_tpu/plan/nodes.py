@@ -57,6 +57,20 @@ class TableScan(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class SingleRow(PlanNode):
+    """Leaf producing exactly one row with a single dummy column. VALUES
+    rows are planned as Project(SingleRow) per row, unioned (reference
+    ValuesNode, sql/planner/plan/ValuesNode.java — re-designed so literal
+    rows flow through the same expression compiler as every projection)."""
+
+    channel: str
+
+    @property
+    def fields(self):
+        return ((self.channel, T.BIGINT),)
+
+
+@dataclasses.dataclass(frozen=True)
 class Filter(PlanNode):
     child: PlanNode
     predicate: RowExpression

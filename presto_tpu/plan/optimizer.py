@@ -36,6 +36,9 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
             cols = node.columns[:1]
         return dataclasses.replace(node, columns=cols)
 
+    if isinstance(node, N.SingleRow):
+        return node
+
     if isinstance(node, N.Filter):
         child_needed = set(needed)
         _expr_channels(node.predicate, child_needed)

@@ -90,6 +90,12 @@ class Session:
 
     def query(self, sql: str) -> QueryResult:
         ast = parse(sql)
+        if isinstance(
+            ast,
+            (t.CreateTable, t.DropTable, t.Insert, t.Delete, t.ShowTables,
+             t.ShowColumns),
+        ):
+            return self._execute_statement(ast)
         node = self.plan(sql)
         if isinstance(ast, t.Explain):
             from .page import Page
@@ -102,6 +108,200 @@ class Session:
             return QueryResult(pg, ("Query Plan",))
         page = self.executor.run(node)
         return QueryResult(page, node.titles)
+
+    # -- DDL / DML tasks (reference execution/CreateTableTask.java,
+    # CreateTableAsSelect via TableWriter/TableFinish operators,
+    # operator/TableWriterOperator.java, operator/DeleteOperator.java;
+    # re-designed: the coordinator task runs the source plan through the
+    # session's executor and hands final pages to the writable connector) --
+
+    def _writable(self):
+        from .connectors.spi import WritableConnector, WriteError
+
+        if not isinstance(self.catalog, WritableConnector):
+            raise WriteError(
+                f"catalog {getattr(self.catalog, 'name', '?')!r} is read-only"
+            )
+        return self.catalog
+
+    def _run_query_ast(self, ast: t.Query):
+        """Plan + execute a Query AST; returns (page, titles, scope)."""
+        planner = Planner(self.catalog)
+        rp = planner.plan_query(ast, outer=None, ctes={})
+        channels = tuple(f.channel for f in rp.scope.fields)
+        titles = tuple(f.name for f in rp.scope.fields)
+        from .plan.optimizer import optimize
+
+        node = optimize(N.Output(rp.node, channels, titles))
+        if self.mesh is not None:
+            from .plan.fragment import fragment_plan
+
+            node = fragment_plan(node, self.catalog, self.broadcast_threshold)
+        return self.executor.run(node), titles, rp.scope
+
+    def _table_schema(self, cat, name: str):
+        if name not in cat.table_names():
+            raise ValueError(f"table {name!r} does not exist")
+        return cat.schema(name)
+
+    @staticmethod
+    def _row_count_result(n: int) -> QueryResult:
+        import numpy as np
+
+        from .page import Page
+
+        pg = Page.from_dict({"rows": np.array([n], dtype=np.int64)})
+        return QueryResult(pg, ("rows",))
+
+    def _execute_statement(self, ast) -> QueryResult:
+        from .page import Page
+
+        if isinstance(ast, t.ShowTables):
+            names = sorted(self.catalog.table_names())
+            pg = Page.from_dict({"Table": list(names) or [None]})
+            if not names:
+                pg = Page(pg.blocks, pg.names, 0)
+            return QueryResult(pg, ("Table",))
+        if isinstance(ast, t.ShowColumns):
+            schema = self._table_schema(self.catalog, ast.table.lower())
+            pg = Page.from_dict(
+                {
+                    "Column": list(schema),
+                    "Type": [str(ty) for ty in schema.values()],
+                }
+            )
+            return QueryResult(pg, ("Column", "Type"))
+        if isinstance(ast, t.CreateTable):
+            return self._create_table(ast)
+        if isinstance(ast, t.DropTable):
+            cat = self._writable()
+            if ast.name.lower() not in cat.table_names():
+                if ast.if_exists:
+                    return self._row_count_result(0)
+                raise ValueError(f"table {ast.name!r} does not exist")
+            cat.drop_table(ast.name.lower())
+            return self._row_count_result(0)
+        if isinstance(ast, t.Insert):
+            return self._insert(ast)
+        if isinstance(ast, t.Delete):
+            return self._delete(ast)
+        raise ValueError(f"unsupported statement {type(ast).__name__}")
+
+    def _create_table(self, ast: t.CreateTable) -> QueryResult:
+        from . import types as T
+        from .page import Page
+
+        cat = self._writable()
+        name = ast.name.lower()
+        if name in cat.table_names():
+            if ast.if_not_exists:
+                return self._row_count_result(0)
+            raise ValueError(f"table {name!r} already exists")
+        if ast.query is None:
+            schema = {}
+            for col in ast.columns:
+                cname = col.name.lower()
+                if cname in schema:
+                    raise ValueError(f"duplicate column {cname!r}")
+                schema[cname] = T.parse_type(col.type_name)
+            cat.create_table(name, schema)
+            return self._row_count_result(0)
+        page, titles, _scope = self._run_query_ast(ast.query)
+        lowered = tuple(tl.lower() for tl in titles)
+        if len(set(lowered)) != len(lowered):
+            raise ValueError("CREATE TABLE AS requires unique column names")
+        for tl, blk in zip(lowered, page.blocks):
+            if isinstance(blk.type, T.UnknownType):
+                raise ValueError(
+                    f"CREATE TABLE AS column {tl!r} has unknown type "
+                    "(all-NULL); cast it to a concrete type"
+                )
+        cat.create_table_from_page(name, Page(page.blocks, lowered, page.count))
+        return self._row_count_result(int(page.count))
+
+    def _insert(self, ast: t.Insert) -> QueryResult:
+        from . import types as T
+        from .expr import ir
+        from .expr.compiler import project_page
+        from .ops.union import null_block
+        from .page import Page
+
+        cat = self._writable()
+        name = ast.table.lower()
+        schema = self._table_schema(cat, name)
+        targets = (
+            tuple(c.lower() for c in ast.columns)
+            if ast.columns
+            else tuple(schema)
+        )
+        if len(set(targets)) != len(targets):
+            raise ValueError("duplicate column in INSERT target list")
+        for c in targets:
+            if c not in schema:
+                raise ValueError(f"column {c!r} not in table {name!r}")
+        page, _titles, _scope = self._run_query_ast(
+            ast.query if isinstance(ast.query, t.Query) else t.Query(ast.query)
+        )
+        if page.num_columns != len(targets):
+            raise ValueError(
+                f"INSERT has {page.num_columns} columns, expected {len(targets)}"
+            )
+        # positional channels, then cast each source column to the target type
+        chans = tuple(f"c{i}" for i in range(page.num_columns))
+        page = Page(page.blocks, chans, page.count)
+        exprs = []
+        for ch, blk, col in zip(chans, page.blocks, targets):
+            ref = ir.ColumnRef(ch, blk.type)
+            want = schema[col]
+            exprs.append(ref if blk.type == want else ir.cast(ref, want))
+        cast_pg = project_page(page, tuple(exprs), targets)
+        # assemble full-width page in table column order; unmentioned
+        # columns are NULL
+        by_name = dict(zip(targets, cast_pg.blocks))
+        cap = cast_pg.capacity if cast_pg.blocks else 1
+        blocks = []
+        for col, ty in schema.items():
+            if col in by_name:
+                blocks.append(by_name[col])
+            else:
+                did = None
+                if isinstance(ty, T.VarcharType):
+                    from .page import intern_dictionary
+
+                    did = intern_dictionary(())
+                blocks.append(null_block(ty, cap, did))
+        cat.append(name, Page(tuple(blocks), tuple(schema), page.count))
+        return self._row_count_result(int(page.count))
+
+    def _delete(self, ast: t.Delete) -> QueryResult:
+        cat = self._writable()
+        name = ast.table.lower()
+        schema = self._table_schema(cat, name)
+        before = int(cat.page(name).count)
+        if ast.where is None:
+            from .ops.union import empty_page
+
+            cat.replace(name, empty_page(schema))
+            return self._row_count_result(before)
+        # keep rows where the predicate is NOT TRUE (false or null)
+        keep = t.Case(
+            None,
+            ((ast.where, t.BooleanLiteral(False)),),
+            t.BooleanLiteral(True),
+        )
+        sel = t.Select(
+            items=(t.Star(),),
+            from_=t.Table(name),
+            where=keep,
+            group_by=(),
+            having=None,
+            distinct=False,
+        )
+        page, titles, _scope = self._run_query_ast(t.Query(sel))
+        from .page import Page
+
+        cat.replace(name, Page(page.blocks, tuple(tl.lower() for tl in titles), page.count))
+        return self._row_count_result(before - int(page.count))
 
     def explain_analyze_plan(self, node: N.PlanNode) -> str:
         """Execute the plan with per-operator accounting and render the

@@ -41,6 +41,7 @@ KEYWORDS = {
     "nulls", "first", "last", "over", "partition", "rows", "range",
     "unbounded", "preceding", "following", "current", "row", "filter",
     "explain", "analyze", "show", "tables", "columns", "substring", "for",
+    "create", "drop", "insert", "into", "delete", "values", "table",
 }
 
 
@@ -165,9 +166,72 @@ class Parser:
                 self.finish()
                 return t.ShowColumns(name)
             self.error("expected TABLES or COLUMNS")
+        if self.accept_kw("create"):
+            stmt = self.parse_create()
+            self.finish()
+            return stmt
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            if_exists = self._accept_if_exists()
+            name = self.ident()
+            self.finish()
+            return t.DropTable(name, if_exists)
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            name = self.ident()
+            cols: Tuple[str, ...] = ()
+            if self.tok.kind == "(":
+                self.expect("(")
+                cs = [self.ident()]
+                while self.accept(","):
+                    cs.append(self.ident())
+                self.expect(")")
+                cols = tuple(cs)
+            q = self.parse_query()
+            self.finish()
+            return t.Insert(name, cols, q)
+        if self.accept_kw("delete"):
+            self.expect_kw("from")
+            name = self.ident()
+            where = self.parse_expr() if self.accept_kw("where") else None
+            self.finish()
+            return t.Delete(name, where)
         q = self.parse_query()
         self.finish()
         return q
+
+    def _accept_if_exists(self) -> bool:
+        # IF is contextual (not a keyword) so that if(c, a, b) stays callable
+        if self.tok.kind == "ident" and self.tok.text.lower() == "if":
+            self.i += 1
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def parse_create(self) -> t.Node:
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.tok.kind == "ident" and self.tok.text.lower() == "if":
+            self.i += 1
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.ident()
+        if self.accept_kw("as"):
+            q = self.parse_query()
+            return t.CreateTable(name, (), q, if_not_exists)
+        self.expect("(")
+        cols = []
+        while True:
+            cname = self.ident()
+            ctype = self.parse_type_name()
+            cols.append(t.ColumnDefinition(cname, ctype))
+            if not self.accept(","):
+                break
+        self.expect(")")
+        if self.accept_kw("as"):
+            self.error("column list and AS query are mutually exclusive")
+        return t.CreateTable(name, tuple(cols), None, if_not_exists)
 
     def finish(self):
         self.accept(";")
@@ -257,7 +321,23 @@ class Parser:
             if not inner.with_items and not inner.order_by and inner.limit is None:
                 return inner.body
             return inner
+        if self.at_kw("values"):
+            return self.parse_values()
         return self.parse_select()
+
+    def parse_values(self) -> t.Values:
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect("(")
+            cells = [self.parse_expr()]
+            while self.accept(","):
+                cells.append(self.parse_expr())
+            self.expect(")")
+            rows.append(tuple(cells))
+            if not self.accept(","):
+                break
+        return t.Values(tuple(rows))
 
     def parse_select(self) -> t.Select:
         self.expect_kw("select")
@@ -352,7 +432,7 @@ class Parser:
     def parse_primary_relation(self) -> t.Node:
         if self.accept("("):
             # subquery or parenthesized join tree
-            if self.at_kw("select", "with") or self.tok.kind == "(":
+            if self.at_kw("select", "with", "values") or self.tok.kind == "(":
                 sub = self.parse_query()
                 self.expect(")")
                 alias, col_aliases = self._parse_alias(required=True)

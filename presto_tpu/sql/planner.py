@@ -223,7 +223,49 @@ class Planner:
             return self.plan_set_op(body, outer, ctes)
         if isinstance(body, t.Query):
             return self.plan_query(body, outer, ctes)
+        if isinstance(body, t.Values):
+            return self.plan_values(body, outer, ctes)
         raise PlanningError(f"unsupported query body {type(body).__name__}")
+
+    def plan_values(self, v: t.Values, outer, ctes) -> RelationPlan:
+        """Each VALUES row becomes Project(SingleRow); rows are coerced to
+        per-column common super types and unioned (reference: Values.java →
+        ValuesNode with per-row constant expressions)."""
+        if not v.rows:
+            raise PlanningError("VALUES requires at least one row")
+        width = len(v.rows[0])
+        row_nodes: List[N.PlanNode] = []
+        for row in v.rows:
+            if len(row) != width:
+                raise PlanningError("VALUES rows differ in column count")
+            sctx = SelectContext(self, [Scope([])], outer, ctes, None)
+            exprs = tuple(sctx.translate(cell) for cell in row)
+            leaf = N.SingleRow(self.channel("singlerow"))
+            names = tuple(self.channel(f"_col{i}") for i in range(width))
+            row_nodes.append(N.Project(leaf, exprs, names))
+        common = [ty for _, ty in row_nodes[0].fields]
+        for rn in row_nodes[1:]:
+            common = [
+                T.common_super_type(a, ty)
+                for a, (_, ty) in zip(common, rn.fields)
+            ]
+        first = self._coerce_columns(row_nodes[0], common)
+        parts: List[N.PlanNode] = [first]
+        first_names = tuple(n for n, _ in first.fields)
+        for rn in row_nodes[1:]:
+            cn = self._coerce_columns(rn, common)
+            exprs = tuple(ir.ColumnRef(n, ty) for n, ty in cn.fields)
+            parts.append(N.Project(cn, exprs, first_names))
+        node: N.PlanNode = (
+            parts[0] if len(parts) == 1 else N.Union(tuple(parts), distinct=False)
+        )
+        scope = Scope(
+            [
+                FieldRef(None, f"_col{i}", ch, ty)
+                for i, (ch, ty) in enumerate(first.fields)
+            ]
+        )
+        return RelationPlan(node, scope)
 
     def _order_expr(self, ast, scope: Scope, outer, ctes, node) -> ir.RowExpression:
         """ORDER BY resolves against output columns (aliases) first."""

@@ -273,3 +273,51 @@ class ShowTables(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowColumns(Node):
     table: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Values(Node):
+    """VALUES (r1c1, r1c2), (r2c1, r2c2) — usable as a query body or inline
+    relation (reference sql/tree/Values.java)."""
+
+    rows: Tuple[Tuple[Node, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnDefinition(Node):
+    name: str
+    type_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Node):
+    """CREATE TABLE [IF NOT EXISTS] name (col type, ...) or AS <query>
+    (reference sql/tree/CreateTable.java, CreateTableAsSelect.java)."""
+
+    name: str
+    columns: Tuple[ColumnDefinition, ...] = ()
+    query: Optional[Query] = None
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert(Node):
+    """INSERT INTO name [(cols)] <query|VALUES> (reference sql/tree/Insert.java)."""
+
+    table: str
+    columns: Tuple[str, ...]  # () = positional, all table columns
+    query: Node = None  # Query or Values
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM name [WHERE p] (reference sql/tree/Delete.java)."""
+
+    table: str
+    where: Optional[Node] = None
