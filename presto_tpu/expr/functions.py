@@ -1327,3 +1327,477 @@ def _date_diff(unit: Val, a: Val, b: Val, out_type: T.Type) -> Val:
     else:
         raise NotImplementedError(f"date_diff unit {u!r}")
     return Val(out, valid, T.BIGINT)
+
+
+# ---------------------------------------------------------------------------
+# breadth pass 2: datetime formatting/parsing, JSON, URL
+# (reference operator/scalar/DateTimeFunctions.java, JsonFunctions.java +
+# JsonExtract.java, UrlFunctions.java)
+# ---------------------------------------------------------------------------
+
+
+def _alias(new: str, existing: str):
+    f = FUNCTIONS[existing]
+    FUNCTIONS[new] = ScalarFunction(new, f.infer, f.impl)
+
+
+_alias("day_of_month", "day")
+_alias("week_of_year", "week")
+
+
+@register("year_of_week", _bigint_infer)
+def _year_of_week(a: Val, out_type: T.Type) -> Val:
+    """ISO week-numbering year (reference DateTimeFunctions.yearOfWeek)."""
+    days = a.data.astype(jnp.int64)
+    thursday = days - ((days + 3) % 7) + 3
+    y, _, _ = dt.days_to_civil(thursday)
+    return Val(y.astype(jnp.int64), a.valid, T.BIGINT)
+
+
+_alias("yow", "year_of_week")
+
+
+@register("from_unixtime", lambda ts: T.TIMESTAMP)
+def _from_unixtime(a: Val, out_type: T.Type) -> Val:
+    secs = _to_double(a)
+    return Val((secs * _TS_US).astype(jnp.int64), a.valid, T.TIMESTAMP)
+
+
+@register("to_unixtime", _double_infer)
+def _to_unixtime(a: Val, out_type: T.Type) -> Val:
+    if isinstance(a.type, T.DateType):
+        return Val(a.data.astype(jnp.float64) * 86400.0, a.valid, T.DOUBLE)
+    return Val(a.data.astype(jnp.float64) / _TS_US, a.valid, T.DOUBLE)
+
+
+# null-correct split_part: returns NULL past the last field (overrides the
+# ''-returning registration above; reference StringFunctions.splitPart)
+@register("split_part", _varchar_infer)
+def _split_part_null(a: Val, delim: Val, index: Val, out_type: T.Type) -> Val:
+    d = _require_literal(delim, "split_part delimiter")
+    i = int(_require_literal(index, "split_part index"))
+    if i < 1:
+        raise ValueError("split_part index must be >= 1")
+
+    def f(s: str) -> str:
+        parts = s.split(d)
+        return parts[i - 1] if i <= len(parts) else ""
+
+    out = _dict_transform(a, f)
+    has = _dict_predicate(a, lambda s: i <= len(s.split(d)))
+    return Val(out.data, and_valid(out.valid, has.data), out.type, out.dict_id)
+
+
+def _mysql_format_date(d, fmt: str) -> str:
+    """MySQL format specifiers over a python date (the reference's
+    date_format uses MySQL syntax, DateTimeFunctions.DATE_FORMATTER)."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            s = fmt[i + 1]
+            i += 2
+            if s == "Y":
+                out.append(f"{d.year:04d}")
+            elif s == "y":
+                out.append(f"{d.year % 100:02d}")
+            elif s == "m":
+                out.append(f"{d.month:02d}")
+            elif s == "c":
+                out.append(str(d.month))
+            elif s == "d":
+                out.append(f"{d.day:02d}")
+            elif s == "e":
+                out.append(str(d.day))
+            elif s == "j":
+                out.append(f"{d.timetuple().tm_yday:03d}")
+            elif s == "M":
+                out.append(d.strftime("%B"))
+            elif s == "b":
+                out.append(d.strftime("%b"))
+            elif s == "W":
+                out.append(d.strftime("%A"))
+            elif s == "a":
+                out.append(d.strftime("%a"))
+            elif s in ("H", "i", "s"):
+                out.append("00")  # date has no time part
+            elif s == "%":
+                out.append("%")
+            else:
+                raise NotImplementedError(f"date_format specifier %{s}")
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+_DATE_FMT_BASE = -141427  # 1582-10-15 (Gregorian adoption)
+_DATE_FMT_N = 335371  # through 2500-12-31
+_DATE_FMT_CACHE: dict = {}
+
+
+def _date_format_table(fmt: str):
+    """day-number -> formatted-string mapping over 1582..2500, deduped into
+    a sorted dictionary (eager: ~335k format calls once per format, then
+    cached). Dedup keeps GROUP BY/equality on the result correct — equal
+    strings always map to equal codes."""
+    cached = _DATE_FMT_CACHE.get(fmt)
+    if cached is not None:
+        return cached
+    import datetime as _dt
+
+    base = _dt.date(1582, 10, 15)
+    strings = [
+        _mysql_format_date(base + _dt.timedelta(days=i), fmt)
+        for i in range(_DATE_FMT_N)
+    ]
+    dictionary = tuple(sorted(set(strings)))
+    index = {s: i for i, s in enumerate(dictionary)}
+    # cache host-side: a jnp array created inside one jit trace must not
+    # leak into another (UnexpectedTracerError); jnp.asarray at use site
+    # folds it into each kernel as a constant
+    mapping = np.array([index[s] for s in strings], np.int32)
+    out = (dictionary, mapping)
+    _DATE_FMT_CACHE[fmt] = out
+    return out
+
+
+@register("date_format", _varchar_infer)
+def _date_format(a: Val, fmt: Val, out_type: T.Type) -> Val:
+    f = _require_literal(fmt, "date_format format")
+    if isinstance(a.type, T.TimestampType):
+        if any(
+            spec in f for spec in ("%H", "%i", "%s", "%f", "%T", "%r", "%h")
+        ):
+            raise NotImplementedError(
+                "date_format with time-of-day specifiers on timestamp"
+            )
+        days = (a.data // (86400 * _TS_US)).astype(jnp.int64)
+    elif isinstance(a.type, T.DateType):
+        days = a.data.astype(jnp.int64)
+    else:
+        raise TypeError(f"date_format on {a.type}")
+    dictionary, mapping = _date_format_table(f)
+    off = days - _DATE_FMT_BASE
+    in_range = (off >= 0) & (off < _DATE_FMT_N)
+    codes = jnp.asarray(mapping)[
+        jnp.clip(off, 0, _DATE_FMT_N - 1).astype(jnp.int32)
+    ]
+    # dates outside the precomputed 1582..2500 table come out NULL rather
+    # than silently clamped to a boundary date's string
+    return Val(
+        codes,
+        and_valid(a.valid, in_range),
+        T.VARCHAR,
+        intern_dictionary(dictionary),
+    )
+
+
+def _mysql_to_strptime(fmt: str) -> str:
+    """MySQL date_parse format -> python strptime format."""
+    table = {
+        "Y": "%Y", "y": "%y", "m": "%m", "c": "%m", "d": "%d", "e": "%d",
+        "H": "%H", "i": "%M", "s": "%S", "j": "%j", "M": "%B", "b": "%b",
+        "%": "%%",
+    }
+    out = []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            s = fmt[i + 1]
+            if s not in table:
+                raise NotImplementedError(f"date_parse specifier %{s}")
+            out.append(table[s])
+            i += 2
+        else:
+            out.append(c.replace("%", "%%"))
+            i += 1
+    return "".join(out)
+
+
+def _dict_table_nullable(a: Val, fn, np_dtype, out_type: T.Type) -> Val:
+    """Numeric sibling of _dict_transform_nullable: evaluate
+    fn(entry) -> (value, ok) per dictionary entry into a lookup table;
+    not-ok entries come out NULL after the per-row gather."""
+    d = a.dictionary
+    if d is None:
+        raise TypeError("varchar value lost its dictionary")
+    values = np.zeros(len(d), np_dtype)
+    ok = np.zeros(len(d), np.bool_)
+    for i, s in enumerate(d):
+        v, good = fn(s)
+        if good:
+            values[i] = v
+            ok[i] = True
+    table = jnp.asarray(values)
+    oktab = jnp.asarray(ok)
+    return Val(table[a.data], and_valid(a.valid, oktab[a.data]), out_type)
+
+
+@register("date_parse", lambda ts: T.TIMESTAMP)
+def _date_parse(a: Val, fmt: Val, out_type: T.Type) -> Val:
+    import datetime as _dt
+
+    f = _mysql_to_strptime(_require_literal(fmt, "date_parse format"))
+    epoch = _dt.datetime(1970, 1, 1)
+
+    def parse(s: str):
+        try:
+            return (
+                int((_dt.datetime.strptime(s, f) - epoch).total_seconds() * _TS_US),
+                True,
+            )
+        except ValueError:
+            return 0, False
+
+    return _dict_table_nullable(a, parse, np.int64, T.TIMESTAMP)
+
+
+@register("from_iso8601_date", _date_infer)
+def _from_iso8601_date(a: Val, out_type: T.Type) -> Val:
+    def parse(s: str):
+        try:
+            return dt.parse_date_literal(s), True
+        except Exception:
+            return 0, False
+
+    return _dict_table_nullable(a, parse, np.int32, T.DATE)
+
+
+# -- JSON (reference operator/scalar/JsonFunctions.java, JsonExtract.java;
+# JSON values are varchar here — dictionary host-eval per entry) -----------
+
+
+def _json_path_steps(path: str):
+    """Parse the JsonPath subset $.a.b[0]["c"] into access steps."""
+    if not path.startswith("$"):
+        raise ValueError(f"invalid JSON path {path!r}")
+    steps = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            j = i + 1
+            while j < n and path[j] not in ".[":
+                j += 1
+            steps.append(path[i + 1 : j])
+            i = j
+        elif c == "[":
+            j = path.index("]", i)
+            inner = path[i + 1 : j].strip()
+            if inner[:1] in ("'", '"'):
+                steps.append(inner[1:-1])
+            else:
+                steps.append(int(inner))
+            i = j + 1
+        else:
+            raise ValueError(f"invalid JSON path {path!r}")
+    return steps
+
+
+def _json_get(s: str, steps):
+    import json as _json
+
+    try:
+        v = _json.loads(s)
+    except Exception:
+        return None, False
+    for step in steps:
+        if isinstance(step, int):
+            if not isinstance(v, list) or not (-len(v) <= step < len(v)):
+                return None, False
+            v = v[step]
+        else:
+            if not isinstance(v, dict) or step not in v:
+                return None, False
+            v = v[step]
+    return v, True
+
+
+def _dict_transform_nullable(a: Val, fn, out_type=T.VARCHAR) -> Val:
+    """Like _dict_transform but fn returns (string, ok); not-ok entries
+    come out NULL."""
+    d = a.dictionary
+    if d is None:
+        raise TypeError("varchar value lost its dictionary")
+    values, oks = [], np.empty(len(d), np.bool_)
+    for i, s in enumerate(d):
+        v, ok = fn(s)
+        values.append(v if ok else "")
+        oks[i] = ok
+    new_dict = tuple(sorted(set(values)))
+    index = {s: i for i, s in enumerate(new_dict)}
+    codes = jnp.asarray(np.array([index[v] for v in values], np.int32))
+    oktab = jnp.asarray(oks)
+    return Val(
+        codes[a.data],
+        and_valid(a.valid, oktab[a.data]),
+        out_type,
+        intern_dictionary(new_dict),
+    )
+
+
+@register("json_extract_scalar", _varchar_infer)
+def _json_extract_scalar(a: Val, path: Val, out_type: T.Type) -> Val:
+    steps = _json_path_steps(_require_literal(path, "JSON path"))
+
+    def f(s: str):
+        import json as _json
+
+        v, ok = _json_get(s, steps)
+        if not ok or isinstance(v, (dict, list)) or v is None:
+            return "", False
+        if isinstance(v, str):
+            return v, True
+        # numbers/booleans keep their JSON text (1.0 stays '1.0')
+        return _json.dumps(v), True
+
+    return _dict_transform_nullable(a, f)
+
+
+@register("json_extract", _varchar_infer)
+def _json_extract(a: Val, path: Val, out_type: T.Type) -> Val:
+    import json as _json
+
+    steps = _json_path_steps(_require_literal(path, "JSON path"))
+
+    def f(s: str):
+        v, ok = _json_get(s, steps)
+        if not ok:
+            return "", False
+        return _json.dumps(v, separators=(",", ":"), sort_keys=True), True
+
+    return _dict_transform_nullable(a, f)
+
+
+@register("json_array_length", _bigint_infer)
+def _json_array_length(a: Val, out_type: T.Type) -> Val:
+    import json as _json
+
+    def f(s: str):
+        try:
+            v = _json.loads(s)
+        except Exception:
+            return 0, False
+        return (len(v), True) if isinstance(v, list) else (0, False)
+
+    return _dict_table_nullable(a, f, np.int64, T.BIGINT)
+
+
+@register("json_array_contains", _bool_infer)
+def _json_array_contains(a: Val, needle: Val, out_type: T.Type) -> Val:
+    import json as _json
+
+    want = _require_literal(needle, "json_array_contains value")
+
+    def f(s: str):
+        # NULL (not false) for invalid JSON / non-arrays (reference
+        # JsonFunctions is @SqlNullable)
+        try:
+            v = _json.loads(s)
+        except Exception:
+            return False, False
+        if not isinstance(v, list):
+            return False, False
+        if isinstance(want, bool):
+            return any(x is want for x in v), True
+        if isinstance(want, (int, float)):
+            return (
+                any(
+                    not isinstance(x, bool)
+                    and isinstance(x, (int, float))
+                    and x == want
+                    for x in v
+                ),
+                True,
+            )
+        return any(isinstance(x, str) and x == want for x in v), True
+
+    return _dict_table_nullable(a, f, np.bool_, T.BOOLEAN)
+
+
+@register("json_format", _varchar_infer)
+def _json_format(a: Val, out_type: T.Type) -> Val:
+    import json as _json
+
+    def f(s: str) -> str:
+        try:
+            return _json.dumps(_json.loads(s), separators=(",", ":"))
+        except Exception:
+            return s
+
+    return _dict_transform(a, f)
+
+
+# -- URL (reference operator/scalar/UrlFunctions.java) ----------------------
+
+
+def _url_host_raw(u) -> str:
+    """Case-preserving host: urlparse().hostname lowercases, the reference
+    keeps the authority as written."""
+    netloc = u.netloc
+    if "@" in netloc:
+        netloc = netloc.rsplit("@", 1)[1]
+    if netloc.startswith("["):  # [ipv6]:port
+        return netloc.split("]", 1)[0] + "]"
+    return netloc.split(":", 1)[0]
+
+
+def _url_part(name: str, getter):
+    """getter(parse_result, raw) -> str or None; None/parse failure -> NULL
+    (reference UrlFunctions returns null for absent components)."""
+
+    @register(name, _varchar_infer)
+    def _f(a: Val, out_type: T.Type) -> Val:
+        from urllib.parse import urlparse
+
+        def f(s: str):
+            try:
+                v = getter(urlparse(s), s)
+            except Exception:
+                return "", False
+            return (v, True) if v is not None else ("", False)
+
+        return _dict_transform_nullable(a, f)
+
+    return _f
+
+
+_url_part("url_extract_host", lambda u, s: _url_host_raw(u) or None)
+_url_part("url_extract_protocol", lambda u, s: u.scheme or None)
+_url_part("url_extract_path", lambda u, s: u.path)
+_url_part("url_extract_query", lambda u, s: u.query if "?" in s else None)
+_url_part(
+    "url_extract_fragment", lambda u, s: u.fragment if "#" in s else None
+)
+
+
+@register("url_extract_port", _bigint_infer)
+def _url_extract_port(a: Val, out_type: T.Type) -> Val:
+    from urllib.parse import urlparse
+
+    def f(s: str):
+        try:
+            p = urlparse(s).port
+        except Exception:
+            p = None
+        return (p, True) if p is not None else (0, False)
+
+    return _dict_table_nullable(a, f, np.int64, T.BIGINT)
+
+
+@register("url_encode", _varchar_infer)
+def _url_encode(a: Val, out_type: T.Type) -> Val:
+    from urllib.parse import quote_plus
+
+    return _dict_transform(a, lambda s: quote_plus(s))
+
+
+@register("url_decode", _varchar_infer)
+def _url_decode(a: Val, out_type: T.Type) -> Val:
+    from urllib.parse import unquote_plus
+
+    return _dict_transform(a, lambda s: unquote_plus(s))

@@ -36,7 +36,7 @@ from ..ops.sort import SortKey
 from ..plan import nodes as N
 from . import tree as t
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max", "checksum"}
+AGG_FUNCS = {"count", "sum", "avg", "min", "max", "checksum", "approx_distinct"}
 
 # aggregates planned by rewriting onto the core set (reference: many of
 # operator/aggregation/*'s 100+ functions decompose into sum/count states)
@@ -401,8 +401,14 @@ class Planner:
 
         group_exprs: List[ir.RowExpression] = []
         group_names: List[str] = []
+        # AST -> (channel, type) of each grouping expression, so select
+        # items / HAVING containing the same expression resolve to the
+        # grouped channel instead of re-translating (reference: the
+        # analyzer's grouping-expression matching in AggregationAnalyzer)
+        group_map: Dict[t.Node, Tuple[str, T.Type]] = {}
         if sel.group_by or agg_calls:
             for g in sel.group_by:
+                ast_g = g
                 if isinstance(g, t.NumberLiteral) and "." not in g.text:
                     idx = int(g.text)
                     if not 1 <= idx <= len(items):
@@ -410,16 +416,29 @@ class Planner:
                             f"GROUP BY position {idx} is not in select list "
                             f"(1..{len(items)})"
                         )
-                    item = items[idx - 1]
-                    e = sctx.translate(item.expr)
-                else:
-                    e = sctx.translate(g)
+                    ast_g = items[idx - 1].expr
+                elif (
+                    isinstance(g, t.Identifier)
+                    and len(g.parts) == 1
+                    and scope.resolve(g.parts) is None
+                ):
+                    # select-list alias (extension over the reference;
+                    # ambiguity -> error below via normal resolution)
+                    matches = [
+                        it
+                        for it in items
+                        if (it.alias or "").lower() == g.parts[0].lower()
+                    ]
+                    if len(matches) == 1:
+                        ast_g = matches[0].expr
+                e = sctx.translate(ast_g)
                 if isinstance(e, ir.ColumnRef):
                     ch = e.name
                 else:
                     ch = self.channel("gk")
                 group_exprs.append(e)
                 group_names.append(ch)
+                group_map[ast_g] = (ch, e.type)
 
             aggs, agg_map = self._plan_aggregates(agg_calls, sctx)
             holder.plan, distinct_rewritten = self._build_aggregate(
@@ -440,6 +459,7 @@ class Planner:
                 post_fields.append(FieldRef(None, a.name, a.name, a.output_type))
             agg_scope = Scope(post_fields)
             sctx = SelectContext(self, [agg_scope], outer, ctes, holder, agg_map)
+            sctx.group_map = group_map
 
         if sel.having is not None:
             pred = sctx.translate(sel.having)
@@ -658,6 +678,21 @@ class Planner:
             if call in agg_map:
                 continue
             fname = call.name
+            orig_call = call
+            if fname == "approx_distinct":
+                # exact distinct count satisfies the approx contract
+                # (reference ApproximateCountDistinctAggregations is an
+                # HLL estimate; this engine dedupes exactly instead). The
+                # optional second argument is the max standard error —
+                # meaningless for an exact count, so it is dropped.
+                if not 1 <= len(call.args) <= 2:
+                    raise PlanningError(
+                        "approx_distinct takes 1 or 2 arguments"
+                    )
+                call = dataclasses.replace(
+                    call, name="count", distinct=True, args=call.args[:1]
+                )
+                fname = "count"
             if fname in REWRITE_AGG_FUNCS:
                 agg_map[call] = self._rewrite_aggregate(call, sctx, aggs)
                 continue
@@ -694,7 +729,7 @@ class Planner:
                 if call.distinct:
                     spec = dataclasses.replace(spec, func=f"distinct_{func}")
             aggs.append(spec)
-            agg_map[call] = (spec.name, spec.output_type)
+            agg_map[orig_call] = (spec.name, spec.output_type)
         return aggs, agg_map
 
     def _rewrite_aggregate(self, call, sctx, aggs) -> ir.RowExpression:
@@ -1374,6 +1409,12 @@ class SelectContext:
                 return v  # composite rewrite (stddev & co) over agg channels
             ch, typ = v
             return ir.ColumnRef(ch, typ)
+        gm = getattr(self, "group_map", None)
+        if gm is not None and not isinstance(ast, t.Identifier):
+            hit = gm.get(ast)
+            if hit is not None:
+                ch, typ = hit
+                return ir.ColumnRef(ch, typ)
         if isinstance(ast, t.Identifier):
             f, is_outer = self.resolve(ast.parts)
             ref = ir.ColumnRef(f.channel, f.type)
@@ -1522,9 +1563,49 @@ class SelectContext:
         args = tuple(self._tr(a) for a in ast.args)
         if name == "ceiling":
             name = "ceil"
+        # special forms handled by the expression compiler, not the
+        # registry (compiler.py SPECIAL_FORMS: coalesce/nullif/if)
+        if name in ("coalesce", "if", "nullif"):
+            return self._special_form(name, args)
+        if name in ("e", "pi", "infinity", "nan") and not args:
+            val = {
+                "e": 2.718281828459045,
+                "pi": 3.141592653589793,
+                "infinity": float("inf"),
+                "nan": float("nan"),
+            }[name]
+            return ir.Literal(val, T.DOUBLE)
+        if name == "typeof" and len(args) == 1:
+            return ir.Literal(str(args[0].type), T.VARCHAR)
         if name not in FUNCTIONS:
             raise PlanningError(f"unknown function {name!r}")
         return ir.Call(name, args, _infer(name, tuple(a.type for a in args)))
+
+    def _special_form(self, name: str, args) -> ir.RowExpression:
+        if name == "coalesce":
+            if not args:
+                raise PlanningError("coalesce requires arguments")
+            out_t = args[0].type
+            for a in args[1:]:
+                out_t = T.common_super_type(out_t, a.type)
+            coerced = tuple(
+                a if a.type == out_t else ir.cast(a, out_t) for a in args
+            )
+            return ir.Call("coalesce", coerced, out_t)
+        if name == "nullif":
+            if len(args) != 2:
+                raise PlanningError("nullif requires 2 arguments")
+            return ir.Call("nullif", args, args[0].type)
+        # if(cond, a [, b])
+        if len(args) == 2:
+            args = args + (ir.Literal(None, args[1].type),)
+        if len(args) != 3:
+            raise PlanningError("if requires 2 or 3 arguments")
+        cond, a, b = args
+        out_t = T.common_super_type(a.type, b.type)
+        a = a if a.type == out_t else ir.cast(a, out_t)
+        b = b if b.type == out_t else ir.cast(b, out_t)
+        return ir.Call("if", (cond, a, b), out_t)
 
     # -- subqueries --
     def _plan_sub(self, q: t.Query):

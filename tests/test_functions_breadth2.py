@@ -1,0 +1,174 @@
+"""Breadth pass 2: special forms (coalesce/nullif/if), datetime
+formatting/parsing, JSON, URL functions, approx_distinct.
+
+Reference: operator/scalar/JsonFunctions.java + JsonExtract.java,
+UrlFunctions.java, DateTimeFunctions.java, and the conditional special
+forms the reference implements in sql/gen (IfCodeGenerator etc.)."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    return Session(MemoryCatalog({}))
+
+
+def one(sess, expr_sql):
+    rows = sess.query(f"select {expr_sql} from (values (1)) t(dummy)").rows()
+    assert len(rows) == 1
+    return rows[0][0]
+
+
+def test_coalesce_nullif_if(sess):
+    assert one(sess, "coalesce(null, 3)") == 3
+    assert one(sess, "coalesce(null, null, 'x')") == "x"
+    assert one(sess, "coalesce(1, 2.5)") == 1.0
+    assert one(sess, "nullif(3, 3)") is None
+    assert one(sess, "nullif(3, 4)") == 3
+    assert one(sess, "if(true, 'a', 'b')") == "a"
+    assert one(sess, "if(false, 'a')") is None
+    assert one(sess, "if(1 > 2, 10, 20.5)") == 20.5
+
+
+def test_constants_and_typeof(sess):
+    import math
+
+    assert abs(one(sess, "pi()") - math.pi) < 1e-12
+    assert abs(one(sess, "e()") - math.e) < 1e-12
+    assert one(sess, "is_infinite(infinity())") is True
+    assert one(sess, "is_nan(nan())") is True
+    assert one(sess, "typeof(1)") == "bigint"
+    assert one(sess, "typeof('x')") == "varchar"
+
+
+def test_date_format(sess):
+    assert one(sess, "date_format(date '1995-03-09', '%Y-%m-%d')") == "1995-03-09"
+    assert one(sess, "date_format(date '1995-03-09', '%d/%m/%y')") == "09/03/95"
+    assert one(sess, "date_format(date '2020-02-29', '%W, %M %e')") == (
+        "Saturday, February 29"
+    )
+
+
+def test_date_format_group_by_is_correct(sess):
+    sess.query("create table d (dt date)")
+    sess.query(
+        "insert into d values (date '2001-05-01'), (date '2001-05-09'),"
+        " (date '2001-06-01'), (date '2002-05-01')"
+    )
+    got = sess.query(
+        "select date_format(dt, '%Y-%m') ym, count(*) c from d group by 1 order by 1"
+    ).rows()
+    assert got == [("2001-05", 2), ("2001-06", 1), ("2002-05", 1)]
+
+
+def test_date_parse_and_iso(sess):
+    import numpy as np
+
+    # timestamps materialize as raw microseconds since epoch
+    us_per_day = 86_400_000_000
+    v = one(sess, "date_parse('1995/03/09', '%Y/%m/%d')")
+    days = (np.datetime64("1995-03-09") - np.datetime64("1970-01-01")).astype(int)
+    assert v == days * us_per_day
+    iso = one(sess, "from_iso8601_date('2011-07-14')")
+    assert np.datetime64(iso, "D") == np.datetime64("2011-07-14")
+    assert one(sess, "date_parse('bogus', '%Y/%m/%d')") is None
+
+
+def test_unixtime_roundtrip(sess):
+    assert one(sess, "from_unixtime(0)") == 0
+    assert one(sess, "to_unixtime(from_unixtime(1500000000))") == 1.5e9
+    assert one(sess, "to_unixtime(date '1970-01-02')") == 86400.0
+
+
+def test_week_year_functions(sess):
+    # 2011-01-01 is a Saturday of ISO week 52 of 2010
+    assert one(sess, "week_of_year(date '2011-01-01')") == 52
+    assert one(sess, "year_of_week(date '2011-01-01')") == 2010
+    assert one(sess, "yow(date '2011-01-02')") == 2010
+    assert one(sess, "day_of_month(date '2011-01-31')") == 31
+
+
+def test_json_extract_scalar(sess):
+    j = '{"a": {"b": [1, 2, "three"]}, "k": true}'
+    assert one(sess, f"json_extract_scalar('{j}', '$.a.b[2]')") == "three"
+    assert one(sess, f"json_extract_scalar('{j}', '$.a.b[0]')") == "1"
+    assert one(sess, f"json_extract_scalar('{j}', '$.k')") == "true"
+    assert one(sess, f"json_extract_scalar('{j}', '$.missing')") is None
+    assert one(sess, f"json_extract_scalar('{j}', '$.a')") is None  # non-scalar
+
+
+def test_json_extract_and_length(sess):
+    j = '{"arr": [10, 20], "o": {"x": 1}}'
+    assert one(sess, f"json_extract('{j}', '$.o')") == '{"x":1}'
+    assert one(sess, f"json_array_length(json_extract('{j}', '$.arr'))") == 2
+    assert one(sess, "json_array_length('[1,2,3]')") == 3
+    assert one(sess, "json_array_length('{}')") is None
+    assert one(sess, "json_array_contains('[1,2,3]', 2)") is True
+    assert one(sess, "json_array_contains('[\"a\"]', 'a')") is True
+    assert one(sess, "json_format('{\"b\": 1}')") == '{"b":1}'
+
+
+def test_url_functions(sess):
+    u = "https://example.com:8080/path/page?q=1#frag"
+    assert one(sess, f"url_extract_host('{u}')") == "example.com"
+    assert one(sess, f"url_extract_protocol('{u}')") == "https"
+    assert one(sess, f"url_extract_path('{u}')") == "/path/page"
+    assert one(sess, f"url_extract_query('{u}')") == "q=1"
+    assert one(sess, f"url_extract_fragment('{u}')") == "frag"
+    assert one(sess, f"url_extract_port('{u}')") == 8080
+    assert one(sess, "url_extract_port('http://x.com/')") is None
+    assert one(sess, "url_encode('a b&c')") == "a+b%26c"
+    assert one(sess, "url_decode('a+b%26c')") == "a b&c"
+
+
+def test_split_part_null_past_end(sess):
+    assert one(sess, "split_part('a,b,c', ',', 2)") == "b"
+    assert one(sess, "split_part('a,b,c', ',', 9)") is None
+
+
+def test_date_format_out_of_range_is_null(sess):
+    assert one(sess, "date_format(date '1492-10-12', '%Y')") is None
+    assert one(sess, "date_format(date '1583-01-01', '%Y')") == "1583"
+    assert one(sess, "date_format(date '2500-12-31', '%Y')") == "2500"
+
+
+def test_json_scalar_number_text_preserved(sess):
+    assert one(sess, "json_extract_scalar('{\"a\": 1.0}', '$.a')") == "1.0"
+    assert one(sess, "json_extract_scalar('{\"a\": 1}', '$.a')") == "1"
+
+
+def test_json_array_contains_null_for_non_array(sess):
+    assert one(sess, "json_array_contains('not json', 1)") is None
+    assert one(sess, "json_array_contains('{\"a\":1}', 1)") is None
+    assert one(sess, "json_array_contains('[2]', 1)") is False
+
+
+def test_url_null_and_case_semantics(sess):
+    assert one(sess, "url_extract_fragment('http://x.com/p')") is None
+    assert one(sess, "url_extract_query('http://x.com/p')") is None
+    assert one(sess, "url_extract_query('http://x.com/p?')") == ""
+    assert one(sess, "url_extract_host('http://EXample.COM/x')") == "EXample.COM"
+    assert one(sess, "url_extract_host('mailto:')") is None
+
+
+def test_approx_distinct_two_args(sess):
+    got = sess.query(
+        "select approx_distinct(x, 0.0040625) from (values (1),(2),(1)) t(x)"
+    ).rows()
+    assert got == [(2,)]
+
+
+def test_approx_distinct(sess):
+    sess.query("create table t (x bigint, g varchar)")
+    sess.query(
+        "insert into t values (1,'a'), (2,'a'), (1,'a'), (3,'b'), (3,'b'), (null,'b')"
+    )
+    assert sess.query("select approx_distinct(x) from t").rows() == [(3,)]
+    got = sess.query(
+        "select g, approx_distinct(x) from t group by g order by g"
+    ).rows()
+    assert got == [("a", 2), ("b", 1)]
