@@ -1004,12 +1004,8 @@ _dict_str_fn(
     if len(s) >= int(n)
     else s + (pad * int(n))[: int(n) - len(s)],
 )
-_dict_str_fn(
-    "split_part",
-    lambda s, delim, idx: (
-        s.split(delim)[int(idx) - 1] if 0 < int(idx) <= len(s.split(delim)) else ""
-    ),
-)
+# split_part is registered in the breadth-pass section below (NULL past
+# the last field, which the simple _dict_str_fn form cannot express)
 
 
 @register("starts_with", _bool_infer)
@@ -1348,7 +1344,10 @@ _alias("week_of_year", "week")
 @register("year_of_week", _bigint_infer)
 def _year_of_week(a: Val, out_type: T.Type) -> Val:
     """ISO week-numbering year (reference DateTimeFunctions.yearOfWeek)."""
-    days = a.data.astype(jnp.int64)
+    if isinstance(a.type, T.TimestampType):
+        days = (a.data // (86400 * _TS_US)).astype(jnp.int64)
+    else:
+        days = a.data.astype(jnp.int64)
     thursday = days - ((days + 3) % 7) + 3
     y, _, _ = dt.days_to_civil(thursday)
     return Val(y.astype(jnp.int64), a.valid, T.BIGINT)
@@ -1370,8 +1369,8 @@ def _to_unixtime(a: Val, out_type: T.Type) -> Val:
     return Val(a.data.astype(jnp.float64) / _TS_US, a.valid, T.DOUBLE)
 
 
-# null-correct split_part: returns NULL past the last field (overrides the
-# ''-returning registration above; reference StringFunctions.splitPart)
+# split_part returns NULL past the last field (reference
+# StringFunctions.splitPart)
 @register("split_part", _varchar_infer)
 def _split_part_null(a: Val, delim: Val, index: Val, out_type: T.Type) -> Val:
     d = _require_literal(delim, "split_part delimiter")
@@ -1769,7 +1768,10 @@ def _url_part(name: str, getter):
 _url_part("url_extract_host", lambda u, s: _url_host_raw(u) or None)
 _url_part("url_extract_protocol", lambda u, s: u.scheme or None)
 _url_part("url_extract_path", lambda u, s: u.path)
-_url_part("url_extract_query", lambda u, s: u.query if "?" in s else None)
+_url_part(
+    "url_extract_query",
+    lambda u, s: u.query if "?" in s.split("#", 1)[0] else None,
+)
 _url_part(
     "url_extract_fragment", lambda u, s: u.fragment if "#" in s else None
 )

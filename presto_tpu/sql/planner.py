@@ -179,6 +179,16 @@ class Planner:
                 try:
                     e = self._order_expr(si.expr, scope, outer, ctes, node)
                 except PlanningError:
+                    # ORDER BY repeating a select-list expression verbatim
+                    # (commonly an aggregate: ORDER BY count(*)) — match
+                    # structurally against the items and reuse the output
+                    # channel (reference: Analyzer orders on output fields)
+                    matched = self._order_item_match(q.body, si.expr, scope)
+                    if matched is not None:
+                        keys.append(
+                            SortKey(matched, si.ascending, si.nulls_first)
+                        )
+                        continue
                     # ORDER BY on a column NOT in the select list: extend
                     # the projection with a hidden sort channel, drop it
                     # after sorting (reference: LogicalPlanner orders on
@@ -215,6 +225,24 @@ class Planner:
         elif q.limit is not None:
             node = N.Limit(node, q.limit)
         return RelationPlan(node, scope)
+
+    @staticmethod
+    def _order_item_match(body, order_ast, scope) -> Optional[ir.ColumnRef]:
+        """If `order_ast` structurally equals a select item's expression,
+        return a ref to that item's output channel. Requires positional
+        item/field alignment, so bails out when the select list has a *."""
+        if not isinstance(body, t.Select):
+            return None
+        if isinstance(order_ast, t.NumberLiteral):
+            return None  # ordinals stay strict — never match a literal item
+        if any(isinstance(it, t.Star) for it in body.items):
+            return None
+        if len(body.items) != len(scope.fields):
+            return None
+        for it, f in zip(body.items, scope.fields):
+            if isinstance(it, t.SelectItem) and it.expr == order_ast:
+                return ir.ColumnRef(f.channel, f.type)
+        return None
 
     def plan_query_body(self, body, outer, ctes) -> RelationPlan:
         if isinstance(body, t.Select):
@@ -859,8 +887,17 @@ class Planner:
                 N.Aggregate(child, tuple(group_exprs), tuple(group_names), tuple(aggs)),
                 False,
             )
+        if len({a.input for a in distinct_specs}) > 1:
+            # the dedupe below is joint over all distinct arguments; with
+            # different arguments it would overcount — refuse loudly
+            raise PlanningError(
+                "multiple DISTINCT aggregates with different arguments "
+                "are not supported"
+            )
         if len(distinct_specs) != len(aggs):
-            raise PlanningError("mixing DISTINCT and plain aggregates is not yet supported")
+            return self._build_mixed_distinct_aggregate(
+                child, group_exprs, group_names, aggs, distinct_specs
+            )
         # project group keys + distinct args, dedupe, then aggregate plainly
         proj_exprs = list(group_exprs)
         proj_names = list(group_names)
@@ -886,6 +923,83 @@ class Planner:
             N.Aggregate(pre, new_groups, tuple(group_names), new_aggs),
             True,
         )
+
+    def _build_mixed_distinct_aggregate(
+        self, child, group_exprs, group_names, aggs, distinct_specs
+    ):
+        """Mixed plain + DISTINCT aggregates: pre-aggregate grouped by
+        (group keys, distinct argument) with decomposable partials, then
+        finalize grouped by the group keys alone. Stage-2 counting of the
+        distinct-argument channel IS the distinct count (reference:
+        OptimizeMixedDistinctAggregations)."""
+        plain_specs = [a for a in aggs if not a.func.startswith("distinct_")]
+        mergeable = {"sum", "count", "count_star", "min", "max"}
+        if any(a.func not in mergeable for a in plain_specs):
+            raise PlanningError(
+                "mixing DISTINCT with non-decomposable aggregates "
+                "(avg/checksum) is not supported"
+            )
+        darg = distinct_specs[0].input
+        dch = self.channel("darg")
+        s1_aggs = [
+            AggSpec(
+                a.func, a.input, self.channel(f"part_{a.func}"), a.output_type
+            )
+            for a in plain_specs
+        ]
+        stage1 = N.Aggregate(
+            child,
+            tuple(group_exprs) + (darg,),
+            tuple(group_names) + (dch,),
+            tuple(s1_aggs),
+        )
+        s2_groups = tuple(
+            ir.ColumnRef(n, e.type) for n, e in zip(group_names, group_exprs)
+        )
+        merge_func = {
+            "sum": "sum", "count": "sum", "count_star": "sum",
+            "min": "min", "max": "max",
+        }
+        s2_aggs = [
+            AggSpec(
+                merge_func[a.func],
+                ir.ColumnRef(p.name, p.output_type),
+                a.name,
+                a.output_type,
+            )
+            for a, p in zip(plain_specs, s1_aggs)
+        ]
+        for a in distinct_specs:
+            s2_aggs.append(
+                dataclasses.replace(
+                    a,
+                    func=a.func.replace("distinct_", ""),
+                    input=ir.ColumnRef(dch, darg.type),
+                )
+            )
+        node = N.Aggregate(
+            stage1, s2_groups, tuple(group_names), tuple(s2_aggs)
+        )
+        # empty global input: merged counts come out NULL from sum; the SQL
+        # answer is 0 — coalesce count-rooted outputs
+        count_names = {
+            a.name for a in plain_specs if a.func in ("count", "count_star")
+        }
+        if count_names:
+            exprs, names = [], []
+            for ch, ty in node.fields:
+                ref = ir.ColumnRef(ch, ty)
+                if ch in count_names:
+                    exprs.append(
+                        ir.Call(
+                            "coalesce", (ref, ir.Literal(0, ty)), ty
+                        )
+                    )
+                else:
+                    exprs.append(ref)
+                names.append(ch)
+            node = N.Project(node, tuple(exprs), tuple(names))
+        return node, True
 
 
 def _field_for_channel(scope: Scope, channel: str) -> Optional[FieldRef]:

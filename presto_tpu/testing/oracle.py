@@ -66,10 +66,18 @@ _INDEXES = {
 
 
 class SqliteOracle:
-    def __init__(self, sf: float = 0.01, tables: Optional[Sequence[str]] = None):
+    """`source` is a generator module exposing table(name, sf) and
+    TABLE_NAMES — connectors.tpch (default) or connectors.tpcds."""
+
+    def __init__(
+        self,
+        sf: float = 0.01,
+        tables: Optional[Sequence[str]] = None,
+        source=tpch,
+    ):
         self.conn = sqlite3.connect(":memory:")
-        for name in tables or tpch.TABLE_NAMES:
-            t = tpch.table(name, sf)
+        for name in tables or source.TABLE_NAMES:
+            t = source.table(name, sf)
             cols = list(t.columns.keys())
             self.conn.execute(
                 f"CREATE TABLE {name} ({', '.join(cols)})"
@@ -80,7 +88,11 @@ class SqliteOracle:
                 f"INSERT INTO {name} VALUES ({', '.join('?' * len(cols))})",
                 rows,
             )
-            for c in _INDEXES.get(name, []):
+            # TPC-DS key columns end in _sk; the TPC-H names are listed
+            indexed = [c for c in cols if c.endswith("_sk")] or _INDEXES.get(
+                name, []
+            )
+            for c in indexed:
                 self.conn.execute(f"CREATE INDEX idx_{name}_{c} ON {name}({c})")
         self.conn.commit()
 

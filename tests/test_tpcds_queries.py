@@ -1,0 +1,32 @@
+"""TPC-DS queries vs the SQLite oracle (same pattern as
+test_tpch_queries.py; reference: presto-tpcds + the benchto TPC-DS suite,
+presto-benchto-benchmarks/.../tpcds.yaml)."""
+
+import pytest
+
+from presto_tpu.benchmark.tpcds_sql import QUERIES
+from presto_tpu.connectors.tpcds import TpcdsCatalog
+from presto_tpu.session import Session
+from presto_tpu.testing.oracle import SqliteOracle, assert_same_results
+from presto_tpu.connectors import tpcds
+
+SF = 0.02
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(TpcdsCatalog(sf=SF))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle(sf=SF, source=tpcds)
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpcds_query(session, oracle, qid):
+    sql = QUERIES[qid]
+    ours = session.query(sql)
+    expected = oracle.query(sql)
+    types = [b.type for b in ours.page.blocks]
+    assert_same_results(ours.rows(), expected, types)
