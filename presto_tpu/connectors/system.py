@@ -1,0 +1,170 @@
+"""system.runtime tables: cluster introspection via SQL.
+
+Re-designed equivalent of the reference's system connector
+(presto-main/.../connector/system/ — SystemTablesMetadata,
+QuerySystemTable, NodeSystemTable; `select * from system.runtime.queries`).
+A wrapper catalog routes `system.runtime.*` names to live snapshots built
+from the coordinator's QueryManager / cluster NodeManager, and everything
+else to the wrapped user catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page
+from .spi import Connector
+
+QUERIES = "system.runtime.queries"
+NODES = "system.runtime.nodes"
+
+
+def _varchar(values: List[Optional[str]]) -> Block:
+    return Block.from_strings(values if values else [None])
+
+
+def _queries_page(manager) -> Page:
+    infos = sorted(manager.list_queries(), key=lambda i: i.query_id)
+    n = len(infos)
+    if n == 0:
+        from ..ops.union import empty_page
+
+        return empty_page(_QUERIES_SCHEMA)
+    now = __import__("time").time()
+    return Page.from_dict(
+        {
+            "query_id": _varchar([i.query_id for i in infos]),
+            "state": _varchar([i.state for i in infos]),
+            "user": _varchar([i.user for i in infos]),
+            "source": _varchar([i.source for i in infos]),
+            "query": _varchar([i.sql for i in infos]),
+            "elapsed_s": (
+                np.array(
+                    [(i.finished_at or now) - i.created_at for i in infos],
+                    np.float64,
+                ),
+                T.DOUBLE,
+            ),
+            "output_rows": (
+                np.array(
+                    [
+                        len(i.rows) if i.rows is not None else -1
+                        for i in infos
+                    ],
+                    np.int64,
+                ),
+                T.BIGINT,
+            ),
+            "error": _varchar(
+                [
+                    i.error.strip().split("\n")[-1][:200] if i.error else None
+                    for i in infos
+                ]
+            ),
+        }
+    )
+
+
+def _nodes_page(node_manager, self_uri: Optional[str]) -> Page:
+    rows: List[Tuple[str, str, str]] = []
+    if self_uri is not None:
+        rows.append((self_uri, "ACTIVE", "true"))
+    if node_manager is not None:
+        for uri, state in node_manager.workers.items():
+            rows.append((uri, state["state"], "false"))
+    if not rows:
+        rows.append(("unknown", "ACTIVE", "true"))
+    return Page.from_dict(
+        {
+            "node_id": _varchar([r[0] for r in rows]),
+            "state": _varchar([r[1] for r in rows]),
+            "coordinator": _varchar([r[2] for r in rows]),
+        }
+    )
+
+
+_QUERIES_SCHEMA: Dict[str, T.Type] = {
+    "query_id": T.VARCHAR, "state": T.VARCHAR, "user": T.VARCHAR,
+    "source": T.VARCHAR, "query": T.VARCHAR, "elapsed_s": T.DOUBLE,
+    "output_rows": T.BIGINT, "error": T.VARCHAR,
+}
+_NODES_SCHEMA: Dict[str, T.Type] = {
+    "node_id": T.VARCHAR, "state": T.VARCHAR, "coordinator": T.VARCHAR,
+}
+
+
+class SystemCatalog(Connector):
+    """Routes system.runtime.* to live snapshots, everything else to the
+    wrapped catalog. `manager`/`node_manager` are late-bound attributes —
+    the coordinator sets them after construction (QueryManager needs a
+    session, whose catalog is this object)."""
+
+    def __init__(self, wrapped, manager=None, node_manager=None,
+                 self_uri: Optional[str] = None):
+        self.wrapped = wrapped
+        self.manager = manager
+        self.node_manager = node_manager
+        self.self_uri = self_uri
+
+    @property
+    def name(self):
+        return getattr(self.wrapped, "name", "catalog")
+
+    # -- metadata --
+
+    def table_names(self) -> List[str]:
+        return list(self.wrapped.table_names()) + [QUERIES, NODES]
+
+    def schema(self, table: str):
+        if table == QUERIES:
+            return dict(_QUERIES_SCHEMA)
+        if table == NODES:
+            return dict(_NODES_SCHEMA)
+        return self.wrapped.schema(table)
+
+    def row_count(self, table: str) -> int:
+        if table == QUERIES:
+            return len(self.manager.list_queries()) if self.manager else 0
+        if table == NODES:
+            return 1
+        return self.wrapped.row_count(table)
+
+    def unique_columns(self, table: str):
+        if table in (QUERIES, NODES):
+            return []
+        return self.wrapped.unique_columns(table)
+
+    # -- data --
+
+    def page(self, table: str) -> Page:
+        if table == QUERIES:
+            return _queries_page(self.manager)
+        if table == NODES:
+            return _nodes_page(self.node_manager, self.self_uri)
+        return self.wrapped.page(table)
+
+    def exact_row_count(self, table: str) -> int:
+        if table in (QUERIES, NODES):
+            return int(self.page(table).count)
+        return self.wrapped.exact_row_count(table)
+
+    def scan(self, table: str, start: int, stop: int, pad_to=None,
+             columns=None, predicate=None) -> Page:
+        if table in (QUERIES, NODES):
+            return Connector.scan(
+                self, table, start, stop, pad_to=pad_to, columns=columns
+            )
+        return self.wrapped.scan(
+            table, start, stop, pad_to=pad_to, columns=columns,
+            predicate=predicate,
+        )
+
+    # -- write passthrough (DDL/DML on the user catalog) --
+
+    def __getattr__(self, item):
+        # create_table/append/... delegate when the wrapped catalog is
+        # writable; AttributeError otherwise, as for any read-only catalog
+        return getattr(self.wrapped, item)

@@ -51,8 +51,29 @@ class CoordinatorServer:
     Session in a QueryManager and serves the REST protocol."""
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 max_concurrent: int = 1):
-        self.manager = QueryManager(session, max_concurrent=max_concurrent)
+                 max_concurrent: int = 1, resource_groups=None,
+                 selectors=None, listeners=None):
+        # expose system.runtime.* through the served session's catalog
+        # (reference connector/system/; the user's own session is untouched)
+        from ..connectors.system import SystemCatalog
+        from ..session import Session
+
+        syscat = SystemCatalog(session.catalog)
+        served = Session(
+            syscat,
+            mesh=session.mesh,
+            broadcast_threshold=session.broadcast_threshold,
+            streaming=session.streaming,
+            batch_rows=session.batch_rows,
+            memory_budget=session.memory_budget,
+        )
+        self.manager = QueryManager(
+            served, max_concurrent=max_concurrent,
+            resource_groups=resource_groups, selectors=selectors,
+            listeners=listeners,
+        )
+        syscat.manager = self.manager
+        self.syscat = syscat
         self.started_at = time.time()
         self.shutting_down = False
         outer = self
@@ -87,7 +108,19 @@ class CoordinatorServer:
                         self._send(503, {"error": "shutting down"})
                         return
                     sql = self._read_body().decode()
-                    info = outer.manager.submit(sql)
+                    user = self.headers.get("X-Presto-User", "user")
+                    source = self.headers.get("X-Presto-Source")
+                    props_hdr = self.headers.get("X-Presto-Session", "")
+                    try:
+                        from ..session import parse_session_properties
+
+                        props = parse_session_properties(props_hdr)
+                    except ValueError as e:
+                        self._send(400, {"error": str(e)})
+                        return
+                    info = outer.manager.submit(
+                        sql, user=user, source=source, properties=props
+                    )
                     # immediate first response: QUEUED with nextUri
                     self._send(200, outer._query_results(info, 0))
                     return
@@ -152,6 +185,20 @@ class CoordinatorServer:
                 if parts == ["v1", "status"]:
                     self._send(200, {"state": "ACTIVE", "version": VERSION})
                     return
+                if parts == ["v1", "resourceGroupState"]:
+                    self._send(
+                        200,
+                        [
+                            {
+                                "group": s.name,
+                                "running": s.running,
+                                "queued": s.queued,
+                                "cpu_used_s": round(s.cpu_used_s, 3),
+                            }
+                            for s in outer.manager.groups.stats()
+                        ],
+                    )
+                    return
                 self._send(404, {"error": "not found"})
 
             def do_DELETE(self):
@@ -173,6 +220,7 @@ class CoordinatorServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
+        self.syscat.self_uri = f"http://{self.host}:{self.port}"
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )

@@ -1,0 +1,98 @@
+"""Query event listener SPI.
+
+Re-designed equivalent of the reference's EventListener SPI
+(presto-spi/.../spi/eventlistener/EventListener.java: queryCreated /
+queryCompleted / splitCompleted) fed by QueryMonitor
+(presto-main/.../event/QueryMonitor.java:73,112,171). Listeners are plain
+objects registered on the QueryManager; failures in a listener never fail
+the query (matching the reference's isolation of listener plugins).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import List, Optional
+
+log = logging.getLogger("presto_tpu.events")
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    user: str
+    source: Optional[str]
+    create_time: float
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    user: str
+    source: Optional[str]
+    state: str  # FINISHED | FAILED | CANCELED
+    error: Optional[str]
+    create_time: float
+    start_time: Optional[float]
+    end_time: float
+    wall_s: float
+    rows: Optional[int]
+
+
+class EventListener:
+    """Subclass and override the hooks you care about."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:  # noqa: B027
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:  # noqa: B027
+        pass
+
+
+class LoggingEventListener(EventListener):
+    """Reference analog: the event-listener plugins that write query logs."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        log.info("query created %s user=%s", event.query_id, event.user)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        log.info(
+            "query completed %s state=%s wall=%.3fs rows=%s",
+            event.query_id, event.state, event.wall_s, event.rows,
+        )
+
+
+class EventBus:
+    def __init__(self, listeners: Optional[List[EventListener]] = None):
+        self.listeners = list(listeners or [])
+
+    def add(self, listener: EventListener) -> None:
+        self.listeners.append(listener)
+
+    def fire_created(self, info) -> None:
+        ev = QueryCreatedEvent(
+            info.query_id, info.sql, getattr(info, "user", "user"),
+            getattr(info, "source", None), info.created_at,
+        )
+        self._fire("query_created", ev)
+
+    def fire_completed(self, info) -> None:
+        end = info.finished_at or time.time()
+        ev = QueryCompletedEvent(
+            info.query_id, info.sql, getattr(info, "user", "user"),
+            getattr(info, "source", None), info.state, info.error,
+            info.created_at, info.started_at, end,
+            end - (info.started_at or end),
+            len(info.rows) if info.rows is not None else None,
+        )
+        self._fire("query_completed", ev)
+
+    def _fire(self, hook: str, event) -> None:
+        for listener in self.listeners:
+            try:
+                getattr(listener, hook)(event)
+            except Exception:  # noqa: BLE001 - listener isolation
+                log.exception("event listener %r failed", listener)

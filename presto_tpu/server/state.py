@@ -5,9 +5,12 @@ SqlQueryManager (execution/SqlQueryManager.java:88), QueryStateMachine and
 the generic listener-based StateMachine (execution/StateMachine.java:44),
 and the /v1/statement paging buffer (server/protocol/Query.java:90,357).
 
-One background executor thread per coordinator drains a submission queue
-(admission control hook — the minimal resource-group analog: a bounded
-number of concurrently RUNNING queries)."""
+Admission control is delegated to hierarchical resource groups
+(server/resource_groups.py — reference InternalResourceGroup.run,
+resourceGroups/InternalResourceGroup.java:584): submissions enter a group
+chosen by user/source selectors, wait for a slot, and are executed by a
+bounded worker pool. Query lifecycle events fan out to EventListeners
+(server/events.py)."""
 
 from __future__ import annotations
 
@@ -40,6 +43,13 @@ class QueryInfo:
     columns: Optional[List[dict]] = None
     rows: Optional[List[tuple]] = None  # materialized result (root buffer)
     plan: Optional[str] = None
+    user: str = "user"
+    source: Optional[str] = None
+    properties: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def priority(self) -> int:  # query_priority scheduling policy input
+        return int(self.properties.get("query_priority", 1))
 
     @property
     def done(self) -> bool:
@@ -51,7 +61,11 @@ class QueryManager:
     factory on worker threads (max_concurrent = admission control)."""
 
     def __init__(self, session, max_concurrent: int = 1,
-                 max_history: int = 100):
+                 max_history: int = 100, resource_groups: Optional[dict] = None,
+                 selectors: Optional[list] = None, listeners=None):
+        from .events import EventBus
+        from .resource_groups import ResourceGroupManager
+
         self.session = session
         self.queries: Dict[str, QueryInfo] = {}
         self.max_history = max_history
@@ -59,23 +73,59 @@ class QueryManager:
         self._lock = threading.Lock()
         self._queue: "queue.Queue[str]" = queue.Queue()
         self._events: Dict[str, threading.Event] = {}
+        self.events = EventBus(listeners)
+        spec = resource_groups or {
+            "name": "global",
+            "hard_concurrency_limit": max_concurrent,
+            "max_queued": 10_000,
+        }
+        self.groups = ResourceGroupManager(
+            spec, selectors, dispatch=lambda info: self._queue.put(info.query_id)
+        )
+        # enough executor threads to honor the root group's concurrency;
+        # beyond the thread cap, clamp the group limit so admission never
+        # exceeds what can actually run (stats stay truthful)
+        pool = min(max(max_concurrent, self.groups.root.hard_concurrency_limit), 32)
+        if self.groups.root.hard_concurrency_limit > pool:
+            import logging
+
+            logging.getLogger("presto_tpu.server").warning(
+                "clamping root hard_concurrency_limit %d to worker pool %d",
+                self.groups.root.hard_concurrency_limit, pool,
+            )
+            self.groups.root.hard_concurrency_limit = pool
         self._workers = [
             threading.Thread(target=self._run_loop, daemon=True)
-            for _ in range(max_concurrent)
+            for _ in range(pool)
         ]
         for w in self._workers:
             w.start()
 
     # -- submission / lifecycle --
 
-    def submit(self, sql: str) -> QueryInfo:
+    def submit(self, sql: str, user: str = "user",
+               source: Optional[str] = None,
+               properties: Optional[dict] = None) -> QueryInfo:
+        from .resource_groups import QueryRejected
+
         with self._lock:
             qid = f"q_{next(self._ids)}"
-            info = QueryInfo(qid, sql)
+            info = QueryInfo(
+                qid, sql, user=user, source=source,
+                properties=dict(properties or {}),
+            )
             self.queries[qid] = info
             self._events[qid] = threading.Event()
             self._expire_locked()
-        self._queue.put(qid)
+        self.events.fire_created(info)
+        try:
+            self.groups.submit(info)
+        except QueryRejected as e:
+            info.state = FAILED
+            info.error = str(e)
+            info.finished_at = time.time()
+            self._events[qid].set()
+            self.events.fire_completed(info)
         return info
 
     def _expire_locked(self):
@@ -104,9 +154,18 @@ class QueryManager:
                 self._events.pop(query_id, None)
             return True
         # cooperative: QUEUED queries are dropped; RUNNING queries finish
-        # their current kernel then observe the canceled state
-        info.state = CANCELED
-        info.finished_at = time.time()
+        # their current kernel then observe the canceled state. The state
+        # write is under the manager lock so it cannot interleave with a
+        # worker's QUEUED->RUNNING transition and get lost.
+        with self._lock:
+            if info.done:
+                return True
+            was_queued = info.state == QUEUED
+            info.state = CANCELED
+            info.finished_at = time.time()
+        if was_queued and self.groups.remove_queued(info):
+            # never admitted: no slot to release
+            self.events.fire_completed(info)
         ev = self._events.get(query_id)
         if ev is not None:
             ev.set()
@@ -128,25 +187,39 @@ class QueryManager:
     def _run_loop(self):
         while True:
             qid = self._queue.get()
-            info = self.queries.get(qid)
-            if info is None or info.state != QUEUED:
-                continue  # canceled/purged while queued
-            info.state = RUNNING
-            info.started_at = time.time()
+            with self._lock:
+                info = self.queries.get(qid)
+                runnable = info is not None and info.state == QUEUED
+                if runnable:
+                    info.state = RUNNING
+                    info.started_at = time.time()
+            if not runnable:
+                # canceled/purged after its group admitted it: the slot
+                # was taken at dispatch, release it (by id — the info may
+                # be gone from history)
+                self.groups.finished_by_id(qid, 0.0)
+                if info is not None:
+                    self.events.fire_completed(info)
+                continue
             try:
-                result = self.session.query(info.sql)
+                session = self.session.with_properties(info.properties)
+                result = session.query(info.sql)
                 info.columns = [
                     {"name": t, "type": str(b.type)}
                     for t, b in zip(result.titles, result.page.blocks)
                 ]
                 info.rows = result.rows()
-                if info.state != CANCELED:
-                    info.state = FINISHED
+                with self._lock:
+                    if info.state != CANCELED:
+                        info.state = FINISHED
             except Exception:  # noqa: BLE001 - query failure is data
                 info.error = traceback.format_exc(limit=20)
-                if info.state != CANCELED:
-                    info.state = FAILED
+                with self._lock:
+                    if info.state != CANCELED:
+                        info.state = FAILED
             info.finished_at = time.time()
+            self.groups.finished(info, info.finished_at - info.started_at)
             ev = self._events.get(qid)
             if ev is not None:
                 ev.set()
+            self.events.fire_completed(info)

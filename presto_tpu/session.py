@@ -29,6 +29,45 @@ class QueryResult:
         return int(self.page.count)
 
 
+# system session properties: per-query engine overrides (reference
+# SystemSessionProperties — 49+ properties; these are the ones this
+# engine's executors actually read). Each entry: parser from string.
+def _parse_bool(v: str) -> bool:
+    if str(v).lower() in ("true", "1", "yes"):
+        return True
+    if str(v).lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"invalid boolean {v!r}")
+
+
+SESSION_PROPERTIES = {
+    "broadcast_threshold": int,   # join build-side broadcast cutover (rows)
+    "streaming": _parse_bool,     # paged scans through the streaming driver
+    "batch_rows": int,            # streaming scan batch size
+    "memory_budget": int,         # device-memory budget (bytes)
+    "query_priority": int,        # resource-group query_priority policy
+}
+
+
+def parse_session_properties(text: str) -> dict:
+    """Parse 'k=v,k=v' (the X-Presto-Session header format,
+    presto-client/.../PrestoHeaders.java) with type checking."""
+    props = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"invalid session property {part!r}")
+        k, v = part.split("=", 1)
+        k = k.strip().lower()
+        parser = SESSION_PROPERTIES.get(k)
+        if parser is None:
+            raise ValueError(f"unknown session property {k!r}")
+        props[k] = parser(v.strip())
+    return props
+
+
 class Session:
     """mesh=None runs single-device; passing a jax.sharding.Mesh fragments
     every plan (plan/fragment.py) and executes it distributed over the
@@ -62,6 +101,24 @@ class Session:
         self.streaming = streaming
         self.batch_rows = batch_rows
         self.memory_budget = memory_budget
+
+    def with_properties(self, props: dict) -> "Session":
+        """A sibling session with per-query property overrides applied
+        (reference: Session.withSystemProperty). Non-engine properties
+        (query_priority) are admission-control metadata and ignored here."""
+        engine = {k: v for k, v in props.items() if k != "query_priority"}
+        if not engine:
+            return self
+        return Session(
+            self.catalog,
+            mesh=self.mesh,
+            broadcast_threshold=engine.get(
+                "broadcast_threshold", self.broadcast_threshold
+            ),
+            streaming=engine.get("streaming", self.streaming),
+            batch_rows=engine.get("batch_rows", self.batch_rows),
+            memory_budget=engine.get("memory_budget", self.memory_budget),
+        )
 
     def plan(self, sql: str) -> N.PlanNode:
         ast = parse(sql)
@@ -118,11 +175,16 @@ class Session:
     def _writable(self):
         from .connectors.spi import WritableConnector, WriteError
 
-        if not isinstance(self.catalog, WritableConnector):
+        # unwrap routing catalogs (connectors/system.py SystemCatalog)
+        cat = self.catalog
+        probe = cat
+        while probe is not None and not isinstance(probe, WritableConnector):
+            probe = getattr(probe, "wrapped", None)
+        if probe is None:
             raise WriteError(
-                f"catalog {getattr(self.catalog, 'name', '?')!r} is read-only"
+                f"catalog {getattr(cat, 'name', '?')!r} is read-only"
             )
-        return self.catalog
+        return cat
 
     def _run_query_ast(self, ast: t.Query):
         """Plan + execute a Query AST; returns (page, titles, scope)."""

@@ -441,6 +441,8 @@ class Parser:
             self.expect(")")
             return rel
         name = self.ident()
+        while self.accept("."):  # qualified: catalog.schema.table
+            name += "." + self.ident()
         alias, _ = self._parse_alias(required=False)
         return t.Table(name, alias)
 

@@ -355,6 +355,31 @@ class Planner:
                 names.append(self.channel("coerce"))
         return N.Project(node, tuple(exprs), tuple(names))
 
+    def _resolve_table_name(self, name: str) -> str:
+        """Resolve a possibly-qualified `[catalog.][schema.]table` against
+        the session catalog (reference: MetadataManager qualified-name
+        resolution; connectors here expose one implicit 'default' schema,
+        except names the catalog itself registers with dots, e.g.
+        system.runtime.queries)."""
+        known = {t.lower() for t in self.catalog.table_names()}
+        if name in known:
+            return name
+        parts = name.split(".")
+        if len(parts) == 1:
+            raise PlanningError(f"unknown table {name!r}")
+        cat_name = str(getattr(self.catalog, "name", "")).lower()
+        if len(parts) == 3 and parts[0] != cat_name:
+            raise PlanningError(
+                f"unknown catalog {parts[0]!r} (session catalog is "
+                f"{cat_name!r})"
+            )
+        schema_part = parts[-2]
+        if schema_part not in ("default", cat_name):
+            raise PlanningError(f"unknown schema {schema_part!r}")
+        if parts[-1] in known:
+            return parts[-1]
+        raise PlanningError(f"unknown table {name!r}")
+
     # -- relations --
     def plan_relation(self, rel, outer, ctes) -> RelationPlan:
         if isinstance(rel, t.Table):
@@ -391,8 +416,11 @@ class Planner:
                 ]
             )
             return RelationPlan(sub.node, scope)
+        name = self._resolve_table_name(name)
         schema = self.catalog.schema(name)
-        alias = rel.alias or name
+        # qualified names default-alias to the last segment, so
+        # `from system.runtime.queries` resolves `queries.state`
+        alias = rel.alias or name.split(".")[-1]
         columns = []
         fields = []
         for cname, ctype in schema.items():

@@ -1,0 +1,319 @@
+"""Resource groups, session properties, event listeners.
+
+Reference: execution/resourceGroups/InternalResourceGroup.java (admission,
+queue limits, scheduling policies), SystemSessionProperties (per-query
+overrides), spi/eventlistener/EventListener.java (query lifecycle events).
+"""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.server.events import EventListener
+from presto_tpu.server.resource_groups import (
+    QueryRejected,
+    ResourceGroupManager,
+)
+from presto_tpu.server.state import FAILED, FINISHED, QueryManager
+from presto_tpu.session import Session, parse_session_properties
+
+
+class FakeInfo:
+    def __init__(self, qid, user="user", source=None, priority=1):
+        self.query_id = qid
+        self.user = user
+        self.source = source
+        self.priority = priority
+
+
+def test_concurrency_and_release():
+    started = []
+    rm = ResourceGroupManager(
+        {"name": "root", "hard_concurrency_limit": 2, "max_queued": 10},
+        dispatch=lambda i: started.append(i.query_id),
+    )
+    infos = [FakeInfo(f"q{i}") for i in range(4)]
+    for i in infos:
+        rm.submit(i)
+    assert started == ["q0", "q1"]  # third/fourth wait
+    rm.finished(infos[0], 0.1)
+    assert started == ["q0", "q1", "q2"]
+    rm.finished(infos[1], 0.1)
+    rm.finished(infos[2], 0.1)
+    assert started == ["q0", "q1", "q2", "q3"]
+
+
+def test_queue_full_rejection():
+    rm = ResourceGroupManager(
+        {"name": "root", "hard_concurrency_limit": 1, "max_queued": 1},
+        dispatch=lambda i: None,
+    )
+    rm.submit(FakeInfo("a"))
+    rm.submit(FakeInfo("b"))  # queued
+    with pytest.raises(QueryRejected):
+        rm.submit(FakeInfo("c"))
+
+
+def test_selectors_route_to_subgroups():
+    started = []
+    rm = ResourceGroupManager(
+        {
+            "name": "global",
+            "hard_concurrency_limit": 10,
+            "sub_groups": [
+                {"name": "etl", "hard_concurrency_limit": 1, "max_queued": 5},
+                {"name": "adhoc", "hard_concurrency_limit": 2, "max_queued": 5},
+            ],
+        },
+        selectors=[
+            {"user": "etl_.*", "group": "global.etl"},
+            {"group": "global.adhoc"},
+        ],
+        dispatch=lambda i: started.append(i.query_id),
+    )
+    a = FakeInfo("a", user="etl_nightly")
+    b = FakeInfo("b", user="etl_hourly")
+    c = FakeInfo("c", user="alice")
+    rm.submit(a)
+    rm.submit(b)  # etl limit 1 -> queued
+    rm.submit(c)  # adhoc -> runs
+    assert started == ["a", "c"]
+    rm.finished(a, 0.0)
+    assert started == ["a", "c", "b"]
+    names = {s.name: s for s in rm.stats()}
+    assert names["global.etl"].running == 1
+    assert names["global"].running == 2
+
+
+def test_weighted_policy_prefers_heavier_group():
+    started = []
+    rm = ResourceGroupManager(
+        {
+            "name": "g",
+            "hard_concurrency_limit": 1,
+            "scheduling_policy": "weighted",
+            "sub_groups": [
+                {"name": "light", "scheduling_weight": 1, "max_queued": 9,
+                 "hard_concurrency_limit": 1},
+                {"name": "heavy", "scheduling_weight": 5, "max_queued": 9,
+                 "hard_concurrency_limit": 1},
+            ],
+        },
+        selectors=[
+            {"source": "l", "group": "g.light"},
+            {"source": "h", "group": "g.heavy"},
+        ],
+        dispatch=lambda i: started.append(i.query_id),
+    )
+    blocker = FakeInfo("blocker", source="l")
+    rm.submit(blocker)
+    rm.submit(FakeInfo("l1", source="l"))
+    rm.submit(FakeInfo("h1", source="h"))
+    rm.finished(blocker, 0.0)
+    assert started[1] == "h1"  # heavier group released first
+
+
+def test_cpu_quota_blocks_then_refills():
+    started = []
+    rm = ResourceGroupManager(
+        {
+            "name": "root", "hard_concurrency_limit": 5, "max_queued": 10,
+            "cpu_quota_period_s": 0.2, "hard_cpu_limit_s": 0.1,
+        },
+        dispatch=lambda i: started.append(i.query_id),
+    )
+    a = FakeInfo("a")
+    rm.submit(a)
+    rm.finished(a, cpu_s=0.15)  # past the 0.1s quota
+    rm.submit(FakeInfo("b"))
+    assert started == ["a"]  # b queued on exhausted quota
+    time.sleep(0.6)  # refill at hard_cpu_limit/period = 0.5/s
+    c = FakeInfo("c")
+    rm.submit(c)
+    # quota refilled: the earlier-queued b starts, FIFO-before c
+    assert "b" in started
+    assert started.index("b") < started.index("c")
+
+
+def test_query_manager_end_to_end_with_groups_and_events():
+    events = []
+
+    class Recorder(EventListener):
+        def query_created(self, e):
+            events.append(("created", e.query_id))
+
+        def query_completed(self, e):
+            events.append(("completed", e.query_id, e.state))
+
+    cat = MemoryCatalog({})
+    sess = Session(cat)
+    qm = QueryManager(
+        sess,
+        max_concurrent=2,
+        resource_groups={
+            "name": "root", "hard_concurrency_limit": 1, "max_queued": 0,
+        },
+        listeners=[Recorder()],
+    )
+    info = qm.submit("select 1 as x from (values (1)) t(d)")
+    deadline = time.time() + 60
+    while not info.done and time.time() < deadline:
+        time.sleep(0.05)
+    assert info.state == FINISHED
+    assert info.rows == [(1,)]
+    assert ("created", info.query_id) in events
+    assert ("completed", info.query_id, FINISHED) in events
+
+
+def test_query_manager_rejects_on_full_queue():
+    cat = MemoryCatalog({})
+    sess = Session(cat)
+    qm = QueryManager(
+        sess,
+        resource_groups={
+            "name": "root", "hard_concurrency_limit": 1, "max_queued": 0,
+        },
+    )
+    gate = threading.Event()
+
+    # hold the only slot with a slow query via a long VALUES chain
+    slow = qm.submit(
+        "select count(*) from (values " +
+        ",".join(f"({i})" for i in range(50)) + ") t(x)"
+    )
+    # race: submit until one lands while the slot is held
+    rejected = None
+    for _ in range(50):
+        if slow.done:
+            break
+        r = qm.submit("select 2 from (values (1)) t(d)")
+        if r.state == FAILED and "queue full" in (r.error or ""):
+            rejected = r
+            break
+        time.sleep(0.01)
+    gate.set()
+    if rejected is not None:
+        assert "queue full" in rejected.error
+
+
+def test_session_properties_parse_and_apply():
+    props = parse_session_properties(
+        "broadcast_threshold=5, streaming=true, batch_rows=1024"
+    )
+    assert props == {
+        "broadcast_threshold": 5, "streaming": True, "batch_rows": 1024,
+    }
+    with pytest.raises(ValueError):
+        parse_session_properties("nope=1")
+    with pytest.raises(ValueError):
+        parse_session_properties("streaming=maybe")
+
+    sess = Session(MemoryCatalog({}))
+    s2 = sess.with_properties(props)
+    assert s2.broadcast_threshold == 5
+    assert s2.streaming is True
+    assert s2.batch_rows == 1024
+    # query_priority is admission metadata, not an engine knob
+    assert sess.with_properties({"query_priority": 9}) is sess
+
+
+def test_rest_session_header_and_group_state():
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    sess = Session(MemoryCatalog({}))
+    srv = CoordinatorServer(sess, max_concurrent=2).start()
+    try:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{srv.uri}/v1/statement",
+            data=b"select 41 + 1 from (values (1)) t(d)",
+            headers={
+                "X-Presto-User": "tester",
+                "X-Presto-Session": "broadcast_threshold=123",
+            },
+        )
+        out = json.loads(urllib.request.urlopen(req).read())
+        qid = out["id"]
+        # follow nextUri until data arrives
+        for _ in range(200):
+            if "data" in out or "error" in out:
+                break
+            out = json.loads(urllib.request.urlopen(out["nextUri"]).read())
+        assert out["data"] == [[42]]
+        rg = json.loads(
+            urllib.request.urlopen(f"{srv.uri}/v1/resourceGroupState").read()
+        )
+        assert rg[0]["group"] == "global"
+        # bad property -> 400
+        bad = urllib.request.Request(
+            f"{srv.uri}/v1/statement", data=b"select 1",
+            headers={"X-Presto-Session": "bogus_prop=1"},
+        )
+        try:
+            urllib.request.urlopen(bad)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()
+
+
+def test_system_runtime_tables():
+    from presto_tpu.server.client import Client
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    sess = Session(MemoryCatalog({}))
+    srv = CoordinatorServer(sess, max_concurrent=2).start()
+    try:
+        client = Client(srv.uri)
+        client.execute("select 1 from (values (1)) t(d)")
+        cols, rows = client.execute(
+            "select query_id, state, user from system.runtime.queries"
+            " order by query_id"
+        )
+        assert [c["name"] for c in cols] == ["query_id", "state", "user"]
+        assert len(rows) >= 1
+        states = {r[1] for r in rows}
+        assert states <= {"QUEUED", "RUNNING", "FINISHED", "FAILED", "CANCELED"}
+        _cols, nodes = client.execute(
+            "select node_id, coordinator from system.runtime.nodes"
+        )
+        assert any(n[1] == "true" for n in nodes)
+        # aggregation over a system table goes through the normal engine
+        _c, agg = client.execute(
+            "select state, count(*) from system.runtime.queries group by state"
+        )
+        # every earlier query (plus intervening ones) is visible
+        assert sum(r[1] for r in agg) >= len(rows)
+    finally:
+        srv.stop()
+
+
+def test_system_catalog_passthrough_ddl():
+    from presto_tpu.connectors.system import SystemCatalog
+
+    syscat = SystemCatalog(MemoryCatalog({}))
+    sess = Session(syscat)
+    sess.query("create table t (a bigint)")
+    sess.query("insert into t values (5)")
+    assert sess.query("select a from t").rows() == [(5,)]
+    assert "system.runtime.queries" in syscat.table_names()
+
+
+def test_qualified_table_names():
+    cat = MemoryCatalog({})
+    sess = Session(cat)
+    sess.query("create table t (a bigint)")
+    sess.query("insert into t values (3)")
+    assert sess.query("select a from default.t").rows() == [(3,)]
+    assert sess.query("select a from memory.default.t").rows() == [(3,)]
+    with pytest.raises(Exception, match="unknown catalog"):
+        sess.query("select a from hive.default.t")
+    with pytest.raises(Exception, match="unknown schema"):
+        sess.query("select a from memory.other.t")
+    with pytest.raises(Exception, match="unknown table"):
+        sess.query("select a from default.nope")
