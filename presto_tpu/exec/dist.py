@@ -592,6 +592,158 @@ replicated subtrees delegate to the single-node Executor."""
             ),
         )
 
+    def _d_sort(self, node: N.Sort):
+        """Distributed sort (reference admin/dist-sort.rst: per-task partial
+        sort + single-node MergeOperator k-way merge). Stage 1 sorts every
+        shard in parallel on the mesh; stage 2 merges on the root.
+
+        Merge fast path (single non-null key): each row's global position is
+        its in-run position plus, per other run, how many of that run's keys
+        precede it (vmapped searchsorted over the sorted runs, ties broken
+        by run index for stability) — one argsort over int32 ranks instead
+        of re-running the full multi-pass key sort. Nullable or multi-key
+        sorts fall back to sorting the gathered page."""
+        import jax.numpy as jnp
+
+        from ..expr.compiler import evaluate
+        from ..ops.sort import sort_page
+        from ..page import Block
+
+        # the fragmenter plans ORDER BY as Sort(Exchange(gather, child));
+        # run the gather's sharded input through the merge path instead of
+        # materializing it unsorted on the root
+        ch = node.child
+        if isinstance(ch, Exchange) and ch.kind == "gather":
+            if self.collector is not None:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                c = self._run(ch.child)
+                below = _time.perf_counter() - t0
+                sub = self.collector.lookup(ch.child)
+                # keep the Exchange visible to EXPLAIN ANALYZE even though
+                # the merge path absorbed it (Sort's self-time subtraction
+                # reads its direct child)
+                self.collector.record(
+                    ch,
+                    max(below - (sub.wall_s if sub else 0.0), 0.0),
+                    0,
+                    c.total_count() if isinstance(c, SPage) else int(c.count),
+                    0,
+                )
+            else:
+                c = self._run(ch.child)
+        else:
+            c = self._run(ch)
+        if not isinstance(c, SPage):
+            return self.local.exec_node(node, c)
+
+        keys = node.keys
+        single_key = len(keys) == 1 and not isinstance(
+            keys[0].expr.type, T.VarcharType
+        )
+
+        def local(p: Page):
+            from ..ops.sort import asc_normalized_scalar_key
+
+            s = sort_page(p, keys)
+            if not single_key:
+                return s, jnp.zeros((), jnp.int32)
+            v = evaluate(keys[0].expr, s)
+            key_col = asc_normalized_scalar_key(v.data, keys[0].ascending)
+            if key_col is None:  # long decimal: not merge-friendly
+                has_nulls = jnp.ones((), jnp.int32)
+                key_col = jnp.zeros(p.capacity, jnp.int64)
+            else:
+                if v.valid is None:
+                    has_nulls = jnp.zeros((), jnp.int32)
+                else:
+                    # only LIVE rows count — shard padding carries a zeroed
+                    # validity mask that is not a real NULL
+                    has_nulls = jnp.any(~v.valid & s.live_mask()).astype(
+                        jnp.int32
+                    )
+            kb = Block(
+                key_col,
+                T.DOUBLE
+                if jnp.issubdtype(key_col.dtype, jnp.floating)
+                else T.BIGINT,
+            )
+            return (
+                Page(s.blocks + (kb,), s.names + ("__sortkey__",), s.count),
+                has_nulls,
+            )
+
+        sorted_sp, (has_nulls,) = self._apply(
+            ("dsort", keys, single_key), local, [c], n_extra=1
+        )
+        if single_key and int(jnp.sum(has_nulls)) == 0:
+            return self._merge_sorted_runs(sorted_sp)
+        page = self.to_single(sorted_sp)
+        if single_key:  # drop the helper key column before the fallback
+            page = Page(page.blocks[:-1], page.names[:-1], page.count)
+        return self.local.exec_node(node, page)
+
+    def _merge_sorted_runs(self, sp: SPage) -> Page:
+        """Rank-merge n sorted runs whose last column is the asc-normalized
+        merge key; returns the single merged Page without that column."""
+        import jax.numpy as jnp
+
+        cap = sp.shard_capacity
+        n = self.n
+        key = ("merge_runs", sp.schema, cap, n)
+        fn = self._steps.get(key)
+        if fn is None:
+
+            def merge(leaves, counts):
+                K = leaves[-1].reshape(n, cap)
+                sentinel = (
+                    jnp.inf
+                    if jnp.issubdtype(K.dtype, jnp.floating)
+                    else jnp.iinfo(K.dtype).max
+                )
+                pos = jnp.arange(cap, dtype=jnp.int32)[None, :]
+                live = pos < counts[:, None]
+                Kp = jnp.where(live, K, sentinel)
+                flat = Kp.reshape(-1)
+                ss_l = jax.vmap(
+                    lambda a: jnp.searchsorted(a, flat, side="left")
+                )(Kp)  # (n, n*cap)
+                ss_r = jax.vmap(
+                    lambda a: jnp.searchsorted(a, flat, side="right")
+                )(Kp)
+                cnt = counts.astype(jnp.int32)[:, None]
+                ss_l = jnp.minimum(ss_l, cnt)
+                ss_r = jnp.minimum(ss_r, cnt)
+                run_of = jnp.repeat(
+                    jnp.arange(n, dtype=jnp.int32), cap
+                )  # (n*cap,)
+                other = jnp.arange(n, dtype=jnp.int32)[:, None]
+                before = jnp.where(other < run_of[None, :], ss_r, ss_l)
+                contrib = jnp.where(
+                    other == run_of[None, :], 0, before
+                ).sum(axis=0)
+                in_run = jnp.tile(pos[0], n)
+                total = jnp.sum(counts).astype(jnp.int32)
+                gidx = jnp.arange(n * cap, dtype=jnp.int32)
+                rank = jnp.where(
+                    live.reshape(-1),
+                    in_run + contrib.astype(jnp.int32),
+                    total + gidx,  # dead rows strictly after all live rows
+                )
+                perm = jnp.argsort(rank)
+                # every leaf's leading dim is n*cap (SPage layout)
+                merged = tuple(leaf[perm] for leaf in leaves)
+                return merged, total
+
+            fn = jax.jit(merge)
+            self._steps[key] = fn
+        merged, total = fn(sp.leaves, sp.counts)
+        page = page_from_arrays(merged, sp.schema, total)
+        # drop the __sortkey__ helper column
+        page = Page(page.blocks[:-1], page.names[:-1], page.count)
+        return self.local._shrink(page)
+
     def _d_topn(self, node: N.TopN):
         return self._unary(
             node,

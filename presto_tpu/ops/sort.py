@@ -32,6 +32,24 @@ class SortKey:
         return not self.ascending
 
 
+def asc_normalized_scalar_key(data, ascending: bool):
+    """Normalize one 1-D key array so ascending numeric order equals the
+    requested order (bool widened, negated for DESC). Shared by the local
+    sort and the distributed rank-merge so the two can never disagree on
+    key order. Returns None for multi-lane (long-decimal) data, which has
+    no single mergeable scalar."""
+    if data.ndim == 2:
+        return None
+    if jnp.issubdtype(data.dtype, jnp.bool_):
+        data = data.astype(jnp.int32)
+    if not ascending:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            data = -data
+        else:
+            data = -data.astype(jnp.int64)
+    return data
+
+
 def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
     """Permutation that orders live rows by the sort keys; dead rows last."""
     cap = page.capacity
@@ -44,9 +62,8 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
 
             require_sorted_dict(v, "ORDER BY")
         data = v.data[perm]
-        if jnp.issubdtype(data.dtype, jnp.bool_):
-            data = data.astype(jnp.int32)
-        if data.ndim == 2:
+        norm = asc_normalized_scalar_key(data, k.ascending)
+        if norm is None:
             # long-decimal lanes (hi, lo): two stable passes compose into
             # lexicographic (hi, lo) order == numeric order (lo >= 0)
             lo = data[:, 1]
@@ -58,12 +75,7 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
             order = jnp.argsort(hi[order], stable=True)
             perm = perm[order]
         else:
-            if not k.ascending:
-                if jnp.issubdtype(data.dtype, jnp.floating):
-                    data = -data
-                else:
-                    data = -data.astype(jnp.int64)
-            order = jnp.argsort(data, stable=True)
+            order = jnp.argsort(norm, stable=True)
             perm = perm[order]
         if v.valid is not None:
             # nulls to the requested end: a second stable sort on the null

@@ -52,28 +52,35 @@ class CoordinatorServer:
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: int = 1, resource_groups=None,
-                 selectors=None, listeners=None):
+                 selectors=None, listeners=None, node_manager=None):
         # expose system.runtime.* through the served session's catalog
-        # (reference connector/system/; the user's own session is untouched)
+        # (reference connector/system/; the user's own session is untouched).
+        # Duck-typed sessions (HttpClusterSession) are served as-is — they
+        # execute on remote workers whose catalogs we don't rewrite.
         from ..connectors.system import SystemCatalog
         from ..session import Session
 
-        syscat = SystemCatalog(session.catalog)
-        served = Session(
-            syscat,
-            mesh=session.mesh,
-            broadcast_threshold=session.broadcast_threshold,
-            streaming=session.streaming,
-            batch_rows=session.batch_rows,
-            memory_budget=session.memory_budget,
-        )
+        self.syscat = None
+        served = session
+        if isinstance(session, Session):
+            syscat = SystemCatalog(session.catalog)
+            served = Session(
+                syscat,
+                mesh=session.mesh,
+                broadcast_threshold=session.broadcast_threshold,
+                streaming=session.streaming,
+                batch_rows=session.batch_rows,
+                memory_budget=session.memory_budget,
+            )
+            self.syscat = syscat
         self.manager = QueryManager(
             served, max_concurrent=max_concurrent,
             resource_groups=resource_groups, selectors=selectors,
             listeners=listeners,
         )
-        syscat.manager = self.manager
-        self.syscat = syscat
+        if self.syscat is not None:
+            self.syscat.manager = self.manager
+            self.syscat.node_manager = node_manager
         self.started_at = time.time()
         self.shutting_down = False
         outer = self
@@ -220,7 +227,8 @@ class CoordinatorServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
-        self.syscat.self_uri = f"http://{self.host}:{self.port}"
+        if self.syscat is not None:
+            self.syscat.self_uri = f"http://{self.host}:{self.port}"
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )

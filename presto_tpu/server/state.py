@@ -124,7 +124,9 @@ class QueryManager:
             info.state = FAILED
             info.error = str(e)
             info.finished_at = time.time()
-            self._events[qid].set()
+            ev = self._events.get(qid)  # may already be expired from history
+            if ev is not None:
+                ev.set()
             self.events.fire_completed(info)
         return info
 
@@ -202,7 +204,9 @@ class QueryManager:
                     self.events.fire_completed(info)
                 continue
             try:
-                session = self.session.with_properties(info.properties)
+                session = self.session
+                if info.properties and hasattr(session, "with_properties"):
+                    session = session.with_properties(info.properties)
                 result = session.query(info.sql)
                 info.columns = [
                     {"name": t, "type": str(b.type)}

@@ -105,20 +105,30 @@ class Session:
     def with_properties(self, props: dict) -> "Session":
         """A sibling session with per-query property overrides applied
         (reference: Session.withSystemProperty). Non-engine properties
-        (query_priority) are admission-control metadata and ignored here."""
+        (query_priority) are admission-control metadata and ignored here.
+        Derived sessions are cached per property set so repeat clients
+        reuse compiled kernels instead of rebuilding executors."""
         engine = {k: v for k, v in props.items() if k != "query_priority"}
         if not engine:
             return self
-        return Session(
-            self.catalog,
-            mesh=self.mesh,
-            broadcast_threshold=engine.get(
-                "broadcast_threshold", self.broadcast_threshold
-            ),
-            streaming=engine.get("streaming", self.streaming),
-            batch_rows=engine.get("batch_rows", self.batch_rows),
-            memory_budget=engine.get("memory_budget", self.memory_budget),
-        )
+        key = tuple(sorted(engine.items()))
+        cache = getattr(self, "_prop_sessions", None)
+        if cache is None:
+            cache = self._prop_sessions = {}
+        derived = cache.get(key)
+        if derived is None:
+            derived = Session(
+                self.catalog,
+                mesh=self.mesh,
+                broadcast_threshold=engine.get(
+                    "broadcast_threshold", self.broadcast_threshold
+                ),
+                streaming=engine.get("streaming", self.streaming),
+                batch_rows=engine.get("batch_rows", self.batch_rows),
+                memory_budget=engine.get("memory_budget", self.memory_budget),
+            )
+            cache[key] = derived
+        return derived
 
     def plan(self, sql: str) -> N.PlanNode:
         ast = parse(sql)
