@@ -642,27 +642,31 @@ replicated subtrees delegate to the single-node Executor."""
         single_key = len(keys) == 1 and not isinstance(
             keys[0].expr.type, T.VarcharType
         )
+        if not single_key:
+            # multi-key/varchar sorts gain nothing from per-shard sorting
+            # (XLA's root sort cost is data-independent) — gather raw
+            return self.local.exec_node(node, self.to_single(c))
 
         def local(p: Page):
             from ..ops.sort import asc_normalized_scalar_key
 
             s = sort_page(p, keys)
-            if not single_key:
-                return s, jnp.zeros((), jnp.int32)
             v = evaluate(keys[0].expr, s)
             key_col = asc_normalized_scalar_key(v.data, keys[0].ascending)
             if key_col is None:  # long decimal: not merge-friendly
                 has_nulls = jnp.ones((), jnp.int32)
                 key_col = jnp.zeros(p.capacity, jnp.int64)
             else:
-                if v.valid is None:
-                    has_nulls = jnp.zeros((), jnp.int32)
-                else:
-                    # only LIVE rows count — shard padding carries a zeroed
-                    # validity mask that is not a real NULL
-                    has_nulls = jnp.any(~v.valid & s.live_mask()).astype(
-                        jnp.int32
-                    )
+                live = s.live_mask()
+                # only LIVE rows count — shard padding carries a zeroed
+                # validity mask that is not a real NULL. NaN keys also
+                # break searchsorted's ordering assumption: fall back.
+                bad = jnp.zeros_like(live)
+                if v.valid is not None:
+                    bad = bad | ~v.valid
+                if jnp.issubdtype(key_col.dtype, jnp.floating):
+                    bad = bad | jnp.isnan(key_col)
+                has_nulls = jnp.any(bad & live).astype(jnp.int32)
             kb = Block(
                 key_col,
                 T.DOUBLE

@@ -1,0 +1,153 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded via ctypes.
+
+The compute path is JAX/XLA; these are the host-side byte-bashing loops
+the reference keeps in its own perf substrate (airlift Slice + pure-Java
+LZ4) — here they are actual native code. First import compiles
+`lz4.cpp` with g++ into `_ptpu_native.so` next to this file (cached by
+mtime); environments without a toolchain fall back cleanly (`available()`
+is False and callers use zlib).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "lz4.cpp"
+_SO = _DIR / "_ptpu_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(_SO), str(_SRC),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+                _build()
+            lib = ctypes.CDLL(str(_SO))
+            lib.ptpu_lz4_compress.restype = ctypes.c_int
+            lib.ptpu_lz4_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ]
+            lib.ptpu_lz4_decompress.restype = ctypes.c_int
+            lib.ptpu_lz4_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ]
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 - no toolchain / bad env
+            _build_error = f"{type(e).__name__}: {e}"
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def lz4_compress(data: bytes) -> bytes:
+    """LZ4 block-format compress. Raises RuntimeError if the native codec
+    is unavailable or the buffer is incompressible past the bound."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native codec unavailable: {_build_error}")
+    n = len(data)
+    cap = n + n // 128 + 64  # worst case: tokens + length extensions
+    dst = (ctypes.c_uint8 * cap)()
+    out = lib.ptpu_lz4_compress(data, n, dst, cap)
+    if out < 0:
+        raise RuntimeError("lz4 compress overflow")
+    return ctypes.string_at(dst, out)
+
+
+def lz4_decompress(data: bytes, original_size: int) -> bytes:
+    """Decode an LZ4 block. Falls back to a pure-Python decoder when the
+    native library is unavailable, so a toolchain-less receiver can still
+    read codec-2 pages produced by a peer that has one."""
+    lib = _load()
+    if lib is None:
+        out = _py_lz4_decompress(data)
+        if len(out) != original_size:
+            raise ValueError(
+                f"lz4 decompress: got {len(out)}, expected {original_size}"
+            )
+        return out
+    dst = (ctypes.c_uint8 * max(original_size, 1))()
+    out = lib.ptpu_lz4_decompress(data, len(data), dst, original_size)
+    if out != original_size:
+        raise ValueError(
+            f"lz4 decompress: got {out}, expected {original_size}"
+        )
+    return ctypes.string_at(dst, original_size)
+
+
+def _py_lz4_decompress(src: bytes) -> bytes:
+    """Spec-faithful LZ4 block decoder (slow path; correctness fallback)."""
+    out = bytearray()
+    i = 0
+    n = len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                if i >= n:
+                    raise ValueError("truncated literal length")
+                b = src[i]
+                i += 1
+                lit += b
+                if b != 255:
+                    break
+        if i + lit > n:
+            raise ValueError("truncated literals")
+        out += src[i : i + lit]
+        i += lit
+        if i >= n:
+            break
+        if i + 2 > n:
+            raise ValueError("truncated offset")
+        off = src[i] | (src[i + 1] << 8)
+        i += 2
+        if off == 0 or off > len(out):
+            raise ValueError("bad match offset")
+        m = token & 15
+        if m == 15:
+            while True:
+                if i >= n:
+                    raise ValueError("truncated match length")
+                b = src[i]
+                i += 1
+                m += b
+                if b != 255:
+                    break
+        m += 4
+        for _ in range(m):
+            out.append(out[-off])
+    return bytes(out)

@@ -46,7 +46,9 @@ def asc_normalized_scalar_key(data, ascending: bool):
         if jnp.issubdtype(data.dtype, jnp.floating):
             data = -data
         else:
-            data = -data.astype(jnp.int64)
+            # bitwise NOT is strictly order-reversing on ints and, unlike
+            # negation, cannot overflow on INT64_MIN
+            data = ~data.astype(jnp.int64)
     return data
 
 

@@ -5,8 +5,9 @@ Re-designed equivalent of the reference's SerializedPage + PagesSerde
 binary pages with optional LZ4). TPU-first differences: blocks are
 fixed-width numpy arrays, so the encoding is a small JSON header (schema,
 types, dictionary payloads) + raw little-endian column buffers,
-compressed with zlib (the stdlib stand-in for airlift's LZ4 — same
-role, zero new dependencies).
+compressed with the native C++ LZ4 block codec (presto_tpu/native/ —
+the same codec role as airlift's aircompressor LZ4), falling back to
+stdlib zlib where no toolchain exists, or raw for incompressible pages.
 
 Pages on the pull-based exchange path are SELF-CONTAINED: dictionaries
 ship with every page (buffers are produced before their consumers are
@@ -88,17 +89,41 @@ def serialize_page(
         body.write(len(buf).to_bytes(8, "little"))
         body.write(buf)
     raw = body.getvalue()
-    flag = b"\x01" if compress else b"\x00"
-    payload = zlib.compress(raw, 1) if compress else raw
-    return _MAGIC + flag + payload
+    if not compress:
+        return _MAGIC + b"\x00" + raw
+    # codec preference: native LZ4 (the reference's PagesSerde codec,
+    # built from native/lz4.cpp) > zlib > raw-if-incompressible
+    from .. import native
+
+    if native.available():
+        packed = native.lz4_compress(raw)
+        if len(packed) + 8 < len(raw):
+            return (
+                _MAGIC + b"\x02" + len(raw).to_bytes(8, "little") + packed
+            )
+        return _MAGIC + b"\x00" + raw
+    payload = zlib.compress(raw, 1)
+    if len(payload) < len(raw):
+        return _MAGIC + b"\x01" + payload
+    return _MAGIC + b"\x00" + raw
 
 
 def deserialize_page(
     data: bytes, cache: Optional[DictionaryCache] = None
 ) -> Page:
     assert data[:4] == _MAGIC, "bad page magic"
-    compressed = data[4:5] == b"\x01"
-    raw = zlib.decompress(data[5:]) if compressed else data[5:]
+    codec = data[4]
+    if codec == 0:
+        raw = data[5:]
+    elif codec == 1:
+        raw = zlib.decompress(data[5:])
+    elif codec == 2:
+        from .. import native
+
+        orig = int.from_bytes(data[5:13], "little")
+        raw = native.lz4_decompress(data[13:], orig)
+    else:
+        raise ValueError(f"unknown page codec {codec}")
     view = memoryview(raw)
     hlen = int.from_bytes(view[:4], "little")
     header = json.loads(bytes(view[4 : 4 + hlen]))

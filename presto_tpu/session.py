@@ -117,6 +117,8 @@ class Session:
             cache = self._prop_sessions = {}
         derived = cache.get(key)
         if derived is None:
+            if len(cache) >= 16:  # bound server memory: FIFO-evict
+                cache.pop(next(iter(cache)))
             derived = Session(
                 self.catalog,
                 mesh=self.mesh,

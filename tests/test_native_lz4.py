@@ -1,0 +1,152 @@
+"""Native LZ4 block codec (native/lz4.cpp via ctypes) and its wiring into
+the page wire serde (reference PagesSerde + aircompressor LZ4,
+execution/buffer/PagesSerde.java:18-39).
+
+The compressor is validated against an INDEPENDENT pure-Python LZ4
+block-format decoder written here from the spec, and the decompressor
+against hand-crafted spec blocks — not just a self-roundtrip.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from presto_tpu import native
+
+
+def py_lz4_block_decode(src: bytes) -> bytes:
+    """Reference decoder for the LZ4 block format, straight from the spec."""
+    out = bytearray()
+    i = 0
+    n = len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                b = src[i]
+                i += 1
+                lit += b
+                if b != 255:
+                    break
+        out += src[i : i + lit]
+        i += lit
+        if i >= n:
+            break
+        off = src[i] | (src[i + 1] << 8)
+        i += 2
+        assert 0 < off <= len(out), "bad offset"
+        m = token & 15
+        if m == 15:
+            while True:
+                b = src[i]
+                i += 1
+                m += b
+                if b != 255:
+                    break
+        m += 4
+        for _ in range(m):
+            out.append(out[-off])
+    return bytes(out)
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native build failed: {native.build_error()}"
+)
+
+
+CASES = [
+    b"",
+    b"a",
+    b"abcd" * 1,
+    b"hello world hello world hello world",
+    b"x" * 10_000,
+    bytes(range(256)) * 50,
+    os.urandom(4096),  # incompressible
+    (b"0123456789abcdef" * 400) + os.urandom(100) + (b"0123456789abcdef" * 10),
+]
+
+
+@pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+def test_compress_output_is_spec_lz4(data):
+    packed = native.lz4_compress(data)
+    assert py_lz4_block_decode(packed) == data
+
+
+@pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+def test_roundtrip(data):
+    packed = native.lz4_compress(data)
+    assert native.lz4_decompress(packed, len(data)) == data
+
+
+def test_compresses_repetitive_data():
+    data = b"presto_tpu page bytes " * 2000
+    packed = native.lz4_compress(data)
+    assert len(packed) < len(data) // 10
+
+
+def test_decompressor_on_handcrafted_block():
+    # literals 'abcdef', then match offset=6 len=6 ('abcdef'), then
+    # trailing literal token for 'XYZWV' (the spec's 5-literal tail)
+    block = bytes([0x62]) + b"abcdef" + bytes([0x06, 0x00])
+    block += bytes([0x50]) + b"XYZWV"
+    assert native.lz4_decompress(block, 17) == b"abcdefabcdefXYZWV"
+
+
+def test_decompressor_rejects_corrupt():
+    with pytest.raises((ValueError, RuntimeError)):
+        native.lz4_decompress(b"\xf0\xff\xff", 1000)
+    # bad offset (points before start)
+    bad = bytes([0x10]) + b"a" + bytes([0x05, 0x00]) + bytes([0x50]) + b"XYZWV"
+    with pytest.raises(ValueError):
+        native.lz4_decompress(bad, 100)
+
+
+def test_fuzz_roundtrip_against_python_decoder():
+    rng = random.Random(7)
+    for _ in range(50):
+        kind = rng.randrange(3)
+        n = rng.randrange(0, 5000)
+        if kind == 0:
+            data = bytes(rng.randrange(256) for _ in range(n))
+        elif kind == 1:
+            word = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 30)))
+            data = (word * (n // max(len(word), 1) + 1))[:n]
+        else:
+            data = np.random.default_rng(n).integers(
+                0, 5, n, dtype=np.uint8
+            ).tobytes()
+        packed = native.lz4_compress(data)
+        assert py_lz4_block_decode(packed) == data
+        assert native.lz4_decompress(packed, len(data)) == data
+
+
+def test_serde_uses_lz4_and_roundtrips():
+    from presto_tpu.page import Page
+    from presto_tpu.server.serde import deserialize_page, serialize_page
+
+    pg = Page.from_dict(
+        {
+            "a": np.arange(5000, dtype=np.int64) % 17,
+            "s": ["alpha", "beta", "alpha", None, "gamma"] * 1000,
+        }
+    )
+    wire = serialize_page(pg)
+    assert wire[4] == 2  # lz4 codec selected
+    back = deserialize_page(wire)
+    assert back.to_pylist() == pg.to_pylist()
+
+
+def test_serde_raw_for_incompressible():
+    from presto_tpu.page import Page
+    from presto_tpu.server.serde import deserialize_page, serialize_page
+
+    rng = np.random.default_rng(3)
+    pg = Page.from_dict({"a": rng.integers(0, 2**62, 4096, dtype=np.int64)})
+    wire = serialize_page(pg)
+    assert wire[4] in (0, 2)
+    back = deserialize_page(wire)
+    assert back.to_pylist() == pg.to_pylist()
