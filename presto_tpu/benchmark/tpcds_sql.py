@@ -56,6 +56,64 @@ group by ca_zip
 order by ca_zip
 limit 100
 """,
+
+    18: """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cast(cs_quantity as double)) agg1,
+       avg(cast(cs_list_price as double)) agg2,
+       avg(cast(cs_coupon_amt as double)) agg3,
+       avg(cast(cs_sales_price as double)) agg4,
+       avg(cast(cs_net_profit as double)) agg5,
+       avg(cast(c_birth_year as double)) agg6,
+       avg(cast(cd1.cd_dep_count as double)) agg7
+from catalog_sales, customer_demographics cd1, customer_demographics cd2,
+     customer, customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd1.cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd1.cd_gender = 'F'
+  and cd1.cd_education_status = 'Unknown'
+  and c_current_cdemo_sk = cd2.cd_demo_sk
+  and c_current_addr_sk = ca_address_sk
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+  and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA', 'MS')
+group by rollup(i_item_id, ca_country, ca_state, ca_county)
+order by ca_country, ca_state, ca_county, i_item_id
+limit 100
+""",
+    22: """
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+  and inv_item_sk = i_item_sk
+  and d_month_seq between 1200 and 1200 + 11
+group by rollup(i_product_name, i_brand, i_class, i_category)
+order by qoh, i_product_name, i_brand, i_class, i_category
+limit 100
+""",
+    27: """
+select i_item_id, s_state, grouping(i_item_id, s_state) g_state,
+       avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3,
+       avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state in ('TN', 'TN', 'TN', 'TN', 'TN', 'TN')
+group by rollup(i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+""",
     19: """
 select i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
        sum(ss_ext_sales_price) ext_price

@@ -28,11 +28,18 @@ _build_error: Optional[str] = None
 
 
 def _build() -> None:
+    # compile to a per-pid temp file and rename atomically: concurrent
+    # processes must never dlopen a half-written .so
+    tmp = _SO.with_suffix(f".so.tmp.{os.getpid()}")
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", str(_SO), str(_SRC),
+        "-o", str(tmp), str(_SRC),
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 def _load() -> Optional[ctypes.CDLL]:

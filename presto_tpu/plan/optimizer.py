@@ -60,6 +60,10 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
 
     if isinstance(node, N.Aggregate):
         keep_aggs = tuple(a for a in node.aggs if a.name in needed)
+        if not keep_aggs and not node.group_exprs:
+            # a global aggregate must keep one accumulator to emit its one
+            # row (the GROUP BY () part of a ROLLUP with no aggregates)
+            keep_aggs = node.aggs[:1]
         child_needed: Set[str] = set()
         for e in node.group_exprs:
             _expr_channels(e, child_needed)

@@ -358,9 +358,9 @@ class Parser:
         group_by: Tuple[t.Node, ...] = ()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            gs = [self.parse_expr()]
+            gs = [self.parse_group_item()]
             while self.accept(","):
-                gs.append(self.parse_expr())
+                gs.append(self.parse_group_item())
             group_by = tuple(gs)
         having = self.parse_expr() if self.accept_kw("having") else None
         return t.Select(tuple(items), from_, where, group_by, having, distinct)
@@ -387,6 +387,55 @@ class Parser:
         return t.SelectItem(e, alias)
 
     # -- relations --
+    def parse_group_item(self) -> t.Node:
+        """One GROUP BY element: plain expression, or the grouping-set
+        constructs ROLLUP(...) / CUBE(...) / GROUPING SETS ((..), ..).
+        The construct names are contextual (not reserved keywords)."""
+        if self.tok.kind == "ident":
+            word = self.tok.text.lower()
+            if word in ("rollup", "cube") and self.peek().kind == "(":
+                self.i += 1
+                exprs = self._parse_paren_exprs()
+                if word == "rollup":
+                    sets = tuple(
+                        tuple(exprs[:k]) for k in range(len(exprs), -1, -1)
+                    )
+                else:  # cube: all subsets, preserving expr order
+                    n = len(exprs)
+                    sets = tuple(
+                        tuple(e for i, e in enumerate(exprs) if mask & (1 << i))
+                        for mask in range((1 << n) - 1, -1, -1)
+                    )
+                return t.GroupingSets(sets)
+            if (
+                word == "grouping"
+                and self.peek().kind == "ident"
+                and self.peek().text.lower() == "sets"
+            ):
+                self.i += 2
+                self.expect("(")
+                sets = []
+                while True:
+                    if self.tok.kind == "(":
+                        sets.append(tuple(self._parse_paren_exprs()))
+                    else:
+                        sets.append((self.parse_expr(),))
+                    if not self.accept(","):
+                        break
+                self.expect(")")
+                return t.GroupingSets(tuple(sets))
+        return self.parse_expr()
+
+    def _parse_paren_exprs(self) -> list:
+        self.expect("(")
+        if self.accept(")"):
+            return []
+        out = [self.parse_expr()]
+        while self.accept(","):
+            out.append(self.parse_expr())
+        self.expect(")")
+        return out
+
     def parse_relation_list(self) -> t.Node:
         rel = self.parse_join_tree()
         while self.accept(","):

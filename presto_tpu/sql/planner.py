@@ -227,6 +227,73 @@ class Planner:
         return RelationPlan(node, scope)
 
     @staticmethod
+    def _expand_group_by(group_by):
+        """Expand GROUP BY items containing GROUPING SETS/ROLLUP/CUBE into
+        the cross product of grouping sets (reference: the analyzer's
+        computeGroupingSetsCrossProduct). None when no construct appears."""
+        if not any(isinstance(g, t.GroupingSets) for g in group_by):
+            return None
+        sets = [()]
+        for g in group_by:
+            options = (
+                list(g.sets) if isinstance(g, t.GroupingSets) else [(g,)]
+            )
+            sets = [s + tuple(o) for s in sets for o in options]
+            if len(sets) > 64:
+                # each set re-plans and re-executes the source; cap like
+                # the reference's max-grouping-sets session limit
+                raise PlanningError(
+                    "too many grouping sets (limit 64); reduce the "
+                    "CUBE/GROUPING SETS cross product"
+                )
+        full: List[t.Node] = []
+        for s in sets:
+            for e in s:
+                if e not in full:
+                    full.append(e)
+        return sets, full
+
+    def _plan_grouping_sets(
+        self, sel: t.Select, sets, full, outer, ctes
+    ) -> RelationPlan:
+        """One Aggregate per grouping set, unioned; missing group columns
+        are typed NULLs (reference plans this as GroupIdNode + one shared
+        aggregation — re-designed as a union of independent aggregations,
+        which XLA handles as parallel fused reductions)."""
+        if sel.distinct:
+            raise PlanningError("SELECT DISTINCT with GROUPING SETS")
+        parts = [
+            self.plan_select(
+                dataclasses.replace(sel, group_by=tuple(s)),
+                outer,
+                ctes,
+                gs_ctx=(s, tuple(e for e in full if e not in s), full),
+            )
+            for s in sets
+        ]
+        common = [ty for _, ty in parts[0].node.fields]
+        for rp in parts[1:]:
+            common = [
+                T.common_super_type(a, ty)
+                for a, (_, ty) in zip(common, rp.node.fields)
+            ]
+        first = self._coerce_columns(parts[0].node, common)
+        first_names = tuple(n for n, _ in first.fields)
+        nodes: List[N.PlanNode] = [first]
+        for rp in parts[1:]:
+            cn = self._coerce_columns(rp.node, common)
+            exprs = tuple(ir.ColumnRef(n, ty) for n, ty in cn.fields)
+            nodes.append(N.Project(cn, exprs, first_names))
+        node = N.Union(tuple(nodes), distinct=False)
+        scope = Scope(
+            [
+                FieldRef(f.qualifier, f.name, ch, ty)
+                for f, (ch, ty) in zip(parts[0].scope.fields, first.fields)
+            ]
+        )
+        return RelationPlan(node, scope)
+
+    @staticmethod
     def _order_item_match(body, order_ast, scope) -> Optional[ir.ColumnRef]:
         """If `order_ast` structurally equals a select item's expression,
         return a ref to that item's output channel. Requires positional
@@ -431,7 +498,15 @@ class Planner:
         return RelationPlan(node, Scope(fields))
 
     # -- SELECT --
-    def plan_select(self, sel: t.Select, outer, ctes) -> RelationPlan:
+    def plan_select(
+        self, sel: t.Select, outer, ctes, gs_ctx=None
+    ) -> RelationPlan:
+        expanded = self._expand_group_by(sel.group_by)
+        if expanded is not None:
+            sets, full = expanded
+            if len(sets) > 1:
+                return self._plan_grouping_sets(sel, sets, full, outer, ctes)
+            sel = dataclasses.replace(sel, group_by=sets[0])
         ctx = FromPlanner(self, outer, ctes)
         if sel.from_ is not None:
             ctx.add_relation(sel.from_)
@@ -462,7 +537,7 @@ class Planner:
         # grouped channel instead of re-translating (reference: the
         # analyzer's grouping-expression matching in AggregationAnalyzer)
         group_map: Dict[t.Node, Tuple[str, T.Type]] = {}
-        if sel.group_by or agg_calls:
+        if sel.group_by or agg_calls or gs_ctx is not None:
             for g in sel.group_by:
                 ast_g = g
                 if isinstance(g, t.NumberLiteral) and "." not in g.text:
@@ -497,6 +572,13 @@ class Planner:
                 group_map[ast_g] = (ch, e.type)
 
             aggs, agg_map = self._plan_aggregates(agg_calls, sctx)
+            if not aggs and not group_exprs:
+                # GROUP BY (): exactly one output row regardless of input
+                # (the empty grouping set of a ROLLUP). A hidden count(*)
+                # drives the global-aggregation machinery; nothing reads it.
+                aggs = [
+                    AggSpec("count_star", None, self.channel("gcount"), T.BIGINT)
+                ]
             holder.plan, distinct_rewritten = self._build_aggregate(
                 holder.plan, group_exprs, group_names, aggs
             )
@@ -514,8 +596,21 @@ class Planner:
             for a in aggs:
                 post_fields.append(FieldRef(None, a.name, a.name, a.output_type))
             agg_scope = Scope(post_fields)
+            pre_sctx = sctx
             sctx = SelectContext(self, [agg_scope], outer, ctes, holder, agg_map)
             sctx.group_map = group_map
+            if gs_ctx is not None:
+                cur_set, null_asts, full = gs_ctx
+                # grouping-set columns absent from this set read as typed
+                # NULLs; grouping() resolves to this set's bitmask
+                sctx.group_null_map = {
+                    a: pre_sctx.translate(a).type for a in null_asts
+                }
+                sctx.grouping_ctx = (tuple(full), tuple(cur_set))
+            else:
+                # plain GROUP BY: grouping() over grouped columns is 0
+                plain = tuple(group_map)
+                sctx.grouping_ctx = (plain, plain)
 
         if sel.having is not None:
             pred = sctx.translate(sel.having)
@@ -1551,6 +1646,11 @@ class SelectContext:
                 return v  # composite rewrite (stddev & co) over agg channels
             ch, typ = v
             return ir.ColumnRef(ch, typ)
+        gnm = getattr(self, "group_null_map", None)
+        if gnm is not None:
+            ty = gnm.get(ast)
+            if ty is not None:  # column not in this grouping set
+                return ir.Literal(None, ty)
         gm = getattr(self, "group_map", None)
         if gm is not None and not isinstance(ast, t.Identifier):
             hit = gm.get(ast)
@@ -1702,6 +1802,27 @@ class SelectContext:
             raise PlanningError(
                 f"aggregate {name} in invalid context (window functions later)"
             )
+        if name == "grouping":
+            # bitmask of which arguments are aggregated away in this
+            # grouping set (reference GroupingOperationFunction); plain
+            # GROUP BY: every argument is grouped -> 0
+            gctx = getattr(self, "grouping_ctx", None)
+            n_args = len(ast.args)
+            if gctx is None:
+                raise PlanningError(
+                    "grouping() is only allowed in the SELECT/HAVING of "
+                    "an aggregation query"
+                )
+            full, cur = gctx
+            value = 0
+            for i, arg in enumerate(ast.args):
+                if arg not in full:
+                    raise PlanningError(
+                        "grouping() arguments must be grouping columns"
+                    )
+                if arg not in cur:
+                    value |= 1 << (n_args - 1 - i)
+            return ir.Literal(value, T.BIGINT)
         args = tuple(self._tr(a) for a in ast.args)
         if name == "ceiling":
             name = "ceil"

@@ -321,3 +321,11 @@ class Delete(Node):
 
     table: str
     where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupingSets(Node):
+    """GROUP BY GROUPING SETS / ROLLUP / CUBE, normalized to explicit sets
+    (reference sql/tree/GroupingSets.java, Rollup.java, Cube.java)."""
+
+    sets: Tuple[Tuple[Node, ...], ...]

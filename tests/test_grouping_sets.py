@@ -1,0 +1,116 @@
+"""GROUPING SETS / ROLLUP / CUBE + grouping() (reference: GroupIdNode
+planning in sql/analyzer + operator/GroupIdOperator.java, grouping() via
+GroupingOperationFunction)."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(MemoryCatalog({}))
+    s.query("create table t (a varchar, b varchar, v bigint)")
+    s.query(
+        "insert into t values ('x','p',1),('x','q',2),('y','p',4),('y','p',8)"
+    )
+    return s
+
+
+def test_rollup(sess):
+    got = sess.query(
+        "select a, b, sum(v) from t group by rollup(a, b) order by 1, 2"
+    ).rows()
+    assert got == [
+        ("x", "p", 1), ("x", "q", 2), ("x", None, 3),
+        ("y", "p", 12), ("y", None, 12), (None, None, 15),
+    ]
+
+
+def test_cube_with_grouping(sess):
+    got = sess.query(
+        "select a, b, sum(v), grouping(a, b) g from t group by cube(a, b)"
+        " order by 4, 1, 2"
+    ).rows()
+    assert got == [
+        ("x", "p", 1, 0), ("x", "q", 2, 0), ("y", "p", 12, 0),
+        ("x", None, 3, 1), ("y", None, 12, 1),
+        (None, "p", 13, 2), (None, "q", 2, 2),
+        (None, None, 15, 3),
+    ]
+
+
+def test_grouping_sets_explicit(sess):
+    got = sess.query(
+        "select a, b, count(*) c from t group by grouping sets ((a, b), (b))"
+        " order by 1, 2"
+    ).rows()
+    assert got == [
+        ("x", "p", 1), ("x", "q", 1), ("y", "p", 2),
+        (None, "p", 3), (None, "q", 1),
+    ]
+
+
+def test_mixed_plain_and_rollup(sess):
+    # GROUP BY a, ROLLUP(b): cross product keeps a in every set
+    got = sess.query(
+        "select a, b, sum(v) from t group by a, rollup(b) order by 1, 2"
+    ).rows()
+    assert got == [
+        ("x", "p", 1), ("x", "q", 2), ("x", None, 3),
+        ("y", "p", 12), ("y", None, 12),
+    ]
+
+
+def test_having_over_grouping_sets(sess):
+    got = sess.query(
+        "select a, sum(v) s from t group by rollup(a) having sum(v) > 5"
+        " order by 1"
+    ).rows()
+    assert got == [("y", 12), (None, 15)]
+
+
+def test_rollup_numeric_keys_and_avg(sess):
+    sess.query("create table n (k bigint, v double)")
+    sess.query("insert into n values (1, 2.0), (1, 4.0), (2, 10.0)")
+    got = sess.query(
+        "select k, avg(v) from n group by rollup(k) order by 1"
+    ).rows()
+    assert got == [(1, 3.0), (2, 10.0), (None, pytest.approx(16.0 / 3))]
+
+
+def test_plain_idents_named_cube_rollup_still_work(sess):
+    sess.query('create table odd (cube bigint, rollup bigint)')
+    sess.query("insert into odd values (1, 2)")
+    got = sess.query(
+        "select cube, rollup from odd group by cube, rollup"
+    ).rows()
+    assert got == [(1, 2)]
+
+
+def test_rollup_without_aggregates(sess):
+    got = sess.query("select a from t group by rollup(a) order by 1").rows()
+    assert got == [("x",), ("y",), (None,)]
+    sess.query("create table e2 (a varchar)")
+    assert sess.query("select a from e2 group by rollup(a)").rows() == [(None,)]
+
+
+def test_grouping_set_limit(sess):
+    cols = ", ".join(f"a" for _ in range(7))
+    with pytest.raises(Exception, match="too many grouping sets"):
+        sess.query(
+            "select a, count(*) from t group by cube(a, b, v, a, b, v, a)"
+        )
+
+
+def test_grouping_requires_aggregation_context(sess):
+    with pytest.raises(Exception, match="grouping"):
+        sess.query("select grouping(a) from t")
+    with pytest.raises(Exception, match="grouping"):
+        sess.query("select a from t where grouping(a) = 0 group by a")
+    # plain GROUP BY: allowed, always 0
+    got = sess.query(
+        "select a, grouping(a) from t group by a order by 1"
+    ).rows()
+    assert got == [("x", 0), ("y", 0)]
