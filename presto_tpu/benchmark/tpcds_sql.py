@@ -4,8 +4,8 @@ reference ships them under presto-benchto-benchmarks and tests them via
 presto-tpcds). Subset chosen to exercise every supported engine feature:
 multi-fact joins, date-dim filters, CASE buckets, correlated scalar
 subqueries, EXISTS, CTE full-outer joins, count(distinct), day-diff
-buckets. Queries needing ROLLUP/GROUPING SETS or windows over aggregates
-are excluded until those land.
+buckets. Queries combining GROUPING SETS with window functions (Q36/Q86)
+are excluded until windows can run over the unioned sets.
 """
 
 QUERIES = {
@@ -40,6 +40,100 @@ where ss_sold_date_sk = d_date_sk
 group by i_item_id
 order by i_item_id
 limit 100
+""",
+
+    12: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) as itemrevenue,
+       sum(ws_ext_sales_price) * 100.0 /
+         sum(sum(ws_ext_sales_price)) over (partition by i_class) as revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ws_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and (date '1999-02-22' + interval '30' day)
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    20: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) as itemrevenue,
+       sum(cs_ext_sales_price) * 100.0 /
+         sum(sum(cs_ext_sales_price)) over (partition by i_class) as revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and (date '1999-02-22' + interval '30' day)
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    53: """
+select manufact_id, sum_sales, avg_quarterly_sales
+from (select i_manufact_id manufact_id,
+             sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price)) over (partition by i_manufact_id) avg_quarterly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_month_seq in (1200, 1200 + 1, 1200 + 2, 1200 + 3, 1200 + 4,
+                            1200 + 5, 1200 + 6, 1200 + 7, 1200 + 8, 1200 + 9,
+                            1200 + 10, 1200 + 11)
+        and ((i_category in ('Books', 'Children', 'Electronics')
+              and i_class in ('books class 01', 'children class 02',
+                              'electronics class 03'))
+          or (i_category in ('Women', 'Music', 'Men')
+              and i_class in ('women class 01', 'music class 02',
+                              'men class 03')))
+      group by i_manufact_id, d_qoy) tmp1
+where case when avg_quarterly_sales > 0
+           then abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+           else null end > 0.1
+order by avg_quarterly_sales, sum_sales, manufact_id
+limit 100
+""",
+    89: """
+select i_category, i_class, i_brand, s_store_name, s_company_name,
+       d_moy, sum_sales, avg_monthly_sales
+from (select i_category, i_class, i_brand, s_store_name, s_company_name,
+             d_moy, sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price)) over
+               (partition by i_category, i_brand, s_store_name,
+                             s_company_name) avg_monthly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_year in (1999)
+        and ((i_category in ('Books', 'Electronics', 'Sports')
+              and i_class in ('books class 01', 'electronics class 02',
+                              'sports class 03'))
+          or (i_category in ('Men', 'Jewelry', 'Women')
+              and i_class in ('men class 01', 'jewelry class 02',
+                              'women class 03')))
+      group by i_category, i_class, i_brand, s_store_name, s_company_name,
+               d_moy) tmp1
+where case when avg_monthly_sales <> 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name
+limit 100
+""",
+    98: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100.0 /
+         sum(sum(ss_ext_sales_price)) over (partition by i_class) as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ss_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and (date '1999-02-22' + interval '30' day)
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 """,
     15: """
 select ca_zip, sum(cs_sales_price) total_sales

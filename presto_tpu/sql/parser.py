@@ -396,6 +396,10 @@ class Parser:
             if word in ("rollup", "cube") and self.peek().kind == "(":
                 self.i += 1
                 exprs = self._parse_paren_exprs()
+                if word == "cube" and len(exprs) > 12:
+                    # expansion is 2^n sets — bound it here so a wide CUBE
+                    # cannot DoS the parser (planner caps total sets at 64)
+                    self.error("CUBE supports at most 12 columns")
                 if word == "rollup":
                     sets = tuple(
                         tuple(exprs[:k]) for k in range(len(exprs), -1, -1)

@@ -249,6 +249,11 @@ class Planner:
         full: List[t.Node] = []
         for s in sets:
             for e in s:
+                if isinstance(e, t.NumberLiteral):
+                    raise PlanningError(
+                        "ordinals are not supported inside "
+                        "ROLLUP/CUBE/GROUPING SETS; name the column"
+                    )
                 if e not in full:
                     full.append(e)
         return sets, full
@@ -262,6 +267,16 @@ class Planner:
         which XLA handles as parallel fused reductions)."""
         if sel.distinct:
             raise PlanningError("SELECT DISTINCT with GROUPING SETS")
+        wins: List[t.FunctionCall] = []
+        for it in sel.items:
+            if isinstance(it, t.SelectItem):
+                _collect_windows(it.expr, wins)
+        if wins:
+            # a window over grouping sets must run over the UNION of all
+            # sets; the per-set planning below would compute it per set
+            raise PlanningError(
+                "window functions over GROUPING SETS are not supported"
+            )
         parts = [
             self.plan_select(
                 dataclasses.replace(sel, group_by=tuple(s)),
@@ -284,7 +299,11 @@ class Planner:
             cn = self._coerce_columns(rp.node, common)
             exprs = tuple(ir.ColumnRef(n, ty) for n, ty in cn.fields)
             nodes.append(N.Project(cn, exprs, first_names))
-        node = N.Union(tuple(nodes), distinct=False)
+        node = (
+            nodes[0]
+            if len(nodes) == 1
+            else N.Union(tuple(nodes), distinct=False)
+        )
         scope = Scope(
             [
                 FieldRef(f.qualifier, f.name, ch, ty)
@@ -503,10 +522,10 @@ class Planner:
     ) -> RelationPlan:
         expanded = self._expand_group_by(sel.group_by)
         if expanded is not None:
+            # always route through the grouping-sets planner (even a single
+            # set) so GROUP BY () / ROLLUP() force aggregation semantics
             sets, full = expanded
-            if len(sets) > 1:
-                return self._plan_grouping_sets(sel, sets, full, outer, ctes)
-            sel = dataclasses.replace(sel, group_by=sets[0])
+            return self._plan_grouping_sets(sel, sets, full, outer, ctes)
         ctx = FromPlanner(self, outer, ctes)
         if sel.from_ is not None:
             ctx.add_relation(sel.from_)
@@ -622,10 +641,10 @@ class Planner:
         for item in items:
             _collect_windows(item.expr, window_calls)
         if window_calls:
-            if agg_calls or sel.group_by:
-                raise PlanningError(
-                    "window functions over aggregated queries not yet supported"
-                )
+            # windows evaluate AFTER aggregation (reference: WindowNode
+            # sits above AggregationNode in LogicalPlanner); over an
+            # aggregated query, window inputs resolve through agg_map /
+            # group channels of the post-aggregation context
             win_map = self._plan_windows(window_calls, sctx, holder)
             sctx.agg_map.update(win_map)
 
@@ -809,6 +828,15 @@ class Planner:
                                 inp.type,
                             )
                         func = "count" if name == "count" else name
+                        if (
+                            isinstance(inp.type, T.DecimalType)
+                            and inp.type.is_long
+                        ):
+                            # the window kernels reduce 1-D arrays; two-lane
+                            # long decimals are computed in double instead
+                            # (documented precision trade; the grouped
+                            # aggregation path stays exact)
+                            inp = ir.cast(inp, T.DOUBLE)
                         out_t = AggSpec.infer_output_type(func, inp.type)
                     wf = WindowFunc(
                         func, inp, ch, out_t, running=running_default,

@@ -97,7 +97,6 @@ def test_rollup_without_aggregates(sess):
 
 
 def test_grouping_set_limit(sess):
-    cols = ", ".join(f"a" for _ in range(7))
     with pytest.raises(Exception, match="too many grouping sets"):
         sess.query(
             "select a, count(*) from t group by cube(a, b, v, a, b, v, a)"
