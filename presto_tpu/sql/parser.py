@@ -300,14 +300,28 @@ class Parser:
         return tuple(items)
 
     def parse_set_operation(self) -> t.Node:
-        left = self.parse_select_or_parens()
-        while self.at_kw("union", "intersect", "except"):
+        # INTERSECT binds tighter than UNION/EXCEPT (SqlBase.g4 set-op
+        # precedence), so each operand here is a full intersect chain
+        left = self.parse_intersect_chain()
+        while self.at_kw("union", "except"):
             op = self.tok.text
             self.i += 1
-            if op == "union":
-                op = "union_all" if self.accept_kw("all") else "union"
+            if self.accept_kw("all"):
+                op = f"{op}_all"  # EXCEPT ALL: planner rejects clearly
             else:
-                self.accept_kw("all")  # INTERSECT/EXCEPT ALL unsupported later
+                self.accept_kw("distinct")
+            right = self.parse_intersect_chain()
+            left = t.SetOperation(op, left, right)
+        return left
+
+    def parse_intersect_chain(self) -> t.Node:
+        left = self.parse_select_or_parens()
+        while self.at_kw("intersect"):
+            self.i += 1
+            op = "intersect"
+            if self.accept_kw("all"):
+                op = "intersect_all"
+            else:
                 self.accept_kw("distinct")
             right = self.parse_select_or_parens()
             left = t.SetOperation(op, left, right)

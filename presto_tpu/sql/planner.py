@@ -416,8 +416,13 @@ class Planner:
         renamed = N.Project(rnode, exprs, tuple(n for n, _ in lnode.fields))
         if op.op in ("union", "union_all"):
             node: N.PlanNode = N.Union((lnode, renamed), distinct=op.op == "union")
+        elif op.op in ("intersect", "except"):
+            node = self._plan_intersect_except(op.op, lnode, renamed)
         else:
-            raise PlanningError(f"set operation {op.op} not yet supported")
+            raise PlanningError(
+                f"set operation {op.op.replace('_', ' ').upper()} "
+                "is not supported (only the DISTINCT variants are)"
+            )
         scope = Scope(
             [
                 FieldRef(f.qualifier, f.name, ch, ty)
@@ -425,6 +430,64 @@ class Planner:
             ]
         )
         return RelationPlan(node, scope)
+
+    def _plan_intersect_except(self, op: str, lnode, rnode) -> N.PlanNode:
+        """INTERSECT/EXCEPT (distinct) as a side-tagged union + grouped
+        per-side counts + filter (reference: SetOperationNodeTranslator
+        rewrites these the same way onto a marker-aggregation). GROUP BY
+        treats NULL keys as equal, which is exactly the set-op semantics —
+        no null-safe join machinery needed."""
+        names = tuple(n for n, _ in lnode.fields)
+        refs = tuple(ir.ColumnRef(n, ty) for n, ty in lnode.fields)
+        side = self.channel("setop_side")
+        lp = N.Project(
+            lnode, refs + (ir.lit(0),), names + (side,)
+        )
+        rrefs = tuple(ir.ColumnRef(n, ty) for n, ty in rnode.fields)
+        rp = N.Project(rnode, rrefs + (ir.lit(1),), names + (side,))
+        u = N.Union((lp, rp), distinct=False)
+        side_ref = ir.ColumnRef(side, T.BIGINT)
+        cl = self.channel("cnt_l")
+        cr = self.channel("cnt_r")
+
+        def count_side(v):
+            cond = ir.Call("eq", (side_ref, ir.lit(v)), T.BOOLEAN)
+            return ir.Call(
+                "if", (cond, ir.lit(1), ir.Literal(None, T.BIGINT)), T.BIGINT
+            )
+
+        agg = N.Aggregate(
+            u,
+            refs,
+            names,
+            (
+                AggSpec("count", count_side(0), cl, T.BIGINT),
+                AggSpec("count", count_side(1), cr, T.BIGINT),
+            ),
+        )
+        cl_ref = ir.ColumnRef(cl, T.BIGINT)
+        cr_ref = ir.ColumnRef(cr, T.BIGINT)
+        zero = ir.lit(0)
+        if op == "intersect":
+            pred = ir.Call(
+                "and",
+                (
+                    ir.Call("gt", (cl_ref, zero), T.BOOLEAN),
+                    ir.Call("gt", (cr_ref, zero), T.BOOLEAN),
+                ),
+                T.BOOLEAN,
+            )
+        else:  # except
+            pred = ir.Call(
+                "and",
+                (
+                    ir.Call("gt", (cl_ref, zero), T.BOOLEAN),
+                    ir.Call("eq", (cr_ref, zero), T.BOOLEAN),
+                ),
+                T.BOOLEAN,
+            )
+        flt = N.Filter(agg, pred)
+        return N.Project(flt, refs, names)
 
     def _coerce_columns(self, node: N.PlanNode, target_types) -> N.PlanNode:
         if all(ty == tt for (_, ty), tt in zip(node.fields, target_types)):

@@ -1,0 +1,58 @@
+"""INTERSECT / EXCEPT (reference: SetOperationNodeTranslator rewriting
+onto marker aggregation; IntersectNode/ExceptNode in sql/planner/plan)."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(MemoryCatalog({}))
+    s.query("create table a (x bigint, y varchar)")
+    s.query("create table b (x bigint, y varchar)")
+    s.query(
+        "insert into a values (1,'p'),(1,'p'),(2,'q'),(3,null),(null,null)"
+    )
+    s.query("insert into b values (1,'p'),(3,null),(4,'r'),(null,null)")
+    return s
+
+
+def test_intersect_nulls_equal(sess):
+    got = sess.query(
+        "select x, y from a intersect select x, y from b order by 1"
+    ).rows()
+    assert got == [(1, "p"), (3, None), (None, None)]
+
+
+def test_except(sess):
+    got = sess.query(
+        "select x, y from a except select x, y from b order by 1"
+    ).rows()
+    assert got == [(2, "q")]
+
+
+def test_chained_and_coerced(sess):
+    # chained left-associative; int vs double coercion across sides
+    got = sess.query(
+        "select x from a intersect select x from b except select 3 from (values (1)) t(d)"
+        " order by 1"
+    ).rows()
+    assert got == [(1,), (None,)]
+
+
+def test_all_variants_rejected(sess):
+    for sql in (
+        "select x from a intersect all select x from b",
+        "select x from a except all select x from b",
+    ):
+        with pytest.raises(Exception, match="not supported"):
+            sess.query(sql)
+
+
+def test_intersect_under_aggregation(sess):
+    got = sess.query(
+        "select count(*) from (select x, y from a intersect select x, y from b) v"
+    ).rows()
+    assert got == [(3,)]
