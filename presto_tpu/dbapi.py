@@ -1,0 +1,220 @@
+"""PEP 249 (DB-API 2.0) client over the REST statement protocol.
+
+Re-designed equivalent of presto-jdbc (presto-jdbc/src/main/java/com/
+facebook/presto/jdbc/ — PrestoConnection/PrestoStatement/PrestoResultSet
+over the same /v1/statement protocol). Python's DB-API is the JDBC analog
+here; `qmark` parameters are bound client-side by literal substitution
+with SQL escaping (the reference's JDBC driver also textualizes simple
+statements before POSTing).
+
+    import presto_tpu.dbapi as dbapi
+    conn = dbapi.connect("http://localhost:8080")
+    cur = conn.cursor()
+    cur.execute("select * from t where x > ?", (5,))
+    print(cur.fetchall())
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import List, Optional, Sequence, Tuple
+
+apilevel = "2.0"
+threadsafety = 1  # threads may share the module, not connections
+paramstyle = "qmark"
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class ProgrammingError(DatabaseError):
+    pass
+
+
+def _escape(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float, decimal.Decimal)):
+        return str(v)
+    if isinstance(v, datetime.datetime):
+        return f"timestamp '{v.isoformat(sep=' ')}'"
+    if isinstance(v, datetime.date):
+        return f"date '{v.isoformat()}'"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    raise InterfaceError(f"cannot bind parameter of type {type(v).__name__}")
+
+
+def _substitute(sql: str, params: Sequence) -> str:
+    """Replace ? placeholders outside string literals, quoted identifiers,
+    and comments."""
+    out = []
+    it = iter(params)
+    i = 0
+    n = len(sql)
+    while i < n:
+        c = sql[i]
+        if c in ("'", '"'):  # string literal / quoted ident ('' "" escapes)
+            q = c
+            j = i + 1
+            while j < n:
+                if sql[j] == q:
+                    if j + 1 < n and sql[j + 1] == q:
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i : j + 1])
+            i = j + 1
+            continue
+        if c == "-" and i + 1 < n and sql[i + 1] == "-":  # -- line comment
+            j = sql.find("\n", i)
+            j = n if j < 0 else j
+            out.append(sql[i:j])
+            i = j
+            continue
+        if c == "/" and i + 1 < n and sql[i + 1] == "*":  # /* block */
+            j = sql.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append(sql[i:j])
+            i = j
+            continue
+        if c == "?":
+            try:
+                out.append(_escape(next(it)))
+            except StopIteration:
+                raise ProgrammingError("not enough parameters") from None
+            i += 1
+            continue
+        out.append(c)
+        i += 1
+    try:
+        next(it)
+        raise ProgrammingError("too many parameters")
+    except StopIteration:
+        pass
+    return "".join(out)
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self.description: Optional[List[tuple]] = None
+        self.rowcount = -1
+        self._rows: List[tuple] = []
+        self._pos = 0
+        self._closed = False
+
+    # -- execution --
+
+    def execute(self, operation: str, parameters: Sequence = ()) -> "Cursor":
+        self._check()
+        sql = _substitute(operation, parameters) if parameters else operation
+        try:
+            cols, rows = self._conn._client.execute(sql)
+        except Exception as e:  # noqa: BLE001 - wrap in DB-API error
+            raise DatabaseError(str(e)) from e
+        self.description = [
+            (c["name"], c["type"], None, None, None, None, None)
+            for c in (cols or [])
+        ]
+        self._rows = [tuple(r) for r in rows]
+        self._pos = 0
+        self.rowcount = len(self._rows)
+        return self
+
+    def executemany(self, operation: str, seq_of_parameters) -> "Cursor":
+        for p in seq_of_parameters:
+            self.execute(operation, p)
+        return self
+
+    # -- fetching --
+
+    def fetchone(self) -> Optional[tuple]:
+        self._check()
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[tuple]:
+        self._check()
+        size = size or self.arraysize
+        out = self._rows[self._pos : self._pos + size]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[tuple]:
+        self._check()
+        out = self._rows[self._pos :]
+        self._pos = len(self._rows)
+        return out
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    # -- boilerplate --
+
+    def setinputsizes(self, sizes):  # noqa: D102 - PEP 249 no-op
+        pass
+
+    def setoutputsize(self, size, column=None):  # noqa: D102 - PEP 249 no-op
+        pass
+
+    def close(self):
+        self._closed = True
+
+    def _check(self):
+        if self._closed or self._conn._closed:
+            raise InterfaceError("cursor is closed")
+
+
+class Connection:
+    def __init__(self, uri: str, timeout: float = 300.0):
+        from .server.client import Client
+
+        self._client = Client(uri, timeout=timeout)
+        self._closed = False
+
+    def cursor(self) -> Cursor:
+        if self._closed:
+            raise InterfaceError("connection is closed")
+        return Cursor(self)
+
+    def commit(self):  # autocommit protocol; present per PEP 249
+        pass
+
+    def rollback(self):
+        raise DatabaseError("transactions are not supported")
+
+    def close(self):
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def connect(uri: str, timeout: float = 300.0) -> Connection:
+    return Connection(uri, timeout=timeout)

@@ -192,6 +192,12 @@ class CoordinatorServer:
                 if parts == ["v1", "status"]:
                     self._send(200, {"state": "ACTIVE", "version": VERSION})
                     return
+                if not parts or parts == ["ui"]:
+                    self._send(
+                        200, outer._render_ui().encode(),
+                        content_type="text/html; charset=utf-8",
+                    )
+                    return
                 if parts == ["v1", "resourceGroupState"]:
                     self._send(
                         200,
@@ -232,6 +238,49 @@ class CoordinatorServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
+
+    # -- web UI (reference: presto-main webapp/ React query list; here a
+    # dependency-free server-rendered page off the same QueryManager) --
+
+    def _render_ui(self) -> str:
+        import html
+
+        rows = []
+        for info in sorted(
+            self.manager.list_queries(),
+            key=lambda i: i.created_at, reverse=True,
+        )[:50]:
+            elapsed = (info.finished_at or time.time()) - info.created_at
+            q = html.escape(info.sql.replace("\n", " ")[:120])
+            err = html.escape((info.error or "").strip().split("\n")[-1][:120])
+            rows.append(
+                f"<tr class='{info.state.lower()}'><td>{info.query_id}</td>"
+                f"<td>{info.state}</td><td>{html.escape(info.user)}</td>"
+                f"<td>{elapsed:.2f}s</td><td><code>{q}</code>"
+                f"{'<br><small>' + err + '</small>' if err else ''}</td></tr>"
+            )
+        groups = "".join(
+            f"<tr><td>{s.name}</td><td>{s.running}</td><td>{s.queued}</td>"
+            f"<td>{s.cpu_used_s:.2f}s</td></tr>"
+            for s in self.manager.groups.stats()
+        )
+        return f"""<!doctype html><html><head><meta charset="utf-8">
+<meta http-equiv="refresh" content="5"><title>presto-tpu</title><style>
+body{{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}}
+table{{border-collapse:collapse;width:100%;margin-bottom:2em;background:#fff}}
+td,th{{border:1px solid #ddd;padding:6px 10px;text-align:left;font-size:14px}}
+th{{background:#2b3a4a;color:#fff}} .failed td{{background:#fde8e8}}
+.running td{{background:#e8f4fd}} .finished td{{background:#f2fdf2}}
+code{{font-size:12px}}</style></head><body>
+<h1>presto-tpu coordinator</h1>
+<p>{VERSION} &middot; uptime {time.time() - self.started_at:.0f}s &middot;
+state {"SHUTTING_DOWN" if self.shutting_down else "ACTIVE"}</p>
+<h2>Queries</h2>
+<table><tr><th>id</th><th>state</th><th>user</th><th>elapsed</th>
+<th>query</th></tr>{''.join(rows)}</table>
+<h2>Resource groups</h2>
+<table><tr><th>group</th><th>running</th><th>queued</th><th>cpu used</th></tr>
+{groups}</table></body></html>"""
 
     # -- protocol payloads --
 

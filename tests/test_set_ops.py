@@ -34,12 +34,12 @@ def test_except(sess):
 
 
 def test_chained_and_coerced(sess):
-    # chained left-associative; int vs double coercion across sides
+    # chained left-associative; bigint vs double coercion across sides
     got = sess.query(
-        "select x from a intersect select x from b except select 3 from (values (1)) t(d)"
-        " order by 1"
+        "select x from a intersect select x from b"
+        " except select 3.0 from (values (1)) t(d) order by 1"
     ).rows()
-    assert got == [(1,), (None,)]
+    assert got == [(1.0,), (None,)]
 
 
 def test_all_variants_rejected(sess):

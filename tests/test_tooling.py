@@ -1,0 +1,158 @@
+"""Verifier (presto-verifier analog), DB-API client (presto-jdbc analog),
+and the coordinator web UI."""
+
+import datetime
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.server.coordinator import CoordinatorServer
+from presto_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CoordinatorServer(Session(TpchCatalog(sf=0.002)), max_concurrent=2)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# -- verifier ---------------------------------------------------------------
+
+
+def test_verifier_match_and_mismatch():
+    from presto_tpu.verifier import SessionTarget, verify_suite
+
+    control = SessionTarget(Session(TpchCatalog(sf=0.002)))
+    test = SessionTarget(Session(TpchCatalog(sf=0.002)))
+    results = verify_suite(
+        control, test,
+        [
+            "select count(*) from orders",
+            "select o_orderpriority, count(*) c from orders group by 1",
+        ],
+    )
+    assert all(r.status == "MATCH" for r in results)
+
+    # different SF -> detected mismatch
+    test2 = SessionTarget(Session(TpchCatalog(sf=0.004)))
+    bad = verify_suite(control, test2, ["select count(*) from orders"])
+    assert bad[0].status == "MISMATCH"
+    assert "row count" in bad[0].detail or "checksum" in bad[0].detail
+
+
+def test_verifier_order_insensitive_digest():
+    from presto_tpu.verifier import row_digest
+
+    n1, d1 = row_digest([(1, "a"), (2, "b")])
+    n2, d2 = row_digest([(2, "b"), (1, "a")])
+    assert (n1, d1) == (n2, d2)
+    n3, d3 = row_digest([(1, "a"), (2, "x")])
+    assert d3 != d1
+
+
+def test_verifier_reports_failures():
+    from presto_tpu.verifier import SessionTarget, verify_query
+
+    control = SessionTarget(Session(TpchCatalog(sf=0.002)))
+    test = SessionTarget(Session(MemoryCatalog({})))
+    r = verify_query(control, test, "select count(*) from orders")
+    assert r.status == "TEST_FAILED"
+
+
+def test_verifier_rest_targets(server):
+    from presto_tpu.verifier import RestTarget, verify_suite
+
+    a = RestTarget(server.uri)
+    b = RestTarget(server.uri)
+    results = verify_suite(a, b, ["select count(*) from lineitem"])
+    assert results[0].status == "MATCH"
+
+
+# -- DB-API -----------------------------------------------------------------
+
+
+def test_dbapi_roundtrip(server):
+    import presto_tpu.dbapi as dbapi
+
+    with dbapi.connect(server.uri) as conn:
+        cur = conn.cursor()
+        cur.execute("select count(*) c from orders")
+        assert cur.description[0][0] == "c"
+        assert cur.fetchone()[0] > 0
+        assert cur.fetchone() is None
+
+        cur.execute(
+            "select o_orderkey, o_orderpriority from orders"
+            " where o_orderkey <= ? order by 1 limit ?",
+            (10, 3),
+        )
+        rows = cur.fetchall()
+        assert len(rows) <= 3
+        assert cur.rowcount == len(rows)
+
+
+def test_dbapi_param_binding():
+    from presto_tpu.dbapi import ProgrammingError, _substitute
+
+    assert _substitute("select ?", (5,)) == "select 5"
+    assert _substitute("select '?', ?", ("a'b",)) == "select '?', 'a''b'"
+    assert (
+        _substitute("select ?", (datetime.date(2020, 2, 2),))
+        == "select date '2020-02-02'"
+    )
+    assert _substitute("select ?, ?", (None, True)) == "select null, true"
+    with pytest.raises(ProgrammingError):
+        _substitute("select ?", ())
+    with pytest.raises(ProgrammingError):
+        _substitute("select ?", (1, 2))
+
+
+def test_dbapi_error_wrapping(server):
+    import presto_tpu.dbapi as dbapi
+
+    conn = dbapi.connect(server.uri)
+    cur = conn.cursor()
+    with pytest.raises(dbapi.DatabaseError):
+        cur.execute("select bogus_column from orders")
+    conn.close()
+    with pytest.raises(dbapi.InterfaceError):
+        cur.execute("select 1")
+
+
+# -- web UI -----------------------------------------------------------------
+
+
+def test_web_ui_renders(server):
+    import urllib.request
+
+    import presto_tpu.dbapi as dbapi
+
+    dbapi.connect(server.uri).cursor().execute("select count(*) from nation")
+    html = urllib.request.urlopen(server.uri + "/").read().decode()
+    assert "presto-tpu coordinator" in html
+    assert "Resource groups" in html
+    assert "select count(*) from nation" in html
+
+
+def test_digest_no_even_multiplicity_cancellation():
+    from presto_tpu.verifier import row_digest
+
+    a = row_digest([(1, "a"), (1, "a")])
+    b = row_digest([(2, "b"), (2, "b")])
+    assert a != b
+
+
+def test_dbapi_placeholders_in_comments_and_quotes():
+    from presto_tpu.dbapi import _substitute
+
+    assert (
+        _substitute("select x from t where y = ? -- why?", (5,))
+        == "select x from t where y = 5 -- why?"
+    )
+    assert (
+        _substitute('select "a?b" from t /* ?? */ where z = ?', (1,))
+        == 'select "a?b" from t /* ?? */ where z = 1'
+    )
