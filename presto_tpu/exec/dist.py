@@ -331,6 +331,19 @@ replicated subtrees delegate to the single-node Executor."""
         out, _ = self._apply(key, local_fn, [c])
         return self._shrink_sp(out) if shrink else out
 
+    def _d_unnest(self, node: N.Unnest):
+        from ..ops.unnest import unnest_page
+
+        return self._unary(
+            node,
+            ("unnest", node),
+            lambda p: unnest_page(
+                p, node.array_exprs, node.elem_channels,
+                node.ordinality_channel,
+            ),
+            shrink=True,
+        )
+
     def _d_filter(self, node: N.Filter):
         return self._unary(
             node,

@@ -138,6 +138,18 @@ class Executor:
         return Page(tuple(blocks), tuple(names), src.count)
 
     # -- stateless row ops --
+    def _exec_unnest(self, node: N.Unnest, page: Page) -> Page:
+        from ..ops.unnest import unnest_page
+
+        fn = self._kernel(
+            node,
+            lambda: lambda p: unnest_page(
+                p, node.array_exprs, node.elem_channels,
+                node.ordinality_channel,
+            ),
+        )
+        return self._shrink(fn(page))
+
     def _exec_filter(self, node: N.Filter, page: Page) -> Page:
         fn = self._kernel(node, lambda: lambda p: filter_page(p, node.predicate))
         return self._shrink(fn(page))

@@ -47,6 +47,12 @@ class Val:
     type: T.Type
     dict_id: Optional[int] = None
     literal: object = None
+    # array-typed values only (T.ArrayType): data is (capacity, width),
+    # lengths is (capacity,) int32 per-row element counts, elem_valid an
+    # optional (capacity, width) element-level null mask. See
+    # T.ArrayType.__doc__ — arrays live in expressions, not in Pages.
+    lengths: Optional[jnp.ndarray] = None
+    elem_valid: Optional[jnp.ndarray] = None
 
     @property
     def dictionary(self) -> Optional[Tuple[str, ...]]:
@@ -1803,3 +1809,181 @@ def _url_decode(a: Val, out_type: T.Type) -> Val:
     from urllib.parse import unquote_plus
 
     return _dict_transform(a, lambda s: unquote_plus(s))
+
+
+# ---------------------------------------------------------------------------
+# arrays (reference operator/scalar/ArrayFunctions.java + ArrayConstructor,
+# ArraySubscriptOperator, SequenceFunction, StringFunctions.split).
+# Representation per T.ArrayType: (capacity, width) data + per-row lengths.
+# ---------------------------------------------------------------------------
+
+
+def _array_infer_element(ts):
+    return ts[0].element
+
+
+@register("array_constructor", lambda ts: T.ArrayType(ts[0] if ts else T.UNKNOWN))
+def _array_constructor(*vals, out_type: T.Type) -> Val:
+    if not vals:
+        raise NotImplementedError("empty ARRAY[] requires a typed context")
+    width = len(vals)
+    did = None
+    if isinstance(out_type.element, T.VarcharType):
+        # varchar elements may arrive with different dictionaries
+        # (literals intern as singletons) — remap all onto one
+        acc = vals[0]
+        for v in vals[1:]:
+            xa, xb, did = unify_dictionaries(acc, v)
+            acc = Val(xa, acc.valid, acc.type, did)
+        did = acc.dict_id
+        remapped = []
+        for v in vals:
+            x, _, _ = unify_dictionaries(v, acc)
+            remapped.append(x)
+        data = jnp.stack(remapped, axis=1)
+    else:
+        data = jnp.stack([v.data for v in vals], axis=1)
+    evalid = None
+    if any(v.valid is not None for v in vals):
+        evalid = jnp.stack([v.valid_mask() for v in vals], axis=1)
+    lengths = jnp.full(vals[0].data.shape[0], width, jnp.int32)
+    return Val(
+        data, None, out_type, did, lengths=lengths, elem_valid=evalid
+    )
+
+
+@register("split", lambda ts: T.ArrayType(T.VARCHAR))
+def _split(a: Val, delim: Val, *rest, out_type: T.Type) -> Val:
+    """split(str, delim [, limit]) -> array(varchar) via dictionary
+    host-eval: each dictionary entry splits once; codes/lengths are
+    per-entry lookup tables."""
+    d = a.dictionary
+    if d is None:
+        raise TypeError("varchar value lost its dictionary")
+    sep = _require_literal(delim, "split delimiter")
+    limit = int(_require_literal(rest[0], "split limit")) if rest else None
+    parts_per = [
+        (s.split(sep, limit - 1) if limit else s.split(sep)) for s in d
+    ]
+    width = max((len(p) for p in parts_per), default=1) or 1
+    out_dict = tuple(sorted({p for parts in parts_per for p in parts}))
+    index = {s: i for i, s in enumerate(out_dict)}
+    codes = np.zeros((len(d), width), np.int32)
+    lens = np.zeros(len(d), np.int32)
+    for i, parts in enumerate(parts_per):
+        lens[i] = len(parts)
+        for j, p in enumerate(parts):
+            codes[i, j] = index[p]
+    ctab = jnp.asarray(codes)
+    ltab = jnp.asarray(lens)
+    return Val(
+        ctab[a.data],
+        a.valid,
+        T.ArrayType(T.VARCHAR),
+        intern_dictionary(out_dict),
+        lengths=ltab[a.data],
+    )
+
+
+@register("cardinality", _bigint_infer)
+def _cardinality(a: Val, out_type: T.Type) -> Val:
+    if a.lengths is None:
+        raise TypeError("cardinality requires an array value")
+    return Val(a.lengths.astype(jnp.int64), a.valid, T.BIGINT)
+
+
+@register("element_at", _array_infer_element)
+def _element_at(a: Val, idx: Val, out_type: T.Type) -> Val:
+    """1-based access; negative indexes from the end; out of range -> NULL
+    (reference ArraySubscriptOperator errors on OOR, element_at nulls —
+    both spellings route here, with element_at's forgiving semantics)."""
+    if a.lengths is None:
+        raise TypeError("element_at requires an array value")
+    i64 = idx.data.astype(jnp.int64)
+    lens = a.lengths.astype(jnp.int64)
+    pos = jnp.where(i64 < 0, lens + i64, i64 - 1)
+    in_range = (pos >= 0) & (pos < lens)
+    safe = jnp.clip(pos, 0, max(a.data.shape[1] - 1, 0)).astype(jnp.int32)
+    data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
+    valid = and_valid(a.valid, idx.valid)
+    valid = and_valid(valid, in_range)
+    if a.elem_valid is not None:
+        ev = jnp.take_along_axis(a.elem_valid, safe[:, None], axis=1)[:, 0]
+        valid = and_valid(valid, ev)
+    return Val(data, valid, out_type, a.dict_id)
+
+
+def _array_elem_eq(a: Val, needle: Val, what: str):
+    """(eq, in_len) matrices for element-vs-needle comparison, handling
+    varchar dictionary mismatch (literal needles resolve against the
+    array's SORTED dictionary; the guard matches _literal_cmp_fastpath)."""
+    if a.lengths is None:
+        raise TypeError(f"{what} requires an array value")
+    width = a.data.shape[1]
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    in_len = pos < jnp.maximum(a.lengths, 0)[:, None]
+    if isinstance(needle.type, T.VarcharType) and needle.dict_id != a.dict_id:
+        require_sorted_dict(a, what)
+        s = _require_literal(needle, f"{what} needle")
+        d = a.dictionary
+        code = _bisect(d, s, "left")
+        if code < len(d) and d[code] == s:
+            eq = a.data == code
+        else:
+            eq = jnp.zeros(a.data.shape, dtype=jnp.bool_)
+    else:
+        eq = a.data == needle.data[:, None]
+    return eq, in_len
+
+
+@register("contains", _bool_infer)
+def _contains(a: Val, needle: Val, out_type: T.Type) -> Val:
+    eq, in_len = _array_elem_eq(a, needle, "contains")
+    null_elem = jnp.zeros(in_len.shape[0], jnp.bool_)
+    if a.elem_valid is not None:
+        eq = eq & a.elem_valid
+        null_elem = jnp.any(~a.elem_valid & in_len, axis=1)
+    hit = jnp.any(eq & in_len, axis=1)
+    # three-valued: not found but a NULL element present -> NULL
+    valid = and_valid(a.valid, needle.valid)
+    valid = and_valid(valid, hit | ~null_elem)
+    return Val(hit, valid, T.BOOLEAN)
+
+
+@register("array_position", _bigint_infer)
+def _array_position(a: Val, needle: Val, out_type: T.Type) -> Val:
+    """1-based index of the first match, 0 when absent."""
+    eq, in_len = _array_elem_eq(a, needle, "array_position")
+    if a.elem_valid is not None:
+        eq = eq & a.elem_valid
+    eq = eq & in_len
+    first = jnp.where(
+        jnp.any(eq, axis=1),
+        jnp.argmax(eq, axis=1).astype(jnp.int64) + 1,
+        0,
+    )
+    return Val(first, and_valid(a.valid, needle.valid), T.BIGINT)
+
+
+@register("sequence", lambda ts: T.ArrayType(ts[0]))
+def _sequence(a: Val, b: Val, *rest, out_type: T.Type) -> Val:
+    """sequence(start, stop [, step]) with literal bounds (static width)."""
+    start = int(_require_literal(a, "sequence start"))
+    stop = int(_require_literal(b, "sequence stop"))
+    if rest:
+        step = int(_require_literal(rest[0], "sequence step"))
+    else:
+        step = 1 if stop >= start else -1  # Presto: implicit descending
+    if step == 0:
+        raise ValueError("sequence step must be non-zero")
+    values = list(range(start, stop + (1 if step > 0 else -1), step))
+    if not values:
+        values = [start]
+        n_elem = 0
+    else:
+        n_elem = len(values)
+    cap = a.data.shape[0]
+    row = jnp.asarray(np.array(values, np.int64))
+    data = jnp.broadcast_to(row[None, :], (cap, len(values)))
+    lengths = jnp.full(cap, n_elem, jnp.int32)
+    return Val(data, None, out_type, lengths=lengths)

@@ -1,0 +1,75 @@
+"""UNNEST kernel: expand array-valued expressions into rows.
+
+Re-designed equivalent of the reference's UnnestOperator
+(presto-main/.../operator/UnnestOperator.java + UnnestNode planning):
+each input row repeats once per array position up to the row's max
+length across the unnested arrays (arrays zip; shorter ones null-pad),
+then the page compacts — the standard static-shape + mask + compaction
+pattern used engine-wide.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..expr.compiler import evaluate
+from ..page import Block, Page
+from .filter import compact
+
+
+def unnest_page(
+    page: Page,
+    array_exprs: Sequence,
+    elem_channels: Sequence[str],
+    ordinality_channel: Optional[str] = None,
+) -> Page:
+    cap = page.capacity
+    vals = [evaluate(e, page) for e in array_exprs]
+    for v in vals:
+        if v.lengths is None:
+            raise TypeError("UNNEST argument is not an array")
+    width = max(max(v.data.shape[1] for v in vals), 1)
+    live = page.live_mask()
+
+    # effective per-row element count: max over arrays, 0 for NULL arrays
+    total_len = jnp.zeros(cap, jnp.int32)
+    for v in vals:
+        ln = jnp.maximum(v.lengths, 0)
+        if v.valid is not None:
+            ln = jnp.where(v.valid, ln, 0)
+        total_len = jnp.maximum(total_len, ln)
+
+    n_out = cap * width
+    row_idx = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), width)
+    pos = jnp.tile(jnp.arange(width, dtype=jnp.int32), cap)
+    keep = live[row_idx] & (pos < total_len[row_idx])
+
+    blocks = []
+    names = []
+    for name, b in zip(page.names, page.blocks):
+        data = b.data[row_idx]
+        valid = None if b.valid is None else b.valid[row_idx]
+        blocks.append(Block(data, b.type, valid, b.dict_id))
+        names.append(name)
+    for v, ch in zip(vals, elem_channels):
+        w = v.data.shape[1]
+        safe = jnp.minimum(pos, w - 1)
+        data = v.data[row_idx, safe]
+        in_len = (pos < jnp.maximum(v.lengths, 0)[row_idx]) & (pos < w)
+        if v.valid is not None:
+            in_len = in_len & v.valid[row_idx]
+        valid = in_len
+        if v.elem_valid is not None:
+            valid = valid & v.elem_valid[row_idx, safe]
+        blocks.append(
+            Block(data, v.type.element, valid, v.dict_id)
+        )
+        names.append(ch)
+    if ordinality_channel is not None:
+        blocks.append(Block((pos + 1).astype(jnp.int64), T.BIGINT))
+        names.append(ordinality_channel)
+    expanded = Page(tuple(blocks), tuple(names), jnp.asarray(n_out, jnp.int32))
+    return compact(expanded, keep)

@@ -156,6 +156,11 @@ class Fragmenter:
     def _v_singlerow(self, node):
         return node, Partitioning(SINGLE)
 
+    def _v_unnest(self, node):
+        # row-local expansion: runs on whatever distribution the child has
+        child, dist = self._visit(node.child)
+        return dataclasses.replace(node, child=child), dist
+
     def _v_filter(self, node):
         child, dist = self._visit(node.child)
         return N.Filter(child, node.predicate), dist

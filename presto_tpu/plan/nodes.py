@@ -57,6 +57,32 @@ class TableScan(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Unnest(PlanNode):
+    """Expand array expressions into rows: child columns replicate per
+    element, arrays zip by position (reference UnnestNode +
+    operator/UnnestOperator.java). One element channel per array, plus an
+    optional 1-based ordinality channel."""
+
+    child: PlanNode
+    array_exprs: Tuple[RowExpression, ...]
+    elem_channels: Tuple[str, ...]
+    ordinality_channel: Optional[str] = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        out = list(self.child.fields)
+        for e, ch in zip(self.array_exprs, self.elem_channels):
+            out.append((ch, e.type.element))
+        if self.ordinality_channel is not None:
+            out.append((self.ordinality_channel, T.BIGINT))
+        return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
 class SingleRow(PlanNode):
     """Leaf producing exactly one row with a single dummy column. VALUES
     rows are planned as Project(SingleRow) per row, unioned (reference

@@ -39,6 +39,15 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
     if isinstance(node, N.SingleRow):
         return node
 
+    if isinstance(node, N.Unnest):
+        child_have = set(node.child.field_names())
+        child_needed = needed & child_have
+        for e in node.array_exprs:
+            _expr_channels(e, child_needed)
+        return dataclasses.replace(
+            node, child=prune_columns(node.child, child_needed)
+        )
+
     if isinstance(node, N.Filter):
         child_needed = set(needed)
         _expr_channels(node.predicate, child_needed)

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><=|>=|<>|!=|\|\||->|[=<>+\-*/%(),.;])
+  | (?P<op><=|>=|<>|!=|\|\||->|[=<>+\-*/%(),.;\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -497,6 +497,23 @@ class Parser:
         return rel
 
     def parse_primary_relation(self) -> t.Node:
+        if (
+            self.tok.kind == "ident"
+            and self.tok.text.lower() == "unnest"
+            and self.peek().kind == "("
+        ):
+            self.i += 1
+            exprs = tuple(self._parse_paren_exprs())
+            if not exprs:
+                self.error("UNNEST requires at least one argument")
+            ordinality = False
+            if self.at_kw("with"):
+                nxt = self.peek()
+                if nxt.kind == "ident" and nxt.text.lower() == "ordinality":
+                    self.i += 2
+                    ordinality = True
+            alias, col_aliases = self._parse_alias(required=False)
+            return t.Unnest(exprs, alias, col_aliases, ordinality)
         if self.accept("("):
             # subquery or parenthesized join tree
             if self.at_kw("select", "with", "values") or self.tok.kind == "(":
@@ -644,10 +661,31 @@ class Parser:
         if self.tok.kind == "+":
             self.i += 1
             return self.parse_unary()
-        return self.parse_primary()
+        node = self.parse_primary()
+        # postfix subscript: a[i] is 1-based element access, sugar for
+        # element_at (SqlBase.g4 subscript -> SubscriptExpression)
+        while self.tok.kind == "[":
+            self.i += 1
+            idx = self.parse_expr()
+            self.expect("]")
+            node = t.FunctionCall("element_at", (node, idx))
+        return node
 
     def parse_primary(self) -> t.Node:
         tok = self.tok
+        if (
+            tok.kind == "ident"
+            and tok.text.lower() == "array"
+            and self.peek().kind == "["
+        ):
+            self.i += 2
+            items = []
+            if self.tok.kind != "]":
+                items.append(self.parse_expr())
+                while self.accept(","):
+                    items.append(self.parse_expr())
+            self.expect("]")
+            return t.ArrayLiteral(tuple(items))
         if tok.kind == "number":
             self.i += 1
             return t.NumberLiteral(tok.text)

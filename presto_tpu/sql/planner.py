@@ -1391,8 +1391,13 @@ class FromPlanner:
         self.pool: List[PoolItem] = []
         self.subquery_conjuncts: List[t.Node] = []
         self._pending_on: List[t.Node] = []
+        self.unnests: List[t.Unnest] = []
 
     def add_relation(self, rel: t.Node):
+        if isinstance(rel, t.Unnest):
+            # lateral: applies to the joined FROM result (assemble())
+            self.unnests.append(rel)
+            return
         if isinstance(rel, t.Join) and rel.kind in ("cross", "inner"):
             self.add_relation(rel.left)
             self.add_relation(rel.right)
@@ -1492,11 +1497,63 @@ class FromPlanner:
 
     def assemble(self, where: Optional[t.Node]) -> Tuple[N.PlanNode, Scope]:
         if not self.pool:
-            raise PlanningError("SELECT without FROM not yet supported")
+            if not self.unnests:
+                raise PlanningError("SELECT without FROM not yet supported")
+            # UNNEST of constants: expand over a one-row base
+            leaf = N.SingleRow(self.p.channel("singlerow"))
+            self.pool.append(
+                PoolItem(
+                    RelationPlan(leaf, Scope([])), set(), 1.0
+                )
+            )
 
         combined = Scope([f for it in self.pool for f in it.plan.scope.fields])
         combined_chs = {f.channel for f in combined.fields}
         ctx = SelectContext(self.p, [combined], self.outer, self.ctes, None)
+
+        # plan UNNEST relations against the joined FROM scope; their
+        # output fields join the visible scope, and conjuncts referencing
+        # them apply after the expansion
+        unnest_specs = []  # (array_exprs, elem_channels, ord_channel)
+        unnest_chs: set = set()
+        unnest_fields: List[FieldRef] = []
+        for un in self.unnests:
+            exprs = tuple(ctx.translate(a) for a in un.exprs)
+            for e in exprs:
+                if not isinstance(e.type, T.ArrayType):
+                    raise PlanningError(
+                        f"UNNEST argument must be an array, got {e.type}"
+                    )
+            n_cols = len(exprs) + (1 if un.ordinality else 0)
+            if un.column_aliases and len(un.column_aliases) != n_cols:
+                raise PlanningError(
+                    f"UNNEST alias has {len(un.column_aliases)} columns, "
+                    f"expected {n_cols}"
+                )
+            names = list(un.column_aliases) or [
+                f"_unnest{i}" for i in range(n_cols)
+            ]
+            chans = tuple(self.p.channel(nm) for nm in names[: len(exprs)])
+            ord_ch = None
+            if un.ordinality:
+                ord_ch = self.p.channel(names[-1])
+            unnest_specs.append((exprs, chans, ord_ch))
+            alias = un.alias
+            for nm, ch, e in zip(names, chans, exprs):
+                unnest_fields.append(
+                    FieldRef(alias, nm, ch, e.type.element)
+                )
+                unnest_chs.add(ch)
+            if ord_ch is not None:
+                unnest_fields.append(
+                    FieldRef(alias, names[-1], ord_ch, T.BIGINT)
+                )
+                unnest_chs.add(ord_ch)
+        post_unnest_filters: List[ir.RowExpression] = []
+        if unnest_fields:
+            combined = Scope(list(combined.fields) + unnest_fields)
+            combined_chs = combined_chs | unnest_chs
+            ctx = SelectContext(self.p, [combined], self.outer, self.ctes, None)
 
         conjuncts = extract_common_or_conjuncts(
             self._pending_on + split_conjuncts(where)
@@ -1515,6 +1572,9 @@ class FromPlanner:
                 # correlated conjunct: record on the enclosing subquery
                 # collector and keep it OUT of the local plan
                 self._record_correlation(e, refs, combined_chs)
+                continue
+            if refs & unnest_chs:
+                post_unnest_filters.append(e)
                 continue
             owners = {
                 i for i, it in enumerate(self.pool) if refs & it.channels
@@ -1540,13 +1600,20 @@ class FromPlanner:
                     continue
             residuals.append((owners, e))
 
+        def finish(plan: N.PlanNode) -> Tuple[N.PlanNode, Scope]:
+            for exprs, chans, ord_ch in unnest_specs:
+                plan = N.Unnest(plan, exprs, chans, ord_ch)
+            for e in post_unnest_filters:
+                plan = N.Filter(plan, e)
+            return plan, combined
+
         # greedy assembly
         n_items = len(self.pool)
         if n_items == 1:
             plan = self.pool[0].plan.node
             for owners, e in residuals:
                 plan = N.Filter(plan, e)
-            return plan, combined
+            return finish(plan)
 
         remaining = set(range(n_items))
         start = min(remaining, key=lambda i: self.pool[i].estimate)
@@ -1603,7 +1670,7 @@ class FromPlanner:
         for k, (owners, e) in enumerate(residuals):
             if k not in applied_res:
                 plan = N.Filter(plan, e)
-        return plan, combined
+        return finish(plan)
 
     def _record_correlation(self, e: ir.RowExpression, refs: set, inner_chs: set):
         """Route a conjunct referencing outer channels to the enclosing
@@ -1775,6 +1842,11 @@ class SelectContext:
         if isinstance(ast, t.UnaryOp):
             v = self._tr(ast.operand)
             if ast.op == "-":
+                if isinstance(v, ir.Literal) and isinstance(
+                    v.value, (int, float)
+                ):
+                    # fold so literal-argument functions see -n as a literal
+                    return ir.Literal(-v.value, v.type)
                 return ir.Call("negate", (v,), v.type)
             return v
         if isinstance(ast, t.BinaryOp):
@@ -1857,6 +1929,19 @@ class SelectContext:
             if ast.field not in ("year", "month", "day", "quarter"):
                 raise PlanningError(f"extract({ast.field}) not supported")
             return ir.Call(ast.field, (v,), T.BIGINT)
+        if isinstance(ast, t.ArrayLiteral):
+            if not ast.items:
+                raise PlanningError("empty ARRAY[] requires a typed context")
+            items = [self._tr(x) for x in ast.items]
+            ct = items[0].type
+            for x in items[1:]:
+                ct = T.common_super_type(ct, x.type)
+            items = [
+                x if x.type == ct else ir.cast(x, ct) for x in items
+            ]
+            return ir.Call(
+                "array_constructor", tuple(items), T.ArrayType(ct)
+            )
         if isinstance(ast, t.FunctionCall):
             return self._function(ast)
         if isinstance(ast, t.ScalarSubquery):

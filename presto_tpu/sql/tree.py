@@ -329,3 +329,21 @@ class GroupingSets(Node):
     (reference sql/tree/GroupingSets.java, Rollup.java, Cube.java)."""
 
     sets: Tuple[Tuple[Node, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Node):
+    """ARRAY[e1, e2, ...] (reference sql/tree/ArrayConstructor.java)."""
+
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Unnest(Node):
+    """UNNEST(a1, ...) [WITH ORDINALITY] [alias(cols)] relation
+    (reference sql/tree/Unnest.java; multiple arrays zip by position)."""
+
+    exprs: Tuple[Node, ...]
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+    ordinality: bool = False

@@ -276,6 +276,32 @@ class UnknownType(Type):
         return jnp.bool_
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(element) (reference spi/type/ArrayType.java). TPU-first
+    representation: arrays exist during EXPRESSION evaluation only — a
+    Val whose data is (capacity, width) with per-row lengths (width is the
+    trace-static max; -1 length marks a NULL array). They are consumed by
+    UNNEST / array functions before page materialization; array-typed
+    table columns are not supported."""
+
+    element: Type = None  # type: ignore[assignment]
+    name: ClassVar[str] = "array"
+
+    @property
+    def storage_dtype(self):
+        return self.element.storage_dtype
+
+    def display(self) -> str:
+        return f"array({self.element})"
+
+    def to_python(self, storage_value, dictionary=None):
+        raise TypeError(
+            "array values cannot be materialized into result rows; "
+            "UNNEST or aggregate them first"
+        )
+
+
 # Singletons
 BIGINT = BigintType()
 INTEGER = IntegerType()
@@ -351,6 +377,8 @@ def parse_type(text: str) -> Type:
         return VarcharType(max_length=int(s[len("varchar(") : -1]))
     if s.startswith("char(") and s.endswith(")"):
         return CharType(max_length=int(s[len("char(") : -1]))
+    if s.startswith("array(") and s.endswith(")"):
+        return ArrayType(parse_type(s[len("array(") : -1]))
     raise ValueError(f"unknown type: {text!r}")
 
 

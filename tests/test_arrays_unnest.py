@@ -1,0 +1,151 @@
+"""Arrays + UNNEST (reference: spi/type/ArrayType.java, sql/tree/Unnest,
+operator/UnnestOperator.java, operator/scalar/ArrayFunctions +
+StringFunctions.split). Arrays live in expressions only — see
+types.ArrayType docstring."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(MemoryCatalog({}))
+    s.query("create table t (id bigint, csv varchar)")
+    s.query("insert into t values (1, 'a,b'), (2, 'c'), (3, ''), (4, null)")
+    return s
+
+
+def test_unnest_literal_array(sess):
+    assert sess.query(
+        "select x from unnest(array[10, 20, 30]) u(x) order by 1"
+    ).rows() == [(10,), (20,), (30,)]
+
+
+def test_unnest_with_ordinality(sess):
+    assert sess.query(
+        "select x, o from unnest(array[5, 6]) with ordinality u(x, o)"
+        " order by o desc"
+    ).rows() == [(6, 2), (5, 1)]
+
+
+def test_cross_join_unnest_split(sess):
+    got = sess.query(
+        "select id, part from t cross join unnest(split(csv, ',')) u(part)"
+        " order by 1, 2"
+    ).rows()
+    # empty string splits to [''], NULL input contributes no rows
+    assert got == [(1, "a"), (1, "b"), (2, "c"), (3, "")]
+
+
+def test_unnest_zip_two_arrays(sess):
+    got = sess.query(
+        "select a, b from unnest(array[1, 2, 3], array[10, 20]) u(a, b)"
+        " order by 1"
+    ).rows()
+    assert got == [(1, 10), (2, 20), (3, None)]
+
+
+def test_cardinality_element_at_contains(sess):
+    assert sess.query(
+        "select cardinality(split(csv, ',')) from t order by id"
+    ).rows() == [(2,), (1,), (1,), (None,)]
+    assert sess.query(
+        "select element_at(split(csv, ','), 1) from t order by id"
+    ).rows() == [("a",), ("c",), ("",), (None,)]
+    assert sess.query(
+        "select element_at(array[7, 8], -1) from (values (1)) v(d)"
+    ).rows() == [(8,)]
+    assert sess.query(
+        "select element_at(array[7, 8], 9) from (values (1)) v(d)"
+    ).rows() == [(None,)]
+    assert sess.query(
+        "select contains(split(csv, ','), 'b') from t order by id"
+    ).rows() == [(True,), (False,), (False,), (None,)]
+
+
+def test_subscript_and_position(sess):
+    assert sess.query(
+        "select array[1,2,3][2] from (values (1)) v(d)"
+    ).rows() == [(2,)]
+    assert sess.query(
+        "select array_position(array[5,6,7], 7),"
+        " array_position(array[5,6,7], 9) from (values (1)) v(d)"
+    ).rows() == [(3, 0)]
+
+
+def test_sequence_and_filter_on_unnest(sess):
+    assert sess.query(
+        "select n from unnest(sequence(1, 5)) u(n) where n % 2 = 1 order by 1"
+    ).rows() == [(1,), (3,), (5,)]
+    assert sess.query(
+        "select n from unnest(sequence(10, 2, -4)) u(n) order by 1"
+    ).rows() == [(2,), (6,), (10,)]
+
+
+def test_array_with_null_elements(sess):
+    got = sess.query(
+        "select x from unnest(array[1, null, 3]) u(x) order by 1"
+    ).rows()
+    assert got == [(1,), (3,), (None,)]
+
+
+def test_aggregate_over_unnest(sess):
+    got = sess.query(
+        "select part, count(*) c from t"
+        " cross join unnest(split(csv, ',')) u(part)"
+        " group by part order by part"
+    ).rows()
+    assert got == [("", 1), ("a", 1), ("b", 1), ("c", 1)]
+
+
+def test_array_in_result_is_clear_error(sess):
+    with pytest.raises(Exception, match="array"):
+        sess.query("select array[1,2] from (values (1)) v(d)").rows()
+
+
+def test_unnest_distributed():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from presto_tpu.connectors.tpch import TpchCatalog
+
+    mesh = Mesh(np.array(devs[:8]), ("workers",))
+    d = Session(TpchCatalog(sf=0.002), mesh=mesh)
+    l = Session(TpchCatalog(sf=0.002))
+    sql = (
+        "select part, count(*) c from orders"
+        " cross join unnest(split(o_orderpriority, '-')) u(part)"
+        " group by part order by part"
+    )
+    assert d.query(sql).rows() == l.query(sql).rows()
+
+
+def test_array_literal_varchar_dictionaries_unify(sess):
+    got = sess.query(
+        "select x from unnest(array['a', 'b']) u(x) order by 1"
+    ).rows()
+    assert got == [("a",), ("b",)]
+    assert sess.query(
+        "select array_position(split(csv, ','), 'b') from t order by id"
+    ).rows() == [(2,), (0,), (0,), (None,)]
+
+
+def test_contains_three_valued(sess):
+    assert sess.query(
+        "select contains(array[1, null], 2) from (values (1)) v(d)"
+    ).rows() == [(None,)]
+    assert sess.query(
+        "select contains(array[1, null], 1) from (values (1)) v(d)"
+    ).rows() == [(True,)]
+
+
+def test_sequence_descending_default(sess):
+    assert sess.query(
+        "select n from unnest(sequence(5, 1)) u(n) order by 1"
+    ).rows() == [(1,), (2,), (3,), (4,), (5,)]
