@@ -1,0 +1,183 @@
+"""Local-file connector: query CSV / JSONL files as tables.
+
+Re-designed equivalent of presto-local-file (presto-local-file/src/main/
+java/...) combined with the row decoders of presto-record-decoder
+(csv/json decoders shared by the kafka/redis connectors). A directory is
+a catalog: every *.csv / *.tsv / *.jsonl file is a table named after the
+file stem. Schemas are inferred from the data (or supplied explicitly);
+columns load once into device Pages and are cached, so repeated queries
+scan device-resident data like every other connector.
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page
+from .spi import Connector
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _infer_type(values: Sequence[Optional[str]]) -> T.Type:
+    """Widest type that parses every non-null sample."""
+    seen = [v for v in values if v is not None and v != ""]
+    if not seen:
+        return T.VARCHAR
+
+    def all_match(fn) -> bool:
+        try:
+            for v in seen:
+                fn(v)
+            return True
+        except (ValueError, TypeError):
+            return False
+
+    if all_match(int):
+        return T.BIGINT
+    if all_match(float):
+        return T.DOUBLE
+    if all_match(datetime.date.fromisoformat):
+        return T.DATE
+    lowered = {str(v).lower() for v in seen}
+    if lowered <= {"true", "false"}:
+        return T.BOOLEAN
+    return T.VARCHAR
+
+
+def _to_block(values: List, typ: T.Type) -> Block:
+    nulls = [v is None or v == "" for v in values]
+    any_null = any(nulls)
+    valid = None if not any_null else np.array([not x for x in nulls], np.bool_)
+    if isinstance(typ, T.VarcharType):
+        return Block.from_strings(
+            [None if n else str(v) for v, n in zip(values, nulls)]
+        )
+    if isinstance(typ, T.DateType):
+        data = np.array(
+            [
+                0 if n else (datetime.date.fromisoformat(str(v)) - _EPOCH).days
+                for v, n in zip(values, nulls)
+            ],
+            np.int32,
+        )
+        return Block.from_numpy(data, typ, valid)
+    if isinstance(typ, T.BooleanType):
+        data = np.array(
+            [False if n else str(v).lower() == "true" for v, n in zip(values, nulls)],
+            np.bool_,
+        )
+        return Block.from_numpy(data, typ, valid)
+    if T.is_floating(typ):
+        data = np.array(
+            [0.0 if n else float(v) for v, n in zip(values, nulls)], np.float64
+        )
+        return Block.from_numpy(data, typ, valid)
+    data = np.array(
+        [0 if n else int(v) for v, n in zip(values, nulls)], np.int64
+    )
+    return Block.from_numpy(data, typ, valid)
+
+
+def read_csv(path: str, delimiter: Optional[str] = None) -> Tuple[List[str], List[List]]:
+    if delimiter is None:
+        delimiter = "\t" if path.endswith(".tsv") else ","
+    with open(path, newline="") as f:
+        reader = csv.reader(f, delimiter=delimiter)
+        rows = list(reader)
+    if not rows:
+        return [], []
+    header, data = rows[0], rows[1:]
+    cols = [[r[i] if i < len(r) else None for r in data] for i in range(len(header))]
+    return header, cols
+
+
+def read_jsonl(path: str) -> Tuple[List[str], List[List]]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    names: List[str] = []
+    for r in records:
+        for k in r:
+            if k not in names:
+                names.append(k)
+    cols = [[r.get(k) for r in records] for k in names]
+    return names, cols
+
+
+class LocalFileCatalog(Connector):
+    """tables: file stem -> path; schemas inferred at first load and
+    overridable via `schemas={'table': {'col': Type}}`."""
+
+    name = "localfile"
+
+    def __init__(self, directory: str, schemas: Optional[Dict] = None):
+        self.directory = directory
+        self.schemas_override = schemas or {}
+        self._paths: Dict[str, str] = {}
+        for fname in sorted(os.listdir(directory)):
+            stem, ext = os.path.splitext(fname)
+            if ext.lower() in (".csv", ".tsv", ".jsonl"):
+                key = stem.lower()
+                if key in self._paths:
+                    raise ValueError(
+                        f"duplicate table name {key!r}: "
+                        f"{os.path.basename(self._paths[key])} and {fname}"
+                    )
+                self._paths[key] = os.path.join(directory, fname)
+        self._pages: Dict[str, Page] = {}
+
+    def table_names(self) -> List[str]:
+        return list(self._paths)
+
+    def _load(self, table: str) -> Page:
+        pg = self._pages.get(table)
+        if pg is not None:
+            return pg
+        path = self._paths[table]
+        if path.endswith(".jsonl"):
+            names, cols = read_jsonl(path)
+        else:
+            names, cols = read_csv(path)
+        override = self.schemas_override.get(table, {})
+        blocks = []
+        lowered = [n.lower() for n in names]
+        for n, c in zip(lowered, cols):
+            # values normalize to strings here; JSONL values arrive typed
+            strs = [None if v is None else str(v) for v in c]
+            typ = override.get(n)
+            if typ is None:
+                typ = _infer_type(strs[:1000])
+            try:
+                blocks.append(_to_block(strs, typ))
+            except (ValueError, TypeError):
+                if n in override:
+                    raise  # explicit schema: surface the bad value
+                # inference sampled a clean prefix; fall back to varchar
+                blocks.append(_to_block(strs, T.VARCHAR))
+        pg = Page.from_blocks(blocks, lowered, count=len(cols[0]) if cols else 0)
+        self._pages[table] = pg
+        return pg
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        pg = self._load(table)
+        return {n: b.type for n, b in zip(pg.names, pg.blocks)}
+
+    def row_count(self, table: str) -> int:
+        return int(self._load(table).count)
+
+    def unique_columns(self, table: str):
+        return []
+
+    def page(self, table: str) -> Page:
+        return self._load(table)

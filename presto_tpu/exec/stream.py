@@ -295,7 +295,9 @@ class StreamingExecutor:
             hints = _pushdown_hints(node.predicate, node.child)
             for batch in self._stream_scan(node.child, predicate=hints):
                 yield self.local.exec_node(node, batch)
-        elif isinstance(node, (N.Filter, N.Project)):
+        elif isinstance(node, (N.Filter, N.Project, N.Unnest)):
+            # all row-local and stateless: apply per batch (Unnest expands
+            # within the batch, keeping the device-memory budget honest)
             for batch in self.stream(node.child):
                 yield self.local.exec_node(node, batch)
         elif isinstance(node, N.Join) and node.kind in ("inner", "left") and not (

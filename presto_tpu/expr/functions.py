@@ -1976,12 +1976,16 @@ def _sequence(a: Val, b: Val, *rest, out_type: T.Type) -> Val:
         step = 1 if stop >= start else -1  # Presto: implicit descending
     if step == 0:
         raise ValueError("sequence step must be non-zero")
+    if (stop - start) * step < 0:
+        # reference SequenceFunction: step must move toward stop
+        raise ValueError(
+            f"sequence step {step} cannot reach stop {stop} from {start}"
+        )
     values = list(range(start, stop + (1 if step > 0 else -1), step))
+    n_elem = len(values)
     if not values:
         values = [start]
         n_elem = 0
-    else:
-        n_elem = len(values)
     cap = a.data.shape[0]
     row = jnp.asarray(np.array(values, np.int64))
     data = jnp.broadcast_to(row[None, :], (cap, len(values)))

@@ -149,3 +149,25 @@ def test_sequence_descending_default(sess):
     assert sess.query(
         "select n from unnest(sequence(5, 1)) u(n) order by 1"
     ).rows() == [(1,), (2,), (3,), (4,), (5,)]
+
+
+def test_sequence_wrong_direction_errors(sess):
+    with pytest.raises(Exception, match="sequence step"):
+        sess.query("select n from unnest(sequence(1, 5, -1)) u(n)")
+
+
+def test_unnest_streams_per_batch():
+    from presto_tpu.connectors.tpch import TpchCatalog
+
+    s = Session(TpchCatalog(sf=0.002), streaming=True, batch_rows=256)
+    got = s.query(
+        "select part, count(*) c from orders"
+        " cross join unnest(split(o_orderpriority, '-')) u(part)"
+        " group by part order by part limit 3"
+    ).rows()
+    ref = Session(TpchCatalog(sf=0.002)).query(
+        "select part, count(*) c from orders"
+        " cross join unnest(split(o_orderpriority, '-')) u(part)"
+        " group by part order by part limit 3"
+    ).rows()
+    assert got == ref
