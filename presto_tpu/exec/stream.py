@@ -491,7 +491,12 @@ class StreamingExecutor:
         return self.stream(node.child)
 
     def _sink_aggregate(self, node: N.Aggregate) -> Page:
-        partial, final, post = decompose_partial(node.aggs)
+        try:
+            partial, final, post = decompose_partial(node.aggs)
+        except KeyError:
+            # non-decomposable (min_by/max_by): aggregate the materialized
+            # input in one pass (same choice the fragmenter makes)
+            return self._exec_fallback(node)
         if not node.group_exprs:
             partials: List[Page] = []
             for batch in self._agg_input_stream(node):

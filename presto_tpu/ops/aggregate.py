@@ -40,23 +40,29 @@ from ..expr.functions import Val
 from ..page import Block, Page
 from .hashing import hash_rows
 
-SUPPORTED = ("count", "count_star", "sum", "min", "max", "avg", "checksum")
+SUPPORTED = (
+    "count", "count_star", "sum", "min", "max", "avg", "checksum",
+    "min_by", "max_by",
+)
 
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
-    """One aggregate: func(input_expr) AS name."""
+    """One aggregate: func(input_expr [, key_expr]) AS name. `input2` is
+    the ordering key of min_by/max_by (reference
+    operator/aggregation/MinMaxByAggregations)."""
 
     func: str  # one of SUPPORTED
     input: Optional[object]  # RowExpression; None for count_star
     name: str
     output_type: T.Type
+    input2: Optional[object] = None
 
     @staticmethod
     def infer_output_type(func: str, input_type: Optional[T.Type]) -> T.Type:
         if func in ("count", "count_star", "checksum"):
             return T.BIGINT
-        if func in ("min", "max"):
+        if func in ("min", "max", "min_by", "max_by"):
             return input_type
         if func == "sum":
             if isinstance(input_type, T.DecimalType):
@@ -242,6 +248,70 @@ def _eval_inputs(page: Page, group_exprs, aggs):
     return keys, ins
 
 
+def _eval_by_keys(page: Page, aggs):
+    """Ordering keys for min_by/max_by (AggSpec.input2), aligned with aggs."""
+    out = []
+    for a in aggs:
+        if a.input2 is None:
+            out.append(None)
+            continue
+        k = evaluate(a.input2, page)
+        if isinstance(k.type, T.VarcharType):
+            from ..expr.functions import require_sorted_dict
+
+            require_sorted_dict(k, f"{a.func} ordering key")
+        if k.data.ndim == 2:
+            raise NotImplementedError(
+                f"{a.func} over a long-decimal ordering key"
+            )
+        out.append(k)
+    return out
+
+
+def _reduce_by(func, value: Val, key: Val, contributes, gid, num_groups: int):
+    """min_by/max_by: per group, the value at the extreme ordering key.
+
+    Two reductions + a representative-row gather — no scatter beyond the
+    engine's .at[].min index trick: (1) best key per group, (2) first row
+    index attaining it, then gather the value column at those rows."""
+    n = key.data.shape[0]
+    kc = contributes if key.valid is None else (contributes & key.valid)
+    if jnp.issubdtype(key.data.dtype, jnp.floating):
+        # NaN keys poison the scatter-min/max (NaN != NaN breaks the
+        # candidate match below); treat them like NULL keys
+        kc = kc & ~jnp.isnan(key.data)
+    ident = (
+        _min_identity(key.data.dtype)
+        if func == "min_by"
+        else _max_identity(key.data.dtype)
+    )
+    kdat = jnp.where(kc, key.data, ident)
+    best = (
+        jnp.full((num_groups,), ident, kdat.dtype)
+        .at[gid]
+        .min(kdat, mode="drop")
+        if func == "min_by"
+        else jnp.full((num_groups,), ident, kdat.dtype)
+        .at[gid]
+        .max(kdat, mode="drop")
+    )
+    has = (
+        jnp.zeros((num_groups,), jnp.int32)
+        .at[gid]
+        .max(kc.astype(jnp.int32), mode="drop")
+        > 0
+    )
+    candidate = kc & (kdat == best[jnp.minimum(gid, num_groups - 1)])
+    ridx = jnp.where(candidate, jnp.arange(n, dtype=jnp.int32), n)
+    first = (
+        jnp.full((num_groups,), n, jnp.int32).at[gid].min(ridx, mode="drop")
+    )
+    first = jnp.minimum(first, n - 1)
+    vdat = value.data[first]
+    vval = has if value.valid is None else (has & value.valid[first])
+    return vdat, vval
+
+
 def _masked_live(page: Page, pre_mask) -> jnp.ndarray:
     """Liveness restricted by a fused selection mask (Aggregate.mask)."""
     live = page.live_mask()
@@ -406,7 +476,20 @@ def grouped_aggregate_direct(
         blocks.append(Block(kdata.astype(v.data.dtype), v.type, kvalid, v.dict_id))
         names.append(name)
 
-    for spec, v in zip(aggs, ins):
+    by_keys = _eval_by_keys(page, aggs)
+    for spec, v, bk in zip(aggs, ins, by_keys):
+        if spec.func in ("min_by", "max_by"):
+            vdat, vval = _reduce_by(spec.func, v, bk, live, gid, num_groups + 1)
+            blocks.append(
+                Block(
+                    vdat[:num_groups].astype(spec.output_type.storage_dtype),
+                    spec.output_type,
+                    vval[:num_groups],
+                    v.dict_id,
+                )
+            )
+            names.append(spec.name)
+            continue
         contributes = _agg_contributes(v, live)
         data = None if v is None else v.data
         if data is None:
@@ -501,7 +584,34 @@ def grouped_aggregate_sorted(
         blocks.append(Block(kdata, v.type, kvalid, v.dict_id))
         names.append(name)
 
-    for spec, v in zip(aggs, ins):
+    by_keys = _eval_by_keys(page, aggs)
+    for spec, v, bk in zip(aggs, ins, by_keys):
+        if spec.func in ("min_by", "max_by"):
+            v_sorted = Val(
+                v.data[order],
+                None if v.valid is None else v.valid[order],
+                v.type,
+                v.dict_id,
+            )
+            k_sorted = Val(
+                bk.data[order],
+                None if bk.valid is None else bk.valid[order],
+                bk.type,
+                bk.dict_id,
+            )
+            vdat, vval = _reduce_by(
+                spec.func, v_sorted, k_sorted, live_s, gid_s, max_groups + 1
+            )
+            blocks.append(
+                Block(
+                    vdat[:max_groups].astype(spec.output_type.storage_dtype),
+                    spec.output_type,
+                    vval[:max_groups],
+                    v.dict_id,
+                )
+            )
+            names.append(spec.name)
+            continue
         if v is None:
             v_s = None
             data_s = jnp.zeros(page.capacity, jnp.int64)
@@ -610,9 +720,22 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
     AggregationOperator)."""
     live = _masked_live(page, pre_mask)
     _, ins = _eval_inputs(page, (), aggs)
+    by_keys = _eval_by_keys(page, aggs)
     blocks, names = [], []
     gid = jnp.zeros(page.capacity, jnp.int32)
-    for spec, v in zip(aggs, ins):
+    for spec, v, bk in zip(aggs, ins, by_keys):
+        if spec.func in ("min_by", "max_by"):
+            vdat, vval = _reduce_by(spec.func, v, bk, live, gid, 1)
+            blocks.append(
+                Block(
+                    vdat.astype(spec.output_type.storage_dtype),
+                    spec.output_type,
+                    vval,
+                    v.dict_id,
+                )
+            )
+            names.append(spec.name)
+            continue
         contributes = _agg_contributes(v, live)
         data = jnp.zeros(page.capacity, jnp.int64) if v is None else v.data
         # mask-reduce: a single-segment segment_sum is the worst-case

@@ -346,7 +346,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0, collector=None) -> str:
         detail = f" [{', '.join(f'{n} := {e}' for n, e in zip(node.names, node.exprs))}]"
     elif isinstance(node, Aggregate):
         keys = ", ".join(node.group_names)
-        aggs = ", ".join(f"{a.name} := {a.func}({a.input})" for a in node.aggs)
+        aggs = ", ".join(
+            f"{a.name} := {a.func}({a.input}, {a.input2})"
+            if a.input2 is not None
+            else f"{a.name} := {a.func}({a.input})"
+            for a in node.aggs
+        )
         detail = f" [keys: {keys}] [{aggs}]"
         if node.mask is not None:
             detail += f" [mask: {node.mask}]"

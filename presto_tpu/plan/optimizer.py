@@ -79,6 +79,8 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
         for a in keep_aggs:
             if a.input is not None:
                 _expr_channels(a.input, child_needed)
+            if a.input2 is not None:
+                _expr_channels(a.input2, child_needed)
         if node.mask is not None:
             _expr_channels(node.mask, child_needed)
         child = prune_columns(node.child, child_needed)

@@ -36,7 +36,10 @@ from ..ops.sort import SortKey
 from ..plan import nodes as N
 from . import tree as t
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max", "checksum", "approx_distinct"}
+AGG_FUNCS = {
+    "count", "sum", "avg", "min", "max", "checksum", "approx_distinct",
+    "min_by", "max_by",
+}
 
 # aggregates planned by rewriting onto the core set (reference: many of
 # operator/aggregation/*'s 100+ functions decompose into sum/count states)
@@ -960,6 +963,22 @@ class Planner:
                     spec = AggSpec(
                         "count_star", None, self.channel("count"), T.BIGINT
                     )
+            elif fname in ("min_by", "max_by"):
+                if len(call.args) != 2:
+                    raise PlanningError(f"{fname} takes 2 arguments")
+                if call.distinct:
+                    raise PlanningError(f"{fname} does not support DISTINCT")
+                e = sctx.translate(call.args[0])
+                k = sctx.translate(call.args[1])
+                if filt is not None:
+                    # null ordering keys never contribute, so FILTER masks
+                    # the key
+                    k = ir.Call(
+                        "if", (filt, k, ir.Literal(None, k.type)), k.type
+                    )
+                spec = AggSpec(
+                    fname, e, self.channel(fname), e.type, input2=k
+                )
             else:
                 (arg,) = call.args
                 e = sctx.translate(arg)

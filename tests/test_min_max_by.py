@@ -1,0 +1,113 @@
+"""min_by / max_by aggregates (reference
+operator/aggregation/MinMaxByAggregations + MaxByNAggregation family)."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(MemoryCatalog({}))
+    s.query("create table t (g varchar, name varchar, score bigint)")
+    s.query(
+        "insert into t values ('a','alice',10),('a','bob',30),"
+        "('b','carol',5),('b','dan',null),('b',null,7)"
+    )
+    return s
+
+
+def test_grouped(sess):
+    got = sess.query(
+        "select g, max_by(name, score), min_by(name, score) from t"
+        " group by g order by g"
+    ).rows()
+    # group b: highest non-null score is 7, whose name is NULL
+    assert got == [("a", "bob", "alice"), ("b", None, "carol")]
+
+
+def test_global_and_varchar_key(sess):
+    assert sess.query("select max_by(name, score) from t").rows() == [("bob",)]
+    assert sess.query("select min_by(score, name) from t").rows() == [(10,)]
+
+
+def test_null_keys_ignored(sess):
+    # dan's NULL score never wins
+    got = sess.query(
+        "select max_by(name, score) from t where g = 'b'"
+    ).rows()
+    assert got == [(None,)]  # score 7 belongs to the NULL name
+
+
+def test_filter_clause(sess):
+    got = sess.query(
+        "select max_by(name, score) filter (where g = 'a') from t"
+    ).rows()
+    assert got == [("bob",)]
+
+
+def test_empty_group_is_null(sess):
+    got = sess.query(
+        "select max_by(name, score) from t where score > 999"
+    ).rows()
+    assert got == [(None,)]
+
+
+def test_decimal_value_and_date_key():
+    s = Session(TpchCatalog(sf=0.002))
+    got = s.query(
+        "select o_orderpriority, min_by(o_totalprice, o_orderdate) p"
+        " from orders group by 1 order by 1 limit 2"
+    ).rows()
+    assert len(got) == 2 and all(r[1] is not None for r in got)
+
+
+def test_streaming_falls_back():
+    s = Session(TpchCatalog(sf=0.002), streaming=True, batch_rows=512)
+    ref = Session(TpchCatalog(sf=0.002))
+    sql = (
+        "select o_orderpriority, max_by(o_orderkey, o_totalprice) from orders"
+        " group by 1 order by 1"
+    )
+    assert s.query(sql).rows() == ref.query(sql).rows()
+
+
+def test_distributed_gathers():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(devs[:8]), ("workers",))
+    d = Session(TpchCatalog(sf=0.002), mesh=mesh)
+    ref = Session(TpchCatalog(sf=0.002))
+    sql = (
+        "select o_orderpriority, max_by(o_orderkey, o_totalprice) from orders"
+        " group by 1 order by 1"
+    )
+    assert d.query(sql).rows() == ref.query(sql).rows()
+
+
+def test_arity_and_distinct_errors(sess):
+    with pytest.raises(Exception, match="2 arguments"):
+        sess.query("select min_by(score) from t")
+    with pytest.raises(Exception, match="DISTINCT"):
+        sess.query("select min_by(distinct name, score) from t")
+
+
+def test_nan_ordering_keys_excluded(sess):
+    got = sess.query(
+        "select g, max_by(name, case when name = 'bob' then nan()"
+        " else score + 0e0 end) from t group by g order by g"
+    ).rows()
+    # bob's NaN key never contributes; alice (10) wins group a
+    assert got[0] == ("a", "alice")
+
+
+def test_explain_shows_ordering_key(sess):
+    plan = sess.explain("select min_by(name, score) from t")
+    assert "min_by" in plan and "score" in plan
