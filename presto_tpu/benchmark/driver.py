@@ -1,0 +1,99 @@
+"""Benchmark driver: run SQL suites against a live coordinator and report
+wall-clock percentiles.
+
+Re-designed equivalent of presto-benchmark-driver
+(presto-benchmark-driver/.../BenchmarkDriver.java + suite.json: named
+suites of queries, N runs each, wall/CPU percentiles per query against a
+running cluster over the client protocol).
+
+Suite file (JSON):
+    {"runs": 5, "warmup": 1,
+     "queries": {"q1": "select ...", "counts": "select count(*) ..."}}
+
+CLI:  python -m presto_tpu.benchmark.driver --server URI suite.json
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class QueryBench:
+    name: str
+    runs_ms: List[float]
+    rows: int
+    error: str = ""
+
+    def percentile(self, p: float) -> float:
+        if not self.runs_ms:
+            return float("nan")
+        s = sorted(self.runs_ms)
+        k = min(int(round(p / 100 * (len(s) - 1))), len(s) - 1)
+        return s[k]
+
+
+def run_suite(target, queries: Dict[str, str], runs: int = 3,
+              warmup: int = 1) -> List[QueryBench]:
+    """`target` has .execute(sql) -> rows (verifier.RestTarget/SessionTarget)."""
+    out = []
+    for name, sql in queries.items():
+        times: List[float] = []
+        rows = 0
+        error = ""
+        try:
+            for _ in range(warmup):
+                target.execute(sql)
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                result = target.execute(sql)
+                times.append((time.perf_counter() - t0) * 1e3)
+                rows = len(result)
+        except Exception as e:  # noqa: BLE001 - reported per query
+            error = f"{type(e).__name__}: {e}"
+        out.append(QueryBench(name, times, rows, error))
+    return out
+
+
+def render(benches: List[QueryBench]) -> str:
+    lines = [
+        f"{'query':20s} {'runs':>4s} {'rows':>8s} {'p50ms':>9s} "
+        f"{'p90ms':>9s} {'max':>9s}"
+    ]
+    for b in benches:
+        if b.error:
+            lines.append(f"{b.name:20s} FAILED  {b.error[:60]}")
+            continue
+        lines.append(
+            f"{b.name:20s} {len(b.runs_ms):>4d} {b.rows:>8d} "
+            f"{b.percentile(50):>9.1f} {b.percentile(90):>9.1f} "
+            f"{max(b.runs_ms):>9.1f}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..verifier import RestTarget
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--server", required=True, help="coordinator URI")
+    p.add_argument("suite", help="JSON suite file")
+    args = p.parse_args(argv)
+    spec = json.load(open(args.suite))
+    benches = run_suite(
+        RestTarget(args.server),
+        spec["queries"],
+        runs=int(spec.get("runs", 3)),
+        warmup=int(spec.get("warmup", 1)),
+    )
+    print(render(benches))
+    return 1 if any(b.error for b in benches) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,7 +52,8 @@ class CoordinatorServer:
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: int = 1, resource_groups=None,
-                 selectors=None, listeners=None, node_manager=None):
+                 selectors=None, listeners=None, node_manager=None,
+                 access_control=None):
         # expose system.runtime.* through the served session's catalog
         # (reference connector/system/; the user's own session is untouched).
         # Duck-typed sessions (HttpClusterSession) are served as-is — they
@@ -71,12 +72,14 @@ class CoordinatorServer:
                 streaming=session.streaming,
                 batch_rows=session.batch_rows,
                 memory_budget=session.memory_budget,
+                access_control=session.access_control,
+                user=session.user,
             )
             self.syscat = syscat
         self.manager = QueryManager(
             served, max_concurrent=max_concurrent,
             resource_groups=resource_groups, selectors=selectors,
-            listeners=listeners,
+            listeners=listeners, access_control=access_control,
         )
         if self.syscat is not None:
             self.syscat.manager = self.manager

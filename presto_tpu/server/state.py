@@ -62,11 +62,18 @@ class QueryManager:
 
     def __init__(self, session, max_concurrent: int = 1,
                  max_history: int = 100, resource_groups: Optional[dict] = None,
-                 selectors: Optional[list] = None, listeners=None):
+                 selectors: Optional[list] = None, listeners=None,
+                 access_control=None):
         from .events import EventBus
         from .resource_groups import ResourceGroupManager
 
         self.session = session
+        # explicit access control covers duck-typed sessions
+        # (HttpClusterSession) that cannot carry one themselves — without
+        # this the manager would silently fail open for them
+        self.access_control = access_control or getattr(
+            session, "access_control", None
+        )
         self.queries: Dict[str, QueryInfo] = {}
         self.max_history = max_history
         self._ids = itertools.count(1)
@@ -207,7 +214,17 @@ class QueryManager:
                 session = self.session
                 if info.properties and hasattr(session, "with_properties"):
                     session = session.with_properties(info.properties)
-                result = session.query(info.sql)
+                if self.access_control is not None:
+                    # authorization runs as the REQUEST user, not the
+                    # server session's default
+                    from ..security import enforce
+                    from ..sql.parser import parse
+
+                    enforce(self.access_control, info.user, parse(info.sql))
+                if getattr(session, "access_control", None) is not None:
+                    result = session.query(info.sql, user=info.user)
+                else:
+                    result = session.query(info.sql)
                 info.columns = [
                     {"name": t, "type": str(b.type)}
                     for t, b in zip(result.titles, result.page.blocks)

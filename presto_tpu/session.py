@@ -82,7 +82,11 @@ class Session:
         streaming: bool = False,
         batch_rows: int = 1 << 20,
         memory_budget=None,
+        access_control=None,
+        user: str = "user",
     ):
+        self.access_control = access_control
+        self.user = user
         self.catalog = catalog
         self.mesh = mesh
         self.broadcast_threshold = broadcast_threshold
@@ -128,6 +132,8 @@ class Session:
                 streaming=engine.get("streaming", self.streaming),
                 batch_rows=engine.get("batch_rows", self.batch_rows),
                 memory_budget=engine.get("memory_budget", self.memory_budget),
+                access_control=self.access_control,
+                user=self.user,
             )
             cache[key] = derived
         return derived
@@ -157,8 +163,12 @@ class Session:
     def explain(self, sql: str) -> str:
         return N.plan_tree_str(self.plan(sql))
 
-    def query(self, sql: str) -> QueryResult:
+    def query(self, sql: str, user: Optional[str] = None) -> QueryResult:
         ast = parse(sql)
+        if self.access_control is not None:
+            from .security import enforce
+
+            enforce(self.access_control, user or self.user, ast)
         if isinstance(
             ast,
             (t.CreateTable, t.DropTable, t.Insert, t.Delete, t.ShowTables,

@@ -1,0 +1,142 @@
+"""Access control (reference: security/AccessControlManager.java,
+SystemAccessControl SPI, file-based access-control rules)."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.security import (
+    AccessDeniedError,
+    RuleBasedAccessControl,
+)
+from presto_tpu.session import Session
+
+RULES = [
+    {"user": "admin", "privileges": "all"},
+    {"user": ".*", "table": "secret.*", "privileges": "none"},
+    {"user": "writer", "privileges": "write"},
+    {"user": ".*", "privileges": "select"},
+]
+
+
+def _session(user):
+    cat = MemoryCatalog({})
+    boot = Session(cat)
+    boot.query("create table t (a bigint)")
+    boot.query("insert into t values (1)")
+    boot.query("create table secret_t (a bigint)")
+    return Session(cat, access_control=RuleBasedAccessControl(RULES), user=user)
+
+
+def test_select_allowed_write_denied():
+    s = _session("alice")
+    assert s.query("select a from t").rows() == [(1,)]
+    with pytest.raises(AccessDeniedError, match="cannot write"):
+        s.query("insert into t values (2)")
+    with pytest.raises(AccessDeniedError, match="cannot write"):
+        s.query("create table t2 (a bigint)")
+    with pytest.raises(AccessDeniedError, match="cannot write"):
+        s.query("delete from t")
+
+
+def test_table_rule_blocks_secret():
+    s = _session("alice")
+    with pytest.raises(AccessDeniedError, match="cannot select"):
+        s.query("select a from secret_t")
+    # blocked even when buried in a join or subquery
+    with pytest.raises(AccessDeniedError):
+        s.query("select * from t join secret_t on t.a = secret_t.a")
+    with pytest.raises(AccessDeniedError):
+        s.query("select (select max(a) from secret_t) from t")
+
+
+def test_writer_and_admin():
+    w = _session("writer")
+    w.query("insert into t values (5)")
+    assert w.query("select count(*) from t").rows() == [(2,)]
+    a = _session("admin")
+    a.query("select a from secret_t")
+    a.query("drop table secret_t")
+
+
+def test_unknown_user_cannot_query():
+    rules = [{"user": "alice", "privileges": "select"}]
+    cat = MemoryCatalog({})
+    s = Session(cat, access_control=RuleBasedAccessControl(rules), user="mallory")
+    with pytest.raises(AccessDeniedError, match="cannot execute"):
+        s.query("select 1 from (values (1)) v(d)")
+
+
+def test_rest_enforces_request_user():
+    import json
+    import urllib.request
+
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    cat = MemoryCatalog({})
+    boot = Session(cat)
+    boot.query("create table t (a bigint)")
+    boot.query("insert into t values (9)")
+    sess = Session(cat, access_control=RuleBasedAccessControl(RULES))
+    srv = CoordinatorServer(sess, max_concurrent=2).start()
+    try:
+        def run_as(user, sql):
+            req = urllib.request.Request(
+                f"{srv.uri}/v1/statement", data=sql.encode(),
+                headers={"X-Presto-User": user},
+            )
+            out = json.loads(urllib.request.urlopen(req).read())
+            for _ in range(200):
+                if "data" in out or "error" in out:
+                    return out
+                out = json.loads(urllib.request.urlopen(out["nextUri"]).read())
+            return out
+
+        ok = run_as("alice", "select a from t")
+        assert ok["data"] == [[9]]
+        denied = run_as("alice", "insert into t values (1)")
+        assert "error" in denied
+        assert "cannot write" in denied["error"]["message"]
+        admin = run_as("writer", "insert into t values (1)")
+        assert "error" not in admin
+    finally:
+        srv.stop()
+
+
+def test_qualified_names_cannot_bypass_rules():
+    s = _session("alice")
+    for sql in (
+        "select a from default.secret_t",
+        "select a from memory.default.secret_t",
+    ):
+        with pytest.raises(AccessDeniedError):
+            s.query(sql)
+
+
+def test_show_columns_requires_select():
+    s = _session("alice")
+    with pytest.raises(AccessDeniedError):
+        s.query("show columns from secret_t")
+    assert s.query("show columns from t").rows()
+
+
+def test_manager_enforces_for_duck_typed_sessions():
+    from presto_tpu.server.state import FAILED, QueryManager
+
+    class DuckSession:
+        def query(self, sql):
+            raise AssertionError("should be denied before execution")
+
+    qm = QueryManager(
+        DuckSession(),
+        access_control=RuleBasedAccessControl(
+            [{"user": "nobody", "privileges": "select"}]
+        ),
+    )
+    import time
+
+    info = qm.submit("select 1 from (values (1)) v(d)", user="mallory")
+    deadline = time.time() + 30
+    while not info.done and time.time() < deadline:
+        time.sleep(0.02)
+    assert info.state == FAILED
+    assert "cannot execute" in info.error

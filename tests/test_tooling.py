@@ -156,3 +156,30 @@ def test_dbapi_placeholders_in_comments_and_quotes():
         _substitute('select "a?b" from t /* ?? */ where z = ?', (1,))
         == 'select "a?b" from t /* ?? */ where z = 1'
     )
+
+
+def test_benchmark_driver(server, tmp_path):
+    import json
+
+    from presto_tpu.benchmark.driver import main, render, run_suite
+    from presto_tpu.verifier import RestTarget
+
+    benches = run_suite(
+        RestTarget(server.uri),
+        {"counts": "select count(*) from orders",
+         "bad": "select nope from orders"},
+        runs=2, warmup=0,
+    )
+    by_name = {b.name: b for b in benches}
+    assert len(by_name["counts"].runs_ms) == 2
+    assert by_name["counts"].rows == 1
+    assert by_name["bad"].error
+    text = render(benches)
+    assert "counts" in text and "FAILED" in text
+
+    suite = tmp_path / "suite.json"
+    suite.write_text(json.dumps(
+        {"runs": 1, "warmup": 0,
+         "queries": {"n": "select count(*) from nation"}}
+    ))
+    assert main(["--server", server.uri, str(suite)]) == 0
