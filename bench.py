@@ -240,4 +240,8 @@ if __name__ == "__main__":
                 }
             )
         )
-        sys.exit(0)
+    # the JSON line is out — skip interpreter teardown, whose native
+    # destructors (XLA/plugin) can SIGABRT and corrupt the exit code
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)

@@ -67,10 +67,11 @@ def render(benches: List[QueryBench]) -> str:
         if b.error:
             lines.append(f"{b.name:20s} FAILED  {b.error[:60]}")
             continue
+        worst = max(b.runs_ms) if b.runs_ms else float("nan")
         lines.append(
             f"{b.name:20s} {len(b.runs_ms):>4d} {b.rows:>8d} "
             f"{b.percentile(50):>9.1f} {b.percentile(90):>9.1f} "
-            f"{max(b.runs_ms):>9.1f}"
+            f"{worst:>9.1f}"
         )
     return "\n".join(lines)
 

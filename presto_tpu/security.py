@@ -94,14 +94,20 @@ class RuleBasedAccessControl(AccessControl):
 
 
 def collect_tables(ast) -> List[str]:
-    """Table names referenced anywhere in a statement AST."""
+    """Storage-table names referenced anywhere in a statement AST. CTE
+    aliases look like tables in FROM clauses but are derived relations —
+    they are collected and subtracted (scoping simplification: a CTE name
+    shadows a same-named table everywhere in the statement)."""
     from .sql import tree as t
 
     out: List[str] = []
+    cte_names: set = set()
 
     def walk(node):
         if isinstance(node, t.Table):
             out.append(node.name.lower())
+        if isinstance(node, t.WithItem):
+            cte_names.add(node.name.lower())
         if not dataclasses.is_dataclass(node):
             return
         for f in dataclasses.fields(node):
@@ -118,7 +124,7 @@ def collect_tables(ast) -> List[str]:
                                 walk(y)
 
     walk(ast)
-    return out
+    return [n for n in out if n not in cte_names]
 
 
 def _names_to_check(name: str) -> List[str]:

@@ -214,15 +214,17 @@ class QueryManager:
                 session = self.session
                 if info.properties and hasattr(session, "with_properties"):
                     session = session.with_properties(info.properties)
-                if self.access_control is not None:
-                    # authorization runs as the REQUEST user, not the
-                    # server session's default
+                if getattr(session, "access_control", None) is not None:
+                    # the session enforces itself, as the REQUEST user
+                    result = session.query(info.sql, user=info.user)
+                elif self.access_control is not None:
+                    # duck-typed session that cannot carry an access
+                    # control: the manager enforces before executing
                     from ..security import enforce
                     from ..sql.parser import parse
 
                     enforce(self.access_control, info.user, parse(info.sql))
-                if getattr(session, "access_control", None) is not None:
-                    result = session.query(info.sql, user=info.user)
+                    result = session.query(info.sql)
                 else:
                     result = session.query(info.sql)
                 info.columns = [

@@ -168,7 +168,11 @@ class Session:
         if self.access_control is not None:
             from .security import enforce
 
-            enforce(self.access_control, user or self.user, ast)
+            # explicit empty-string identity must NOT fall back to the
+            # (possibly privileged) session default
+            effective = self.user if user is None else user
+            enforce(self.access_control, effective, ast)
+            self._query_user = effective
         if isinstance(
             ast,
             (t.CreateTable, t.DropTable, t.Insert, t.Delete, t.ShowTables,
@@ -242,6 +246,22 @@ class Session:
 
         if isinstance(ast, t.ShowTables):
             names = sorted(self.catalog.table_names())
+            if self.access_control is not None:
+                # filter out tables the user cannot read (reference
+                # SystemAccessControl.filterTables)
+                from .security import AccessDeniedError
+
+                user = getattr(self, "_query_user", self.user)
+                visible = []
+                for n in names:
+                    try:
+                        self.access_control.check_can_select_from_table(
+                            user, n
+                        )
+                        visible.append(n)
+                    except AccessDeniedError:
+                        pass
+                names = visible
             pg = Page.from_dict({"Table": list(names) or [None]})
             if not names:
                 pg = Page(pg.blocks, pg.names, 0)

@@ -140,3 +140,25 @@ def test_manager_enforces_for_duck_typed_sessions():
         time.sleep(0.02)
     assert info.state == FAILED
     assert "cannot execute" in info.error
+
+
+def test_cte_aliases_not_checked_as_tables():
+    s = _session("alice")
+    got = s.query(
+        "with v as (select a from t) select * from v"
+    ).rows()
+    assert got == [(1,)]
+
+
+def test_show_tables_filters_denied():
+    s = _session("alice")
+    names = [r[0] for r in s.query("show tables").rows()]
+    assert "t" in names and "secret_t" not in names
+    a = _session("admin")
+    assert "secret_t" in [r[0] for r in a.query("show tables").rows()]
+
+
+def test_empty_user_is_not_session_default():
+    s = _session("admin")
+    with pytest.raises(AccessDeniedError):
+        s.query("select a from secret_t", user="")
