@@ -43,21 +43,49 @@ def _fmt(v) -> str:
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="presto-tpu")
     ap.add_argument("query", nargs="?", help="SQL to run (REPL if omitted)")
-    ap.add_argument("--sf", type=float, default=0.01, help="TPC-H scale factor")
-    ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--sf", type=float, default=0.01, help="tpch/tpcds scale factor")
+    ap.add_argument(
+        "--catalog", default="tpch",
+        help="tpch | tpcds | memory | a directory of csv/tsv/jsonl files",
+    )
     ap.add_argument("--server", help="coordinator URI (remote REST mode)")
     ap.add_argument("--serve", action="store_true",
                     help="start a coordinator server instead of a REPL")
     ap.add_argument("--port", type=int, default=8080)
     args = ap.parse_args(argv)
 
-    from .connectors.tpch import TpchCatalog
+    import os
+
     from .session import Session
 
-    if args.catalog != "tpch":
-        ap.error(f"unknown catalog {args.catalog}")
+    def build_catalog():
+        # only the --serve and local-REPL paths need one; remote mode
+        # must not validate a path that exists only on the coordinator
+        if args.catalog == "tpch":
+            from .connectors.tpch import TpchCatalog
 
-    import os
+            return TpchCatalog(sf=args.sf)
+        if args.catalog == "tpcds":
+            from .connectors.tpcds import TpcdsCatalog
+
+            return TpcdsCatalog(sf=args.sf)
+        if args.catalog == "memory":
+            from .connectors.memory import MemoryCatalog
+
+            return MemoryCatalog({})
+        if os.path.isdir(args.catalog):
+            from .connectors.localfile import LocalFileCatalog
+
+            return LocalFileCatalog(args.catalog)
+        ap.error(
+            f"unknown catalog {args.catalog!r} "
+            "(tpch | tpcds | memory | directory path)"
+        )
+
+    def banner_name():
+        if args.catalog in ("tpch", "tpcds"):
+            return f"{args.catalog} sf{args.sf:g}"
+        return args.catalog
 
     if os.environ.get("JAX_PLATFORMS") and not args.server:
         # the axon sitecustomize overrides the env var before we run;
@@ -70,9 +98,9 @@ def main(argv=None):
         from .server import CoordinatorServer
 
         server = CoordinatorServer(
-            Session(TpchCatalog(sf=args.sf)), port=args.port
+            Session(build_catalog()), port=args.port
         ).start()
-        print(f"coordinator listening on {server.uri} (tpch sf{args.sf:g})")
+        print(f"coordinator listening on {server.uri} ({banner_name()})")
         try:
             while True:
                 time.sleep(3600)
@@ -118,7 +146,7 @@ def main(argv=None):
                     print(f"error: {e}", file=sys.stderr)
         return
 
-    session = Session(TpchCatalog(sf=args.sf))
+    session = Session(build_catalog())
 
     def run_one(sql: str):
         sql = sql.strip().rstrip(";")
@@ -147,7 +175,7 @@ def main(argv=None):
         run_one(args.query)
         return
 
-    print(f"presto-tpu CLI — tpch sf{args.sf:g}. End statements with ';'.")
+    print(f"presto-tpu CLI — {banner_name()}. End statements with ';'.")
     buf = []
     while True:
         try:
