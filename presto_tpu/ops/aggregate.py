@@ -42,7 +42,7 @@ from .hashing import hash_rows
 
 SUPPORTED = (
     "count", "count_star", "sum", "min", "max", "avg", "checksum",
-    "min_by", "max_by",
+    "min_by", "max_by", "percentile",
 )
 
 
@@ -252,7 +252,9 @@ def _eval_by_keys(page: Page, aggs):
     """Ordering keys for min_by/max_by (AggSpec.input2), aligned with aggs."""
     out = []
     for a in aggs:
-        if a.input2 is None:
+        if a.input2 is None or a.func == "percentile":
+            # percentile's input2 is a literal fraction parameter, not an
+            # ordering-key column — nothing to evaluate per batch
             out.append(None)
             continue
         k = evaluate(a.input2, page)
@@ -266,6 +268,65 @@ def _eval_by_keys(page: Page, aggs):
             )
         out.append(k)
     return out
+
+
+def _reduce_percentile(
+    fraction: float, value: Val, contributes, gid, num_groups: int
+):
+    """Exact percentile by selection: one composite sort by (group, value)
+    with non-contributing rows pushed to each group's end, then a gather
+    at first + round(p * (n-1)) per group. Satisfies approx_percentile's
+    contract exactly (the reference uses a qdigest estimate,
+    operator/aggregation/ApproximateLongPercentileAggregations)."""
+    from .sort import asc_normalized_scalar_key
+
+    data = value.data
+    if data.ndim == 2:
+        raise NotImplementedError("approx_percentile over long decimals")
+    vc = contributes if value.valid is None else (contributes & value.valid)
+    norm = asc_normalized_scalar_key(data, True)
+    if jnp.issubdtype(norm.dtype, jnp.floating):
+        vc = vc & ~jnp.isnan(norm)
+    # stable three-pass composite sort: by value, then contributing rows
+    # first, then by group id — no sentinel values, so genuine extremes
+    # (inf / INT64_MAX) can never collide with excluded rows
+    order = jnp.argsort(norm, stable=True)
+    order = order[jnp.argsort((~vc)[order], stable=True)]
+    order = order[jnp.argsort(gid[order], stable=True)]
+    n = data.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    gid_o = gid[order]
+    vc_o = vc[order]
+    # contributing rows sit at each group's FRONT, so the group start is
+    # the first contributing position
+    first = (
+        jnp.full((num_groups,), n, jnp.int32)
+        .at[gid_o]
+        .min(jnp.where(vc_o, pos, n), mode="drop")
+    )
+    cnt = (
+        jnp.zeros((num_groups,), jnp.int32)
+        .at[gid_o]
+        .add(vc_o.astype(jnp.int32), mode="drop")
+    )
+    has = cnt > 0
+    target = jnp.minimum(
+        first + jnp.round(fraction * jnp.maximum(cnt - 1, 0)).astype(jnp.int32),
+        n - 1,
+    )
+    picked = order[jnp.minimum(target, n - 1)]
+    return data[picked], has
+
+
+def positional_reduce(spec: "AggSpec", value, by_key, contributes, gid,
+                      num_groups: int):
+    """Dispatch for positional aggregates (min_by/max_by/percentile) —
+    the one place all three aggregation strategies call into."""
+    if spec.func == "percentile":
+        return _reduce_percentile(
+            float(spec.input2.value), value, contributes, gid, num_groups
+        )
+    return _reduce_by(spec.func, value, by_key, contributes, gid, num_groups)
 
 
 def _reduce_by(func, value: Val, key: Val, contributes, gid, num_groups: int):
@@ -478,8 +539,10 @@ def grouped_aggregate_direct(
 
     by_keys = _eval_by_keys(page, aggs)
     for spec, v, bk in zip(aggs, ins, by_keys):
-        if spec.func in ("min_by", "max_by"):
-            vdat, vval = _reduce_by(spec.func, v, bk, live, gid, num_groups + 1)
+        if spec.func in ("min_by", "max_by", "percentile"):
+            vdat, vval = positional_reduce(
+                spec, v, bk, live, gid, num_groups + 1
+            )
             blocks.append(
                 Block(
                     vdat[:num_groups].astype(spec.output_type.storage_dtype),
@@ -586,21 +649,23 @@ def grouped_aggregate_sorted(
 
     by_keys = _eval_by_keys(page, aggs)
     for spec, v, bk in zip(aggs, ins, by_keys):
-        if spec.func in ("min_by", "max_by"):
+        if spec.func in ("min_by", "max_by", "percentile"):
             v_sorted = Val(
                 v.data[order],
                 None if v.valid is None else v.valid[order],
                 v.type,
                 v.dict_id,
             )
-            k_sorted = Val(
-                bk.data[order],
-                None if bk.valid is None else bk.valid[order],
-                bk.type,
-                bk.dict_id,
-            )
-            vdat, vval = _reduce_by(
-                spec.func, v_sorted, k_sorted, live_s, gid_s, max_groups + 1
+            k_sorted = None
+            if bk is not None:
+                k_sorted = Val(
+                    bk.data[order],
+                    None if bk.valid is None else bk.valid[order],
+                    bk.type,
+                    bk.dict_id,
+                )
+            vdat, vval = positional_reduce(
+                spec, v_sorted, k_sorted, live_s, gid_s, max_groups + 1
             )
             blocks.append(
                 Block(
@@ -724,8 +789,8 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
     blocks, names = [], []
     gid = jnp.zeros(page.capacity, jnp.int32)
     for spec, v, bk in zip(aggs, ins, by_keys):
-        if spec.func in ("min_by", "max_by"):
-            vdat, vval = _reduce_by(spec.func, v, bk, live, gid, 1)
+        if spec.func in ("min_by", "max_by", "percentile"):
+            vdat, vval = positional_reduce(spec, v, bk, live, gid, 1)
             blocks.append(
                 Block(
                     vdat.astype(spec.output_type.storage_dtype),

@@ -38,7 +38,7 @@ from . import tree as t
 
 AGG_FUNCS = {
     "count", "sum", "avg", "min", "max", "checksum", "approx_distinct",
-    "min_by", "max_by",
+    "min_by", "max_by", "approx_percentile",
 }
 
 # aggregates planned by rewriting onto the core set (reference: many of
@@ -963,6 +963,45 @@ class Planner:
                     spec = AggSpec(
                         "count_star", None, self.channel("count"), T.BIGINT
                     )
+            elif fname == "approx_percentile":
+                # computed EXACTLY by selection (the reference's qdigest is
+                # an estimate; exact satisfies the contract)
+                if len(call.args) != 2:
+                    raise PlanningError(
+                        "approx_percentile takes (value, percentile); the "
+                        "weighted/accuracy forms are not supported"
+                    )
+                if call.distinct:
+                    raise PlanningError(
+                        "approx_percentile does not support DISTINCT"
+                    )
+                import decimal as _dec
+
+                e = sctx.translate(call.args[0])
+                p = sctx.translate(call.args[1])
+                if not isinstance(p, ir.Literal) or not isinstance(
+                    p.value, (int, float, _dec.Decimal)
+                ):
+                    raise PlanningError(
+                        "approx_percentile requires a literal percentile"
+                    )
+                frac = float(p.value)
+                if not 0.0 <= frac <= 1.0:
+                    raise PlanningError("percentile must be in [0, 1]")
+                if isinstance(
+                    e.type, (T.VarcharType, T.BooleanType, T.UnknownType)
+                ):
+                    raise PlanningError(
+                        f"approx_percentile over {e.type} is not supported"
+                    )
+                if filt is not None:
+                    e = ir.Call(
+                        "if", (filt, e, ir.Literal(None, e.type)), e.type
+                    )
+                spec = AggSpec(
+                    "percentile", e, self.channel(fname), e.type,
+                    input2=ir.Literal(frac, T.DOUBLE),
+                )
             elif fname in ("min_by", "max_by"):
                 if len(call.args) != 2:
                     raise PlanningError(f"{fname} takes 2 arguments")

@@ -111,3 +111,73 @@ def test_nan_ordering_keys_excluded(sess):
 def test_explain_shows_ordering_key(sess):
     plan = sess.explain("select min_by(name, score) from t")
     assert "min_by" in plan and "score" in plan
+
+
+def test_approx_percentile_grouped(sess):
+    sess.query("create table p (g varchar, v bigint)")
+    rows = ",".join(f"('a',{v})" for v in range(1, 101))
+    sess.query(f"insert into p values {rows},('b',5),('b',50),('b',500),('b',null)")
+    got = sess.query(
+        "select g, approx_percentile(v, 0.5), approx_percentile(v, 0.9)"
+        " from p group by g order by g"
+    ).rows()
+    assert got == [("a", 51, 90), ("b", 50, 500)]
+
+
+def test_approx_percentile_edges(sess):
+    sess.query("create table q (v double)")
+    sess.query("insert into q values (1.5), (2.5), (9.5)")
+    assert sess.query(
+        "select approx_percentile(v, 0.0), approx_percentile(v, 1.0) from q"
+    ).rows() == [(1.5, 9.5)]
+    assert sess.query(
+        "select approx_percentile(v, 0.5) from q where v > 99"
+    ).rows() == [(None,)]
+
+
+def test_approx_percentile_validation(sess):
+    with pytest.raises(Exception, match="literal percentile"):
+        sess.query("select approx_percentile(score, score) from t")
+    with pytest.raises(Exception, match=r"\[0, 1\]"):
+        sess.query("select approx_percentile(score, 1.5) from t")
+    with pytest.raises(Exception, match="weighted"):
+        sess.query("select approx_percentile(score, 1, 0.5) from t")
+
+
+def test_approx_percentile_streaming_and_distributed():
+    ref = Session(TpchCatalog(sf=0.002))
+    sql = (
+        "select o_orderpriority, approx_percentile(o_totalprice, 0.5)"
+        " from orders group by 1 order by 1"
+    )
+    want = ref.query(sql).rows()
+    st = Session(TpchCatalog(sf=0.002), streaming=True, batch_rows=512)
+    assert st.query(sql).rows() == want
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) >= 8:
+        mesh = Mesh(np.array(devs[:8]), ("workers",))
+        d = Session(TpchCatalog(sf=0.002), mesh=mesh)
+        assert d.query(sql).rows() == want
+
+
+def test_percentile_extremes_do_not_collide_with_nulls(sess):
+    sess.query("create table ext (v double)")
+    sess.query("insert into ext values (null), (infinity()), (1.0)")
+    got = sess.query("select approx_percentile(v, 1.0) from ext").rows()
+    assert got[0][0] == float("inf")
+    sess.query("create table exti (v bigint)")
+    sess.query(
+        "insert into exti values (null), (9223372036854775807), (1)"
+    )
+    assert sess.query(
+        "select approx_percentile(v, 1.0) from exti"
+    ).rows() == [(9223372036854775807,)]
+
+
+def test_percentile_rejects_varchar(sess):
+    with pytest.raises(Exception, match="not supported"):
+        sess.query("select approx_percentile(name, 0.5) from t")
