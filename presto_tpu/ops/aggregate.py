@@ -310,11 +310,14 @@ def _reduce_percentile(
         .add(vc_o.astype(jnp.int32), mode="drop")
     )
     has = cnt > 0
-    target = jnp.minimum(
-        first + jnp.round(fraction * jnp.maximum(cnt - 1, 0)).astype(jnp.int32),
-        n - 1,
+    last = jnp.maximum(cnt - 1, 0)
+    # clamp to the group's last contributing row: float32 rounding of
+    # fraction*(cnt-1) can land one past it for cnt > 2^24
+    off = jnp.minimum(
+        jnp.round(fraction * last).astype(jnp.int32), last
     )
-    picked = order[jnp.minimum(target, n - 1)]
+    target = jnp.minimum(first + off, n - 1)
+    picked = order[target]
     return data[picked], has
 
 

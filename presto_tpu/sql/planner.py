@@ -988,9 +988,13 @@ class Planner:
                 frac = float(p.value)
                 if not 0.0 <= frac <= 1.0:
                     raise PlanningError("percentile must be in [0, 1]")
-                if isinstance(
-                    e.type, (T.VarcharType, T.BooleanType, T.UnknownType)
-                ):
+                unsupported = isinstance(
+                    e.type,
+                    (T.VarcharType, T.BooleanType, T.UnknownType, T.ArrayType),
+                ) or (
+                    isinstance(e.type, T.DecimalType) and e.type.is_long
+                )
+                if unsupported:
                     raise PlanningError(
                         f"approx_percentile over {e.type} is not supported"
                     )

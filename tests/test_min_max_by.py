@@ -181,3 +181,9 @@ def test_percentile_extremes_do_not_collide_with_nulls(sess):
 def test_percentile_rejects_varchar(sess):
     with pytest.raises(Exception, match="not supported"):
         sess.query("select approx_percentile(name, 0.5) from t")
+
+
+def test_percentile_rejects_long_decimal_at_plan_time(sess):
+    sess.query("create table ld (v decimal(30,2))")
+    with pytest.raises(Exception, match="not supported"):
+        sess.query("select approx_percentile(v, 0.5) from ld")
