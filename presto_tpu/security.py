@@ -95,43 +95,73 @@ class RuleBasedAccessControl(AccessControl):
 
 def collect_tables(ast) -> List[str]:
     """Storage-table names referenced anywhere in a statement AST. CTE
-    aliases look like tables in FROM clauses but are derived relations —
-    they are collected and subtracted (scoping simplification: a CTE name
-    shadows a same-named table everywhere in the statement)."""
+    aliases look like tables in FROM clauses but are derived relations and
+    are excluded — with the SAME scoping the planner applies
+    (sql/planner.py plan_query/plan_table): a CTE name is in scope only
+    within the Query that defines it, and a CTE's own definition body does
+    NOT see its own name (so `WITH t AS (SELECT * FROM t)` reads the
+    physical t and is checked against it)."""
     from .sql import tree as t
 
     out: List[str] = []
-    cte_names: set = set()
+    seen: set = set()
 
-    def walk(node):
+    def walk(node, scope: dict):
+        # scope: cte name -> WithItem, exactly the planner's `ctes` dict.
+        # CTE bodies are expanded LAZILY at the reference site with the
+        # referenced name stripped — the planner strips names transitively
+        # along an expansion chain, so a mutually-referencing pair
+        # (a -> b -> a) bottoms out at the physical table; eager per-item
+        # walks would miss that.
         if isinstance(node, t.Table):
-            out.append(node.name.lower())
-        if isinstance(node, t.WithItem):
-            cte_names.add(node.name.lower())
+            name = node.name.lower()
+            if name in scope:
+                item = scope[name]
+                walk(
+                    item.query,
+                    {k: v for k, v in scope.items() if k != name},
+                )
+            elif name not in seen:
+                seen.add(name)
+                out.append(name)
+            return
+        if isinstance(node, t.Query) and node.with_items:
+            inner = dict(scope)
+            for item in node.with_items:
+                inner[item.name.lower()] = item
+            walk(node.body, inner)
+            for child in node.order_by:
+                walk(child, inner)
+            return
         if not dataclasses.is_dataclass(node):
             return
         for f in dataclasses.fields(node):
             v = getattr(node, f.name)
             if isinstance(v, t.Node):
-                walk(v)
+                walk(v, scope)
             elif isinstance(v, tuple):
                 for x in v:
                     if isinstance(x, t.Node):
-                        walk(x)
+                        walk(x, scope)
                     elif isinstance(x, tuple):
                         for y in x:
                             if isinstance(y, t.Node):
-                                walk(y)
+                                walk(y, scope)
 
-    walk(ast)
-    return [n for n in out if n not in cte_names]
+    walk(ast, {})
+    return out
 
 
 def _names_to_check(name: str) -> List[str]:
     """A table reference is checked under BOTH its written form and its
     bare resolved name, so `default.secret_t` cannot sidestep a rule
     written against `secret_t` (the planner resolves qualified names to
-    the bare table; connectors here have one implicit schema)."""
+    the bare table; connectors here have one implicit schema).
+
+    Rules must therefore target the BARE resolved name (`secret_t`), the
+    canonical form the planner uses: a rule written only against a
+    qualified pattern (`default\\.secret_t`) does not protect the bare
+    reference, which never produces the qualified form."""
     bare = name.split(".")[-1]
     return [name] if bare == name else [name, bare]
 

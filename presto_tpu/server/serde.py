@@ -121,6 +121,14 @@ def deserialize_page(
         from .. import native
 
         orig = int.from_bytes(data[5:13], "little")
+        # the size header is untrusted wire input: bound it before the
+        # decompressor allocates (LZ4 block expansion is < 256x; also cap
+        # absolutely so a corrupt header cannot demand 2^64 bytes)
+        if orig > max(256 * (len(data) - 13), 1 << 12) or orig > 1 << 32:
+            raise ValueError(
+                f"lz4 page declares implausible size {orig} "
+                f"for {len(data) - 13} compressed bytes"
+            )
         raw = native.lz4_decompress(data[13:], orig)
     else:
         raise ValueError(f"unknown page codec {codec}")

@@ -165,20 +165,21 @@ class Session:
 
     def query(self, sql: str, user: Optional[str] = None) -> QueryResult:
         ast = parse(sql)
+        # explicit empty-string identity must NOT fall back to the
+        # (possibly privileged) session default
+        effective = self.user if user is None else user
         if self.access_control is not None:
             from .security import enforce
 
-            # explicit empty-string identity must NOT fall back to the
-            # (possibly privileged) session default
-            effective = self.user if user is None else user
             enforce(self.access_control, effective, ast)
-            self._query_user = effective
         if isinstance(
             ast,
             (t.CreateTable, t.DropTable, t.Insert, t.Delete, t.ShowTables,
              t.ShowColumns),
         ):
-            return self._execute_statement(ast)
+            # the user travels as an argument: the Session is shared across
+            # QueryManager worker threads, so instance state would race
+            return self._execute_statement(ast, effective)
         node = self.plan(sql)
         if isinstance(ast, t.Explain):
             from .page import Page
@@ -241,8 +242,11 @@ class Session:
         pg = Page.from_dict({"rows": np.array([n], dtype=np.int64)})
         return QueryResult(pg, ("rows",))
 
-    def _execute_statement(self, ast) -> QueryResult:
+    def _execute_statement(self, ast, user: Optional[str] = None) -> QueryResult:
         from .page import Page
+
+        if user is None:
+            user = self.user
 
         if isinstance(ast, t.ShowTables):
             names = sorted(self.catalog.table_names())
@@ -251,7 +255,6 @@ class Session:
                 # SystemAccessControl.filterTables)
                 from .security import AccessDeniedError
 
-                user = getattr(self, "_query_user", self.user)
                 visible = []
                 for n in names:
                     try:

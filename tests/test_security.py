@@ -162,3 +162,57 @@ def test_empty_user_is_not_session_default():
     s = _session("admin")
     with pytest.raises(AccessDeniedError):
         s.query("select a from secret_t", user="")
+
+
+def test_cte_cannot_shadow_denied_table_in_own_body():
+    """A CTE body does not see its own name (planner plan_table scoping),
+    so `WITH secret_t AS (SELECT FROM secret_t)` reads the physical table
+    and must be denied."""
+    s = _session("alice")
+    with pytest.raises(AccessDeniedError, match="cannot select"):
+        s.query(
+            "with secret_t as (select * from secret_t) "
+            "select * from secret_t"
+        )
+
+
+def test_cte_scope_is_per_subtree():
+    """A CTE defined in a derived-table subquery does not shadow a
+    same-named physical table referenced OUTSIDE that subquery."""
+    s = _session("alice")
+    with pytest.raises(AccessDeniedError, match="cannot select"):
+        s.query(
+            "select * from secret_t cross join "
+            "(with secret_t as (select 1 z) select z from secret_t) s"
+        )
+
+
+def test_mutually_referencing_ctes_cannot_bypass():
+    """The planner strips CTE names transitively along the expansion chain
+    (a -> b -> a bottoms out at the physical table); collection must too."""
+    s = _session("alice")
+    with pytest.raises(AccessDeniedError, match="cannot select"):
+        s.query(
+            "with secret_t as (select * from b), "
+            "b as (select * from secret_t) "
+            "select * from secret_t"
+        )
+
+
+def test_cte_shadowing_still_allowed_in_scope():
+    """Within scope, a CTE legitimately shadows a denied table name."""
+    s = _session("alice")
+    got = s.query(
+        "with secret_t as (select a from t) select a from secret_t"
+    ).rows()
+    assert got == [(1,)]
+
+
+def test_lz4_size_header_bounded():
+    """Codec-2 wire pages with an implausible declared size are rejected
+    before any allocation (untrusted exchange input)."""
+    from presto_tpu.server.serde import _MAGIC, deserialize_page
+
+    evil = _MAGIC + b"\x02" + (1 << 60).to_bytes(8, "little") + b"\x00" * 64
+    with pytest.raises(ValueError, match="implausible"):
+        deserialize_page(evil)
