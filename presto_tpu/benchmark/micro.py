@@ -1,0 +1,560 @@
+"""Per-operator kernel microbenchmark suite — the JMH analog.
+
+Reference: presto-benchmark's in-process operator suite
+(presto-benchmark/.../BenchmarkSuite.java:32, AbstractOperatorBenchmark.java)
+plus the 62 JMH kernel benchmarks (presto-main/src/test/.../operator/
+Benchmark*.java: BenchmarkGroupByHash, BenchmarkHashBuildAndJoinOperators,
+BenchmarkPartitionedOutputOperator, BenchmarkWindowOperator, ...). Same idea,
+TPU-first: each entry times ONE relational kernel over device-resident TPC-H
+pages and reports rows/s, runnable unchanged on CPU or TPU from one entry
+point:
+
+    python -m presto_tpu.benchmark.micro --sf 0.1 --runs 5 [--out micro.json]
+
+Timing protocol: device benchmarks chain each run's input on the previous
+run's output (a zero-valued data dependency) and end the chain in a single
+host transfer — `block_until_ready` through the axon tunnel returns at
+enqueue, so independent per-run timing would measure dispatch latency, not
+kernel time (see bench.py `_chained_device_time`). Host benchmarks (serde)
+time plain wall clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+RUNS = 5
+REPS = 3
+
+
+@dataclasses.dataclass
+class Bench:
+    name: str
+    rows: int  # input rows processed per run (rows/s denominator)
+    step: Callable  # (acc: int64, *args) -> int64  (jittable)
+    args: tuple
+    note: str = ""
+
+
+def _chain(x, acc):
+    """Inject a zero-valued dependency on the carried accumulator into an
+    input array, forcing serial execution of chained runs."""
+    import jax.numpy as jnp
+
+    return x + (acc * 0).astype(x.dtype)
+
+
+def _consume(out, samples: int = 1024):
+    """Reduce an output (Page / Val / array / dict of arrays) to an int64
+    that depends on a strided sample of every produced array, so XLA cannot
+    dead-code-eliminate the work while the reduction stays O(samples)."""
+    import jax.numpy as jnp
+
+    acc = jnp.int64(0)
+    arrays: List = []
+    if hasattr(out, "blocks"):  # Page
+        arrays = [b.data for b in out.blocks]
+        arrays.append(out.count)
+    elif hasattr(out, "data"):  # Val / Block
+        arrays = [out.data]
+    elif isinstance(out, dict):
+        arrays = list(out.values())
+    elif isinstance(out, (list, tuple)):
+        arrays = list(out)
+    else:
+        arrays = [out]
+    for a in arrays:
+        a = jnp.asarray(a)
+        if a.ndim == 0:
+            acc = acc + a.astype(jnp.int64)
+            continue
+        stride = max(1, a.shape[0] // samples)
+        acc = acc + jnp.sum(a[::stride].astype(jnp.int64))
+    return acc
+
+
+def _chained_page(page, acc):
+    """Perturb the first block of a Page with the accumulator dependency."""
+    from ..page import Block, Page
+
+    b0 = page.blocks[0]
+    blocks = (Block(_chain(b0.data, acc), b0.type, b0.valid, b0.dict_id),) + tuple(
+        page.blocks[1:]
+    )
+    return Page(blocks, page.names, page.count)
+
+
+def time_device_bench(b: Bench, runs: int = RUNS, reps: int = REPS) -> float:
+    """Best-of-reps seconds per run for a chained device benchmark."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(b.step)
+    acc = f(jnp.int64(0), *b.args)
+    int(acc)  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s = jnp.int64(0)
+        for _ in range(runs):
+            s = f(s, *b.args)
+        int(s)
+        best = min(best, (time.perf_counter() - t0) / runs)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# benchmark constructors (each returns a Bench over device-resident pages)
+# ---------------------------------------------------------------------------
+
+
+def bench_filter_compact(sf: float) -> Bench:
+    """Predicate filter + compaction (ref: BenchmarkPageProcessor /
+    PredicateFilterBenchmark — Q6 predicate over lineitem)."""
+    from ..ops.filter import filter_page
+    from .handcoded import Q6_PREDICATE, lineitem_q6_page
+
+    page = lineitem_q6_page(sf)
+
+    def step(acc, p):
+        out = filter_page(_chained_page(p, acc), Q6_PREDICATE)
+        return _consume(out)
+
+    return Bench("filter_compact", int(page.count), step, (page,))
+
+
+def bench_agg_direct(sf: float) -> Bench:
+    """Small-domain grouped aggregation, mask-reduce strategy (ref:
+    HandTpchQuery1 / BenchmarkHashAggregationOperator DIRECT path)."""
+    from ..ops.aggregate import grouped_aggregate_direct
+    from .handcoded import (
+        Q1_DOMAINS,
+        Q1_GROUP_NAMES,
+        Q1_GROUPS,
+        Q1_PREDICATE,
+        lineitem_q1_page,
+        q1_aggs,
+    )
+
+    page = lineitem_q1_page(sf)
+
+    def step(acc, p):
+        out = grouped_aggregate_direct(
+            _chained_page(p, acc),
+            Q1_GROUPS,
+            Q1_GROUP_NAMES,
+            q1_aggs(),
+            Q1_DOMAINS,
+            pre_mask=Q1_PREDICATE,
+        )
+        return _consume(out)
+
+    return Bench("agg_direct_q1", int(page.count), step, (page,))
+
+
+def bench_agg_sorted(sf: float) -> Bench:
+    """High-cardinality grouped aggregation, hash-sort strategy (ref:
+    BenchmarkGroupByHash — group by l_suppkey, NDV = 10k x sf)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.aggregate import AggSpec, grouped_aggregate_sorted
+    from .handcoded import DEC12_2, _table_page
+
+    page = _table_page(
+        "lineitem", sf, ("l_suppkey", "l_quantity", "l_extendedprice")
+    )
+    ndv = max(int(10_000 * sf), 1) + 1
+    max_groups = 1 << (ndv - 1).bit_length()
+    qty = col("l_quantity", DEC12_2)
+    aggs = (
+        AggSpec("sum", qty, "s", AggSpec.infer_output_type("sum", DEC12_2)),
+        AggSpec("count_star", None, "c", T.BIGINT),
+    )
+
+    def step(acc, p):
+        out = grouped_aggregate_sorted(
+            _chained_page(p, acc),
+            (col("l_suppkey", T.BIGINT),),
+            ("l_suppkey",),
+            aggs,
+            max_groups,
+        )
+        return _consume(out)
+
+    return Bench(
+        "agg_sorted_suppkey",
+        int(page.count),
+        step,
+        (page,),
+        note=f"groups<={max_groups}",
+    )
+
+
+def _orders_keys_page(sf: float):
+    from .handcoded import _table_page
+
+    return _table_page("orders", sf, ("o_orderkey", "o_custkey", "o_totalprice"))
+
+
+def bench_join_build(sf: float) -> Bench:
+    """Build-side index construction (ref: BenchmarkHashBuildAndJoinOperators
+    build phase / HashBuilderOperator.finish)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.join import build
+
+    page = _orders_keys_page(sf)
+    keys = (col("o_orderkey", T.BIGINT),)
+
+    def step(acc, p):
+        bs = build(_chained_page(p, acc), keys)
+        return _consume((bs.sorted_hash, bs.order, bs.count))
+
+    return Bench("join_build", int(page.count), step, (page,))
+
+
+def bench_join_probe(sf: float) -> Bench:
+    """FK->PK probe: lineitem x orders (ref: join phase of
+    BenchmarkHashBuildAndJoinOperators; rows/s counts PROBE rows)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.join import build, join_n1
+    from .handcoded import _table_page
+
+    probe = _table_page("lineitem", sf, ("l_orderkey", "l_extendedprice"))
+    bs = build(_orders_keys_page(sf), (col("o_orderkey", T.BIGINT),))
+    pkeys = (col("l_orderkey", T.BIGINT),)
+
+    def step(acc, p, sorted_hash, order, bpage, count):
+        import dataclasses as dc
+
+        b = dc.replace(bs, sorted_hash=sorted_hash, order=order,
+                       page=bpage, count=count)
+        out = join_n1(
+            _chained_page(p, acc), b, pkeys,
+            ("o_custkey", "o_totalprice"), ("o_custkey", "o_totalprice"),
+        )
+        return _consume(out)
+
+    return Bench(
+        "join_probe_n1",
+        int(probe.count),
+        step,
+        (probe, bs.sorted_hash, bs.order, bs.page, bs.count),
+    )
+
+
+def bench_sort(sf: float) -> Bench:
+    """Full-table sort (ref: OrderByBenchmark / BenchmarkWindowOperator's
+    sort phase)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.sort import SortKey, sort_page
+    from .handcoded import DEC12_2, _table_page
+
+    page = _table_page("lineitem", sf, ("l_extendedprice", "l_orderkey"))
+    keys = (
+        SortKey(col("l_extendedprice", DEC12_2), ascending=False),
+        SortKey(col("l_orderkey", T.BIGINT)),
+    )
+
+    def step(acc, p):
+        return _consume(sort_page(_chained_page(p, acc), keys))
+
+    return Bench("sort_2key", int(page.count), step, (page,))
+
+
+def bench_top_n(sf: float) -> Bench:
+    """TopN (ref: TopNBenchmark / BenchmarkTopNOperator)."""
+    from ..expr.ir import col
+    from ..ops.sort import SortKey, top_n
+    from .handcoded import DEC12_2, _table_page
+
+    page = _table_page("lineitem", sf, ("l_extendedprice", "l_orderkey"))
+    keys = (SortKey(col("l_extendedprice", DEC12_2), ascending=False),)
+
+    def step(acc, p):
+        return _consume(top_n(_chained_page(p, acc), keys, 100))
+
+    return Bench("top_n_100", int(page.count), step, (page,))
+
+
+def bench_window(sf: float) -> Bench:
+    """Partitioned window: rank + running sum over o_custkey (ref:
+    BenchmarkWindowOperator)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.sort import SortKey
+    from ..ops.window import WindowFunc, window_op
+    from .handcoded import _table_page
+
+    page = _orders_keys_page(sf)
+    DEC = T.DecimalType(12, 2)
+    funcs = (
+        WindowFunc("row_number", None, "rn", T.BIGINT),
+        WindowFunc(
+            "sum",
+            col("o_totalprice", DEC),
+            "running",
+            AggSpec_sum_type(DEC),
+            running=True,
+        ),
+    )
+    parts = (col("o_custkey", T.BIGINT),)
+    order = (SortKey(col("o_orderkey", T.BIGINT)),)
+
+    def step(acc, p):
+        return _consume(window_op(_chained_page(p, acc), parts, order, funcs))
+
+    return Bench("window_rank_runsum", int(page.count), step, (page,))
+
+
+def AggSpec_sum_type(t):
+    from ..ops.aggregate import AggSpec
+
+    return AggSpec.infer_output_type("sum", t)
+
+
+def bench_hash_rows(sf: float) -> Bench:
+    """Row hashing over two key columns (ref: BenchmarkGroupByHash's
+    hashPosition / InterpretedHashGenerator)."""
+    from ..ops.hashing import hash_rows
+
+    page = _orders_keys_page(sf)
+    b0, b1 = page.block("o_orderkey"), page.block("o_custkey")
+
+    def step(acc, x0, x1):
+        import jax.numpy as jnp
+
+        class V:
+            pass
+
+        v0, v1 = V(), V()
+        v0.data, v0.valid = _chain(x0, acc), None
+        v1.data, v1.valid = x1, None
+        return _consume(hash_rows([v0, v1]))
+
+    return Bench("hash_rows_2key", int(page.count), step, (b0.data, b1.data))
+
+
+DEVICE_BENCHES = {
+    "filter_compact": bench_filter_compact,
+    "agg_direct_q1": bench_agg_direct,
+    "agg_sorted_suppkey": bench_agg_sorted,
+    "join_build": bench_join_build,
+    "join_probe_n1": bench_join_probe,
+    "sort_2key": bench_sort,
+    "top_n_100": bench_top_n,
+    "window_rank_runsum": bench_window,
+    "hash_rows_2key": bench_hash_rows,
+}
+
+
+# ---------------------------------------------------------------------------
+# host-side benchmarks
+# ---------------------------------------------------------------------------
+
+
+def run_serde_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Page wire serde + LZ4 (ref: BenchmarkBlockSerde /
+    BenchmarkDataSerialization; PagesSerde.java:39). Host-side: measures the
+    DCN exchange codec, not device compute."""
+    from ..server.serde import deserialize_page, serialize_page
+    from .handcoded import lineitem_q6_page
+
+    page = lineitem_q6_page(sf)
+    page.block("l_quantity").data.block_until_ready()
+    wire = serialize_page(page)
+    deserialize_page(wire)  # warm
+    t_ser = t_des = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        wire = serialize_page(page)
+        t_ser = min(t_ser, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        deserialize_page(wire)
+        t_des = min(t_des, time.perf_counter() - t0)
+    raw_bytes = sum(
+        np.asarray(b.data).nbytes for b in page.blocks
+    )
+    n = int(page.count)
+    return {
+        "name": "serde_lz4",
+        "rows": n,
+        "rows_per_s": round(n / (t_ser + t_des)),
+        "ms": round((t_ser + t_des) * 1e3, 3),
+        "serialize_MBps": round(raw_bytes / t_ser / 1e6, 1),
+        "deserialize_MBps": round(raw_bytes / t_des / 1e6, 1),
+        "wire_bytes": len(wire),
+        "raw_bytes": raw_bytes,
+        "note": "host codec",
+    }
+
+
+def run_exchange_bench(sf: float, runs: int = RUNS) -> Optional[Dict]:
+    """Hash-repartition all_to_all over the device mesh (ref:
+    BenchmarkPartitionedOutputOperator + ExchangeOperator; the ICI data
+    plane). Requires >1 device; returns None (skipped) on a single chip."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return None
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from .. import types as T
+    from ..expr.ir import col
+    from ..page import Page
+    from ..parallel.exchange import exchange_by_hash
+    from ..parallel.mesh import default_mesh
+
+    mesh = default_mesh(n_dev)
+    axis = mesh.axis_names[0]
+    rows_per_shard = max(int(600_000 * sf) // n_dev, 1024)
+    rows_per_shard = -(-rows_per_shard // 128) * 128
+    total = n_dev * rows_per_shard
+    rng = np.random.default_rng(0)
+    key = rng.integers(0, 1 << 40, size=(total,), dtype=np.int64)
+    payload = np.arange(total, dtype=np.int64)
+    sh = NamedSharding(mesh, P(axis))
+    key_d = jax.device_put(jnp.asarray(key), sh)
+    pay_d = jax.device_put(jnp.asarray(payload), sh)
+    # uniform hash: per-destination rows ~ rows_per_shard/n_dev; 2x slack
+    part_capacity = -(-2 * rows_per_shard // n_dev // 128) * 128
+    key_exprs = (col("k", T.BIGINT),)
+
+    def shard_fn(acc, k, v):
+        page = Page.from_blocks(
+            [Block_(_chain(k, acc), T.BIGINT), Block_(v, T.BIGINT)],
+            ("k", "v"),
+            count=k.shape[0],
+        )
+        out, dropped = exchange_by_hash(
+            page, key_exprs, axis, n_dev, part_capacity
+        )
+        return _consume(out) + dropped.astype(jnp.int64)
+
+    def Block_(data, t):
+        from ..page import Block
+
+        return Block(data, t, None)
+
+    smapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=P(),
+        check_rep=False,
+    )
+
+    def step(acc, k, v):
+        return smapped(acc, k, v)
+
+    b = Bench("exchange_all_to_all", total, step, (key_d, pay_d))
+    sec = time_device_bench(b, runs)
+    return {
+        "name": b.name,
+        "rows": b.rows,
+        "rows_per_s": round(b.rows / sec),
+        "ms": round(sec * 1e3, 3),
+        "note": f"{n_dev} devices",
+    }
+
+
+# ---------------------------------------------------------------------------
+# suite runner
+# ---------------------------------------------------------------------------
+
+
+def run_suite(
+    sf: float = 0.1,
+    runs: int = RUNS,
+    only: Optional[List[str]] = None,
+) -> Dict:
+    import jax
+
+    results: List[Dict] = []
+    errors: Dict[str, str] = {}
+    for name, ctor in DEVICE_BENCHES.items():
+        if only and name not in only:
+            continue
+        try:
+            b = ctor(sf)
+            sec = time_device_bench(b, runs)
+            r = {
+                "name": b.name,
+                "rows": b.rows,
+                "rows_per_s": round(b.rows / sec),
+                "ms": round(sec * 1e3, 3),
+            }
+            if b.note:
+                r["note"] = b.note
+            results.append(r)
+        except Exception as e:  # noqa: BLE001 - suite entries are independent
+            errors[name] = repr(e)[:300]
+    if not only or "serde_lz4" in only:
+        try:
+            results.append(run_serde_bench(sf, runs))
+        except Exception as e:  # noqa: BLE001
+            errors["serde_lz4"] = repr(e)[:300]
+    if not only or "exchange_all_to_all" in only:
+        try:
+            r = run_exchange_bench(sf, runs)
+            if r is not None:
+                results.append(r)
+            else:
+                errors["exchange_all_to_all"] = "skipped: single device"
+        except Exception as e:  # noqa: BLE001
+            errors["exchange_all_to_all"] = repr(e)[:300]
+    return {
+        "suite": "operator_micro",
+        "backend": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "sf": sf,
+        "results": results,
+        "errors": errors,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--runs", type=int, default=RUNS)
+    ap.add_argument("--only", nargs="*", default=None)
+    ap.add_argument("--out", default=None, help="write JSON here too")
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import presto_tpu  # noqa: F401  (enables x64)
+
+    table = run_suite(args.sf, args.runs, args.only)
+    txt = json.dumps(table, indent=2)
+    print(txt)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(txt)
+    return table
+
+
+if __name__ == "__main__":
+    main()
+    sys.stdout.flush()
+    import os
+
+    os._exit(0)  # skip native teardown (see bench.py)

@@ -105,6 +105,12 @@ def collect_tables(ast) -> List[str]:
 
     out: List[str] = []
     seen: set = set()
+    # a CTE referenced N times re-expands N times (matching the planner),
+    # which is exponential for chains that reference the previous CTE twice
+    # — memoize on (definition, names-in-scope) and hard-cap expansions so a
+    # few-KB statement cannot hang the gate before authorization runs
+    expanded: set = set()
+    expansions = [0]
 
     def walk(node, scope: dict):
         # scope: cte name -> WithItem, exactly the planner's `ctes` dict.
@@ -117,10 +123,17 @@ def collect_tables(ast) -> List[str]:
             name = node.name.lower()
             if name in scope:
                 item = scope[name]
-                walk(
-                    item.query,
-                    {k: v for k, v in scope.items() if k != name},
-                )
+                inner = {k: v for k, v in scope.items() if k != name}
+                memo_key = (id(item), frozenset(inner))
+                if memo_key in expanded:
+                    return
+                expanded.add(memo_key)
+                expansions[0] += 1
+                if expansions[0] > 10_000:
+                    raise ValueError(
+                        "statement exceeds the CTE expansion limit"
+                    )
+                walk(item.query, inner)
             elif name not in seen:
                 seen.add(name)
                 out.append(name)

@@ -31,6 +31,11 @@ from ..page import Block, Page, dictionary_by_id, intern_dictionary
 
 _MAGIC = b"PTP1"
 
+# absolute cap on one deserialized wire page (untrusted input bound; the
+# exchange sends pages far smaller than this — it exists so a corrupt or
+# malicious header/stream cannot demand unbounded memory)
+MAX_PAGE_BYTES = 1 << 30
+
 
 def _type_to_wire(t: T.Type) -> str:
     return t.display()
@@ -116,7 +121,15 @@ def deserialize_page(
     if codec == 0:
         raw = data[5:]
     elif codec == 1:
-        raw = zlib.decompress(data[5:])
+        # untrusted wire input: bound the inflated size (a zlib bomb can
+        # expand ~1000x, so a ratio bound would reject legitimately
+        # compressible pages — use the absolute page cap instead)
+        d = zlib.decompressobj()
+        raw = d.decompress(data[5:], MAX_PAGE_BYTES)
+        if d.unconsumed_tail:
+            raise ValueError(
+                f"zlib page exceeds the {MAX_PAGE_BYTES}-byte page cap"
+            )
     elif codec == 2:
         from .. import native
 
@@ -124,7 +137,7 @@ def deserialize_page(
         # the size header is untrusted wire input: bound it before the
         # decompressor allocates (LZ4 block expansion is < 256x; also cap
         # absolutely so a corrupt header cannot demand 2^64 bytes)
-        if orig > max(256 * (len(data) - 13), 1 << 12) or orig > 1 << 32:
+        if orig > max(256 * (len(data) - 13), 1 << 12) or orig > MAX_PAGE_BYTES:
             raise ValueError(
                 f"lz4 page declares implausible size {orig} "
                 f"for {len(data) - 13} compressed bytes"

@@ -216,3 +216,39 @@ def test_lz4_size_header_bounded():
     evil = _MAGIC + b"\x02" + (1 << 60).to_bytes(8, "little") + b"\x00" * 64
     with pytest.raises(ValueError, match="implausible"):
         deserialize_page(evil)
+
+
+def test_deep_cte_chain_is_fast():
+    """A doubling chain of CTEs (each referencing the previous twice) must
+    not make the pre-auth walk exponential."""
+    import time
+
+    s = _session("alice")
+    n = 25
+    parts = ["c0 as (select a from t x, t y)"]
+    for k in range(1, n):
+        parts.append(f"c{k} as (select * from c{k-1} x, c{k-1} y)")
+    sql = "with " + ", ".join(parts) + f" select * from c{n-1}"
+    from presto_tpu.security import collect_tables
+    from presto_tpu.sql.parser import parse
+
+    t0 = time.time()
+    tables = collect_tables(parse(sql))
+    assert time.time() - t0 < 2.0
+    assert tables == ["t"]
+
+
+def test_zlib_bomb_bounded():
+    """Codec-1 wire pages cannot inflate past the absolute page cap."""
+    import zlib
+
+    from presto_tpu.server import serde
+
+    bomb = serde._MAGIC + b"\x01" + zlib.compress(b"\x00" * (1 << 22))
+    old = serde.MAX_PAGE_BYTES
+    serde.MAX_PAGE_BYTES = 1 << 20
+    try:
+        with pytest.raises(ValueError, match="page cap"):
+            serde.deserialize_page(bomb)
+    finally:
+        serde.MAX_PAGE_BYTES = old
