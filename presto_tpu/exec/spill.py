@@ -1,0 +1,350 @@
+"""Host-offload ("spill") execution for sort, window, and final aggregation.
+
+Re-designed equivalent of the reference's spill-to-disk machinery:
+OrderByOperator spill + MergeHashSort, SpillableHashAggregationBuilder
+(operator/aggregation/builder/SpillableHashAggregationBuilder.java:209
+`spillToDisk`), GenericPartitioningSpiller (spiller/, 18 files), and the
+revocable-memory scheduler (execution/MemoryRevokingScheduler.java:46).
+
+TPU-first redesign — device memory is the scarce resource and host RAM is
+the spill target (SURVEY.md §5 "long-context analog"), and the heavy
+compute stays on device:
+
+* External sort = RANGE-PARTITIONED, not run-merge: offload the input to
+  host, choose first-key value boundaries from a sample (the distributed
+  sort's range partitioning turned inward), then upload one key-range at a
+  time and fully sort it on device with ALL keys. Chunks come back in
+  range order, so no k-way merge loop runs on the host — every comparison
+  happens in a device kernel. Ties on the first key stay inside one chunk
+  (boundaries are values, not positions), which keeps multi-key sorts
+  correct; an oversized all-tie chunk recurses on the remaining keys.
+* Aggregation spill = hash-partitioned partial states: when the merged
+  group state outgrows the budget, partial-aggregate pages are partitioned
+  by group-key hash onto the host (GenericPartitioningSpiller's layout);
+  each partition holds a disjoint set of groups, so final aggregation
+  runs per-partition on device and results concatenate.
+* Window spill = partition-chunked execution: rows are hash-bucketed on
+  the PARTITION BY keys (a window function never looks across partitions),
+  each bucket runs the normal device window kernel.
+
+Offloaded bytes live in numpy arrays (HostTable); device uploads are
+budget-sized and accounted in the caller's MemoryPool; sorted/processed
+chunks download to host immediately so the device never holds more than
+its share. Results assemble into a HOST-backed Page (numpy blocks) —
+downstream Output only selects/renames blocks and row materialization
+reads numpy directly, so a beyond-HBM result never re-uploads.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..ops.sort import SortKey, asc_normalized_scalar_key, sort_page
+from ..page import Block, Page, round_capacity
+from .stats import page_device_bytes
+
+
+def to_host_page(page: Page) -> Page:
+    """Download a device page's live rows into numpy-backed blocks."""
+    n = int(page.count)
+    blocks = []
+    for b in page.blocks:
+        data = np.asarray(b.data[:n])
+        valid = None if b.valid is None else np.asarray(b.valid[:n])
+        blocks.append(Block(data, b.type, valid, b.dict_id))
+    return Page(tuple(blocks), page.names, n)
+
+
+def host_concat_pages(pages: Sequence[Page]) -> Page:
+    """Concatenate host-backed pages column-wise into one host Page."""
+    from ..ops.union import unify_block_dictionaries
+
+    total = sum(int(p.count) for p in pages)
+    first = pages[0]
+    blocks = []
+    for i in range(len(first.blocks)):
+        bl, did = unify_block_dictionaries([p.blocks[i] for p in pages])
+        any_valid = any(b.valid is not None for b in bl)
+        datas, valids = [], []
+        for p, b in zip(pages, bl):
+            n = int(p.count)
+            datas.append(np.asarray(b.data[:n]))
+            if any_valid:
+                valids.append(
+                    np.asarray(b.valid[:n])
+                    if b.valid is not None
+                    else np.ones((n,), np.bool_)
+                )
+        data = (
+            np.concatenate(datas)
+            if datas
+            else np.empty((0,), np.asarray(first.blocks[i].data).dtype)
+        )
+        valid = np.concatenate(valids) if any_valid else None
+        blocks.append(Block(data, bl[0].type, valid, did))
+    return Page(tuple(blocks), first.names, total)
+
+
+class SpilledRows:
+    """Append-only host store of offloaded pages (the spill-file analog)."""
+
+    def __init__(self, host=None):
+        self._host = host  # exec.stream.HostTable
+
+    def append(self, page: Page) -> None:
+        from .stream import HostTable
+
+        if self._host is None:
+            self._host = HostTable.from_pages([page])
+        else:
+            self._host.append_page(page)
+
+    @property
+    def host(self):
+        return self._host
+
+    @property
+    def num_rows(self) -> int:
+        return 0 if self._host is None else self._host.num_rows
+
+    @property
+    def row_bytes(self) -> int:
+        return 0 if self._host is None else max(self._host.row_bytes, 1)
+
+    def subset(self, indices: np.ndarray) -> "SpilledRows":
+        from .stream import HostTable
+
+        h = self._host
+        return SpilledRows(
+            HostTable(
+                h.names,
+                h.types,
+                h.dict_ids,
+                [c[indices] for c in h.columns],
+                [None if v is None else v[indices] for v in h.valids],
+            )
+        )
+
+    def take_page(self, indices: np.ndarray) -> Page:
+        """Gather host rows by position into a device-uploadable Page."""
+        h = self._host
+        n = len(indices)
+        cap = round_capacity(max(n, 1))
+        blocks = []
+        for c, v, typ, did in zip(h.columns, h.valids, h.types, h.dict_ids):
+            data = c[indices]
+            if cap > n:
+                pad = (cap - n,) + data.shape[1:]
+                data = np.concatenate([data, np.zeros(pad, data.dtype)])
+            valid = None
+            if v is not None:
+                valid = v[indices]
+                if cap > n:
+                    valid = np.concatenate(
+                        [valid, np.zeros(cap - n, np.bool_)]
+                    )
+            blocks.append(
+                Block(
+                    jnp.asarray(data),
+                    typ,
+                    None if valid is None else jnp.asarray(valid),
+                    did,
+                )
+            )
+        return Page.from_blocks(blocks, h.names, count=n)
+
+    def column_eval(
+        self, eval_fn: Callable[[Page], jnp.ndarray], chunk_rows: int
+    ) -> np.ndarray:
+        """Evaluate a device function over the host rows chunk-by-chunk,
+        returning the concatenated host result (sort-key normalization,
+        partition hashing)."""
+        outs = []
+        n = self.num_rows
+        step = max(chunk_rows, 1)
+        for start in range(0, n, step):
+            stop = min(start + step, n)
+            page = self._host.slice_page(start, stop)
+            outs.append(np.asarray(eval_fn(page))[: stop - start])
+        return np.concatenate(outs) if outs else np.empty((0,))
+
+
+def choose_boundaries(
+    norm: np.ndarray, num_chunks: int, sample: int = 1 << 20
+) -> np.ndarray:
+    """Pick <= num_chunks-1 first-key VALUES splitting `norm` into roughly
+    equal chunks. Value (not position) boundaries keep equal keys in one
+    chunk — required for multi-key correctness."""
+    if num_chunks <= 1 or len(norm) == 0:
+        return np.empty((0,), norm.dtype)
+    if len(norm) > sample:
+        idx = np.linspace(0, len(norm) - 1, sample).astype(np.int64)
+        s = np.sort(norm[idx])
+    else:
+        s = np.sort(norm)
+    qs = [s[int(len(s) * k / num_chunks)] for k in range(1, num_chunks)]
+    return np.unique(np.asarray(qs, norm.dtype))
+
+
+def external_sort_chunks(
+    spilled: SpilledRows,
+    keys: Sequence[SortKey],
+    chunk_rows: int,
+    pool,
+) -> List[Page]:
+    """Sort spilled rows: range-partition on the first key, device-sort
+    each range with ALL keys, download, return host chunks in global
+    order. Device residency per chunk is reserved against `pool`."""
+    first = keys[0]
+    # exact: equal norm == equal first key (scalar keys). Long-decimal
+    # lanes use a monotone float64 approximation (hi*2^32 + lo): correct
+    # for range BOUNDARIES, but its ties are not key ties — tie chunks
+    # then sort with the FULL key list instead of recursing on the rest.
+    norm_exact = True
+
+    def eval_norm(page: Page) -> jnp.ndarray:
+        nonlocal norm_exact
+        from ..expr.compiler import evaluate
+
+        v = evaluate(first.expr, page)
+        if isinstance(v.type, T.VarcharType):
+            from ..expr.functions import require_sorted_dict
+
+            require_sorted_dict(v, "ORDER BY")
+        norm = asc_normalized_scalar_key(v.data, first.ascending)
+        if norm is None:
+            norm_exact = False
+            approx = (
+                v.data[:, 0].astype(jnp.float64) * float(1 << 32)
+                + v.data[:, 1].astype(jnp.float64)
+            )
+            norm = approx if first.ascending else -approx
+        return norm
+
+    def eval_nulls(page: Page) -> jnp.ndarray:
+        from ..expr.compiler import evaluate
+
+        v = evaluate(first.expr, page)
+        if v.valid is None:
+            return jnp.ones((page.capacity,), jnp.bool_)
+        return v.valid
+
+    n = spilled.num_rows
+    # float norms stay float (truncation would overflow large doubles);
+    # range partitioning only needs a consistent total order
+    norm = spilled.column_eval(eval_norm, chunk_rows)
+    valid = spilled.column_eval(eval_nulls, chunk_rows).astype(np.bool_)
+    has_nulls = not valid.all()
+    null_idx = np.nonzero(~valid)[0] if has_nulls else np.empty(0, np.int64)
+    live_idx = np.nonzero(valid)[0] if has_nulls else np.arange(n)
+
+    chunks: List[Page] = []
+
+    def device_sort(indices: np.ndarray, sub_keys) -> None:
+        page = spilled.take_page(indices)
+        nb = page_device_bytes(page)
+        pool.reserve(nb, "external sort chunk")
+        try:
+            chunks.append(to_host_page(sort_page(page, sub_keys)))
+        finally:
+            pool.free(nb)
+
+    def emit(indices: np.ndarray, sub_keys) -> None:
+        if len(indices) == 0:
+            return
+        if len(indices) <= max(chunk_rows, 1):
+            device_sort(indices, sub_keys)
+            return
+        sub_norm = norm[indices]
+        uniq = np.unique(sub_norm)
+        if len(uniq) > 1:
+            bounds = choose_boundaries(
+                sub_norm, -(-len(indices) // max(chunk_rows, 1))
+            )
+            part = np.searchsorted(bounds, sub_norm, side="right")
+            sizes = np.bincount(part, minlength=len(bounds) + 1)
+            if sizes.max() == len(indices):
+                # quantile boundaries made no progress (one dominant value
+                # swallowed every cut): split at the middle DISTINCT value,
+                # which is strictly inside the range — guaranteed progress
+                mid = uniq[len(uniq) // 2]
+                emit(indices[sub_norm < mid], sub_keys)
+                emit(indices[sub_norm >= mid], sub_keys)
+                return
+            for p in range(len(bounds) + 1):
+                sel = indices[part == p]
+                if len(sel) <= max(chunk_rows, 1) or len(
+                    np.unique(norm[sel])
+                ) > 1:
+                    emit(sel, sub_keys)
+                else:
+                    emit_ties(sel, sub_keys)
+            return
+        emit_ties(indices, sub_keys)
+
+    def emit_ties(indices: np.ndarray, sub_keys) -> None:
+        """All first-key values equal: order falls to the remaining keys;
+        with none, any order is valid — emit budget-sized slices. With an
+        approximate norm, equal norm does NOT mean equal key: sort the
+        whole tie chunk with every key (the pool bounds the upload)."""
+        if not norm_exact and sub_keys is keys:
+            device_sort(indices, sub_keys)
+            return
+        rest = list(sub_keys)[1:]
+        if rest:
+            chunks.extend(
+                external_sort_chunks(
+                    spilled.subset(indices), rest, chunk_rows, pool
+                )
+            )
+            return
+        step = max(chunk_rows, 1)
+        for s in range(0, len(indices), step):
+            page = spilled.take_page(indices[s : s + step])
+            nb = page_device_bytes(page)
+            pool.reserve(nb, "external sort tie slice")
+            try:
+                chunks.append(to_host_page(page))
+            finally:
+                pool.free(nb)
+
+    # null first-key rows are all EQUAL on the first key: their order is
+    # decided by the remaining keys (emit_ties), never by the garbage norm
+    # values sitting in invalid slots
+    if has_nulls and first.effective_nulls_first:
+        emit_ties(null_idx, keys)
+        emit(live_idx, keys)
+    elif has_nulls:
+        emit(live_idx, keys)
+        emit_ties(null_idx, keys)
+    else:
+        emit(live_idx, keys)
+    return chunks
+
+
+def hash_partition_indices(
+    spilled: SpilledRows,
+    key_exprs,
+    num_parts: int,
+    chunk_rows: int,
+    salt: int = 0,
+) -> List[np.ndarray]:
+    """Partition spilled row indices by device-computed key hash (the
+    GenericPartitioningSpiller layout): rows with equal keys land in the
+    same partition, so per-partition processing is complete. `salt`
+    shifts the hash so recursive re-partitioning uses fresh bits."""
+    from ..expr.compiler import evaluate
+    from ..ops.hashing import hash_rows
+
+    def eval_hash(page: Page) -> jnp.ndarray:
+        keys = [evaluate(e, page) for e in key_exprs]
+        h = hash_rows(keys)
+        return (h >> np.uint64(salt)).astype(jnp.uint64)
+
+    h = spilled.column_eval(eval_hash, chunk_rows).astype(np.uint64)
+    part = (h % np.uint64(num_parts)).astype(np.int64)
+    return [np.nonzero(part == p)[0] for p in range(num_parts)]

@@ -241,6 +241,47 @@ class StreamingExecutor:
         self.pool = MemoryPool(memory_budget)
         self.local = Executor(catalog, collector=collector)
         self.collector = collector
+        # which operators offloaded to host this query (tests/EXPLAIN assert
+        # the spill path actually fired; reference: OperatorStats spill
+        # counters)
+        self.spill_events: List[str] = []
+
+    def _spill_share(self) -> int:
+        """Device bytes one offloaded operator may hold at a time: half the
+        budget remaining after resident reservations."""
+        budget = self.pool.max_bytes or (1 << 62)
+        return max((budget - self.pool.reserved) // 2, 1)
+
+    def _collect_or_spill(self, child: N.PlanNode, tag: str):
+        """Accumulate a child stream on device while the budget allows;
+        past it, migrate everything to a host SpilledRows store (the
+        revoke-to-spill moment). Returns (first_batch, device_batches,
+        held_bytes, spilled_or_None)."""
+        from .spill import SpilledRows
+
+        batches: List[Page] = []
+        held = 0
+        spilled = None
+        first: Optional[Page] = None
+        for b in self.stream(child):
+            if first is None:
+                first = b  # schema carrier for the all-empty case
+            if int(b.count) == 0:
+                continue
+            nb = page_device_bytes(b)
+            if spilled is None and self.pool.can_reserve(held + nb):
+                batches.append(b)
+                held += nb
+                continue
+            if spilled is None:
+                self.spill_events.append(tag)
+                spilled = SpilledRows()
+                for p in batches:
+                    spilled.append(p)
+                batches = []
+                held = 0
+            spilled.append(b)
+        return first, batches, held, spilled
 
     # -- public --
 
@@ -317,6 +358,13 @@ class StreamingExecutor:
                     if first_names is None:
                         first_names = batch.names
                     yield Page(batch.blocks, first_names, batch.count)
+        elif isinstance(node, N.Window) and node.partition_exprs:
+            yield from self._stream_window(node)
+        elif isinstance(node, (N.Aggregate, N.Distinct, N.TopN, N.Limit, N.Sort)):
+            # sink nodes reached mid-tree (e.g. Sort under the Project that
+            # drops a hidden order channel) still go through their
+            # budget-aware sinks, not the materializing fallback
+            yield self._run(node)
         else:
             # window / outer compositions / distinct-union / exchanges:
             # materialize the subtree with the classic executor (its inputs
@@ -465,12 +513,58 @@ class StreamingExecutor:
                 out = filter_page(out, node.residual)
             yield self.local._shrink(out)
 
+    def _stream_window(self, node: N.Window) -> Iterator[Page]:
+        """Partitioned window under the budget: if the input fits, one
+        device window kernel; otherwise partition-chunked execution — rows
+        hash-bucketed on the PARTITION BY keys (a window function never
+        reads across partitions), one device window kernel per bucket
+        (reference: grouped execution via Lifespan + the spilling
+        WindowOperator). Output keeps within-bucket (partition, order)
+        ordering; bucket order is a hash order, which the SQL contract
+        allows (a Sort node above imposes any required final order)."""
+        from .spill import hash_partition_indices
+
+        first, batches, held, spilled = self._collect_or_spill(
+            node.child, "window"
+        )
+        if spilled is None:
+            if not batches:
+                yield self.local.exec_node(node, first)
+                return
+            self.pool.reserve(held, "window input")
+            try:
+                acc = batches[0] if len(batches) == 1 else concat_pages(batches)
+                yield self.local.exec_node(node, acc)
+            finally:
+                self.pool.free(held)
+            return
+        chunk_rows = max(self._spill_share() // spilled.row_bytes, 1 << 10)
+        num_parts = max(-(-spilled.num_rows // chunk_rows), 2)
+        for idx in hash_partition_indices(
+            spilled, node.partition_exprs, num_parts, chunk_rows
+        ):
+            if not len(idx):
+                continue
+            page = spilled.take_page(idx)
+            nb = page_device_bytes(page)
+            self.pool.reserve(nb, "window partition bucket")
+            try:
+                yield self.local.exec_node(node, page)
+            finally:
+                self.pool.free(nb)
+
     def _stream_semijoin(self, node: N.SemiJoin) -> Iterator[Page]:
         source = self._run(node.source)
         held = self.pool.reserve(page_device_bytes(source), "semijoin source")
         try:
             bs = build(source, node.source_keys)
             for batch in self.stream(node.child):
+                if node.mark is not None:
+                    from ..ops.join import semi_match_mask
+
+                    mask = semi_match_mask(batch, bs, node.probe_keys)
+                    yield self.local._attach_mark(batch, mask, node.mark)
+                    continue
                 out = join_n1(
                     batch, bs, node.probe_keys, [], [],
                     kind="anti" if node.anti else "semi",
@@ -514,6 +608,7 @@ class StreamingExecutor:
         merge_rows = max(self.batch_rows // 2, 1 << 14)
         pending: List[Page] = []
         pending_rows = 0
+        spilled = None  # SpilledRows of partial-state pages
 
         def merge(parts: List[Page], bound: int) -> Page:
             acc = parts[0] if len(parts) == 1 else concat_pages(parts)
@@ -528,6 +623,20 @@ class StreamingExecutor:
                 mg = round_capacity(true_groups)
             return self.local._shrink(out)
 
+        def spill_all(pages: List[Page]) -> None:
+            """Move partial-state pages to the host store (re-finalizable:
+            `final` over partial columns is idempotent, so spilled merged
+            state and raw partials share one schema)."""
+            nonlocal spilled
+            from .spill import SpilledRows
+
+            if spilled is None:
+                self.spill_events.append("aggregate")
+                spilled = SpilledRows()
+            for p in pages:
+                if int(p.count) > 0 or spilled.num_rows == 0:
+                    spilled.append(p)
+
         for batch in self._agg_input_stream(node):
             mg = round_capacity(min(max(int(batch.count), 1), 1 << 16))
             while True:
@@ -539,23 +648,97 @@ class StreamingExecutor:
                     break
                 mg = round_capacity(int(part.count))
             part = self.local._shrink(part)
+            if spilled is not None:
+                spill_all([part])
+                continue
             pending.append(part)
             pending_rows += int(part.count)
-            if pending_rows >= merge_rows:
+            pending_bytes = sum(page_device_bytes(p) for p in pending)
+            if pending_rows >= merge_rows or not self.pool.can_reserve(
+                pending_bytes
+            ):
                 parts = ([state] if state is not None else []) + pending
                 new_state = merge(parts, pending_rows + int(state.count if state is not None else 0))
                 self.pool.free(state_held)
-                state_held = self.pool.reserve(
-                    page_device_bytes(new_state), "aggregation state"
-                )
-                state = new_state
+                state_held = 0
+                nb = page_device_bytes(new_state)
+                if self.pool.can_reserve(nb):
+                    state_held = self.pool.reserve(nb, "aggregation state")
+                    state = new_state
+                else:
+                    # group state outgrew the budget: switch to spilling
+                    # (SpillableHashAggregationBuilder.spillToDisk)
+                    spill_all([new_state])
+                    state = None
                 pending = []
                 pending_rows = 0
+        if spilled is not None:
+            spill_all(pending)
+            return self._finalize_spilled_agg(
+                node, spilled, group_refs, final, post
+            )
         # stream() always yields at least one batch, so parts is non-empty
         parts = ([state] if state is not None else []) + pending
+        est = sum(page_device_bytes(p) for p in parts)
+        if not self.pool.can_reserve(est - state_held):
+            # the final merged state itself would not fit: finish on the
+            # spill path, which emits a host-backed result
+            spill_all(parts)
+            self.pool.free(state_held)
+            return self._finalize_spilled_agg(
+                node, spilled, group_refs, final, post
+            )
         out = merge(parts, pending_rows + int(state.count if state is not None else 0))
         self.pool.free(state_held)
         return apply_avg_post(out, node.aggs, post)
+
+    def _finalize_spilled_agg(
+        self, node: N.Aggregate, spilled, group_refs, final, post
+    ) -> Page:
+        """Final aggregation over host-spilled partial states: hash-
+        partition rows by group key (equal keys share a partition), run the
+        device final aggregation per partition, concatenate on the host.
+        Skewed partitions re-partition recursively on fresh hash bits."""
+        from .spill import (
+            hash_partition_indices,
+            host_concat_pages,
+            to_host_page,
+        )
+
+        outs: List[Page] = []
+        chunk_rows = max(self._spill_share() // spilled.row_bytes, 1 << 10)
+
+        def finalize(sub, depth: int) -> None:
+            n = sub.num_rows
+            if n > chunk_rows and depth < 4:
+                parts = max(-(-n // chunk_rows), 2)
+                for idx in hash_partition_indices(
+                    sub, group_refs, parts, chunk_rows, salt=13 * (depth + 1)
+                ):
+                    if len(idx):
+                        finalize(sub.subset(idx), depth + 1)
+                return
+            # one partition's groups fit (or hashing cannot split further:
+            # upload regardless and let the pool fail honestly)
+            page = sub.take_page(np.arange(n))
+            nb = page_device_bytes(page)
+            self.pool.reserve(nb, "final aggregation partition")
+            try:
+                mg = round_capacity(max(int(page.count), 1))
+                while True:
+                    out = grouped_aggregate_sorted(
+                        page, group_refs, node.group_names, final, mg
+                    )
+                    if int(out.count) <= mg:
+                        break
+                    mg = round_capacity(int(out.count))
+                out = apply_avg_post(out, node.aggs, post)
+                outs.append(to_host_page(out))
+            finally:
+                self.pool.free(nb)
+
+        finalize(spilled, 0)
+        return host_concat_pages(outs)
 
     def _sink_distinct(self, node: N.Distinct) -> Page:
         state: Optional[Page] = None
@@ -593,9 +776,23 @@ class StreamingExecutor:
         return self.local._shrink(limit_page(acc, node.count))
 
     def _sink_sort(self, node: N.Sort) -> Page:
-        acc = self._materialize(node.child)
-        self.pool.reserve(page_device_bytes(acc), "sort input")
-        try:
-            return sort_page(acc, node.keys)
-        finally:
-            self.pool.free(page_device_bytes(acc))
+        """Full-table sort; beyond the budget it goes external: offload to
+        host, range-partition on the first key, device-sort each range
+        (spill.external_sort_chunks — the OrderByOperator-spill analog)."""
+        from .spill import external_sort_chunks, host_concat_pages
+
+        first, batches, held, spilled = self._collect_or_spill(
+            node.child, "sort"
+        )
+        if spilled is None:
+            if not batches:
+                return sort_page(first, node.keys)
+            self.pool.reserve(held, "sort input")
+            try:
+                acc = batches[0] if len(batches) == 1 else concat_pages(batches)
+                return sort_page(acc, node.keys)
+            finally:
+                self.pool.free(held)
+        chunk_rows = max(self._spill_share() // spilled.row_bytes, 1 << 10)
+        chunks = external_sort_chunks(spilled, node.keys, chunk_rows, self.pool)
+        return host_concat_pages(chunks)

@@ -1,0 +1,179 @@
+"""Spill/offload for sort, window, and final aggregation (reference:
+OrderByOperator + spiller/, SpillableHashAggregationBuilder.java:209,
+MemoryRevokingScheduler.java:46). Queries whose state exceeds the device
+budget must offload to host RAM, keep device residency within budget, and
+produce byte-identical results to the materializing executor."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+SF = 0.01
+BATCH = 512
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return TpchCatalog(sf=SF)
+
+
+@pytest.fixture(scope="module")
+def plain(catalog):
+    return Session(catalog)
+
+
+def _streaming(catalog, **kw):
+    kw.setdefault("batch_rows", BATCH)
+    return Session(catalog, streaming=True, **kw)
+
+
+FULL_SORT = (
+    "select l_orderkey, l_extendedprice, l_shipdate from lineitem "
+    "order by l_extendedprice desc, l_orderkey"
+)
+
+
+def test_external_sort_matches_and_stays_bounded(catalog, plain):
+    budget = 1 << 20  # ~1MB: far below the ~2MB 3-column lineitem footprint
+    s = _streaming(catalog, memory_budget=budget)
+    got = s.query(FULL_SORT).rows()
+    want = plain.query(FULL_SORT).rows()
+    assert got == want
+    assert "sort" in s.executor.spill_events
+    assert s.executor.pool.peak <= budget
+
+
+def test_external_sort_single_key_asc(catalog, plain):
+    sql = "select o_orderkey from orders order by o_totalprice"
+    s = _streaming(catalog, memory_budget=64 << 10)
+    assert s.query(sql).rows() == plain.query(sql).rows()
+    assert "sort" in s.executor.spill_events
+
+
+def test_external_sort_nulls_and_ties():
+    rng = np.random.default_rng(7)
+    n = 20_000
+    k1 = rng.integers(0, 5, n).astype(np.float64)  # heavy ties
+    k1_null = rng.random(n) < 0.1
+    k2 = rng.integers(0, 1000, n)
+    t = Page.from_dict(
+        {
+            "a": np.where(k1_null, 0.0, k1),
+            "b": k2.astype(np.int64),
+            "c": np.arange(n, dtype=np.int64),
+        }
+    )
+    # punch nulls into column a
+    from presto_tpu.page import Block
+
+    blocks = list(t.blocks)
+    a = blocks[0]
+    blocks[0] = Block(a.data, a.type, np.asarray(~k1_null), a.dict_id)
+    t = Page(tuple(blocks), t.names, t.count)
+    cat = MemoryCatalog({"t": t})
+    sql = "select a, b, c from t order by a desc nulls last, b, c desc"
+    want = Session(cat).query(sql).rows()
+    s = Session(cat, streaming=True, batch_rows=512, memory_budget=96 << 10)
+    got = s.query(sql).rows()
+    assert got == want
+    assert "sort" in s.executor.spill_events
+
+
+def test_spilled_aggregation_high_cardinality(catalog, plain):
+    sql = (
+        "select l_orderkey, sum(l_quantity) q, count(*) n, "
+        "avg(l_extendedprice) ap from lineitem group by l_orderkey"
+    )
+    budget = 192 << 10  # below the ~15k-group state footprint
+    s = _streaming(catalog, memory_budget=budget)
+    got = sorted(s.query(sql).rows())
+    want = sorted(plain.query(sql).rows())
+    assert got == want
+    assert "aggregate" in s.executor.spill_events
+    assert s.executor.pool.peak <= budget
+
+
+def test_spilled_aggregation_with_strings():
+    rng = np.random.default_rng(3)
+    n = 30_000
+    keys = [f"user_{i:05d}" for i in rng.integers(0, 4000, n)]
+    vals = rng.integers(0, 100, n).astype(np.int64)
+    cat = MemoryCatalog({"t": Page.from_dict({"k": keys, "v": vals})})
+    sql = "select k, sum(v) s, count(*) c from t group by k"
+    want = sorted(Session(cat).query(sql).rows())
+    s = Session(cat, streaming=True, batch_rows=1024, memory_budget=48 << 10)
+    got = sorted(s.query(sql).rows())
+    assert got == want
+    assert "aggregate" in s.executor.spill_events
+
+
+def test_partition_chunked_window(catalog, plain):
+    sql = (
+        "select o_orderkey, o_custkey, "
+        "rank() over (partition by o_custkey order by o_totalprice desc) r, "
+        "sum(o_totalprice) over (partition by o_custkey) tot "
+        "from orders"
+    )
+    budget = 256 << 10
+    s = _streaming(catalog, memory_budget=budget)
+    got = sorted(s.query(sql).rows())
+    want = sorted(plain.query(sql).rows())
+    assert got == want
+    assert "window" in s.executor.spill_events
+    assert s.executor.pool.peak <= budget
+
+
+def test_window_running_sum_chunked(catalog, plain):
+    sql = (
+        "select o_orderkey, sum(o_totalprice) over "
+        "(partition by o_custkey order by o_orderkey) run from orders"
+    )
+    s = _streaming(catalog, memory_budget=256 << 10)
+    got = sorted(s.query(sql).rows())
+    want = sorted(plain.query(sql).rows())
+    assert got == want
+    assert "window" in s.executor.spill_events
+
+
+def test_no_spill_within_budget(catalog, plain):
+    # a generous budget must keep everything on device (no offload)
+    s = _streaming(catalog, memory_budget=1 << 30)
+    got = s.query(FULL_SORT).rows()
+    assert got == plain.query(FULL_SORT).rows()
+    assert s.executor.spill_events == []
+
+
+def test_sort_above_spilled_aggregation(catalog, plain):
+    # composition: spilled aggregation feeding an external sort
+    sql = (
+        "select l_orderkey, sum(l_quantity) q from lineitem "
+        "group by l_orderkey order by q desc, l_orderkey"
+    )
+    s = _streaming(catalog, memory_budget=192 << 10)
+    got = s.query(sql).rows()
+    want = plain.query(sql).rows()
+    assert got == want
+    assert "aggregate" in s.executor.spill_events
+
+
+def test_external_sort_dominant_min_value():
+    """A first key whose minimum value dominates the input defeated the
+    quantile boundaries (every cut landed on the same value); the split
+    must still make progress instead of recursing forever."""
+    n = 30_000
+    a = np.zeros(n)
+    a[-5:] = [1.0, 2.0, 3.0, 4.0, 5.0]
+    t = Page.from_dict(
+        {"a": a, "b": np.arange(n, dtype=np.int64)[::-1].copy()}
+    )
+    cat = MemoryCatalog({"t": t})
+    sql = "select a, b from t order by a, b"
+    want = Session(cat).query(sql).rows()
+    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=64 << 10)
+    got = s.query(sql).rows()
+    assert got == want
+    assert "sort" in s.executor.spill_events
