@@ -60,6 +60,58 @@ class Connector(Catalog):
     def exact_row_count(self, table: str) -> int:
         return int(self.page(table).count)
 
+    # -- statistics (reference ConnectorMetadata.getTableStatistics /
+    # spi/statistics/TableStatistics) --
+
+    STATS_SAMPLE_ROWS = 1 << 18
+
+    def column_stats(self, table: str, column: str):
+        """NDV / logical min / logical max / null fraction for one column,
+        computed from a bounded sample of the table and cached. NDV scales
+        up linearly when the sample looks key-like (>50% distinct), the
+        standard low/high-cardinality split; file connectors override this
+        with format metadata where available."""
+        cache = getattr(self, "_column_stats_cache", None)
+        if cache is None:
+            cache = self._column_stats_cache = {}
+        key = (table, column)
+        if key not in cache:
+            cache[key] = self._compute_column_stats(table, column)
+        return cache[key]
+
+    def _compute_column_stats(self, table: str, column: str):
+        import numpy as np
+
+        from ..plan.stats import ColumnStats, stats_from_column
+
+        total = self.exact_row_count(table)
+        n = min(total, self.STATS_SAMPLE_ROWS)
+        if n == 0:
+            return ColumnStats(ndv=0.0, null_fraction=0.0)
+        # STRIDED ranges, not a prefix: tables are often stored sorted by
+        # key/date, and a prefix sample would systematically miss the top
+        # of the range (wrecking range-selectivity estimates)
+        pieces, vpieces = [], []
+        n_ranges = 8 if total > n else 1
+        span = max(n // n_ranges, 1)
+        any_valid = False
+        for start in np.linspace(0, max(total - span, 0), n_ranges).astype(
+            np.int64
+        ):
+            page = self.scan(table, int(start), int(start) + span,
+                             columns=[column])
+            b = page.block(column)
+            m = int(page.count)
+            pieces.append(np.asarray(b.data[:m]))
+            if b.valid is not None:
+                any_valid = True
+                vpieces.append(np.asarray(b.valid[:m]))
+            else:
+                vpieces.append(np.ones((m,), np.bool_))
+        data = np.concatenate(pieces)
+        valid = np.concatenate(vpieces) if any_valid else None
+        return stats_from_column(data, valid, b.type, b.dictionary, total)
+
     def scan(
         self,
         table: str,

@@ -605,6 +605,26 @@ class TpchCatalog:
     def exact_row_count(self, tname: str) -> int:
         return self.host_table(tname).num_rows
 
+    def column_stats(self, tname: str, column: str):
+        """Exact per-column statistics from the host-resident generator
+        data (reference presto-tpch statistics provider), cached."""
+        from ..plan.stats import stats_from_column
+
+        cache = getattr(self, "_stats_cache", None)
+        if cache is None:
+            cache = self._stats_cache = {}
+        key = (tname, column)
+        if key not in cache:
+            col = self.host_table(tname).columns[column]
+            cache[key] = stats_from_column(
+                col.data,
+                getattr(col, "valid", None),
+                col.type,
+                col.dictionary,
+                self.exact_row_count(tname),
+            )
+        return cache[key]
+
     def scan(self, tname: str, start: int, stop: int, pad_to=None,
              columns=None, predicate=None) -> "Page":
         """One batch of rows [start, stop) as a device Page — the split/

@@ -97,11 +97,26 @@ class AggFinalize(N.PlanNode):
 
 class Fragmenter:
     """Insert exchanges bottom-up so every operator's co-location
-    requirement is met; track each subtree's delivered Partitioning."""
+    requirement is met; track each subtree's delivered Partitioning.
 
-    def __init__(self, catalog, broadcast_threshold: int = 1_000_000):
+    broadcast_threshold=None selects COST-BASED join distribution
+    (reference DetermineJoinDistributionType): broadcast replicates the
+    build side onto every worker (build_rows x W moved, probe stays put);
+    repartition moves both sides once. An explicit integer keeps the
+    legacy fixed row cutover."""
+
+    def __init__(
+        self,
+        catalog,
+        broadcast_threshold: Optional[int] = None,
+        num_workers: int = 8,
+    ):
         self.catalog = catalog
         self.broadcast_threshold = broadcast_threshold
+        self.num_workers = max(num_workers, 2)
+        from .stats import StatsDeriver
+
+        self._stats = StatsDeriver(catalog)
 
     def fragment(self, root: N.PlanNode) -> N.PlanNode:
         node, dist = self._visit(root)
@@ -112,26 +127,16 @@ class Fragmenter:
     # -- helpers --
 
     def _estimate(self, node: N.PlanNode) -> float:
-        if isinstance(node, N.TableScan):
-            try:
-                return float(self.catalog.row_count(node.table))
-            except Exception:
-                return 1e9
-        if isinstance(node, N.Filter):
-            return 0.25 * self._estimate(node.child)
-        if isinstance(node, N.Aggregate):
-            return max(1.0, 0.1 * self._estimate(node.child))
-        if isinstance(node, N.Distinct):
-            return 0.5 * self._estimate(node.child)
-        if isinstance(node, (N.TopN, N.Limit)):
-            return float(node.count)
-        if isinstance(node, N.Join):
-            return max(
-                self._estimate(node.left), self._estimate(node.right)
-            )
-        if node.children:
-            return max(self._estimate(c) for c in node.children)
-        return 1.0
+        return self._stats.stats(node).rows
+
+    def _should_broadcast(self, build: N.PlanNode, probe: N.PlanNode) -> bool:
+        build_rows = self._estimate(build)
+        if self.broadcast_threshold is not None:
+            return build_rows <= self.broadcast_threshold
+        probe_rows = self._estimate(probe)
+        # replicate cost: every worker holds the build (W x build moved);
+        # repartition cost: both sides cross the exchange once
+        return build_rows * self.num_workers <= probe_rows + build_rows
 
     def _gather(self, node: N.PlanNode, dist: Partitioning) -> N.PlanNode:
         return Exchange(node, "gather") if dist.is_sharded else node
@@ -252,9 +257,8 @@ class Fragmenter:
                 dataclasses.replace(node, left=left, right=right),
                 Partitioning(SINGLE),
             )
-        build_rows = self._estimate(node.right)
         broadcast = (
-            build_rows <= self.broadcast_threshold
+            self._should_broadcast(node.right, node.left)
             or not node.left_keys
             or self._has_varchar_keys(node.left_keys)
             or self._has_varchar_keys(node.right_keys)
@@ -281,9 +285,8 @@ class Fragmenter:
                 dataclasses.replace(node, child=child, source=source),
                 Partitioning(SINGLE),
             )
-        source_rows = self._estimate(node.source)
         broadcast = (
-            source_rows <= self.broadcast_threshold
+            self._should_broadcast(node.source, node.child)
             or not node.probe_keys
             or node.residual is not None
             or self._has_varchar_keys(node.probe_keys)
@@ -366,10 +369,14 @@ class Fragmenter:
 
 
 def fragment_plan(
-    root: N.PlanNode, catalog, broadcast_threshold: int = 1_000_000
+    root: N.PlanNode,
+    catalog,
+    broadcast_threshold: Optional[int] = None,
+    num_workers: int = 8,
 ) -> N.PlanNode:
-    """AddExchanges + fragmentation entry point."""
-    return Fragmenter(catalog, broadcast_threshold).fragment(root)
+    """AddExchanges + fragmentation entry point. broadcast_threshold=None
+    = cost-based distribution from the stats framework."""
+    return Fragmenter(catalog, broadcast_threshold, num_workers).fragment(root)
 
 
 def fragments(root: N.PlanNode) -> List[N.PlanNode]:

@@ -180,7 +180,12 @@ class Join(PlanNode):
 class SemiJoin(PlanNode):
     """EXISTS/IN-subquery join (reference SemiJoinNode): keeps probe rows
     with (anti: without) a match in `source`. Residual (for correlated
-    EXISTS with extra predicates) references both sides' channels."""
+    EXISTS with extra predicates) references both sides' channels.
+
+    With `mark` set, NO rows are filtered: every probe row passes through
+    plus a boolean `mark` column recording match membership (the
+    reference's semi-join output symbol, HashSemiJoinOperator) — how
+    EXISTS/IN under OR plans."""
 
     child: PlanNode
     source: PlanNode
@@ -188,9 +193,12 @@ class SemiJoin(PlanNode):
     source_keys: Tuple[RowExpression, ...]
     anti: bool = False
     residual: Optional[RowExpression] = None
+    mark: Optional[str] = None
 
     @property
     def fields(self):
+        if self.mark is not None:
+            return self.child.fields + ((self.mark, T.BOOLEAN),)
         return self.child.fields
 
     @property
@@ -330,11 +338,14 @@ class Output(PlanNode):
         return (self.child,)
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0, collector=None) -> str:
+def plan_tree_str(
+    node: PlanNode, indent: int = 0, collector=None, stats_of=None
+) -> str:
     """EXPLAIN-style rendering (reference sql/planner/planPrinter). With a
     StatsCollector (exec/stats.py) this is the EXPLAIN ANALYZE view — per-
     operator wall/rows/bytes/retries (reference ExplainAnalyzeContext +
-    PlanNodeStatsSummarizer)."""
+    PlanNodeStatsSummarizer). `stats_of(node)` (plan/stats.PlanStats)
+    annotates ESTIMATED rows, the reference's `{rows: N}` cost prints."""
     pad = "  " * indent
     name = type(node).__name__
     detail = ""
@@ -381,7 +392,13 @@ def plan_tree_str(node: PlanNode, indent: int = 0, collector=None) -> str:
         s = collector.lookup(node)
         if s is not None:
             stat = " " + s.line()
+    if stats_of is not None:
+        try:
+            est = stats_of(node)
+            stat += f" {{est: {est.rows:,.0f} rows}}"
+        except Exception:
+            pass
     lines = [f"{pad}- {name}{detail}{stat}"]
     for c in node.children:
-        lines.append(plan_tree_str(c, indent + 1, collector))
+        lines.append(plan_tree_str(c, indent + 1, collector, stats_of))
     return "\n".join(lines)

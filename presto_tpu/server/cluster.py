@@ -291,7 +291,7 @@ class HttpClusterSession:
     DistributedQueryRunner analog for the DCN path."""
 
     def __init__(self, catalog, nodes: NodeManager,
-                 broadcast_threshold: int = 1_000_000):
+                 broadcast_threshold=None):  # None = cost-based
         from ..session import Session
 
         self._planner = Session(catalog)  # reuse parse/plan/fragment
@@ -305,6 +305,7 @@ class HttpClusterSession:
         from ..session import QueryResult
 
         node = self._planner.plan(sql)
-        node = fragment_plan(node, self.catalog, self.broadcast_threshold)
+        node = fragment_plan(node, self.catalog, self.broadcast_threshold,
+                             num_workers=max(len(self.scheduler.nodes.active_workers()), 2))
         page = self.scheduler.run(node)
         return QueryResult(page, node.titles)

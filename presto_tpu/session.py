@@ -78,7 +78,7 @@ class Session:
         self,
         catalog,
         mesh=None,
-        broadcast_threshold: int = 1_000_000,
+        broadcast_threshold=None,  # None = cost-based distribution
         streaming: bool = False,
         batch_rows: int = 1 << 20,
         memory_budget=None,
@@ -156,12 +156,17 @@ class Session:
             from .plan.fragment import fragment_plan
 
             node = fragment_plan(
-                node, self.catalog, self.broadcast_threshold
+                node, self.catalog, self.broadcast_threshold,
+                num_workers=self.mesh.devices.size,
             )
         return node
 
     def explain(self, sql: str) -> str:
-        return N.plan_tree_str(self.plan(sql))
+        from .plan.stats import StatsDeriver
+
+        return N.plan_tree_str(
+            self.plan(sql), stats_of=StatsDeriver(self.catalog).stats
+        )
 
     def query(self, sql: str, user: Optional[str] = None) -> QueryResult:
         ast = parse(sql)
@@ -225,7 +230,10 @@ class Session:
         if self.mesh is not None:
             from .plan.fragment import fragment_plan
 
-            node = fragment_plan(node, self.catalog, self.broadcast_threshold)
+            node = fragment_plan(
+                node, self.catalog, self.broadcast_threshold,
+                num_workers=self.mesh.devices.size,
+            )
         return self.executor.run(node), titles, rp.scope
 
     def _table_schema(self, cat, name: str):
