@@ -699,4 +699,449 @@ group by substr(w_warehouse_name, 1, 20), sm_type, cc_name
 order by wname, sm_type, cc_name
 limit 100
 """,
+    9: """
+select case when (select count(*) from store_sales
+                  where ss_quantity between 1 and 20) > 74129
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 1 and 20)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 1 and 20) end bucket1,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 21 and 40) > 122840
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 21 and 40)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 21 and 40) end bucket2,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 41 and 60) > 56580
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 41 and 60)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 41 and 60) end bucket3,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 61 and 80) > 10097
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 61 and 80)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 61 and 80) end bucket4,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 81 and 100) > 165306
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 81 and 100)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 81 and 100) end bucket5
+from reason
+where r_reason_sk = 1
+""",
+    13: """
+select avg(ss_quantity) aq,
+       avg(ss_ext_sales_price) aesp,
+       avg(ss_ext_wholesale_cost) aewc,
+       sum(ss_ext_wholesale_cost) sewc
+from store_sales, store, customer_demographics, household_demographics,
+     customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+    or (ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00
+        and hd_dep_count = 1)
+    or (ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'W'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00
+        and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'TX')
+        and ss_net_profit between 100 and 200)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('OR', 'NM', 'KY')
+        and ss_net_profit between 150 and 300)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('VA', 'TX', 'MS')
+        and ss_net_profit between 50 and 250))
+""",
+    16: """
+select count(distinct cs_order_number) as order_count,
+       sum(cs_ext_ship_cost) as total_shipping_cost,
+       sum(cs_net_profit) as total_net_profit
+from catalog_sales cs1, date_dim, customer_address, call_center
+where d_date between date '2002-02-01' and (date '2002-02-01' + interval '60' day)
+  and cs1.cs_ship_date_sk = d_date_sk
+  and cs1.cs_ship_addr_sk = ca_address_sk
+  and ca_state = 'GA'
+  and cs1.cs_call_center_sk = cc_call_center_sk
+  and cc_county = 'Williamson County'
+  and exists (select *
+              from catalog_sales cs2
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  and not exists (select *
+                  from catalog_returns cr1
+                  where cs1.cs_order_number = cr1.cr_order_number)
+order by count(distinct cs_order_number)
+limit 100
+""",
+    21: """
+select *
+from (select w_warehouse_name, i_item_id,
+             sum(case when d_date < date '2000-03-11'
+                      then inv_quantity_on_hand else 0 end) as inv_before,
+             sum(case when d_date >= date '2000-03-11'
+                      then inv_quantity_on_hand else 0 end) as inv_after
+      from inventory, warehouse, item, date_dim
+      where i_current_price between 0.99 and 1.49
+        and i_item_sk = inv_item_sk
+        and inv_warehouse_sk = w_warehouse_sk
+        and inv_date_sk = d_date_sk
+        and d_date between date '2000-02-10' and (date '2000-03-11' + interval '30' day)
+      group by w_warehouse_name, i_item_id) x
+where (case when inv_before > 0 then inv_after / inv_before else null end)
+      between 0.666667 and 1.5
+order by w_warehouse_name, i_item_id
+limit 100
+""",
+    28: """
+select *
+from (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+             count(distinct ss_list_price) b1_cntd
+      from store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between 8 and 18
+             or ss_coupon_amt between 459 and 1459
+             or ss_wholesale_cost between 57 and 77)) b1,
+     (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+             count(distinct ss_list_price) b2_cntd
+      from store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between 90 and 100
+             or ss_coupon_amt between 2323 and 3323
+             or ss_wholesale_cost between 31 and 51)) b2,
+     (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+             count(distinct ss_list_price) b3_cntd
+      from store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between 142 and 152
+             or ss_coupon_amt between 12214 and 13214
+             or ss_wholesale_cost between 79 and 99)) b3,
+     (select avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+             count(distinct ss_list_price) b4_cntd
+      from store_sales
+      where ss_quantity between 16 and 20
+        and (ss_list_price between 135 and 145
+             or ss_coupon_amt between 6071 and 7071
+             or ss_wholesale_cost between 38 and 58)) b4,
+     (select avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+             count(distinct ss_list_price) b5_cntd
+      from store_sales
+      where ss_quantity between 21 and 25
+        and (ss_list_price between 122 and 132
+             or ss_coupon_amt between 836 and 1836
+             or ss_wholesale_cost between 17 and 37)) b5,
+     (select avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+             count(distinct ss_list_price) b6_cntd
+      from store_sales
+      where ss_quantity between 26 and 30
+        and (ss_list_price between 154 and 164
+             or ss_coupon_amt between 7326 and 8326
+             or ss_wholesale_cost between 7 and 27)) b6
+limit 100
+""",
+    32: """
+select sum(cs_ext_discount_amt) as excess_discount_amount
+from catalog_sales, item, date_dim
+where i_manufact_id = 977
+  and i_item_sk = cs_item_sk
+  and d_date between date '2000-01-27' and (date '2000-01-27' + interval '90' day)
+  and d_date_sk = cs_sold_date_sk
+  and cs_ext_discount_amt >
+      (select 1.3 * avg(cs_ext_discount_amt)
+       from catalog_sales, date_dim
+       where cs_item_sk = i_item_sk
+         and d_date between date '2000-01-27' and (date '2000-01-27' + interval '90' day)
+         and d_date_sk = cs_sold_date_sk)
+limit 100
+""",
+    34: """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and (date_dim.d_dom between 1 and 3 or date_dim.d_dom between 25 and 28)
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and (case when household_demographics.hd_vehicle_count > 0
+                  then household_demographics.hd_dep_count / household_demographics.hd_vehicle_count
+                  else null end) > 1.2
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county = 'Williamson County'
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 15 and 20
+order by c_last_name asc, c_first_name asc, c_salutation asc,
+         c_preferred_cust_flag desc, ss_ticket_number asc
+""",
+    40: """
+select w_state, i_item_id,
+       sum(case when d_date < date '2000-03-11'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) as sales_before,
+       sum(case when d_date >= date '2000-03-11'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) as sales_after
+from catalog_sales
+     left outer join catalog_returns
+       on (cs_order_number = cr_order_number and cs_item_sk = cr_item_sk),
+     warehouse, item, date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '2000-02-10' and (date '2000-03-11' + interval '30' day)
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+""",
+    41: """
+select distinct i_product_name
+from item i1
+where i_manufact_id between 738 and 778
+  and (select count(*) as item_cnt
+       from item
+       where (i_manufact = i1.i_manufact
+              and ((i_category = 'Women'
+                    and (i_color = 'powder' or i_color = 'khaki')
+                    and (i_units = 'Ounce' or i_units = 'Oz')
+                    and (i_size = 'medium' or i_size = 'extra large'))
+                or (i_category = 'Women'
+                    and (i_color = 'brown' or i_color = 'honeydew')
+                    and (i_units = 'Bunch' or i_units = 'Ton')
+                    and (i_size = 'N/A' or i_size = 'small'))
+                or (i_category = 'Men'
+                    and (i_color = 'floral' or i_color = 'deep')
+                    and (i_units = 'N/A' or i_units = 'Dozen')
+                    and (i_size = 'petite' or i_size = 'large'))
+                or (i_category = 'Men'
+                    and (i_color = 'light' or i_color = 'cornflower')
+                    and (i_units = 'Box' or i_units = 'Pound')
+                    and (i_size = 'medium' or i_size = 'extra large'))))
+          or (i_manufact = i1.i_manufact
+              and ((i_category = 'Women'
+                    and (i_color = 'midnight' or i_color = 'snow')
+                    and (i_units = 'Pallet' or i_units = 'Gross')
+                    and (i_size = 'medium' or i_size = 'extra large'))
+                or (i_category = 'Women'
+                    and (i_color = 'cyan' or i_color = 'papaya')
+                    and (i_units = 'Cup' or i_units = 'Dram')
+                    and (i_size = 'N/A' or i_size = 'small'))
+                or (i_category = 'Men'
+                    and (i_color = 'orange' or i_color = 'frosted')
+                    and (i_units = 'Each' or i_units = 'Tbl')
+                    and (i_size = 'petite' or i_size = 'large'))
+                or (i_category = 'Men'
+                    and (i_color = 'forest' or i_color = 'ghost')
+                    and (i_units = 'Lb' or i_units = 'Bundle')
+                    and (i_size = 'medium' or i_size = 'extra large'))))) > 0
+order by i_product_name
+limit 100
+""",
+    45: """
+select ca_zip, ca_city, sum(ws_sales_price) total_price
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                '86475', '85392', '85460', '80348', '81792')
+       or i_item_id in (select i_item_id
+                        from item
+                        where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)))
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+""",
+    73: """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and (case when household_demographics.hd_vehicle_count > 0
+                  then household_demographics.hd_dep_count / household_demographics.hd_vehicle_count
+                  else null end) > 1
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county = 'Williamson County'
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 1 and 5
+order by cnt desc, c_last_name asc
+""",
+    84: """
+select c_customer_id as customer_id,
+       coalesce(c_last_name, '') || ', ' || coalesce(c_first_name, '') as customername
+from customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+where ca_city = 'Edgewood'
+  and c_current_addr_sk = ca_address_sk
+  and ib_lower_bound >= 38128
+  and ib_upper_bound <= 88128
+  and ib_income_band_sk = hd_income_band_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and sr_cdemo_sk = cd_demo_sk
+order by c_customer_id
+limit 100
+""",
+    88: """
+select *
+from (select count(*) h8_30_to_9
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 8 and time_dim.t_minute >= 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s1,
+     (select count(*) h9_to_9_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute < 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s2,
+     (select count(*) h9_30_to_10
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute >= 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s3,
+     (select count(*) h10_to_10_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 10 and time_dim.t_minute < 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s4,
+     (select count(*) h10_30_to_11
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 10 and time_dim.t_minute >= 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s5,
+     (select count(*) h11_to_11_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 11 and time_dim.t_minute < 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s6,
+     (select count(*) h11_30_to_12
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 11 and time_dim.t_minute >= 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s7,
+     (select count(*) h12_to_12_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 12 and time_dim.t_minute < 30
+        and ((household_demographics.hd_dep_count = 4
+              and household_demographics.hd_vehicle_count <= 6)
+          or (household_demographics.hd_dep_count = 2
+              and household_demographics.hd_vehicle_count <= 4)
+          or (household_demographics.hd_dep_count = 0
+              and household_demographics.hd_vehicle_count <= 2))
+        and store.s_store_name = 'ese') s8
+""",
+    94: """
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales ws1, date_dim, customer_address, web_site
+where d_date between date '1999-02-01' and (date '1999-02-01' + interval '60' day)
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk
+  and ca_state = 'IL'
+  and ws1.ws_web_site_sk = web_site_sk
+  and web_company_name = 'pri'
+  and exists (select *
+              from web_sales ws2
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  and not exists (select *
+                  from web_returns wr1
+                  where ws1.ws_order_number = wr1.wr_order_number)
+order by count(distinct ws_order_number)
+limit 100
+""",
 }

@@ -337,9 +337,22 @@ class Executor:
             )
         return self._shrink(concat_pages(parts))
 
+    @staticmethod
+    def _attach_mark(probe: Page, mask, name: str) -> Page:
+        return Page(
+            probe.blocks + (Block(mask, T.BOOLEAN, None),),
+            probe.names + (name,),
+            probe.count,
+        )
+
     def _exec_semijoin(self, node: N.SemiJoin, probe: Page, source: Page) -> Page:
         if node.residual is None:
             bs = build(source, node.source_keys)
+            if node.mark is not None:
+                from ..ops.join import semi_match_mask
+
+                mask = semi_match_mask(probe, bs, node.probe_keys)
+                return self._attach_mark(probe, mask, node.mark)
             out = join_n1(
                 probe,
                 bs,
@@ -376,6 +389,13 @@ class Executor:
         matched = self._shrink(matched)
         rid_type = T.BIGINT
         bs2 = build(matched, (ir.ColumnRef(rid, rid_type),))
+        if node.mark is not None:
+            from ..ops.join import semi_match_mask
+
+            mask = semi_match_mask(
+                probe2, bs2, (ir.ColumnRef(rid, rid_type),)
+            )
+            return self._attach_mark(probe, mask, node.mark)
         out = join_n1(
             probe2,
             bs2,

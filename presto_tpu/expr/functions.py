@@ -329,7 +329,15 @@ def _divide(a: Val, b: Val, out_type: T.Type) -> Val:
             valid = and_valid(valid, y != 0)
             return Val(d128.from_int64(q), valid, out_type)
         # scale numerator so raw-int division yields out_type.scale
-        x = _rescale(a.data.astype(jnp.int64), xs, out_type.scale + ys)
+        x_src = a.data
+        if x_src.ndim == 2:
+            # long-decimal numerator with a short result type (avg's
+            # sum/count division): narrow lanes to raw int64 units first
+            # (exact while the value fits — the checked-cast contract)
+            from ..ops import decimal128 as d128
+
+            x_src = d128.to_int64(x_src)
+        x = _rescale(x_src.astype(jnp.int64), xs, out_type.scale + ys)
         y = b.data.astype(jnp.int64)
         safe = jnp.where(y == 0, 1, y)
         q = _div_round(x, safe)

@@ -162,6 +162,16 @@ def join_n1(
     raise ValueError(f"unknown join kind {kind!r}")
 
 
+def semi_match_mask(probe: Page, bs: BuildSide, probe_key_exprs) -> jnp.ndarray:
+    """Boolean per-probe-row match membership (the mark-join kernel:
+    reference HashSemiJoinOperator's semiJoinOutput channel)."""
+    probe_keys = [evaluate(e, probe) for e in probe_key_exprs]
+    live = probe.live_mask()
+    _, lo, hi = _probe_ranges(bs, probe_keys, probe.capacity)
+    matched, _ = _collision_scan(bs, probe_keys, lo, hi)
+    return matched & live
+
+
 def join_expand(
     probe: Page,
     bs: BuildSide,

@@ -1209,20 +1209,33 @@ class Planner:
         distinct-argument channel IS the distinct count (reference:
         OptimizeMixedDistinctAggregations)."""
         plain_specs = [a for a in aggs if not a.func.startswith("distinct_")]
-        mergeable = {"sum", "count", "count_star", "min", "max"}
+        mergeable = {"sum", "count", "count_star", "min", "max", "avg"}
         if any(a.func not in mergeable for a in plain_specs):
             raise PlanningError(
                 "mixing DISTINCT with non-decomposable aggregates "
-                "(avg/checksum) is not supported"
+                "(checksum/min_by/...) is not supported"
             )
         darg = distinct_specs[0].input
         dch = self.channel("darg")
-        s1_aggs = [
-            AggSpec(
-                a.func, a.input, self.channel(f"part_{a.func}"), a.output_type
-            )
-            for a in plain_specs
-        ]
+        # avg decomposes into (sum, count) partials merged by sum, divided
+        # in a final projection — decimal divide rounds HALF_UP at the
+        # output scale, identical to the engine's avg finalization
+        s1_aggs: List[AggSpec] = []
+        parts: Dict[str, tuple] = {}  # plain name -> partial spec(s)
+        for a in plain_specs:
+            if a.func == "avg":
+                sum_t = AggSpec.infer_output_type("sum", a.input.type)
+                s = AggSpec("sum", a.input, self.channel("part_sum"), sum_t)
+                c = AggSpec("count", a.input, self.channel("part_cnt"), T.BIGINT)
+                s1_aggs.extend((s, c))
+                parts[a.name] = ("avg", s, c)
+            else:
+                p = AggSpec(
+                    a.func, a.input, self.channel(f"part_{a.func}"),
+                    a.output_type,
+                )
+                s1_aggs.append(p)
+                parts[a.name] = ("simple", p)
         stage1 = N.Aggregate(
             child,
             tuple(group_exprs) + (darg,),
@@ -1236,15 +1249,33 @@ class Planner:
             "sum": "sum", "count": "sum", "count_star": "sum",
             "min": "min", "max": "max",
         }
-        s2_aggs = [
-            AggSpec(
-                merge_func[a.func],
-                ir.ColumnRef(p.name, p.output_type),
-                a.name,
-                a.output_type,
-            )
-            for a, p in zip(plain_specs, s1_aggs)
-        ]
+        s2_aggs = []
+        for a in plain_specs:
+            kind = parts[a.name]
+            if kind[0] == "avg":
+                _, s, c = kind
+                s2_aggs.append(
+                    AggSpec(
+                        "sum", ir.ColumnRef(s.name, s.output_type),
+                        s.name, s.output_type,
+                    )
+                )
+                s2_aggs.append(
+                    AggSpec(
+                        "sum", ir.ColumnRef(c.name, T.BIGINT),
+                        c.name, T.BIGINT,
+                    )
+                )
+            else:
+                p = kind[1]
+                s2_aggs.append(
+                    AggSpec(
+                        merge_func[a.func],
+                        ir.ColumnRef(p.name, p.output_type),
+                        a.name,
+                        a.output_type,
+                    )
+                )
         for a in distinct_specs:
             s2_aggs.append(
                 dataclasses.replace(
@@ -1256,24 +1287,42 @@ class Planner:
         node = N.Aggregate(
             stage1, s2_groups, tuple(group_names), tuple(s2_aggs)
         )
-        # empty global input: merged counts come out NULL from sum; the SQL
-        # answer is 0 — coalesce count-rooted outputs
+        # final projection: original output order; avg = sum/count; empty
+        # global input leaves merged counts NULL where SQL answers 0
         count_names = {
             a.name for a in plain_specs if a.func in ("count", "count_star")
         }
-        if count_names:
+        avg_names = {a.name for a in plain_specs if a.func == "avg"}
+        if count_names or avg_names:
             exprs, names = [], []
-            for ch, ty in node.fields:
-                ref = ir.ColumnRef(ch, ty)
-                if ch in count_names:
+            for nm, e in zip(group_names, group_exprs):
+                exprs.append(ir.ColumnRef(nm, e.type))
+                names.append(nm)
+            for a in aggs:
+                if a.name in avg_names:
+                    _, s, c = parts[a.name]
                     exprs.append(
                         ir.Call(
-                            "coalesce", (ref, ir.Literal(0, ty)), ty
+                            "divide",
+                            (
+                                ir.ColumnRef(s.name, s.output_type),
+                                ir.ColumnRef(c.name, T.BIGINT),
+                            ),
+                            a.output_type,
+                        )
+                    )
+                elif a.name in count_names:
+                    ref = ir.ColumnRef(a.name, a.output_type)
+                    exprs.append(
+                        ir.Call(
+                            "coalesce",
+                            (ref, ir.Literal(0, a.output_type)),
+                            a.output_type,
                         )
                     )
                 else:
-                    exprs.append(ref)
-                names.append(ch)
+                    exprs.append(ir.ColumnRef(a.name, a.output_type))
+                names.append(a.name)
             node = N.Project(node, tuple(exprs), tuple(names))
         return node, True
 
@@ -1438,13 +1487,17 @@ class PoolItem:
     plan: RelationPlan
     channels: set
     estimate: float
+    stats: object = None  # plan.stats.PlanStats
 
 
 class FromPlanner:
     """Flattens the FROM clause into a relation pool + join edges, classifies
-    WHERE conjuncts, and assembles a greedy join order (reference
-    ReorderJoins, radically simplified: sizes from catalog row counts,
-    filters assumed selective)."""
+    WHERE conjuncts, and assembles a cost-based greedy join order: the next
+    relation is the one whose join with the current tree has the smallest
+    ESTIMATED OUTPUT (reference ReorderJoins + JoinStatsRule), with the
+    smaller estimated side as the build side. Estimates come from the
+    stats framework (plan/stats.py: connector NDV/min/max/null-fraction
+    derived through filters)."""
 
     def __init__(self, planner: Planner, outer, ctes):
         self.p = planner
@@ -1473,8 +1526,12 @@ class FromPlanner:
             self.pool.append(item)
             return
         rp = self.p.plan_relation(rel, self.outer, self.ctes)
-        est = self._estimate(rp.node)
-        self.pool.append(PoolItem(rp, {f.channel for f in rp.scope.fields}, est))
+        st = self._stats(rp.node)
+        self.pool.append(
+            PoolItem(
+                rp, {f.channel for f in rp.scope.fields}, st.rows, st
+            )
+        )
 
     def _plan_outer_join(self, rel: t.Join) -> PoolItem:
         kind = rel.kind
@@ -1531,31 +1588,13 @@ class FromPlanner:
             kind, left.node, rnode, tuple(lkeys), tuple(rkeys), res, unique
         )
         rp = RelationPlan(node, combined)
-        return PoolItem(
-            rp,
-            left_chs | right_chs,
-            max(self._estimate(left.node), self._estimate(rnode)),
-        )
+        st = self._stats(node)
+        return PoolItem(rp, left_chs | right_chs, st.rows, st)
 
-    def _estimate(self, node: N.PlanNode) -> float:
-        if isinstance(node, N.TableScan):
-            try:
-                return float(self.p.catalog.row_count(node.table))
-            except Exception:
-                return 1e6
-        if isinstance(node, N.Filter):
-            return 0.2 * self._estimate(node.child)
-        if isinstance(node, N.Aggregate):
-            return max(1.0, 0.1 * self._estimate(node.child))
-        if isinstance(node, (N.Distinct,)):
-            return 0.5 * self._estimate(node.child)
-        if isinstance(node, N.Join):
-            return max(self._estimate(node.left), self._estimate(node.right))
-        if isinstance(node, (N.TopN, N.Limit)):
-            return float(node.count)
-        if node.children:
-            return max(self._estimate(c) for c in node.children)
-        return 1e6
+    def _stats(self, node: N.PlanNode):
+        from ..plan.stats import derive
+
+        return derive(node, self.p.catalog)
 
     def assemble(self, where: Optional[t.Node]) -> Tuple[N.PlanNode, Scope]:
         if not self.pool:
@@ -1647,7 +1686,8 @@ class FromPlanner:
                 it.plan = RelationPlan(
                     N.Filter(it.plan.node, e), it.plan.scope
                 )
-                it.estimate *= _selectivity(e)
+                it.stats = self._stats(it.plan.node)
+                it.estimate = it.stats.rows
                 continue
             if len(owners) == 2 and isinstance(e, ir.Call) and e.name == "eq":
                 a, b = e.args
@@ -1677,26 +1717,18 @@ class FromPlanner:
                 plan = N.Filter(plan, e)
             return finish(plan)
 
+        from ..plan.stats import join_output_rows
+
         remaining = set(range(n_items))
         start = min(remaining, key=lambda i: self.pool[i].estimate)
         joined = {start}
         remaining.discard(start)
         plan = self.pool[start].plan.node
-        est = self.pool[start].estimate
+        cur_stats = self.pool[start].stats
         applied_res: set = set()
 
-        while remaining:
-            # candidates connected by an edge
-            cand = set()
-            for (i, j, _, _) in edges:
-                if i in joined and j in remaining:
-                    cand.add(j)
-                if j in joined and i in remaining:
-                    cand.add(i)
-            if cand:
-                nxt = min(cand, key=lambda i: self.pool[i].estimate)
-            else:
-                nxt = min(remaining, key=lambda i: self.pool[i].estimate)
+        def edge_keys(nxt: int):
+            """(tree-side, candidate-side) key expression lists."""
             lkeys, rkeys = [], []
             for (i, j, a, b) in edges:
                 if i in joined and j == nxt:
@@ -1705,20 +1737,61 @@ class FromPlanner:
                 elif j in joined and i == nxt:
                     lkeys.append(b)
                     rkeys.append(a)
+            return lkeys, rkeys
+
+        while remaining:
+            # candidates connected by an edge; pick the one whose join
+            # with the current tree has the smallest estimated OUTPUT
+            # (reference ReorderJoins cost comparison)
+            cand = set()
+            for (i, j, _, _) in edges:
+                if i in joined and j in remaining:
+                    cand.add(j)
+                if j in joined and i in remaining:
+                    cand.add(i)
+
+            def join_est(c: int) -> float:
+                lk, rk = edge_keys(c)
+                return join_output_rows(
+                    cur_stats, self.pool[c].stats, lk, rk, "inner"
+                )
+
+            if cand:
+                nxt = min(cand, key=lambda i: (join_est(i), self.pool[i].estimate))
+            else:
+                nxt = min(remaining, key=lambda i: self.pool[i].estimate)
+            lkeys, rkeys = edge_keys(nxt)
             rnode = self.pool[nxt].plan.node
-            unique = _build_side_unique(rnode, rkeys, self.p.catalog)
-            plan = N.Join(
-                "inner",
-                plan,
-                rnode,
-                tuple(lkeys),
-                tuple(rkeys),
-                None,
-                unique,
-            )
+            # build side = smaller estimated side (reference: CBO flips the
+            # join so the hash build is the cheaper input), except keep a
+            # UNIQUE build side — the n:1 fast path beats a smaller build
+            tree_rows = cur_stats.rows if cur_stats else 1e9
+            cand_rows = self.pool[nxt].estimate
+            unique_r = _build_side_unique(rnode, rkeys, self.p.catalog)
+            if not unique_r and cand_rows > tree_rows and lkeys:
+                unique_l = _build_side_unique(plan, lkeys, self.p.catalog)
+                plan = N.Join(
+                    "inner",
+                    rnode,
+                    plan,
+                    tuple(rkeys),
+                    tuple(lkeys),
+                    None,
+                    unique_l,
+                )
+            else:
+                plan = N.Join(
+                    "inner",
+                    plan,
+                    rnode,
+                    tuple(lkeys),
+                    tuple(rkeys),
+                    None,
+                    unique_r,
+                )
             joined.add(nxt)
             remaining.discard(nxt)
-            est = max(est, self.pool[nxt].estimate)
+            cur_stats = self._stats(plan)
             # apply residuals that became fully available
             joined_channels = set()
             for i in joined:
@@ -1929,13 +2002,35 @@ class SelectContext:
         if isinstance(ast, t.LogicalOp):
             # EXISTS/IN translate by mutating the plan with a SemiJoin and
             # returning None — only valid as top-level WHERE conjuncts.
-            # Detect them in non-conjunct position BEFORE mutating the plan.
+            # Under OR, a direct EXISTS/IN term instead plans a MARK
+            # semi-join (no filtering; a boolean membership column replaces
+            # the predicate — reference semiJoinOutput). NOT IN stays
+            # unsupported there: its NULL semantics differ from NOT mark.
             if ast.op == "or" and any(
                 _contains_subquery_pred(x) for x in ast.terms
             ):
-                raise PlanningError(
-                    "EXISTS/IN subquery under OR is not supported"
-                )
+                marked = []
+                for x in ast.terms:
+                    if isinstance(x, t.Exists):
+                        marked.append(self._subquery_mark(x, negate=False))
+                    elif isinstance(x, t.InSubquery) and not getattr(
+                        x, "negated", False
+                    ):
+                        marked.append(self._subquery_mark(x, negate=False))
+                    elif isinstance(x, t.NotOp) and isinstance(
+                        x.operand, t.Exists
+                    ):
+                        marked.append(
+                            self._subquery_mark(x.operand, negate=True)
+                        )
+                    elif _contains_subquery_pred(x):
+                        raise PlanningError(
+                            "subquery under OR is only supported as a "
+                            "direct EXISTS / IN / NOT EXISTS term"
+                        )
+                    else:
+                        marked.append(self._tr(x))
+                return ir.Call("or", tuple(marked), T.BOOLEAN)
             terms = tuple(self._tr(x) for x in ast.terms)
             if any(x is None for x in terms):
                 raise PlanningError(
@@ -2153,6 +2248,21 @@ class SelectContext:
         sub.plan_in(ast.query, value, self.holder, anti=negate)
         return None
 
+    def _subquery_mark(self, ast, negate: bool) -> ir.RowExpression:
+        """Plan EXISTS / IN as a MARK semi-join and return the boolean
+        membership column (usable inside OR, unlike the filtering form).
+        EXISTS is two-valued, so NOT of the mark is exact."""
+        self._require_holder()
+        mark = self.p.channel("mark")
+        sub = SubqueryPlanner(self.p, self, self.ctes)
+        if isinstance(ast, t.Exists):
+            sub.plan_exists(ast.query, self.holder, anti=False, mark=mark)
+        else:
+            value = self._tr(ast.value)
+            sub.plan_in(ast.query, value, self.holder, anti=False, mark=mark)
+        ref = ir.ColumnRef(mark, T.BOOLEAN)
+        return ir.not_(ref) if negate else ref
+
     def translate_conjunct_or_apply(self, conj) -> Optional[ir.RowExpression]:
         return self.translate(conj)
 
@@ -2224,7 +2334,8 @@ class SubqueryPlanner:
         )
         return ir.ColumnRef(out_name, out_type)
 
-    def plan_exists(self, q: t.Query, holder: PlanHolder, anti: bool):
+    def plan_exists(self, q: t.Query, holder: PlanHolder, anti: bool,
+                    mark: Optional[str] = None):
         rp, corr = self._plan_with_correlation(q)
         if not corr.pairs:
             raise PlanningError("uncorrelated EXISTS not yet supported")
@@ -2254,9 +2365,11 @@ class SubqueryPlanner:
             tuple(inner for (inner, _outer) in corr.pairs),
             anti=anti,
             residual=residual,
+            mark=mark,
         )
 
-    def plan_in(self, q: t.Query, value: ir.RowExpression, holder: PlanHolder, anti: bool):
+    def plan_in(self, q: t.Query, value: ir.RowExpression, holder: PlanHolder,
+                anti: bool, mark: Optional[str] = None):
         rp, corr = self._plan_with_correlation(q)
         if corr.pairs or corr.residuals:
             raise PlanningError("correlated IN subquery not yet supported")
@@ -2269,6 +2382,7 @@ class SubqueryPlanner:
             (value,),
             (ir.ColumnRef(name, typ),),
             anti=anti,
+            mark=mark,
         )
 
 

@@ -127,15 +127,92 @@ def test_order_by_ordinal_out_of_range(session):
             session.query(f"select n_name from nation order by {bad}")
 
 
-def test_exists_under_or_rejected(session):
-    from presto_tpu.sql.planner import PlanningError
+def test_exists_under_or_plans_mark_semijoin(session):
+    """EXISTS under OR plans a MARK semi-join (membership column, no
+    filtering — reference semiJoinOutput); verified against the
+    equivalent UNION of the two disjuncts."""
+    got = session.query(
+        "select count(*) as c from orders where exists "
+        "(select 1 from lineitem where l_orderkey = o_orderkey "
+        " and l_quantity > 45) "
+        "or o_totalprice < 5000"
+    ).rows()
+    want = session.query(
+        "select count(*) as c from ("
+        "  select o_orderkey from orders where exists "
+        "  (select 1 from lineitem where l_orderkey = o_orderkey "
+        "   and l_quantity > 45) "
+        "  union "
+        "  select o_orderkey from orders where o_totalprice < 5000"
+        ") u"
+    ).rows()
+    assert got == want and got[0][0] > 0
 
-    with pytest.raises(PlanningError, match="OR"):
-        session.query(
-            "select count(*) as c from orders where exists "
-            "(select 1 from lineitem where l_orderkey = o_orderkey) "
-            "or o_orderkey = 1"
-        )
+
+def test_in_subquery_under_or(session):
+    got = session.query(
+        "select count(*) c from orders where o_orderkey in "
+        "(select l_orderkey from lineitem where l_quantity > 45) "
+        "or o_totalprice < 5000"
+    ).rows()
+    want = session.query(
+        "select count(*) as c from ("
+        "  select o_orderkey from orders where o_orderkey in "
+        "  (select l_orderkey from lineitem where l_quantity > 45) "
+        "  union "
+        "  select o_orderkey from orders where o_totalprice < 5000"
+        ") u"
+    ).rows()
+    assert got == want and got[0][0] > 0
+
+
+def test_not_exists_under_or(session):
+    # every TPC-H order has lineitems, so the NOT EXISTS disjunct is
+    # empty: the OR must reduce exactly to the price predicate
+    got = session.query(
+        "select count(*) c from orders where not exists "
+        "(select 1 from lineitem where l_orderkey = o_orderkey) "
+        "or o_totalprice < 5000"
+    ).rows()
+    want = session.query(
+        "select count(*) c from orders where o_totalprice < 5000"
+    ).rows()
+    assert got == want and got[0][0] > 0
+
+
+def test_mixed_distinct_and_avg(session):
+    got = session.query(
+        "select l_returnflag, avg(l_quantity) aq, "
+        "count(distinct l_suppkey) cd, count(*) n, sum(l_quantity) s "
+        "from lineitem group by l_returnflag order by l_returnflag"
+    ).rows()
+    base = session.query(
+        "select l_returnflag, avg(l_quantity) aq, count(*) n, "
+        "sum(l_quantity) s from lineitem "
+        "group by l_returnflag order by l_returnflag"
+    ).rows()
+    dist = session.query(
+        "select l_returnflag, count(distinct l_suppkey) cd from lineitem "
+        "group by l_returnflag order by l_returnflag"
+    ).rows()
+    assert [(r[0], r[1], r[3], r[4]) for r in got] == base
+    assert [(r[0], r[2]) for r in got] == dist
+
+
+def test_mixed_distinct_avg_global_and_empty(session):
+    got = session.query(
+        "select avg(l_quantity) aq, count(distinct l_suppkey) cd, "
+        "count(*) n from lineitem where l_quantity > 1000"
+    ).rows()
+    assert got == [(None, 0, 0)]
+    got = session.query(
+        "select avg(l_extendedprice) aq, count(distinct l_suppkey) cd "
+        "from lineitem"
+    ).rows()
+    want = session.query(
+        "select avg(l_extendedprice) aq from lineitem"
+    ).rows()
+    assert got[0][0] == want[0][0] and got[0][1] > 0
 
 
 def test_try_cast_rejected_until_supported(session):
