@@ -3,9 +3,11 @@ parameters — same category as the TPC-H texts in tpch_sql.py; the
 reference ships them under presto-benchto-benchmarks and tests them via
 presto-tpcds). Subset chosen to exercise every supported engine feature:
 multi-fact joins, date-dim filters, CASE buckets, correlated scalar
-subqueries, EXISTS, CTE full-outer joins, count(distinct), day-diff
-buckets. Queries combining GROUPING SETS with window functions (Q36/Q86)
-are excluded until windows can run over the unioned sets.
+subqueries, EXISTS (incl. under OR via mark semi-joins), CTE full-outer
+joins, count(distinct), day-diff buckets, windows over ROLLUP
+(Q36/Q70/Q86 — planned as an aggregation union feeding the window).
+Ratio expressions cast to double where the spec's decimal division
+would otherwise round at a dialect-specific scale.
 """
 
 QUERIES = {
@@ -1142,6 +1144,759 @@ where d_date between date '1999-02-01' and (date '1999-02-01' + interval '60' da
                   from web_returns wr1
                   where ws1.ws_order_number = wr1.wr_order_number)
 order by count(distinct ws_order_number)
+limit 100
+""",
+    1: """
+with customer_total_return as
+  (select sr_customer_sk as ctr_customer_sk, sr_store_sk as ctr_store_sk,
+          sum(sr_return_amt) as ctr_total_return
+   from store_returns, date_dim
+   where sr_returned_date_sk = d_date_sk and d_year = 2000
+   group by sr_customer_sk, sr_store_sk)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return >
+      (select avg(ctr_total_return) * 1.2
+       from customer_total_return ctr2
+       where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk
+  and s_state = 'TN'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+""",
+    6: """
+select a.ca_state state, count(*) cnt
+from customer_address a, customer c, store_sales s, date_dim d, item i
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk
+  and s.ss_item_sk = i.i_item_sk
+  and d.d_month_seq =
+      (select distinct d_month_seq from date_dim
+       where d_year = 2001 and d_moy = 1)
+  and i.i_current_price >
+      1.2 * (select avg(j.i_current_price) from item j
+             where j.i_category = i.i_category)
+group by a.ca_state
+having count(*) >= 10
+order by cnt, a.ca_state
+limit 100
+""",
+    10: """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3,
+       cd_dep_count, count(*) cnt4, cd_dep_employed_count, count(*) cnt5,
+       cd_dep_college_count, count(*) cnt6
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county = 'Rush County'
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_moy between 1 and 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_moy between 1 and 4)
+    or exists (select * from catalog_sales, date_dim
+               where c.c_customer_sk = cs_ship_customer_sk
+                 and cs_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_moy between 1 and 4))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+""",
+    30: """
+with customer_total_return as
+  (select wr_returning_customer_sk as ctr_customer_sk, ca_state as ctr_state,
+          sum(wr_return_amt) as ctr_total_return
+   from web_returns, date_dim, customer_address
+   where wr_returned_date_sk = d_date_sk and d_year = 2002
+     and wr_returning_addr_sk = ca_address_sk
+   group by wr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+       c_email_address, c_last_review_date_sk, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return >
+      (select avg(ctr_total_return) * 1.2
+       from customer_total_return ctr2
+       where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'GA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+         c_email_address, c_last_review_date_sk, ctr_total_return
+limit 100
+""",
+    33: """
+with ss as
+  (select i_manufact_id, sum(ss_ext_sales_price) total_sales
+   from store_sales, date_dim, customer_address, item
+   where i_manufact_id in (select i_manufact_id from item
+                           where i_category in ('Electronics'))
+     and ss_item_sk = i_item_sk
+     and ss_sold_date_sk = d_date_sk
+     and d_year = 1998 and d_moy = 5
+     and ss_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_manufact_id),
+ cs as
+  (select i_manufact_id, sum(cs_ext_sales_price) total_sales
+   from catalog_sales, date_dim, customer_address, item
+   where i_manufact_id in (select i_manufact_id from item
+                           where i_category in ('Electronics'))
+     and cs_item_sk = i_item_sk
+     and cs_sold_date_sk = d_date_sk
+     and d_year = 1998 and d_moy = 5
+     and cs_bill_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_manufact_id),
+ ws as
+  (select i_manufact_id, sum(ws_ext_sales_price) total_sales
+   from web_sales, date_dim, customer_address, item
+   where i_manufact_id in (select i_manufact_id from item
+                           where i_category in ('Electronics'))
+     and ws_item_sk = i_item_sk
+     and ws_sold_date_sk = d_date_sk
+     and d_year = 1998 and d_moy = 5
+     and ws_bill_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss
+      union all
+      select * from cs
+      union all
+      select * from ws) tmp1
+group by i_manufact_id
+order by total_sales
+limit 100
+""",
+    35: """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count, count(*) cnt1,
+       min(cd_dep_count) mn1, max(cd_dep_count) mx1, avg(cd_dep_count) av1,
+       cd_dep_employed_count, count(*) cnt2, min(cd_dep_employed_count) mn2,
+       max(cd_dep_employed_count) mx2, avg(cd_dep_employed_count) av2,
+       cd_dep_college_count, count(*) cnt3, min(cd_dep_college_count) mn3,
+       max(cd_dep_college_count) mx3, avg(cd_dep_college_count) av3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_qoy < 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_qoy < 4)
+    or exists (select * from catalog_sales, date_dim
+               where c.c_customer_sk = cs_ship_customer_sk
+                 and cs_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+""",
+    56: """
+with ss as
+  (select i_item_id, sum(ss_ext_sales_price) total_sales
+   from store_sales, date_dim, customer_address, item
+   where i_item_id in (select i_item_id from item
+                       where i_color in ('slate', 'blanched', 'burnished'))
+     and ss_item_sk = i_item_sk
+     and ss_sold_date_sk = d_date_sk
+     and d_year = 2001 and d_moy = 2
+     and ss_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_item_id),
+ cs as
+  (select i_item_id, sum(cs_ext_sales_price) total_sales
+   from catalog_sales, date_dim, customer_address, item
+   where i_item_id in (select i_item_id from item
+                       where i_color in ('slate', 'blanched', 'burnished'))
+     and cs_item_sk = i_item_sk
+     and cs_sold_date_sk = d_date_sk
+     and d_year = 2001 and d_moy = 2
+     and cs_bill_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_item_id),
+ ws as
+  (select i_item_id, sum(ws_ext_sales_price) total_sales
+   from web_sales, date_dim, customer_address, item
+   where i_item_id in (select i_item_id from item
+                       where i_color in ('slate', 'blanched', 'burnished'))
+     and ws_item_sk = i_item_sk
+     and ws_sold_date_sk = d_date_sk
+     and d_year = 2001 and d_moy = 2
+     and ws_bill_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss
+      union all
+      select * from cs
+      union all
+      select * from ws) tmp1
+group by i_item_id
+order by total_sales, i_item_id
+limit 100
+""",
+    60: """
+with ss as
+  (select i_item_id, sum(ss_ext_sales_price) total_sales
+   from store_sales, date_dim, customer_address, item
+   where i_item_id in (select i_item_id from item where i_category in ('Music'))
+     and ss_item_sk = i_item_sk
+     and ss_sold_date_sk = d_date_sk
+     and d_year = 1998 and d_moy = 9
+     and ss_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_item_id),
+ cs as
+  (select i_item_id, sum(cs_ext_sales_price) total_sales
+   from catalog_sales, date_dim, customer_address, item
+   where i_item_id in (select i_item_id from item where i_category in ('Music'))
+     and cs_item_sk = i_item_sk
+     and cs_sold_date_sk = d_date_sk
+     and d_year = 1998 and d_moy = 9
+     and cs_bill_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_item_id),
+ ws as
+  (select i_item_id, sum(ws_ext_sales_price) total_sales
+   from web_sales, date_dim, customer_address, item
+   where i_item_id in (select i_item_id from item where i_category in ('Music'))
+     and ws_item_sk = i_item_sk
+     and ws_sold_date_sk = d_date_sk
+     and d_year = 1998 and d_moy = 9
+     and ws_bill_addr_sk = ca_address_sk
+     and ca_gmt_offset = -5
+   group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss
+      union all
+      select * from cs
+      union all
+      select * from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+""",
+    69: """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KY', 'GA', 'NM')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2001 and d_moy between 4 and 6)
+  and not exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+  and not exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+limit 100
+""",
+    81: """
+with customer_total_return as
+  (select cr_returning_customer_sk as ctr_customer_sk, ca_state as ctr_state,
+          sum(cr_return_amt_inc_tax) as ctr_total_return
+   from catalog_returns, date_dim, customer_address
+   where cr_returned_date_sk = d_date_sk and d_year = 2000
+     and cr_returning_addr_sk = ca_address_sk
+   group by cr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+       ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+       ca_location_type, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return >
+      (select avg(ctr_total_return) * 1.2
+       from customer_total_return ctr2
+       where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'GA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+         ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+         ca_location_type, ctr_total_return
+limit 100
+""",
+    2: """
+with wscs as
+  (select sold_date_sk, sales_price
+   from (select ws_sold_date_sk sold_date_sk, ws_ext_sales_price sales_price
+         from web_sales
+         union all
+         select cs_sold_date_sk sold_date_sk, cs_ext_sales_price sales_price
+         from catalog_sales) u),
+ wswscs as
+  (select d_week_seq,
+          sum(case when (d_day_name = 'Sunday') then sales_price else null end) sun_sales,
+          sum(case when (d_day_name = 'Monday') then sales_price else null end) mon_sales,
+          sum(case when (d_day_name = 'Tuesday') then sales_price else null end) tue_sales,
+          sum(case when (d_day_name = 'Wednesday') then sales_price else null end) wed_sales,
+          sum(case when (d_day_name = 'Thursday') then sales_price else null end) thu_sales,
+          sum(case when (d_day_name = 'Friday') then sales_price else null end) fri_sales,
+          sum(case when (d_day_name = 'Saturday') then sales_price else null end) sat_sales
+   from wscs, date_dim
+   where d_date_sk = sold_date_sk
+   group by d_week_seq)
+select d_week_seq1,
+       round(sun_sales1 / sun_sales2, 2) r1,
+       round(mon_sales1 / mon_sales2, 2) r2,
+       round(tue_sales1 / tue_sales2, 2) r3,
+       round(wed_sales1 / wed_sales2, 2) r4,
+       round(thu_sales1 / thu_sales2, 2) r5,
+       round(fri_sales1 / fri_sales2, 2) r6,
+       round(sat_sales1 / sat_sales2, 2) r7
+from (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1, wed_sales wed_sales1,
+             thu_sales thu_sales1, fri_sales fri_sales1, sat_sales sat_sales1
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2001) y,
+     (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2, wed_sales wed_sales2,
+             thu_sales thu_sales2, fri_sales fri_sales2, sat_sales sat_sales2
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2002) z
+where d_week_seq1 = d_week_seq2 - 53
+order by d_week_seq1
+""",
+    31: """
+with ss as
+  (select ca_county, d_qoy, d_year, sum(ss_ext_sales_price) as store_sales
+   from store_sales, date_dim, customer_address
+   where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk
+   group by ca_county, d_qoy, d_year),
+ ws as
+  (select ca_county, d_qoy, d_year, sum(ws_ext_sales_price) as web_sales
+   from web_sales, date_dim, customer_address
+   where ws_sold_date_sk = d_date_sk and ws_bill_addr_sk = ca_address_sk
+   group by ca_county, d_qoy, d_year)
+select ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase,
+       ws3.web_sales / ws2.web_sales web_q2_q3_increase,
+       ss3.store_sales / ss2.store_sales store_q2_q3_increase
+from ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+where ss1.d_qoy = 1 and ss1.d_year = 2000
+  and ss1.ca_county = ss2.ca_county
+  and ss2.d_qoy = 2 and ss2.d_year = 2000
+  and ss2.ca_county = ss3.ca_county
+  and ss3.d_qoy = 3 and ss3.d_year = 2000
+  and ss1.ca_county = ws1.ca_county
+  and ws1.d_qoy = 1 and ws1.d_year = 2000
+  and ws1.ca_county = ws2.ca_county
+  and ws2.d_qoy = 2 and ws2.d_year = 2000
+  and ws1.ca_county = ws3.ca_county
+  and ws3.d_qoy = 3 and ws3.d_year = 2000
+  and case when ws1.web_sales > 0 then ws2.web_sales / ws1.web_sales
+           else null end
+      > case when ss1.store_sales > 0 then ss2.store_sales / ss1.store_sales
+             else null end
+  and case when ws2.web_sales > 0 then ws3.web_sales / ws2.web_sales
+           else null end
+      > case when ss2.store_sales > 0 then ss3.store_sales / ss2.store_sales
+             else null end
+order by ss1.ca_county
+""",
+    46: """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_dow in (6, 0)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Fairview', 'Midway')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100
+""",
+    47: """
+with v1 as
+  (select i_category, i_brand, s_store_name, s_company_name, d_year, d_moy,
+          sum(ss_sales_price) sum_sales,
+          avg(sum(ss_sales_price)) over
+            (partition by i_category, i_brand, s_store_name, s_company_name,
+                          d_year) avg_monthly_sales,
+          rank() over
+            (partition by i_category, i_brand, s_store_name, s_company_name
+             order by d_year, d_moy) rn
+   from item, store_sales, date_dim, store
+   where ss_item_sk = i_item_sk
+     and ss_sold_date_sk = d_date_sk
+     and ss_store_sk = s_store_sk
+     and (d_year = 1999
+          or (d_year = 1998 and d_moy = 12)
+          or (d_year = 2000 and d_moy = 1))
+   group by i_category, i_brand, s_store_name, s_company_name, d_year, d_moy),
+ v2 as
+  (select v1.i_category, v1.i_brand, v1.s_store_name, v1.s_company_name,
+          v1.d_year, v1.d_moy, v1.avg_monthly_sales, v1.sum_sales,
+          v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+   from v1, v1 v1_lag, v1 v1_lead
+   where v1.i_category = v1_lag.i_category
+     and v1.i_category = v1_lead.i_category
+     and v1.i_brand = v1_lag.i_brand
+     and v1.i_brand = v1_lead.i_brand
+     and v1.s_store_name = v1_lag.s_store_name
+     and v1.s_store_name = v1_lead.s_store_name
+     and v1.s_company_name = v1_lag.s_company_name
+     and v1.s_company_name = v1_lead.s_company_name
+     and v1.rn = v1_lag.rn + 1
+     and v1.rn = v1_lead.rn - 1)
+select *
+from v2
+where d_year = 1999
+  and avg_monthly_sales > 0
+  and case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name
+limit 100
+""",
+    57: """
+with v1 as
+  (select i_category, i_brand, cc_name, d_year, d_moy,
+          sum(cs_sales_price) sum_sales,
+          avg(sum(cs_sales_price)) over
+            (partition by i_category, i_brand, cc_name, d_year)
+            avg_monthly_sales,
+          rank() over
+            (partition by i_category, i_brand, cc_name
+             order by d_year, d_moy) rn
+   from item, catalog_sales, date_dim, call_center
+   where cs_item_sk = i_item_sk
+     and cs_sold_date_sk = d_date_sk
+     and cc_call_center_sk = cs_call_center_sk
+     and (d_year = 1999
+          or (d_year = 1998 and d_moy = 12)
+          or (d_year = 2000 and d_moy = 1))
+   group by i_category, i_brand, cc_name, d_year, d_moy),
+ v2 as
+  (select v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+          v1.avg_monthly_sales, v1.sum_sales,
+          v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+   from v1, v1 v1_lag, v1 v1_lead
+   where v1.i_category = v1_lag.i_category
+     and v1.i_category = v1_lead.i_category
+     and v1.i_brand = v1_lag.i_brand
+     and v1.i_brand = v1_lead.i_brand
+     and v1.cc_name = v1_lag.cc_name
+     and v1.cc_name = v1_lead.cc_name
+     and v1.rn = v1_lag.rn + 1
+     and v1.rn = v1_lead.rn - 1)
+select *
+from v2
+where d_year = 1999
+  and avg_monthly_sales > 0
+  and case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, cc_name
+limit 100
+""",
+    59: """
+with wss as
+  (select d_week_seq, ss_store_sk,
+          sum(case when (d_day_name = 'Sunday') then ss_sales_price else null end) sun_sales,
+          sum(case when (d_day_name = 'Monday') then ss_sales_price else null end) mon_sales,
+          sum(case when (d_day_name = 'Tuesday') then ss_sales_price else null end) tue_sales,
+          sum(case when (d_day_name = 'Wednesday') then ss_sales_price else null end) wed_sales,
+          sum(case when (d_day_name = 'Thursday') then ss_sales_price else null end) thu_sales,
+          sum(case when (d_day_name = 'Friday') then ss_sales_price else null end) fri_sales,
+          sum(case when (d_day_name = 'Saturday') then ss_sales_price else null end) sat_sales
+   from store_sales, date_dim
+   where d_date_sk = ss_sold_date_sk
+   group by d_week_seq, ss_store_sk)
+select s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2 r1, mon_sales1 / mon_sales2 r2,
+       tue_sales1 / tue_sales2 r3, wed_sales1 / wed_sales2 r4,
+       thu_sales1 / thu_sales2 r5, fri_sales1 / fri_sales2 r6,
+       sat_sales1 / sat_sales2 r7
+from (select s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             s_store_id s_store_id1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq
+        and ss_store_sk = s_store_sk
+        and d_month_seq between 1212 and 1223) y,
+     (select s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             s_store_id s_store_id2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq
+        and ss_store_sk = s_store_sk
+        and d_month_seq between 1224 and 1235) x
+where s_store_id1 = s_store_id2
+  and d_week_seq1 = d_week_seq2 - 52
+order by s_store_name1, s_store_id1, d_week_seq1
+limit 100
+""",
+    68: """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Fairview', 'Midway')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+""",
+    74: """
+with year_total as
+  (select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name, d_year as year1,
+          sum(ss_net_paid) year_total, 's' sale_type
+   from customer, store_sales, date_dim
+   where c_customer_sk = ss_customer_sk
+     and ss_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name, d_year
+   union all
+   select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name, d_year as year1,
+          sum(ws_net_paid) year_total, 'w' sale_type
+   from customer, web_sales, date_dim
+   where c_customer_sk = ws_bill_customer_sk
+     and ws_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name, d_year)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's'
+  and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's'
+  and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.year1 = 2001
+  and t_s_secyear.year1 = 2002
+  and t_w_firstyear.year1 = 2001
+  and t_w_secyear.year1 = 2002
+  and t_s_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else null end
+order by 1, 2, 3
+limit 100
+""",
+    83: """
+with sr_items as
+  (select i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+   from store_returns, item, date_dim
+   where sr_item_sk = i_item_sk
+     and d_date in (select d_date from date_dim
+                    where d_week_seq in
+                          (select d_week_seq from date_dim
+                           where d_date in (date '2000-06-30',
+                                            date '2000-09-27',
+                                            date '2000-11-17')))
+     and sr_returned_date_sk = d_date_sk
+   group by i_item_id),
+ cr_items as
+  (select i_item_id item_id, sum(cr_return_quantity) cr_item_qty
+   from catalog_returns, item, date_dim
+   where cr_item_sk = i_item_sk
+     and d_date in (select d_date from date_dim
+                    where d_week_seq in
+                          (select d_week_seq from date_dim
+                           where d_date in (date '2000-06-30',
+                                            date '2000-09-27',
+                                            date '2000-11-17')))
+     and cr_returned_date_sk = d_date_sk
+   group by i_item_id),
+ wr_items as
+  (select i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+   from web_returns, item, date_dim
+   where wr_item_sk = i_item_sk
+     and d_date in (select d_date from date_dim
+                    where d_week_seq in
+                          (select d_week_seq from date_dim
+                           where d_date in (date '2000-06-30',
+                                            date '2000-09-27',
+                                            date '2000-11-17')))
+     and wr_returned_date_sk = d_date_sk
+   group by i_item_id)
+select sr_items.item_id, sr_item_qty,
+       cast(sr_item_qty as double) / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 sr_dev,
+       cr_item_qty,
+       cast(cr_item_qty as double) / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 cr_dev,
+       wr_item_qty,
+       cast(wr_item_qty as double) / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 wr_dev,
+       cast(sr_item_qty + cr_item_qty + wr_item_qty as double) / 3.0 average
+from sr_items, cr_items, wr_items
+where sr_items.item_id = cr_items.item_id
+  and sr_items.item_id = wr_items.item_id
+order by sr_items.item_id, sr_item_qty
+limit 100
+""",
+    85: """
+select substr(r_reason_desc, 1, 20) rdesc, avg(ws_quantity) q,
+       avg(wr_refunded_cash) rc, avg(wr_fee) f
+from web_sales, web_returns, web_page, customer_demographics cd1,
+     customer_demographics cd2, customer_address, date_dim, reason
+where ws_web_page_sk = wp_web_page_sk
+  and ws_item_sk = wr_item_sk
+  and ws_order_number = wr_order_number
+  and ws_sold_date_sk = d_date_sk and d_year = 2000
+  and cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  and cd2.cd_demo_sk = wr_returning_cdemo_sk
+  and ca_address_sk = wr_refunded_addr_sk
+  and r_reason_sk = wr_reason_sk
+  and ((cd1.cd_marital_status = 'M'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = 'Advanced Degree'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 100.00 and 150.00)
+    or (cd1.cd_marital_status = 'S'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = 'College'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 50.00 and 100.00)
+    or (cd1.cd_marital_status = 'W'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = '2 yr Degree'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 150.00 and 200.00))
+  and ((ca_country = 'United States'
+        and ca_state in ('IN', 'OH', 'NJ')
+        and ws_net_profit between 100 and 200)
+    or (ca_country = 'United States'
+        and ca_state in ('WI', 'CT', 'KY')
+        and ws_net_profit between 150 and 300)
+    or (ca_country = 'United States'
+        and ca_state in ('LA', 'IA', 'AR')
+        and ws_net_profit between 50 and 250))
+group by r_reason_desc
+order by rdesc, q, rc, f
+limit 100
+""",
+    36: """
+select cast(sum(ss_net_profit) as double) /
+         cast(sum(ss_ext_sales_price) as double) as gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by cast(sum(ss_net_profit) as double) /
+                             cast(sum(ss_ext_sales_price) as double) asc)
+         as rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state = 'TN'
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
+    70: """
+select sum(ss_net_profit) as total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) as lochierarchy,
+       rank() over (partition by grouping(s_state) + grouping(s_county),
+                    case when grouping(s_county) = 0 then s_state end
+                    order by sum(ss_net_profit) desc) as rank_within_parent
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_state in
+      (select s_state
+       from (select s_state as s_state,
+                    rank() over (partition by s_state
+                                 order by sum(ss_net_profit) desc) as ranking
+             from store_sales, store, date_dim
+             where d_month_seq between 1200 and 1211
+               and d_date_sk = ss_sold_date_sk
+               and s_store_sk = ss_store_sk
+             group by s_state) tmp1
+       where ranking <= 5)
+group by rollup(s_state, s_county)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent
+limit 100
+""",
+    86: """
+select sum(ws_net_paid) as total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ws_net_paid) desc) as rank_within_parent
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ws_sold_date_sk
+  and i_item_sk = ws_item_sk
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
 limit 100
 """,
 }

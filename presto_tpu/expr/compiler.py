@@ -183,7 +183,18 @@ def _kleene_or(vals: Sequence[Val]) -> Val:
 def _if_val(cond: Val, then: Val, els: Val, out_type: T.Type) -> Val:
     c = cond.data & cond.valid_mask()
     a, b = _align_pair(then, els, out_type)  # same dict_id after alignment
-    data = jnp.where(c, a.data, b.data)
+    da, db = a.data, b.data
+    if da.ndim != db.ndim:
+        # one branch is long-decimal lanes, the other a scalar column
+        # (e.g. a NULL/int literal): widen the scalar side exactly
+        from ..ops import decimal128 as d128
+
+        if da.ndim == 1:
+            da = d128.from_int64(da.astype(jnp.int64))
+        else:
+            db = d128.from_int64(db.astype(jnp.int64))
+    cw = c[:, None] if da.ndim == 2 else c
+    data = jnp.where(cw, da, db)
     if a.valid is None and b.valid is None:
         valid = None
     else:

@@ -275,11 +275,11 @@ class Planner:
             if isinstance(it, t.SelectItem):
                 _collect_windows(it.expr, wins)
         if wins:
-            # a window over grouping sets must run over the UNION of all
-            # sets; the per-set planning below would compute it per set
-            raise PlanningError(
-                "window functions over GROUPING SETS are not supported"
-            )
+            # a window over grouping sets runs over the UNION of all sets:
+            # rewrite into (inner: per-set aggregation union exposing group
+            # columns, aggregates, and grouping() bits) -> (outer: windows
+            # over the union). Reference: GroupIdNode feeding WindowNode.
+            return self._plan_gs_with_windows(sel, full, outer, ctes)
         parts = [
             self.plan_select(
                 dataclasses.replace(sel, group_by=tuple(s)),
@@ -314,6 +314,80 @@ class Planner:
             ]
         )
         return RelationPlan(node, scope)
+
+    def _plan_gs_with_windows(self, sel: t.Select, full, outer, ctes):
+        """Split a grouping-sets SELECT containing window functions into an
+        inner aggregation-only select (per-set union, existing path) and an
+        outer select computing the windows over that union.
+
+        Every group expression, aggregate call, and grouping() call is
+        given an inner output alias; the outer expressions are the original
+        ones with those subtrees replaced by alias references."""
+        aggs: List[t.FunctionCall] = []
+        grps: List[t.FunctionCall] = []
+        for it in sel.items:
+            if isinstance(it, t.SelectItem):
+                _collect_aggregates(it.expr, aggs)
+                _collect_grouping_calls(it.expr, grps)
+        if sel.having is not None:
+            _collect_aggregates(sel.having, aggs)
+
+        mapping: Dict[t.Node, t.Node] = {}
+        inner_items: List[t.SelectItem] = []
+
+        def add_inner(expr: t.Node, alias: str) -> None:
+            inner_items.append(t.SelectItem(expr, alias))
+            mapping[expr] = t.Identifier((alias,))
+
+        seen: set = set()
+        used_aliases: set = set()
+        for i, g in enumerate(full):
+            if g in seen:
+                continue
+            seen.add(g)
+            # bare identifiers keep their natural name; qualified ones
+            # (a.x vs b.x would collide on 'x') and expressions get
+            # positional aliases
+            if isinstance(g, t.Identifier) and len(g.parts) == 1 and (
+                g.parts[-1] not in used_aliases
+            ):
+                alias = g.parts[-1]
+            else:
+                alias = f"_gs{i}"
+            used_aliases.add(alias)
+            add_inner(g, alias)
+        for i, a in enumerate(aggs):
+            if a in seen:
+                continue
+            seen.add(a)
+            add_inner(a, f"_agg{i}")
+        for i, g in enumerate(grps):
+            if g in seen:
+                continue
+            seen.add(g)
+            add_inner(g, f"_grp{i}")
+
+        inner_sel = dataclasses.replace(
+            sel, items=tuple(inner_items), distinct=False
+        )
+        outer_items = tuple(
+            t.SelectItem(_ast_replace(it.expr, mapping), it.alias)
+            if isinstance(it, t.SelectItem)
+            else it
+            for it in sel.items
+        )
+        derived = t.SubqueryRelation(
+            t.Query(body=inner_sel), alias="_gsw", column_aliases=()
+        )
+        outer_sel = t.Select(
+            items=outer_items,
+            from_=derived,
+            where=None,
+            group_by=(),
+            having=None,
+            distinct=sel.distinct,
+        )
+        return self.plan_select(outer_sel, outer, ctes)
 
     @staticmethod
     def _order_item_match(body, order_ast, scope) -> Optional[ir.ColumnRef]:
@@ -1380,6 +1454,58 @@ def _contains_subquery_pred(expr: t.Node) -> bool:
                         if isinstance(y, t.Node) and _contains_subquery_pred(y):
                             return True
     return False
+
+
+def _collect_grouping_calls(expr: t.Node, out: List[t.FunctionCall]):
+    """Find grouping(...) calls (grouping-sets level indicators)."""
+    if isinstance(expr, t.FunctionCall) and expr.name == "grouping":
+        out.append(expr)
+        return
+    if isinstance(expr, (t.ScalarSubquery, t.InSubquery, t.Exists)):
+        return
+    for f in dataclasses.fields(expr):
+        v = getattr(expr, f.name)
+        if isinstance(v, t.Node):
+            _collect_grouping_calls(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, t.Node):
+                    _collect_grouping_calls(x, out)
+
+
+def _ast_replace(node: t.Node, mapping: Dict[t.Node, t.Node]) -> t.Node:
+    """Structurally replace subtrees (equality-keyed) in a frozen AST; does
+    not descend into nested subqueries."""
+    if node in mapping:
+        return mapping[node]
+    if isinstance(node, (t.ScalarSubquery, t.InSubquery, t.Exists)):
+        return node
+    if not dataclasses.is_dataclass(node):
+        return node
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, t.Node):
+            nv = _ast_replace(v, mapping)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple):
+            nv = _tuple_replace(v, mapping)
+            if nv != v:
+                changes[f.name] = nv
+    return dataclasses.replace(node, **changes) if changes else node
+
+
+def _tuple_replace(v: tuple, mapping) -> tuple:
+    out = []
+    for x in v:
+        if isinstance(x, t.Node):
+            out.append(_ast_replace(x, mapping))
+        elif isinstance(x, tuple):
+            out.append(_tuple_replace(x, mapping))
+        else:
+            out.append(x)
+    return tuple(out)
 
 
 def _collect_aggregates(expr: t.Node, out: List[t.FunctionCall]):

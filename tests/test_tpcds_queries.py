@@ -18,6 +18,18 @@ def session():
     return Session(TpcdsCatalog(sf=SF))
 
 
+@pytest.fixture(autouse=True)
+def _clear_jax_caches():
+    """73 distinct query pipelines compile thousands of XLA executables;
+    one process accumulates them until native allocation fails (observed
+    as a segfault around the 60th query). Each query is unique, so the
+    cache buys nothing across tests — drop it."""
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="module")
 def oracle():
     return SqliteOracle(sf=SF, source=tpcds)
@@ -45,6 +57,25 @@ def _expand_rollup(aggs_sql, rollup_cols, body, order_limit, grouping_alias=None
 _Q18_BODY = QUERIES[18].split("from", 1)[1].split("group by")[0]
 _Q22_BODY = QUERIES[22].split("from", 1)[1].split("group by")[0]
 _Q27_BODY = QUERIES[27].split("from", 1)[1].split("group by")[0]
+
+
+def _rollup_level_union(aggs_sql, cols, body, level_alias):
+    """ROLLUP expansion where `level_alias` carries the SUM of grouping
+    bits (grouping(a)+grouping(b) = number of rolled-up columns), the form
+    Q36/Q70/Q86 partition their windows by."""
+    n = len(cols)
+    parts = []
+    for k in range(n, -1, -1):
+        sel_cols = [
+            (c if i < k else f"null as {c.split('.')[-1]}")
+            for i, c in enumerate(cols)
+        ]
+        gb = f" group by {', '.join(cols[:k])}" if k else ""
+        parts.append(
+            f"select {aggs_sql}, {', '.join(sel_cols)}, "
+            f"{n - k} as {level_alias} {body}{gb}"
+        )
+    return " union all ".join(parts)
 
 ORACLE_SQL = {
     18: _expand_rollup(
@@ -79,6 +110,71 @@ ORACLE_SQL = {
         grouping_alias="g_state",
     ),
 }
+
+_Q36_BODY = (
+    "from store_sales, date_dim d1, item, store "
+    "where d1.d_year = 2001 and d1.d_date_sk = ss_sold_date_sk "
+    "and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk "
+    "and s_state = 'TN'"
+)
+_Q70_BODY = (
+    "from store_sales, date_dim d1, store "
+    "where d1.d_month_seq between 1200 and 1211 "
+    "and d1.d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk "
+    "and s_state in (select s_state from "
+    " (select s_state as s_state, rank() over (partition by s_state "
+    "  order by sum(ss_net_profit) desc) as ranking "
+    "  from store_sales, store, date_dim "
+    "  where d_month_seq between 1200 and 1211 "
+    "    and d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk "
+    "  group by s_state) tmp1 where ranking <= 5)"
+)
+_Q86_BODY = (
+    "from web_sales, date_dim d1, item "
+    "where d1.d_month_seq between 1200 and 1211 "
+    "and d1.d_date_sk = ws_sold_date_sk and i_item_sk = ws_item_sk"
+)
+
+ORACLE_SQL[36] = f"""
+select gross_margin, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then i_category end
+                    order by gross_margin asc) rank_within_parent
+from ({_rollup_level_union(
+        "cast(sum(ss_net_profit) as real) / cast(sum(ss_ext_sales_price) as real)"
+        " as gross_margin",
+        ["i_category", "i_class"], _Q36_BODY, "lochierarchy")}) t
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end nulls last,
+         rank_within_parent
+limit 100
+"""
+ORACLE_SQL[70] = f"""
+select total_sum, s_state, s_county, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then s_state end
+                    order by total_sum desc) rank_within_parent
+from ({_rollup_level_union(
+        "sum(ss_net_profit) as total_sum",
+        ["s_state", "s_county"], _Q70_BODY, "lochierarchy")}) t
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end nulls last,
+         rank_within_parent
+limit 100
+"""
+ORACLE_SQL[86] = f"""
+select total_sum, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then i_category end
+                    order by total_sum desc) rank_within_parent
+from ({_rollup_level_union(
+        "sum(ws_net_paid) as total_sum",
+        ["i_category", "i_class"], _Q86_BODY, "lochierarchy")}) t
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end nulls last,
+         rank_within_parent
+limit 100
+"""
 
 
 @pytest.mark.parametrize("qid", sorted(QUERIES))
