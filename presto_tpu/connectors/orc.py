@@ -19,21 +19,28 @@ import numpy as np
 
 from .. import types as T
 from ..page import Page
-from .parquet import _arrow_to_type, arrow_table_to_page
-from .spi import Connector, Predicate
+from .parquet import (FileWriteMixin, _arrow_to_type,
+                      arrow_table_to_page)
+from .spi import Connector, Predicate, WritableConnector, WriteError
 
 
-class OrcCatalog(Connector):
-    """tables: {name: orc file path}."""
+class OrcCatalog(FileWriteMixin, WritableConnector):
+    """tables: {name: orc file path}. With `directory` set, the catalog is
+    WRITABLE: CREATE TABLE / CTAS / INSERT / DELETE produce ORC files
+    under it (reference: presto-orc writer + OrcWriteValidation — pyarrow
+    is the bootstrap encoder, matching the read path)."""
 
     name = "orc"
+    _ext = "orc"
 
     def __init__(self, tables: Dict[str, str],
-                 unique: Optional[Dict[str, list]] = None):
+                 unique: Optional[Dict[str, list]] = None,
+                 directory: Optional[str] = None):
         from pyarrow import orc
 
         self.paths = dict(tables)
         self.unique = unique or {}
+        self.directory = directory
         self._files: Dict[str, object] = {}
         self._dicts: Dict[Tuple[str, str], tuple] = {}
         self._orc = orc
@@ -44,6 +51,12 @@ class OrcCatalog(Connector):
             f = self._orc.ORCFile(self.paths[table])
             self._files[table] = f
         return f
+
+    def _encode_write(self, arrow_table, path: str) -> None:
+        self._orc.write_table(arrow_table, path)
+
+    def _read_all(self, table: str):
+        return self._file(table).read()
 
     # -- metadata --
 

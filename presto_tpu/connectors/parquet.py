@@ -28,7 +28,34 @@ import numpy as np
 
 from .. import types as T
 from ..page import Block, Page, _pad_block
-from .spi import Connector, Predicate
+from .spi import Connector, Predicate, WritableConnector, WriteError
+
+
+def _type_to_arrow(typ: T.Type):
+    """Engine type -> arrow type (writer-side inverse of _arrow_to_type)."""
+    import pyarrow as pa
+
+    if isinstance(typ, T.VarcharType):
+        return pa.string()
+    if isinstance(typ, T.DecimalType):
+        return pa.decimal128(typ.precision, typ.scale)
+    if isinstance(typ, T.DateType):
+        return pa.date32()
+    if isinstance(typ, T.TimestampType):
+        return pa.timestamp("us")
+    if isinstance(typ, T.BooleanType):
+        return pa.bool_()
+    if isinstance(typ, T.DoubleType):
+        return pa.float64()
+    if isinstance(typ, T.RealType):
+        return pa.float32()
+    if isinstance(typ, T.IntegerType):
+        return pa.int32()
+    if isinstance(typ, T.SmallintType):
+        return pa.int16()
+    if isinstance(typ, T.TinyintType):
+        return pa.int8()
+    return pa.int64()
 
 
 def _arrow_to_type(at) -> T.Type:
@@ -80,20 +107,92 @@ def _decimal_ints(arr) -> np.ndarray:
     return np.concatenate(his), np.concatenate(los)
 
 
-class ParquetCatalog(Connector):
-    """tables: {name: parquet file path}."""
+class FileWriteMixin:
+    """Shared write protocol for single-file-per-table catalogs
+    (reference ConnectorPageSink; INSERT rewrites table = existing +
+    appended rows). Subclasses define `_ext`, `_encode_write(arrow_table,
+    path)`, and `_read_all(table) -> arrow Table`."""
+
+    def _write_path(self, table: str) -> str:
+        if table in self.paths:
+            return self.paths[table]
+        if self.directory is None:
+            raise WriteError(
+                f"{self.name} catalog is read-only (no directory configured)"
+            )
+        import os
+
+        return os.path.join(self.directory, f"{table}.{self._ext}")
+
+    def _invalidate(self, table: str) -> None:
+        self._files.pop(table, None)
+        for key in [k for k in self._dicts if k[0] == table]:
+            self._dicts.pop(key)
+
+    def _write(self, table: str, arrow_table) -> None:
+        path = self._write_path(table)
+        self._encode_write(arrow_table, path)
+        self.paths[table] = path
+        self._invalidate(table)
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        import pyarrow as pa
+
+        self._write(table, pa.table(
+            {name: pa.array([], type=_type_to_arrow(typ))
+             for name, typ in schema.items()}
+        ))
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        self._write(table, page_to_arrow(page))
+
+    def append(self, table: str, page: Page) -> None:
+        import pyarrow as pa
+
+        existing = self._read_all(table)
+        new = page_to_arrow(page)
+        # unify: cast appended columns to the file schema's types
+        new = new.select(existing.column_names).cast(existing.schema)
+        self._write(table, pa.concat_tables([existing, new]))
+
+    def replace(self, table: str, page: Page) -> None:
+        self._write(table, page_to_arrow(page))
+
+    def drop_table(self, table: str) -> None:
+        import os
+
+        path = self.paths.pop(table)
+        self._invalidate(table)
+        if os.path.exists(path):
+            os.remove(path)
+
+
+class ParquetCatalog(FileWriteMixin, WritableConnector):
+    """tables: {name: parquet file path}. With `directory` set, the
+    catalog is WRITABLE: CREATE TABLE / CTAS / INSERT / DELETE produce
+    parquet files under it (reference: HivePageSink + ParquetWriter —
+    pyarrow is the bootstrap encoder, matching the read path)."""
 
     name = "parquet"
+    _ext = "parquet"
 
     def __init__(self, tables: Dict[str, str],
-                 unique: Optional[Dict[str, list]] = None):
+                 unique: Optional[Dict[str, list]] = None,
+                 directory: Optional[str] = None):
         import pyarrow.parquet as pq
 
         self.paths = dict(tables)
         self.unique = unique or {}
+        self.directory = directory
         self._files: Dict[str, object] = {}
         self._dicts: Dict[Tuple[str, str], tuple] = {}
         self._pq = pq
+
+    def _encode_write(self, arrow_table, path: str) -> None:
+        self._pq.write_table(arrow_table, path, row_group_size=1 << 17)
+
+    def _read_all(self, table: str):
+        return self._file(table).read()
 
     # -- metadata --
 

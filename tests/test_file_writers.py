@@ -1,0 +1,108 @@
+"""Parquet/ORC writers: CTAS / INSERT / DELETE against file catalogs
+(reference: HivePageSink + ParquetWriter, presto-orc writer +
+OrcWriteValidation). Round-trip contract: a fresh catalog over the
+written files reads back exactly what was written."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.orc import OrcCatalog
+from presto_tpu.connectors.parquet import ParquetCatalog
+from presto_tpu.session import Session
+
+
+@pytest.fixture(params=["parquet", "orc"])
+def catalog_maker(request, tmp_path):
+    def make(tables=None):
+        cls = ParquetCatalog if request.param == "parquet" else OrcCatalog
+        return cls(tables or {}, directory=str(tmp_path))
+
+    make.kind = request.param
+    return make
+
+
+def test_ctas_roundtrip(catalog_maker):
+    cat = catalog_maker()
+    s = Session(cat)
+    s.query(
+        "create table t as select * from (values "
+        "(1, 'alpha', 1.5, date '2021-03-04'), "
+        "(2, 'beta', -2.25, date '1999-12-31'), "
+        "(3, null, null, null)) v(k, name, x, d)"
+    )
+    want = sorted(s.query("select k, name, x, d from t").rows())
+    # a FRESH catalog over the same files must read identical rows
+    cat2 = catalog_maker(dict(cat.paths))
+    got = sorted(Session(cat2).query("select k, name, x, d from t").rows())
+    assert got == want and len(got) == 3
+    assert got[2][1] is None and got[2][2] is None
+
+
+def test_create_insert_delete(catalog_maker):
+    cat = catalog_maker()
+    s = Session(cat)
+    s.query("create table ev (id bigint, tag varchar)")
+    assert s.query("select count(*) c from ev").rows() == [(0,)]
+    s.query("insert into ev values (1, 'a'), (2, 'b'), (3, 'a')")
+    s.query("insert into ev values (4, 'c')")
+    assert s.query("select count(*) c from ev").rows() == [(4,)]
+    s.query("delete from ev where tag = 'a'")
+    got = sorted(Session(catalog_maker(dict(cat.paths))).query(
+        "select id, tag from ev").rows())
+    assert got == [(2, "b"), (4, "c")]
+
+
+def test_ctas_from_computation(catalog_maker):
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+
+    rng = np.random.default_rng(5)
+    src = MemoryCatalog(
+        {
+            "src": Page.from_dict(
+                {
+                    "g": rng.integers(0, 7, 5000).astype(np.int64),
+                    "v": rng.integers(0, 1000, 5000).astype(np.int64),
+                }
+            )
+        }
+    )
+    summary = Session(src).query(
+        "select g, sum(v) s, count(*) n from src group by g"
+    )
+    cat = catalog_maker()
+    cat.create_table_from_page("summary", summary.page)
+    got = sorted(Session(cat).query("select g, s, n from summary").rows())
+    want = sorted(summary.rows())
+    assert got == want
+
+
+def test_drop_table_removes_file(catalog_maker):
+    import os
+
+    cat = catalog_maker()
+    s = Session(cat)
+    s.query("create table gone (a bigint)")
+    path = cat.paths["gone"]
+    assert os.path.exists(path)
+    s.query("drop table gone")
+    assert not os.path.exists(path)
+    assert "gone" not in cat.table_names()
+
+
+def test_decimal_roundtrip_parquet(tmp_path):
+    cat = ParquetCatalog({}, directory=str(tmp_path))
+    s = Session(cat)
+    s.query(
+        "create table d as select * from (values "
+        "(12345.67), (-0.01)) v(x)"
+    )
+    got = Session(ParquetCatalog(dict(cat.paths))).query(
+        "select x from d order by x"
+    ).rows()
+    import decimal
+
+    assert got == [
+        (decimal.Decimal("-0.01"),),
+        (decimal.Decimal("12345.67"),),
+    ]
