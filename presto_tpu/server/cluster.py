@@ -93,7 +93,7 @@ class HttpScheduler:
 
     # -- public --
 
-    def run(self, root: N.PlanNode):
+    def run(self, root: N.PlanNode, query_id: Optional[str] = None):
         # snapshot membership for the whole query (threaded explicitly so
         # concurrent queries can't clobber each other): producer partition
         # counts must match consumer task counts even if a node fails
@@ -102,10 +102,11 @@ class HttpScheduler:
         if not workers:
             raise TaskFailure("no active workers")
         all_tasks: List[Tuple[str, str]] = []
+        query_id = query_id or f"q_{next(self._task_ids)}"
         try:
             fragment, specs = self._cut(root)
             sources = self._resolve_sources(
-                specs, False, workers, all_tasks
+                specs, False, workers, all_tasks, query_id
             )
             ex = FragmentExecutor(self.catalog, {}, sources)
             return ex.run(fragment)
@@ -159,7 +160,8 @@ class HttpScheduler:
     # -- stage execution --
 
     def _resolve_sources(self, specs, sharded_consumer: bool,
-                         workers: List[str], all_tasks):
+                         workers: List[str], all_tasks,
+                         query_id: Optional[str] = None):
         """Run producer stages for each exchange; returns either
         {sid: (kind, handles)} (sharded consumer) or {sid: [pages]}
         (coordinator consumer)."""
@@ -167,15 +169,20 @@ class HttpScheduler:
         for sid, ex in specs.items():
             if ex.kind == "repartition" and sharded_consumer:
                 handles = self._run_sharded_stage(
-                    ex.child, ("hash", ex.keys), workers, all_tasks
+                    ex.child, ("hash", ex.keys), workers, all_tasks, query_id
                 )
                 resolved[sid] = ("repartition", handles)
             else:
                 # gather / replicate — and repartition consumed by the
                 # coordinator itself, which reads everything anyway (hash
-                # partitioning there would just drop partitions != 0)
+                # partitioning there would just drop partitions != 0).
+                # Replicated outputs are pulled by EVERY consumer without
+                # acks, so their producer buffers must be unbounded.
                 handles = self._run_sharded_stage(
-                    ex.child, ("single",), workers, all_tasks
+                    ex.child, ("single",), workers, all_tasks, query_id,
+                    unbounded_output=(
+                        sharded_consumer and ex.kind == "replicate"
+                    ),
                 )
                 resolved[sid] = ("gather", handles)
         if sharded_consumer:
@@ -191,7 +198,9 @@ class HttpScheduler:
         return out
 
     def _run_sharded_stage(self, node: N.PlanNode, output,
-                           all_workers: List[str], all_tasks) -> List[Tuple[str, str]]:
+                           all_workers: List[str], all_tasks,
+                           query_id: Optional[str] = None,
+                           unbounded_output: bool = False) -> List[Tuple[str, str]]:
         """One task per worker for sharded stages (splits/repartition
         inputs); scan-less single-distribution stages run as ONE task so
         rows are never duplicated. Returns [(worker_uri, task_id)]."""
@@ -202,7 +211,7 @@ class HttpScheduler:
         )
         workers = all_workers if sharded else all_workers[:1]
         child_resolved = self._resolve_sources(
-            specs, True, all_workers, all_tasks
+            specs, True, all_workers, all_tasks, query_id
         )
 
         # row-range splits per scanned table
@@ -230,16 +239,22 @@ class HttpScheduler:
             sources = {}
             for sid, (kind, child_handles) in child_resolved.items():
                 if kind == "repartition":
+                    # partition w has exactly ONE consumer: acks may free
+                    # producer pages as this task consumes them
                     locs = [(u, t, w) for (u, t) in child_handles]
+                    exclusive = True
                 else:  # gather/replicate: every consumer pulls buffer 0
                     locs = [(u, t, 0) for (u, t) in child_handles]
-                sources[sid] = {"locations": locs}
+                    exclusive = len(workers) == 1
+                sources[sid] = {"locations": locs, "exclusive": exclusive}
             spec = {
                 "fragment": frag_b64,
                 "splits": {t: list(ranges[t][w]) for t in tables},
                 "sources": sources,
                 "partition_keys": part_keys_b64,
                 "num_partitions": nparts,
+                "query_id": query_id,
+                "buffer_unbounded": unbounded_output,
             }
             task_id = f"t_{next(self._task_ids)}"
             self._post_task(uri, task_id, spec)
@@ -286,12 +301,102 @@ class HttpScheduler:
             return json.loads(resp.read())
 
 
+class ClusterMemoryManager:
+    """Coordinator-side cluster memory management (reference
+    memory/ClusterMemoryManager.java:89,210 + LowMemoryKiller.java:26):
+    polls every worker's /v1/memory, aggregates per-query reservation
+    across the cluster, and when any worker is memory-blocked kills the
+    query with the LARGEST total reservation (the TotalReservation
+    strategy) by aborting its tasks on every worker."""
+
+    def __init__(self, nodes: NodeManager, interval: float = 0.25,
+                 on_kill=None, grace_polls: int = 4):
+        self.nodes = nodes
+        self.interval = interval
+        self.on_kill = on_kill
+        self.grace_polls = grace_polls  # sustained blockage before a kill
+        self._blocked_streak = 0
+        self.killed: List[str] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> "ClusterMemoryManager":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - keep polling
+                pass
+
+    def poll_once(self) -> Optional[str]:
+        """One manager cycle; returns the killed query id, if any."""
+        states = []
+        for uri in self.nodes.active_workers():
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/memory", timeout=5
+                ) as resp:
+                    states.append((uri, json.loads(resp.read())))
+            except Exception:  # noqa: BLE001 - failure detector's job
+                continue
+        blocked = any(st.get("blocked") for _, st in states)
+        if not blocked:
+            self._blocked_streak = 0
+            return None
+        # transient blocking is normal flow control (acks free bytes
+        # continuously); only SUSTAINED exhaustion triggers the killer
+        self._blocked_streak += 1
+        if self._blocked_streak < self.grace_polls:
+            return None
+        self._blocked_streak = 0
+        victim = self.choose_victim(states)
+        if victim is None:
+            return None
+        self.kill(victim)
+        return victim
+
+    @staticmethod
+    def choose_victim(states) -> Optional[str]:
+        """TotalReservation: the query holding the most bytes cluster-wide
+        (blocked-but-unreserved queries are victims of last resort)."""
+        totals: Dict[str, int] = {}
+        for _uri, st in states:
+            for qid, nbytes in (st.get("queries") or {}).items():
+                totals[qid] = totals.get(qid, 0) + int(nbytes)
+            for qid in st.get("blocked") or ():
+                totals.setdefault(qid, 0)
+        if not totals:
+            return None
+        return max(totals, key=lambda q: (totals[q], q))
+
+    def kill(self, query_id: str) -> None:
+        for uri in self.nodes.active_workers():
+            try:
+                req = urllib.request.Request(
+                    f"{uri}/v1/query/{query_id}", method="DELETE"
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:  # noqa: BLE001 - best effort per worker
+                pass
+        self.killed.append(query_id)
+        if self.on_kill is not None:
+            self.on_kill(query_id)
+
+
 class HttpClusterSession:
     """Session facade executing SQL over an HTTP worker cluster — the
     DistributedQueryRunner analog for the DCN path."""
 
     def __init__(self, catalog, nodes: NodeManager,
-                 broadcast_threshold=None):  # None = cost-based
+                 broadcast_threshold=None,  # None = cost-based
+                 memory_manager: bool = False):
         from ..session import Session
 
         self._planner = Session(catalog)  # reuse parse/plan/fragment
@@ -299,6 +404,10 @@ class HttpClusterSession:
         self.catalog = catalog
         self.broadcast_threshold = broadcast_threshold
         self.scheduler = HttpScheduler(catalog, nodes)
+        self._query_ids = itertools.count(1)
+        self.memory_manager = (
+            ClusterMemoryManager(nodes).start() if memory_manager else None
+        )
 
     def query(self, sql: str):
         from ..plan.fragment import fragment_plan
@@ -307,5 +416,9 @@ class HttpClusterSession:
         node = self._planner.plan(sql)
         node = fragment_plan(node, self.catalog, self.broadcast_threshold,
                              num_workers=max(len(self.scheduler.nodes.active_workers()), 2))
-        page = self.scheduler.run(node)
+        page = self.scheduler.run(node, query_id=f"q_{next(self._query_ids)}")
         return QueryResult(page, node.titles)
+
+    def close(self):
+        if self.memory_manager is not None:
+            self.memory_manager.stop()

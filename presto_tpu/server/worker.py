@@ -20,6 +20,7 @@ import dataclasses
 import json
 import pickle
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -28,8 +29,9 @@ import numpy as np
 
 from .. import types as T
 from ..exec.executor import Executor
+from ..exec.stream import StreamingExecutor
 from ..ops.union import concat_pages
-from ..page import Page
+from ..page import Block, Page
 from ..plan import nodes as N
 from .serde import deserialize_page, serialize_page
 
@@ -47,13 +49,195 @@ class RemoteSource(N.PlanNode):
         return self.schema
 
 
+class QueryKilledError(RuntimeError):
+    """Raised into blocked tasks when the cluster memory manager kills
+    their query (reference: ExceededMemoryLimitException from
+    LowMemoryKiller)."""
+
+
+class WorkerMemoryPool:
+    """Worker-wide memory accounting for task OUTPUT buffers (reference:
+    worker MemoryPool polled by ClusterMemoryManager.process,
+    memory/ClusterMemoryManager.java:89). Reservations past the limit
+    BLOCK (the reference's blocking futures) until space frees or the
+    cluster memory manager kills a query."""
+
+    def __init__(self, limit: Optional[int] = None):
+        self.limit = limit
+        self.reserved = 0
+        self.by_query: Dict[str, int] = {}
+        self.blocked: set = set()  # query ids currently waiting
+        self._cond = threading.Condition()
+
+    def reserve(self, query_id: str, nbytes: int, abort: threading.Event,
+                timeout: float = 60.0) -> None:
+        if self.limit is None:
+            with self._cond:
+                self.reserved += nbytes
+                self.by_query[query_id] = self.by_query.get(query_id, 0) + nbytes
+            return
+        deadline = time.time() + timeout
+        with self._cond:
+            while self.reserved + nbytes > self.limit:
+                if abort.is_set():
+                    self.blocked.discard(query_id)
+                    raise QueryKilledError(
+                        "Query killed: the cluster ran out of memory "
+                        "(TotalReservation low-memory killer)"
+                    )
+                if time.time() > deadline:
+                    self.blocked.discard(query_id)
+                    raise MemoryError(
+                        f"worker memory exhausted: {nbytes:,}B requested, "
+                        f"{self.reserved:,}B of {self.limit:,}B reserved"
+                    )
+                self.blocked.add(query_id)
+                self._cond.wait(timeout=0.05)
+            self.blocked.discard(query_id)
+            self.reserved += nbytes
+            self.by_query[query_id] = self.by_query.get(query_id, 0) + nbytes
+
+    def free(self, query_id: str, nbytes: int) -> None:
+        with self._cond:
+            self.reserved = max(0, self.reserved - nbytes)
+            left = self.by_query.get(query_id, 0) - nbytes
+            if left > 0:
+                self.by_query[query_id] = left
+            else:
+                self.by_query.pop(query_id, None)
+            self._cond.notify_all()
+
+    def wake(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "limit": self.limit,
+                "reserved": self.reserved,
+                "queries": dict(self.by_query),
+                "blocked": sorted(self.blocked),
+            }
+
+
+class OutputBuffers:
+    """Bounded, ack-consumed task output buffers (reference
+    PartitionedOutputBuffer + OutputBufferMemoryManager,
+    execution/buffer/): producers append page-at-a-time and BLOCK while
+    unacknowledged bytes exceed the bound (backpressure); consumers pull
+    by token and acknowledge, which frees producer budget. Bytes are also
+    accounted in the worker memory pool so the cluster memory manager
+    sees them."""
+
+    def __init__(self, pool: "WorkerMemoryPool", query_id: str,
+                 abort: threading.Event, bound: Optional[int] = None):
+        self.pool = pool
+        self.query_id = query_id
+        self.abort = abort
+        self.bound = bound
+        self._pages: Dict[int, List[Optional[bytes]]] = {}
+        self._unacked = 0
+        self._finished = False
+        self._drained = False
+        self._cond = threading.Condition()
+
+    def put(self, buffer_id: int, data: bytes,
+            timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        with self._cond:
+            while self.bound is not None and self._unacked + len(data) > max(
+                self.bound, len(data)
+            ):
+                if self.abort.is_set():
+                    raise QueryKilledError(
+                        "Query killed: the cluster ran out of memory "
+                        "(TotalReservation low-memory killer)"
+                    )
+                if time.time() > deadline:
+                    raise MemoryError(
+                        "output buffer consumer stalled past the bound"
+                    )
+                self._cond.wait(timeout=0.05)
+        self.pool.reserve(self.query_id, len(data), self.abort)
+        with self._cond:
+            if self._drained:
+                # task was deleted while this producer was mid-stream:
+                # hand the bytes straight back, never strand them
+                self.pool.free(self.query_id, len(data))
+                raise QueryKilledError("task deleted while producing")
+            self._pages.setdefault(buffer_id, []).append(data)
+            self._unacked += len(data)
+            self._cond.notify_all()
+
+    def finish(self) -> None:
+        with self._cond:
+            self._finished = True
+            self._cond.notify_all()
+
+    def get(self, buffer_id: int, token: int,
+            timeout: float = 60.0):
+        """(serialized page | None, complete, ready): ready=False means
+        long-poll again (the page is not produced yet)."""
+        with self._cond:
+            deadline = time.time() + timeout
+            while True:
+                pages = self._pages.get(buffer_id, [])
+                if token < len(pages):
+                    if pages[token] is None:
+                        raise RuntimeError(
+                            f"buffer {buffer_id} token {token} was already "
+                            "acknowledged (exchange protocol violation)"
+                        )
+                    return pages[token], False, True
+                if self._finished:
+                    return None, True, True
+                if time.time() > deadline:
+                    return None, False, False
+                self._cond.wait(timeout=0.1)
+
+    def ack(self, buffer_id: int, upto_token: int) -> None:
+        """Acknowledge pages [0, upto_token): their bytes free the bound
+        and the worker pool (reference: acknowledge + delete results)."""
+        with self._cond:
+            pages = self._pages.get(buffer_id, [])
+            freed = 0
+            for i in range(min(upto_token, len(pages))):
+                if pages[i] is not None:
+                    freed += len(pages[i])
+                    pages[i] = None
+            if freed:
+                self._unacked -= freed
+                self._cond.notify_all()
+        if freed:
+            self.pool.free(self.query_id, freed)
+
+    def drain(self) -> None:
+        """Free everything still held (task deleted); later puts are
+        rejected so a mid-stream producer cannot leak reservations."""
+        with self._cond:
+            self._drained = True
+            freed = sum(
+                len(p)
+                for pages in self._pages.values()
+                for p in pages
+                if p is not None
+            )
+            self._pages.clear()
+            self._unacked = 0
+            self._cond.notify_all()
+        if freed:
+            self.pool.free(self.query_id, freed)
+
+
 class TaskState:
-    def __init__(self):
+    def __init__(self, query_id: str = ""):
         self.state = "RUNNING"
         self.error: Optional[str] = None
-        # buffer_id -> list of serialized pages
-        self.buffers: Dict[int, List[bytes]] = {}
+        self.buffers: Optional[OutputBuffers] = None
         self.done = threading.Event()
+        self.query_id = query_id
+        self.abort = threading.Event()  # set by the low-memory killer
 
 
 class FragmentExecutor(Executor):
@@ -86,14 +270,64 @@ class FragmentExecutor(Executor):
         return pages[0] if len(pages) == 1 else concat_pages(pages)
 
 
+class StreamingFragmentExecutor(StreamingExecutor):
+    """Streaming task execution (reference Driver pipeline fed by
+    ExchangeOperator): scans honor split ranges batch-by-batch, and
+    RemoteSource inputs arrive PAGE-AT-A-TIME from the pull clients —
+    never materialize-then-concat. Budget-aware sinks (aggregation state
+    merging, join build offload, external sort) compose unchanged, so an
+    upstream stage larger than this worker's memory flows through in
+    bounded pieces."""
+
+    def __init__(self, catalog, splits, source_streams,
+                 batch_rows: int = 1 << 18,
+                 memory_budget: Optional[int] = None):
+        super().__init__(
+            catalog, batch_rows=batch_rows, memory_budget=memory_budget
+        )
+        self.splits = splits or {}
+        self.source_streams = source_streams or {}
+
+    def stream(self, node: N.PlanNode):
+        if isinstance(node, RemoteSource):
+            yield from self.source_streams[node.source_id]()
+            return
+        yield from super().stream(node)
+
+    def _stream_scan(self, node: N.TableScan, predicate=None):
+        rng = self.splits.get(node.table)
+        if rng is None:
+            yield from super()._stream_scan(node, predicate)
+            return
+        start, stop = rng
+        B = self.batch_rows
+        pos = start
+        first = True
+        while pos < stop or first:
+            # split bounds are exact, so connector pruning hints stay safe
+            # (a pruned short batch cannot be mistaken for end-of-table)
+            src = self.catalog.scan(
+                node.table, pos, min(pos + B, stop), pad_to=B,
+                columns=[c for _, c, _ in node.columns],
+                predicate=predicate,
+            )
+            yield self._rename_scan(node, src)
+            first = False
+            pos += B
+
+
 class WorkerServer:
     """One worker process/port: executes tasks against its own catalog
     instance (catalogs must be deterministic across nodes — the TPC-H
     generator and parquet files are)."""
 
-    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0,
+                 memory_limit: Optional[int] = None,
+                 buffer_bound: Optional[int] = 32 << 20):
         self.catalog = catalog
         self.tasks: Dict[str, TaskState] = {}
+        self.pool = WorkerMemoryPool(memory_limit)
+        self.buffer_bound = buffer_bound
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -124,16 +358,33 @@ class WorkerServer:
                 self._send(404, {"error": "not found"})
 
             def do_GET(self):
+                try:
+                    self._do_get()
+                except (BrokenPipeError, ConnectionResetError):
+                    raise
+                except Exception:  # noqa: BLE001 - surface handler bugs
+                    self._send(
+                        500, {"error": traceback.format_exc(limit=10)}
+                    )
+
+            def _do_get(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 if parts == ["v1", "status"]:
                     self._send(200, {"state": "ACTIVE"})
+                    return
+                if parts == ["v1", "memory"]:
+                    # reference MemoryResource polled by the coordinator's
+                    # ClusterMemoryManager
+                    self._send(200, outer.pool.snapshot())
                     return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     t = outer.tasks.get(parts[2])
                     if t is None:
                         self._send(404, {"error": "unknown task"})
                         return
-                    t.done.wait(timeout=60)  # long-poll; RUNNING if not done
+                    t.done.wait(timeout=0.5)  # short-poll: consumers
+                    # pipeline against RUNNING producers; failures also
+                    # surface as 500s on the results pull
                     self._send(200, {"state": t.state, "error": t.error})
                     return
                 if (
@@ -146,33 +397,60 @@ class WorkerServer:
                     if t is None:
                         self._send(404, {"error": "unknown task"})
                         return
-                    if not t.done.wait(timeout=60):
-                        # still running: tell the consumer to retry — an
-                        # empty-buffer answer here would silently drop rows
-                        self._send(503, {"retry": True, "state": t.state})
-                        return
                     if t.state == "FAILED":
                         self._send(500, {"error": t.error})
                         return
-                    pages = t.buffers.get(buffer_id, [])
-                    if token < len(pages):
-                        self._send(
-                            200,
-                            {
-                                "page": base64.b64encode(pages[token]).decode(),
-                                "complete": token + 1 >= len(pages),
-                            },
-                        )
-                    else:
-                        self._send(200, {"page": None, "complete": True})
+                    if t.buffers is None:  # task thread not started yet
+                        self._send(503, {"retry": True, "state": t.state})
+                        return
+                    data, complete, ready = t.buffers.get(
+                        buffer_id, token, timeout=50
+                    )
+                    if t.state == "FAILED":
+                        # finish() fires in the task's finally, so a failed
+                        # producer must never look like a complete stream
+                        self._send(500, {"error": t.error})
+                        return
+                    if not ready:
+                        self._send(503, {"retry": True, "state": t.state})
+                        return
+                    self._send(
+                        200,
+                        {
+                            "page": None if data is None
+                            else base64.b64encode(data).decode(),
+                            "complete": complete,
+                        },
+                    )
                     return
                 self._send(404, {"error": "not found"})
 
             def do_DELETE(self):
                 parts = [p for p in self.path.split("/") if p]
+                if (
+                    parts[:2] == ["v1", "task"]
+                    and len(parts) == 6
+                    and parts[3] == "results"
+                ):
+                    # acknowledge pages [0, token): frees producer budget
+                    t = outer.tasks.get(parts[2])
+                    if t is not None and t.buffers is not None:
+                        t.buffers.ack(int(parts[4]), int(parts[5]))
+                    self._send(200, {"acknowledged": True})
+                    return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
-                    outer.tasks.pop(parts[2], None)
+                    t = outer.tasks.pop(parts[2], None)
+                    if t is not None:
+                        t.abort.set()
+                        if t.buffers is not None:
+                            t.buffers.drain()
                     self._send(200, {"deleted": True})
+                    return
+                if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                    # low-memory kill: abort every task of this query;
+                    # blocked reservations raise QueryKilledError
+                    outer.kill_query(parts[2])
+                    self._send(200, {"killed": parts[2]})
                     return
                 self._send(404, {"error": "not found"})
 
@@ -185,44 +463,82 @@ class WorkerServer:
     # -- task execution --
 
     def _start_task(self, task_id: str, spec: dict):
-        state = TaskState()
+        state = TaskState(query_id=spec.get("query_id") or task_id)
         self.tasks[task_id] = state
         threading.Thread(
             target=self._run_task, args=(task_id, spec, state), daemon=True
         ).start()
 
     def _run_task(self, task_id: str, spec: dict, state: TaskState):
+        # broadcast consumers never ack (pages are shared; freed at task
+        # DELETE), so a bounded buffer would deadlock its producer
+        bound = None if spec.get("buffer_unbounded") else self.buffer_bound
+        buffers = OutputBuffers(
+            self.pool, state.query_id, state.abort, bound=bound
+        )
+        state.buffers = buffers
         try:
             fragment = pickle.loads(base64.b64decode(spec["fragment"]))
             splits = {
                 t: tuple(rng) for t, rng in (spec.get("splits") or {}).items()
             }
-            sources = {}
-            for sid, src in (spec.get("sources") or {}).items():
-                pages = []
-                for uri, utask, buf in src["locations"]:
-                    for data in _pull_buffer(uri, utask, buf):
-                        pages.append(deserialize_page(data))
-                sources[sid] = pages
-            ex = FragmentExecutor(self.catalog, splits, sources)
-            out = ex.run(fragment)
+
+            def make_stream(locations, exclusive):
+                def gen():
+                    for uri, utask, buf in locations:
+                        # acks free producer pages — only safe when this
+                        # task is the buffer's sole consumer (replicated
+                        # buffers are pulled by every consumer and are
+                        # freed on task DELETE instead)
+                        for data in _pull_buffer(uri, utask, buf,
+                                                 ack=exclusive):
+                            yield _min_capacity(deserialize_page(data))
+                return gen
+
+            streams = {
+                sid: make_stream(
+                    src["locations"], bool(src.get("exclusive", True))
+                )
+                for sid, src in (spec.get("sources") or {}).items()
+            }
+            ex = StreamingFragmentExecutor(self.catalog, splits, streams)
             part_keys = spec.get("partition_keys")
             nparts = int(spec.get("num_partitions", 1))
-            if part_keys and nparts > 1:
-                keys = pickle.loads(base64.b64decode(part_keys))
-                state.buffers = _hash_partition(out, keys, nparts)
-            else:
-                state.buffers = {0: [serialize_page(out)]}
+            keys = (
+                pickle.loads(base64.b64decode(part_keys))
+                if part_keys and nparts > 1
+                else None
+            )
+            # page-at-a-time into the bounded buffers: put() applies
+            # backpressure when the consumer lags past the bound; pages
+            # bigger than the bound split into row slices first
+            # (reference PageSplitterUtil)
+            for page in ex.stream(fragment):
+                for piece in _split_to_bound(page, bound):
+                    if keys is not None:
+                        parts = _hash_partition(piece, keys, nparts)
+                        for p, data in parts.items():
+                            for d in data:
+                                buffers.put(p, d)
+                    else:
+                        buffers.put(0, serialize_page(piece))
             state.state = "FINISHED"
         except Exception:  # noqa: BLE001
             state.error = traceback.format_exc(limit=20)
             state.state = "FAILED"
         finally:
+            buffers.finish()
             state.done.set()
 
     def start(self) -> "WorkerServer":
         self._thread.start()
         return self
+
+    def kill_query(self, query_id: str) -> None:
+        for t in list(self.tasks.values()):
+            if t.query_id == query_id:
+                t.abort.set()
+        self.pool.wake()
 
     def stop(self):
         self._httpd.shutdown()
@@ -231,6 +547,54 @@ class WorkerServer:
     @property
     def uri(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+
+def _split_to_bound(page: Page, bound: Optional[int]):
+    """Split a page into row slices whose RAW bytes fit the output-buffer
+    bound (serialized bytes are smaller), so one page never blows through
+    the backpressure budget (reference PageSplitterUtil.splitPage)."""
+    n = int(page.count)
+    if bound is None or n == 0:
+        yield page
+        return
+    row_bytes = max(
+        sum(
+            b.data.dtype.itemsize * (b.data.size // max(b.data.shape[0], 1))
+            + (1 if b.valid is not None else 0)
+            for b in page.blocks
+        ),
+        1,
+    )
+    max_rows = max(bound // (2 * row_bytes), 256)
+    if n <= max_rows:
+        yield page
+        return
+    for start in range(0, n, max_rows):
+        stop = min(start + max_rows, n)
+        blocks = tuple(
+            Block(
+                b.data[start:stop],
+                b.type,
+                None if b.valid is None else b.valid[start:stop],
+                b.dict_id,
+            )
+            for b in page.blocks
+        )
+        yield Page(blocks, page.names, stop - start)
+
+
+def _min_capacity(page: Page, minimum: int = 16) -> Page:
+    """Empty wire pages deserialize with ZERO capacity; the streaming
+    sinks' static-shape kernels need at least one slot — pad up."""
+    if not page.blocks or page.blocks[0].data.shape[0] >= minimum:
+        return page
+    from ..page import _pad_block
+
+    return Page(
+        tuple(_pad_block(b, minimum) for b in page.blocks),
+        page.names,
+        page.count,
+    )
 
 
 def _hash_partition(page: Page, key_exprs, nparts: int) -> Dict[int, List[bytes]]:
@@ -252,14 +616,15 @@ def _hash_partition(page: Page, key_exprs, nparts: int) -> Dict[int, List[bytes]
     return out
 
 
-def _pull_buffer(uri: str, task_id: str, buffer_id: int):
-    """Generator of serialized pages from an upstream buffer (reference
-    ExchangeClient/HttpPageBufferClient pull + ack loop)."""
+def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True):
+    """Generator of serialized pages from an upstream buffer, one page per
+    long-poll, acknowledging each consumed page so the bounded producer
+    buffer frees its bytes (reference ExchangeClient.java:55,201 +
+    HttpPageBufferClient pull/ack/delete loop)."""
     import base64 as b64
     import json as js
-    import urllib.request
-
     import urllib.error
+    import urllib.request
 
     token = 0
     while True:
@@ -273,6 +638,18 @@ def _pull_buffer(uri: str, task_id: str, buffer_id: int):
             raise
         if payload.get("page"):
             yield b64.b64decode(payload["page"])
+            token += 1
+            if ack:
+                try:
+                    req = urllib.request.Request(
+                        f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}",
+                        method="DELETE",
+                    )
+                    urllib.request.urlopen(req, timeout=5).read()
+                except Exception:  # noqa: BLE001 - ack is advisory
+                    pass
+            if payload.get("complete", True):
+                return
+            continue
         if payload.get("complete", True):
             return
-        token += 1

@@ -1,0 +1,102 @@
+"""Cluster memory management (reference ClusterMemoryManager.java:89,210 +
+LowMemoryKiller.java:26): the coordinator polls worker /v1/memory, and a
+memory-blocked cluster kills exactly the query with the largest total
+reservation, which fails with a cluster-OOM error while others complete."""
+
+import time
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.server.cluster import (
+    ClusterMemoryManager,
+    HttpClusterSession,
+    NodeManager,
+    TaskFailure,
+)
+from presto_tpu.server.worker import WorkerServer
+
+SF = 0.002
+
+
+def _cluster(limit, n=2, manager=True):
+    workers = [
+        WorkerServer(TpchCatalog(sf=SF), memory_limit=limit).start()
+        for _ in range(n)
+    ]
+    nodes = NodeManager([w.uri for w in workers], interval=3600)
+    sess = HttpClusterSession(
+        TpchCatalog(sf=SF), nodes, memory_manager=manager
+    )
+    return workers, sess
+
+
+BIG = (
+    "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) rev "
+    "from lineitem, orders where l_orderkey = o_orderkey "
+    "group by l_orderkey order by rev desc limit 10"
+)
+SMALL = "select count(*) c from region"
+
+
+def test_memory_endpoint_reports_reservation():
+    workers, sess = _cluster(limit=None, manager=False)
+    try:
+        assert sess.query(SMALL).rows() == [(5,)]
+        snap = workers[0].pool.snapshot()
+        assert snap["limit"] is None and snap["blocked"] == []
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_cluster_oom_kills_largest_query():
+    # a limit far below the big query's exchange output: its reservation
+    # blocks, the manager sees the blocked worker, and the query fails
+    # with the low-memory-killer error — the cluster stays usable
+    workers, sess = _cluster(limit=2_000)
+    try:
+        with pytest.raises(TaskFailure, match="ran out of memory"):
+            sess.query(BIG).rows()
+        assert sess.memory_manager.killed, "manager recorded no kill"
+        # pools drained back to zero after the kill + task cleanup
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(w.pool.snapshot()["blocked"] == [] for w in workers):
+                break
+            time.sleep(0.05)
+        # small queries still run on the same cluster
+        assert sess.query(SMALL).rows() == [(5,)]
+    finally:
+        sess.close()
+        for w in workers:
+            w.stop()
+
+
+def test_within_limit_queries_complete():
+    workers, sess = _cluster(limit=64 << 20)
+    try:
+        got = sess.query(BIG).rows()
+        want = HttpClusterSession(
+            TpchCatalog(sf=SF),
+            NodeManager([w.uri for w in workers], interval=3600),
+        ).query(BIG).rows()
+        assert got == want and len(got) == 10
+        assert not sess.memory_manager.killed
+    finally:
+        sess.close()
+        for w in workers:
+            w.stop()
+
+
+def test_victim_selection_total_reservation():
+    states = [
+        ("w1", {"limit": 100, "reserved": 90,
+                "queries": {"qa": 60, "qb": 30}, "blocked": ["qb"]}),
+        ("w2", {"limit": 100, "reserved": 50,
+                "queries": {"qa": 10, "qc": 40}, "blocked": []}),
+    ]
+    # qa holds 70 cluster-wide: the TotalReservation victim even though
+    # qb is the one blocked
+    assert ClusterMemoryManager.choose_victim(states) == "qa"
+    assert ClusterMemoryManager.choose_victim([("w", {"queries": {}})]) is None
