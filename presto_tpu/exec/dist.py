@@ -372,6 +372,17 @@ replicated subtrees delegate to the single-node Executor."""
                 [c],
             )
             return out
+        # collection aggregates (array_agg/map_agg/histogram) are not
+        # decomposable, so the fragmenter always gathers them to the
+        # local-executor path above (which owns the adaptive-width retry);
+        # only scalar + HLL-register specs run on sharded inputs
+        from ..ops.aggregate import COLLECTION_AGGS
+
+        if any(a.func in COLLECTION_AGGS for a in node.aggs):
+            raise ExecutionError(
+                "collection aggregates must be gathered before the "
+                "sharded aggregation path"
+            )
         max_groups = round_capacity(min(max(c.max_count(), 1), 1 << 16))
         while True:
             mg = max_groups

@@ -105,11 +105,8 @@ class Executor:
         cap = round_capacity(max(n, 1))
         if cap >= page.capacity:
             return page
-        blocks = []
-        for b in page.blocks:
-            data = b.data[:cap]
-            valid = None if b.valid is None else b.valid[:cap]
-            blocks.append(Block(data, b.type, valid, b.dict_id))
+        idx = slice(0, cap)
+        blocks = [b.take_rows(idx) for b in page.blocks]
         return Page(tuple(blocks), page.names, page.count)
 
     # -- physical nodes (fragmented plans executed single-node) --
@@ -176,21 +173,39 @@ class Executor:
         # (returned regardless of the bound) on overflow — the adaptive-
         # capacity pattern used by all static-shape operators here
         max_groups = round_capacity(min(max(int(page.count), 1), 1 << 16))
+        max_elems = 128  # collection-aggregate width (adaptive, like mg)
         while True:
-            mg = max_groups
+            mg, me = max_groups, max_elems
             fn = self._kernel(
-                (node, mg),
+                (node, mg, me),
                 lambda: lambda p: grouped_aggregate_sorted(
                     p, node.group_exprs, node.group_names, node.aggs, mg,
-                    node.mask,
+                    node.mask, max_elems=me,
                 ),
             )
             out = fn(page)
             true_groups = int(out.count)
-            if true_groups <= max_groups:
-                break
-            max_groups = round_capacity(true_groups)
-            self._retries += 1
+            if true_groups > max_groups:
+                max_groups = round_capacity(true_groups)
+                self._retries += 1
+                continue
+            if "$collect_need" in out.names:
+                need = int(out.block("$collect_need").data[0])
+                if need > max_elems:
+                    max_elems = round_capacity(need)
+                    self._retries += 1
+                    continue
+                keep = [
+                    (n, b)
+                    for n, b in zip(out.names, out.blocks)
+                    if n != "$collect_need"
+                ]
+                out = Page(
+                    tuple(b for _, b in keep),
+                    tuple(n for n, _ in keep),
+                    out.count,
+                )
+            break
         return self._shrink(out)
 
     def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:

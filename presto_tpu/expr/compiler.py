@@ -31,7 +31,17 @@ from .. import types as T
 from ..page import Block, Page, intern_dictionary
 from . import datetime_kernels as dt
 from .functions import Val, and_valid, apply_function
-from .ir import Call, ColumnRef, Literal, RowExpression
+from .ir import Call, ColumnRef, Lambda, Literal, RowExpression
+
+LAMBDA_FORMS = {
+    "transform",
+    "filter",
+    "reduce",
+    "zip_with",
+    "any_match",
+    "all_match",
+    "none_match",
+}
 
 SPECIAL_FORMS = {
     "and",
@@ -55,13 +65,26 @@ def evaluate(expr: RowExpression, page: Page, n: Optional[int] = None) -> Val:
 
     if isinstance(expr, ColumnRef):
         blk = page.block(expr.name)
-        return Val(blk.data, blk.valid, blk.type, blk.dict_id)
+        keys_val = None
+        if blk.key_block is not None:
+            kb = blk.key_block
+            keys_val = Val(
+                kb.data, None, T.ArrayType(blk.type.key), kb.dict_id,
+                lengths=kb.lengths, elem_valid=kb.elem_valid,
+            )
+        return Val(
+            blk.data, blk.valid, blk.type, blk.dict_id,
+            lengths=blk.lengths, elem_valid=blk.elem_valid, keys=keys_val,
+        )
 
     if isinstance(expr, Literal):
         return _literal_val(expr, cap)
 
     assert isinstance(expr, Call), expr
     name = expr.name
+
+    if name in LAMBDA_FORMS:
+        return _eval_lambda_form(expr, page)
 
     if name == "and":
         return _kleene_and([evaluate(a, page) for a in expr.args])
@@ -306,7 +329,19 @@ def project_page(
     blocks = []
     for e in exprs:
         v = evaluate(e, page)
-        blocks.append(Block(v.data, v.type, v.valid, v.dict_id))
+        kb = None
+        if v.keys is not None:
+            k = v.keys
+            kb = Block(
+                k.data, v.type.key, None, k.dict_id,
+                lengths=k.lengths, elem_valid=k.elem_valid,
+            )
+        blocks.append(
+            Block(
+                v.data, v.type, v.valid, v.dict_id,
+                lengths=v.lengths, elem_valid=v.elem_valid, key_block=kb,
+            )
+        )
     return Page(tuple(blocks), tuple(names), page.count)
 
 
@@ -319,3 +354,214 @@ def compile_projection(exprs, names) -> Callable[[Page], Page]:
         return project_page(page, exprs, names)
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# higher-order (lambda) functions over arrays
+# ---------------------------------------------------------------------------
+# Strategy (reference ArrayTransformFunction & friends, re-designed for
+# XLA): flatten the (capacity, width) element matrix to one (capacity *
+# width) column, append every outer column row-repeated `width` times, and
+# evaluate the lambda BODY as an ordinary scalar expression over that flat
+# page — every scalar kernel is reused unchanged, and XLA fuses the whole
+# thing. Results reshape back to (capacity, width).
+
+
+def _flat_page_for(page: Page, width: int, params) -> Page:
+    """Outer columns row-repeated `width` times + lambda-param blocks."""
+    blocks, names = [], []
+    for nm, b in zip(page.names, page.blocks):
+        data = jnp.repeat(b.data, width, axis=0)
+        valid = None if b.valid is None else jnp.repeat(b.valid, width)
+        blocks.append(Block(data, b.type, valid, b.dict_id))
+        names.append(nm)
+    for nm, v in params:
+        blocks.append(Block(v.data, v.type, v.valid, v.dict_id))
+        names.append(nm)
+    cap = page.capacity * width
+    return Page(tuple(blocks), tuple(names), jnp.asarray(cap, jnp.int32))
+
+
+def _elements_val(arr: Val, elem_t: T.Type) -> Val:
+    """Flatten an array Val's elements to a (capacity*width,) Val."""
+    width = arr.data.shape[1]
+    data = arr.data.reshape((arr.data.shape[0] * width,) + arr.data.shape[2:])
+    valid = (
+        None if arr.elem_valid is None else arr.elem_valid.reshape(-1)
+    )
+    return Val(data, valid, elem_t, arr.dict_id)
+
+
+def _in_bounds(arr: Val) -> jnp.ndarray:
+    """(capacity, width) mask of slots inside each row's length."""
+    cap, width = arr.data.shape[0], arr.data.shape[1]
+    lens = (
+        arr.lengths
+        if arr.lengths is not None
+        else jnp.full(cap, width, jnp.int32)
+    )
+    return jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
+
+
+def _eval_lambda_form(expr: Call, page: Page) -> Val:
+    name = expr.name
+    out_type = expr.type
+    if name == "zip_with":
+        return _eval_zip_with(expr, page)
+    if name == "reduce":
+        return _eval_reduce(expr, page)
+    arr = evaluate(expr.args[0], page)
+    lam: Lambda = expr.args[1]
+    if arr.data.ndim != 2:
+        raise TypeError(f"{name} expects an array value")
+    cap, width = arr.data.shape[0], arr.data.shape[1]
+    elems = _elements_val(arr, lam.param_types[0])
+    flat = _flat_page_for(page, width, [(lam.params[0], elems)])
+    body = evaluate(lam.body, flat)
+    inb = _in_bounds(arr)
+
+    if name == "transform":
+        data = body.data.reshape((cap, width) + body.data.shape[1:])
+        evalid = (
+            None
+            if body.valid is None
+            else body.valid.reshape(cap, width)
+        )
+        return Val(
+            data, arr.valid, out_type, body.dict_id,
+            lengths=arr.lengths
+            if arr.lengths is not None
+            else jnp.full(cap, width, jnp.int32),
+            elem_valid=evalid,
+        )
+    if name == "filter":
+        keep = (body.data & body.valid_mask()).reshape(cap, width) & inb
+        # stable left-compaction per row: kept slots first, order preserved
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        data = jnp.take_along_axis(
+            arr.data, order.reshape(order.shape + (1,) * (arr.data.ndim - 2)),
+            axis=1,
+        ) if arr.data.ndim > 2 else jnp.take_along_axis(arr.data, order, axis=1)
+        evalid = (
+            None
+            if arr.elem_valid is None
+            else jnp.take_along_axis(arr.elem_valid, order, axis=1)
+        )
+        lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+        return Val(
+            data, arr.valid, out_type, arr.dict_id,
+            lengths=lengths, elem_valid=evalid,
+        )
+    # any/all/none_match over in-bounds elements (SQL semantics: NULL
+    # lambda results participate in three-valued logic; the engine takes
+    # the two-valued reduction like the reference's simplified matchers)
+    truthy = (body.data & body.valid_mask()).reshape(cap, width)
+    if name == "any_match":
+        agg = jnp.any(truthy & inb, axis=1)
+    elif name == "all_match":
+        agg = jnp.all(truthy | ~inb, axis=1)
+    else:  # none_match
+        agg = ~jnp.any(truthy & inb, axis=1)
+    return Val(agg, arr.valid, T.BOOLEAN)
+
+
+def _eval_zip_with(expr: Call, page: Page) -> Val:
+    a = evaluate(expr.args[0], page)
+    b = evaluate(expr.args[1], page)
+    lam: Lambda = expr.args[2]
+    cap = a.data.shape[0]
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    width = max(wa, wb)
+
+    def widen(v: Val, w: int) -> Val:
+        if v.data.shape[1] == w:
+            return v
+        pad = w - v.data.shape[1]
+        data = jnp.pad(v.data, ((0, 0), (0, pad)) + ((0, 0),) * (v.data.ndim - 2))
+        ev = v.elem_valid
+        ev = (
+            jnp.pad(ev, ((0, 0), (0, pad)))
+            if ev is not None
+            else jnp.ones((cap, v.data.shape[1]), jnp.bool_)
+        )
+        if ev.shape[1] != w:
+            ev = jnp.pad(ev, ((0, 0), (0, w - ev.shape[1])))
+        return Val(data, v.valid, v.type, v.dict_id,
+                   lengths=v.lengths, elem_valid=ev)
+
+    a2, b2 = widen(a, width), widen(b, width)
+    la = a.lengths if a.lengths is not None else jnp.full(cap, wa, jnp.int32)
+    lb = b.lengths if b.lengths is not None else jnp.full(cap, wb, jnp.int32)
+    out_len = jnp.maximum(la, lb)
+    # shorter array's missing elements are NULL (Presto zip_with)
+    ev_a = (
+        a2.elem_valid
+        if a2.elem_valid is not None
+        else jnp.ones((cap, width), jnp.bool_)
+    ) & (jnp.arange(width, dtype=jnp.int32)[None, :] < la[:, None])
+    ev_b = (
+        b2.elem_valid
+        if b2.elem_valid is not None
+        else jnp.ones((cap, width), jnp.bool_)
+    ) & (jnp.arange(width, dtype=jnp.int32)[None, :] < lb[:, None])
+    ea = Val(
+        a2.data.reshape((cap * width,) + a2.data.shape[2:]),
+        ev_a.reshape(-1), lam.param_types[0], a.dict_id,
+    )
+    eb = Val(
+        b2.data.reshape((cap * width,) + b2.data.shape[2:]),
+        ev_b.reshape(-1), lam.param_types[1], b.dict_id,
+    )
+    flat = _flat_page_for(
+        page, width, [(lam.params[0], ea), (lam.params[1], eb)]
+    )
+    body = evaluate(lam.body, flat)
+    data = body.data.reshape((cap, width) + body.data.shape[1:])
+    evalid = (
+        body.valid.reshape(cap, width)
+        if body.valid is not None
+        else None
+    )
+    valid = and_valid(a.valid, b.valid)
+    return Val(
+        data, valid, expr.type, body.dict_id,
+        lengths=out_len, elem_valid=evalid,
+    )
+
+
+def _eval_reduce(expr: Call, page: Page) -> Val:
+    """reduce(array, init, (s, x) -> s', s -> r): the state folds over a
+    STATIC-width python loop (widths are trace constants), masked past
+    each row's length — XLA unrolls and fuses the chain."""
+    arr = evaluate(expr.args[0], page)
+    init = evaluate(expr.args[1], page)
+    input_fn: Lambda = expr.args[2]
+    output_fn: Lambda = expr.args[3]
+    cap, width = arr.data.shape[0], arr.data.shape[1]
+    inb = _in_bounds(arr)
+    state = init
+    if state.type != input_fn.param_types[0]:
+        state = _cast_val(state, input_fn.param_types[0])
+    for j in range(width):
+        edata = arr.data[:, j]
+        evalid = None if arr.elem_valid is None else arr.elem_valid[:, j]
+        ev = Val(edata, evalid, input_fn.param_types[1], arr.dict_id)
+        flat = _flat_page_for(
+            page, 1, [(input_fn.params[0], state), (input_fn.params[1], ev)]
+        )
+        nxt = evaluate(input_fn.body, flat)
+        live = inb[:, j]
+        data = jnp.where(_bcast(live, nxt.data), nxt.data, state.data)
+        if state.valid is None and nxt.valid is None:
+            valid = None
+        else:
+            valid = jnp.where(live, nxt.valid_mask(), state.valid_mask())
+        state = Val(data, valid, nxt.type, nxt.dict_id)
+    flat = _flat_page_for(page, 1, [(output_fn.params[0], state)])
+    out = evaluate(output_fn.body, flat)
+    return Val(out.data, and_valid(out.valid, arr.valid), expr.type, out.dict_id)
+
+
+def _bcast(mask, data):
+    """Broadcast a row mask over trailing lanes (long-decimal data)."""
+    return mask.reshape(mask.shape + (1,) * (data.ndim - 1))

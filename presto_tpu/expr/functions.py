@@ -53,6 +53,9 @@ class Val:
     # T.ArrayType.__doc__ — arrays live in expressions, not in Pages.
     lengths: Optional[jnp.ndarray] = None
     elem_valid: Optional[jnp.ndarray] = None
+    # map-typed values only (T.MapType): `keys` holds the keys as an
+    # array-shaped Val; data/lengths/elem_valid describe the VALUES
+    keys: Optional["Val"] = None
 
     @property
     def dictionary(self) -> Optional[Tuple[str, ...]]:
@@ -1900,11 +1903,20 @@ def _cardinality(a: Val, out_type: T.Type) -> Val:
     return Val(a.lengths.astype(jnp.int64), a.valid, T.BIGINT)
 
 
-@register("element_at", _array_infer_element)
+def _map_infer_element(ts):
+    if isinstance(ts[0], T.MapType):
+        return ts[0].value
+    return ts[0].element
+
+
+@register("element_at", _map_infer_element)
 def _element_at(a: Val, idx: Val, out_type: T.Type) -> Val:
     """1-based access; negative indexes from the end; out of range -> NULL
     (reference ArraySubscriptOperator errors on OOR, element_at nulls —
-    both spellings route here, with element_at's forgiving semantics)."""
+    both spellings route here, with element_at's forgiving semantics).
+    For MAP values, key lookup -> value or NULL."""
+    if isinstance(a.type, T.MapType):
+        return _map_element_at(a, idx, out_type)
     if a.lengths is None:
         raise TypeError("element_at requires an array value")
     i64 = idx.data.astype(jnp.int64)
@@ -1971,6 +1983,75 @@ def _array_position(a: Val, needle: Val, out_type: T.Type) -> Val:
         0,
     )
     return Val(first, and_valid(a.valid, needle.valid), T.BIGINT)
+
+
+def _map_element_at(m: Val, k: Val, out_type: T.Type) -> Val:
+    eq, in_len = _array_elem_eq(m.keys, k, "map key lookup")
+    hit = eq & in_len
+    found = jnp.any(hit, axis=1)
+    pos = jnp.argmax(hit, axis=1)
+    data = jnp.take_along_axis(m.data, pos[:, None], axis=1)[:, 0]
+    valid = and_valid(and_valid(m.valid, k.valid), found)
+    if m.elem_valid is not None:
+        ev = jnp.take_along_axis(m.elem_valid, pos[:, None], axis=1)[:, 0]
+        valid = and_valid(valid, ev)
+    return Val(data, valid, out_type, m.dict_id)
+
+
+def _map_infer(ts):
+    return T.MapType(ts[0].element, ts[1].element)
+
+
+@register("map", _map_infer)
+def _map_constructor(karr: Val, varr: Val, out_type: T.Type) -> Val:
+    """map(key_array, value_array) (reference MapConstructor). Key and
+    value arrays must be equal-length per row."""
+    if karr.lengths is None or varr.lengths is None:
+        raise TypeError("map() takes two array arguments")
+    valid = and_valid(karr.valid, varr.valid)
+    # mismatched lengths -> NULL map (the reference raises; NULL keeps the
+    # kernel jittable, matching the engine's data-dependent-error policy)
+    valid = and_valid(valid, karr.lengths == varr.lengths)
+    keys = Val(
+        karr.data, None, T.ArrayType(out_type.key), karr.dict_id,
+        lengths=karr.lengths, elem_valid=karr.elem_valid,
+    )
+    w = max(karr.data.shape[1], varr.data.shape[1])
+
+    def widen(d, width):
+        pad = width - d.shape[1]
+        if pad <= 0:
+            return d
+        return jnp.pad(d, ((0, 0), (0, pad)) + ((0, 0),) * (d.ndim - 2))
+
+    keys = Val(
+        widen(keys.data, w), None, keys.type, keys.dict_id,
+        lengths=keys.lengths,
+        elem_valid=None if keys.elem_valid is None else widen(keys.elem_valid, w),
+    )
+    return Val(
+        widen(varr.data, w), valid, out_type, varr.dict_id,
+        lengths=karr.lengths,
+        elem_valid=None if varr.elem_valid is None else widen(varr.elem_valid, w),
+        keys=keys,
+    )
+
+
+@register("map_keys", lambda ts: T.ArrayType(ts[0].key))
+def _map_keys(m: Val, out_type: T.Type) -> Val:
+    k = m.keys
+    return Val(
+        k.data, m.valid, out_type, k.dict_id,
+        lengths=m.lengths, elem_valid=k.elem_valid,
+    )
+
+
+@register("map_values", lambda ts: T.ArrayType(ts[0].value))
+def _map_values(m: Val, out_type: T.Type) -> Val:
+    return Val(
+        m.data, m.valid, out_type, m.dict_id,
+        lengths=m.lengths, elem_valid=m.elem_valid,
+    )
 
 
 @register("sequence", lambda ts: T.ArrayType(ts[0]))

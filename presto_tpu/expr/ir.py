@@ -63,6 +63,23 @@ class Literal(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(RowExpression):
+    """Lambda argument to a higher-order function: params are synthetic
+    channel names the body references (reference LambdaDefinitionExpression)."""
+
+    params: Tuple[str, ...]
+    body: RowExpression
+    param_types: Tuple[T.Type, ...]
+
+    @property
+    def type(self) -> T.Type:
+        return self.body.type
+
+    def __str__(self):
+        return f"({', '.join(self.params)}) -> {self.body}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(RowExpression):
     """Function call. `name` is either a scalar function from
     expr/functions.py or a special form (see compiler.SPECIAL_FORMS)."""

@@ -43,6 +43,8 @@ from .hashing import hash_rows
 SUPPORTED = (
     "count", "count_star", "sum", "min", "max", "avg", "checksum",
     "min_by", "max_by", "percentile",
+    "array_agg", "map_agg", "histogram",
+    "approx_distinct", "hll_registers", "hll_merge",
 )
 
 
@@ -60,8 +62,12 @@ class AggSpec:
 
     @staticmethod
     def infer_output_type(func: str, input_type: Optional[T.Type]) -> T.Type:
-        if func in ("count", "count_star", "checksum"):
+        if func in ("count", "count_star", "checksum", "approx_distinct"):
             return T.BIGINT
+        if func == "array_agg":
+            return T.ArrayType(input_type)
+        if func == "histogram":
+            return T.MapType(input_type, T.BIGINT)
         if func in ("min", "max", "min_by", "max_by"):
             return input_type
         if func == "sum":
@@ -542,6 +548,12 @@ def grouped_aggregate_direct(
 
     by_keys = _eval_by_keys(page, aggs)
     for spec, v, bk in zip(aggs, ins, by_keys):
+        if spec.func in COLLECTION_AGGS or spec.func in (
+            "approx_distinct", "hll_registers", "hll_merge"
+        ):
+            raise NotImplementedError(
+                f"{spec.func} runs through the SORT aggregation strategy"
+            )
         if spec.func in ("min_by", "max_by", "percentile"):
             vdat, vval = positional_reduce(
                 spec, v, bk, live, gid, num_groups + 1
@@ -595,6 +607,7 @@ def grouped_aggregate_sorted(
     aggs: Sequence[AggSpec],
     max_groups: int,
     pre_mask=None,
+    max_elems: int = 128,
 ) -> Page:
     """General grouped aggregation via hash-sort + run detection.
 
@@ -651,7 +664,69 @@ def grouped_aggregate_sorted(
         names.append(name)
 
     by_keys = _eval_by_keys(page, aggs)
+    collect_need = None
     for spec, v, bk in zip(aggs, ins, by_keys):
+        if spec.func in COLLECTION_AGGS:
+            v_sorted = Val(
+                v.data[order],
+                None if v.valid is None else v.valid[order],
+                v.type,
+                v.dict_id,
+            )
+            if spec.func == "array_agg":
+                blk, need = collect_array_agg(
+                    v_sorted, live_s, gid_s, max_groups, max_elems
+                )
+            else:
+                bk_sorted = None
+                if spec.func == "map_agg":
+                    bk_sorted = Val(
+                        bk.data[order],
+                        None if bk.valid is None else bk.valid[order],
+                        bk.type,
+                        bk.dict_id,
+                    )
+                    blk, need = collect_map_agg(
+                        spec, v_sorted, bk_sorted, live_s, gid_s,
+                        max_groups, max_elems,
+                    )
+                else:  # histogram
+                    blk, need = collect_map_agg(
+                        spec, v_sorted, None, live_s, gid_s,
+                        max_groups, max_elems,
+                    )
+            blocks.append(blk)
+            names.append(spec.name)
+            collect_need = (
+                need if collect_need is None
+                else jnp.maximum(collect_need, need)
+            )
+            continue
+        if spec.func in ("approx_distinct", "hll_registers"):
+            v_sorted_data = v.data[order]
+            contributes = live_s if v.valid is None else (
+                live_s & v.valid[order]
+            )
+            vv = Val(v_sorted_data, None, v.type, v.dict_id)
+            regs = hll_group_registers(vv, contributes, gid_s, max_groups + 1)
+            regs = regs[:max_groups]
+            if spec.func == "approx_distinct":
+                blocks.append(Block(hll_estimate(regs), T.BIGINT, None))
+            else:
+                blocks.append(
+                    Block(regs, T.ArrayType(T.TINYINT), None)
+                )
+            names.append(spec.name)
+            continue
+        if spec.func == "hll_merge":
+            data_s = v.data[order]
+            contributes = live_s
+            regs = hll_merge_registers(
+                data_s, contributes, gid_s, max_groups + 1
+            )[:max_groups]
+            blocks.append(Block(regs, T.ArrayType(T.TINYINT), None))
+            names.append(spec.name)
+            continue
         if spec.func in ("min_by", "max_by", "percentile"):
             v_sorted = Val(
                 v.data[order],
@@ -700,6 +775,20 @@ def grouped_aggregate_sorted(
         blocks.append(_finalize(spec, raw, has, in_t, did))
         names.append(spec.name)
 
+    if collect_need is not None:
+        # adaptive-width protocol: the executor reads this hidden block,
+        # retries with a larger max_elems when any group overflowed, and
+        # drops it from the result (same pattern as the max_groups retry)
+        blocks.append(
+            Block(
+                jnp.full(
+                    (max_groups,), 0, jnp.int32
+                ).at[0].set(collect_need.astype(jnp.int32)),
+                T.INTEGER,
+                None,
+            )
+        )
+        names.append("$collect_need")
     return Page.from_blocks(blocks, names, count=num_live_groups)
 
 
@@ -723,6 +812,23 @@ class AvgPost:
     cnt_col: str
     output_type: T.Type
     input_type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class HllPost:
+    """Post-exchange step: name = HLL estimate of merged registers."""
+
+    name: str
+    reg_col: str
+
+    # mirror AvgPost's helper-column protocol
+    @property
+    def sum_col(self):
+        return self.reg_col
+
+    @property
+    def cnt_col(self):
+        return self.reg_col
 
 
 def decompose_partial(aggs: Sequence[AggSpec]):
@@ -751,6 +857,14 @@ def decompose_partial(aggs: Sequence[AggSpec]):
             final.append(AggSpec("sum", ColumnRef(s_name, sum_t), s_name, sum_t))
             final.append(AggSpec("sum", ColumnRef(c_name, T.BIGINT), c_name, T.BIGINT))
             post.append(AvgPost(a.name, s_name, c_name, a.output_type, in_t))
+        elif a.func == "approx_distinct":
+            reg_t = T.ArrayType(T.TINYINT)
+            r_name = f"{a.name}$hll"
+            partial.append(AggSpec("hll_registers", a.input, r_name, reg_t))
+            final.append(
+                AggSpec("hll_merge", ColumnRef(r_name, reg_t), r_name, reg_t)
+            )
+            post.append(HllPost(a.name, r_name))
         else:
             raise KeyError(f"cannot decompose aggregate {a.func!r}")
     return tuple(partial), tuple(final), tuple(post)
@@ -773,6 +887,11 @@ def apply_avg_post(page: Page, aggs: Sequence[AggSpec], post: Sequence[AvgPost])
         p = by_name.get(a.name)
         if p is None:
             blocks.append(page.block(a.name))
+            names.append(a.name)
+            continue
+        if isinstance(p, HllPost):
+            regs = page.block(p.reg_col).data
+            blocks.append(Block(hll_estimate(regs), T.BIGINT, None))
             names.append(a.name)
             continue
         s = page.block(p.sum_col).data
@@ -804,6 +923,52 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
             )
             names.append(spec.name)
             continue
+        if spec.func in COLLECTION_AGGS or spec.func in (
+            "approx_distinct", "hll_registers", "hll_merge"
+        ):
+            gid0 = jnp.zeros(page.capacity, jnp.int32)
+            live0 = live
+            order0 = jnp.argsort(~live0, stable=True)  # live rows first
+            gid_s0 = jnp.where(live0[order0], 0, 1)
+            v_s = Val(
+                v.data[order0],
+                None if v.valid is None else v.valid[order0],
+                v.type,
+                v.dict_id,
+            )
+            if spec.func == "array_agg":
+                blk, _need = collect_array_agg(
+                    v_s, live0[order0], gid_s0, 1, page.capacity
+                )
+            elif spec.func in ("map_agg", "histogram"):
+                bk2 = None
+                if spec.func == "map_agg":
+                    bk2 = _eval_by_keys(page, [spec])[0]
+                    bk2 = Val(
+                        bk2.data[order0],
+                        None if bk2.valid is None else bk2.valid[order0],
+                        bk2.type,
+                        bk2.dict_id,
+                    )
+                blk, _need = collect_map_agg(
+                    spec, v_s, bk2, live0[order0], gid_s0, 1, page.capacity
+                )
+            elif spec.func == "hll_merge":
+                regs = hll_merge_registers(v_s.data, live0[order0], gid_s0, 2)[:1]
+                blk = Block(regs, T.ArrayType(T.TINYINT), None)
+            else:
+                contributes0 = live0[order0] if v.valid is None else (
+                    live0[order0] & v_s.valid_mask()
+                )
+                vv0 = Val(v_s.data, None, v.type, v.dict_id)
+                regs = hll_group_registers(vv0, contributes0, gid_s0, 2)[:1]
+                if spec.func == "approx_distinct":
+                    blk = Block(hll_estimate(regs), T.BIGINT, None)
+                else:
+                    blk = Block(regs, T.ArrayType(T.TINYINT), None)
+            blocks.append(blk)
+            names.append(spec.name)
+            continue
         contributes = _agg_contributes(v, live)
         data = jnp.zeros(page.capacity, jnp.int64) if v is None else v.data
         # mask-reduce: a single-segment segment_sum is the worst-case
@@ -816,3 +981,177 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
         blocks.append(_finalize(spec, raw, has, in_t, did))
         names.append(spec.name)
     return Page.from_blocks(blocks, names, count=1)
+
+
+# ---------------------------------------------------------------------------
+# collection aggregates + HyperLogLog (reference: aggregation/
+# ArrayAggregationFunction, MapAggregationFunction, HistogramAggregation,
+# ApproximateCountDistinctAggregations + airlift HyperLogLog)
+# ---------------------------------------------------------------------------
+
+COLLECTION_AGGS = ("array_agg", "map_agg", "histogram")
+
+HLL_P = 10  # 2^10 = 1024 registers; standard error 1.04/sqrt(m) ~ 3.25%
+HLL_M = 1 << HLL_P
+
+
+def _clz64(x):
+    """Count leading zeros of a uint64 (exact, branch-free binary search:
+    at each step, if the TOP `shift` bits are zero, skip them)."""
+    x = x.astype(jnp.uint64)
+    is_zero = x == 0
+    n = jnp.zeros(x.shape, jnp.int32)
+    for shift in (32, 16, 8, 4, 2, 1):
+        top_zero = (x >> jnp.uint64(64 - shift)) == 0
+        n = n + jnp.where(top_zero, shift, 0)
+        x = jnp.where(top_zero, x << jnp.uint64(shift), x)
+    return jnp.where(is_zero, 64, n)
+
+
+def hll_row_registers(value, contributes):
+    """(register index, rank) per row: the HLL insert decomposition."""
+    from .hashing import hash_column
+
+    h = hash_column(value.data, None)
+    reg = (h >> jnp.uint64(64 - HLL_P)).astype(jnp.int32)
+    rank = (_clz64(h << jnp.uint64(HLL_P)) + 1).astype(jnp.int32)
+    rank = jnp.minimum(rank, 64 - HLL_P + 1)
+    return jnp.where(contributes, reg, -1), rank
+
+
+def hll_group_registers(value, contributes, gid, num_groups: int):
+    """Per-group register arrays (num_groups, HLL_M) int8: scatter-max of
+    row ranks — the mergeable HLL partial state."""
+    reg, rank = hll_row_registers(value, contributes)
+    flat_idx = jnp.where(
+        reg >= 0, gid * HLL_M + reg, num_groups * HLL_M
+    )
+    flat = (
+        jnp.zeros((num_groups * HLL_M + 1,), jnp.int8)
+        .at[flat_idx]
+        .max(rank.astype(jnp.int8), mode="drop")
+    )
+    return flat[:-1].reshape(num_groups, HLL_M)
+
+
+def hll_merge_registers(data_s, contributes, gid, num_groups: int):
+    """Elementwise max-merge of register-array rows per group."""
+    masked = jnp.where(
+        contributes[:, None], data_s, jnp.zeros((), data_s.dtype)
+    )
+    return (
+        jnp.zeros((num_groups, HLL_M), data_s.dtype)
+        .at[gid]
+        .max(masked, mode="drop")
+    )
+
+
+def hll_estimate(registers):
+    """(num_groups, HLL_M) registers -> int64 estimates (HLL with the
+    linear-counting small-range correction)."""
+    m = float(HLL_M)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    r = registers.astype(jnp.float64)
+    raw = alpha * m * m / jnp.sum(jnp.exp2(-r), axis=1)
+    zeros = jnp.sum(registers == 0, axis=1).astype(jnp.float64)
+    linear = m * (jnp.log(m) - jnp.log(jnp.maximum(zeros, 1.0)))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def _run_bounds(gid_s, max_groups: int):
+    """Per-group [start, count) of the contiguous runs in sorted order."""
+    grange = jnp.arange(max_groups, dtype=gid_s.dtype)
+    start = jnp.searchsorted(gid_s, grange, side="left").astype(jnp.int32)
+    end = jnp.searchsorted(gid_s, grange, side="right").astype(jnp.int32)
+    return start, end - start
+
+
+def collect_array_agg(v, live_s, gid_s, max_groups: int, max_elems: int):
+    """array_agg over sorted group runs: gather each run into a
+    (max_groups, max_elems) matrix. Returns (block, needed_elems)."""
+    start, counts = _run_bounds(gid_s, max_groups)
+    j = jnp.arange(max_elems, dtype=jnp.int32)
+    pos = start[:, None] + j[None, :]
+    safe = jnp.clip(pos, 0, gid_s.shape[0] - 1)
+    inb = j[None, :] < jnp.minimum(counts[:, None], max_elems)
+    data = v.data[safe]
+    ev = inb if v.valid is None else (inb & v.valid[safe])
+    lengths = jnp.minimum(counts, max_elems)
+    blk = Block(
+        data, T.ArrayType(v.type), None, v.dict_id,
+        lengths=lengths, elem_valid=ev,
+    )
+    return blk, jnp.max(counts)
+
+
+def _pair_runs(gid_s, key_norm, contributes, max_groups: int):
+    """Sort rows by (group, key) and detect distinct (group, key) runs.
+    Returns (perm, pair_gid, pair_first_pos, pair_count, pair_id) where
+    pair arrays have capacity length (garbage past the pair count is
+    masked by pair_gid == max_groups)."""
+    cap = gid_s.shape[0]
+    gidc = jnp.where(contributes, gid_s, max_groups)
+    o1 = jnp.argsort(key_norm, stable=True)
+    o2 = jnp.argsort(gidc[o1], stable=True)
+    perm = o1[o2]
+    g2 = gidc[perm]
+    k2 = key_norm[perm]
+    boundary = jnp.ones(cap, jnp.bool_).at[1:].set(
+        (g2[1:] != g2[:-1]) | (k2[1:] != k2[:-1])
+    )
+    pair_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    first_pos = (
+        jnp.full((cap,), cap, jnp.int32)
+        .at[pair_id]
+        .min(jnp.arange(cap, dtype=jnp.int32))
+    )
+    pair_count = jnp.zeros((cap,), jnp.int32).at[pair_id].add(1)
+    pair_gid = jnp.full((cap,), max_groups, jnp.int32).at[pair_id].set(
+        g2.astype(jnp.int32)
+    )
+    return perm, pair_gid, jnp.minimum(first_pos, cap - 1), pair_count
+
+
+def collect_map_agg(
+    spec, kv, vv, live_s, gid_s, max_groups: int, max_elems: int
+):
+    """histogram / map_agg over sorted rows: distinct keys per group via a
+    second (group, key) sort; values are counts (histogram) or the first
+    row's value (map_agg). Returns (block, needed_elems)."""
+    cap = gid_s.shape[0]
+    contributes = live_s if kv.valid is None else (live_s & kv.valid)
+    key_norm = hash_rows([kv])
+    perm, pair_gid, first_pos, pair_count = _pair_runs(
+        gid_s, key_norm, contributes, max_groups
+    )
+    # per-group range over the pair axis (pairs are sorted by group)
+    grange = jnp.arange(max_groups, dtype=jnp.int32)
+    pstart = jnp.searchsorted(pair_gid, grange, side="left").astype(jnp.int32)
+    pend = jnp.searchsorted(pair_gid, grange, side="right").astype(jnp.int32)
+    pcounts = pend - pstart
+    j = jnp.arange(max_elems, dtype=jnp.int32)
+    ppos = jnp.clip(pstart[:, None] + j[None, :], 0, cap - 1)
+    inb = j[None, :] < jnp.minimum(pcounts[:, None], max_elems)
+    first_row = perm[first_pos]  # pair -> original sorted-row index
+    keys_mat = kv.data[first_row][ppos]
+    kblk = Block(
+        keys_mat, T.ArrayType(kv.type), None, kv.dict_id,
+        lengths=jnp.minimum(pcounts, max_elems), elem_valid=inb,
+    )
+    if spec.func == "histogram":
+        vals_mat = pair_count[ppos].astype(jnp.int64)
+        vtype = T.BIGINT
+        vdict = None
+        ev = inb
+    else:  # map_agg: value at the pair's first row
+        vals_mat = vv.data[first_row][ppos]
+        vtype = vv.type
+        vdict = vv.dict_id
+        ev = inb if vv.valid is None else (inb & vv.valid[first_row][ppos])
+    blk = Block(
+        vals_mat, T.MapType(kv.type, vtype), None, vdict,
+        lengths=jnp.minimum(pcounts, max_elems), elem_valid=ev,
+        key_block=kblk,
+    )
+    return blk, jnp.max(pcounts)

@@ -31,11 +31,7 @@ def compact(page: Page, keep: jnp.ndarray) -> Page:
     # int32 count invariant (page.py): x64 mode would promote the sum
     count = jnp.sum(keep.astype(jnp.int32)).astype(jnp.int32)
     perm = jnp.argsort(~keep, stable=True)  # kept rows first, stable
-    blocks = []
-    for b in page.blocks:
-        data = b.data[perm]
-        valid = None if b.valid is None else b.valid[perm]
-        blocks.append(Block(data, b.type, valid, b.dict_id))
+    blocks = [b.take_rows(perm) for b in page.blocks]
     return Page(tuple(blocks), page.names, count)
 
 

@@ -93,12 +93,11 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
 
 
 def apply_permutation(page: Page, perm: jnp.ndarray) -> Page:
-    blocks = []
-    for b in page.blocks:
-        data = b.data[perm]
-        valid = None if b.valid is None else b.valid[perm]
-        blocks.append(Block(data, b.type, valid, b.dict_id))
-    return Page(tuple(blocks), page.names, page.count)
+    return Page(
+        tuple(b.take_rows(perm) for b in page.blocks),
+        page.names,
+        page.count,
+    )
 
 
 def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:

@@ -49,6 +49,11 @@ def concat_pages(pages: Sequence[Page], distinct: bool = False) -> Page:
     for i, _name in enumerate(first.names):
         col_blocks = [p.blocks[i] for p in pages]
         col_blocks, dict_id = unify_block_dictionaries(col_blocks)
+        if any(b.lengths is not None for b in col_blocks):
+            blocks.append(
+                _concat_collection(col_blocks, first.blocks[i].type, dict_id)
+            )
+            continue
         datas = []
         valids = []
         any_valid = any(b.valid is not None for b in col_blocks)
@@ -74,6 +79,61 @@ def concat_pages(pages: Sequence[Page], distinct: bool = False) -> Page:
 
         out = distinct_page(out, out.capacity)
     return out
+
+
+def _concat_collection(col_blocks, typ, dict_id) -> Block:
+    """Row-stack collection blocks: element matrices pad to the widest
+    width; lengths/elem_valid/key_block concatenate alongside."""
+    width = max(b.data.shape[1] for b in col_blocks)
+
+    def padw(x, fill_bool=False):
+        pad = width - x.shape[1]
+        if pad <= 0:
+            return x
+        return jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
+
+    datas, lens, evs, valids = [], [], [], []
+    any_valid = any(b.valid is not None for b in col_blocks)
+    any_ev = any(b.elem_valid is not None for b in col_blocks)
+    for b in col_blocks:
+        cap = b.data.shape[0]
+        datas.append(padw(b.data))
+        lens.append(
+            b.lengths
+            if b.lengths is not None
+            else jnp.full(cap, b.data.shape[1], jnp.int32)
+        )
+        if any_ev:
+            ev = b.elem_valid
+            if ev is None:  # in-bounds slots are valid
+                ln = lens[-1]
+                ev = (
+                    jnp.arange(b.data.shape[1], dtype=jnp.int32)[None, :]
+                    < ln[:, None]
+                )
+            evs.append(padw(ev, True))
+        if any_valid:
+            valids.append(
+                b.valid
+                if b.valid is not None
+                else jnp.ones((cap,), jnp.bool_)
+            )
+    key_block = None
+    if any(b.key_block is not None for b in col_blocks):
+        key_block = _concat_collection(
+            [b.key_block for b in col_blocks],
+            T.ArrayType(typ.key),
+            col_blocks[0].key_block.dict_id,
+        )
+    return Block(
+        jnp.concatenate(datas),
+        typ,
+        jnp.concatenate(valids) if any_valid else None,
+        dict_id,
+        lengths=jnp.concatenate(lens),
+        elem_valid=jnp.concatenate(evs) if any_ev else None,
+        key_block=key_block,
+    )
 
 
 def null_block(typ: T.Type, capacity: int, dict_id: Optional[int] = None) -> Block:

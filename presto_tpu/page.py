@@ -91,6 +91,13 @@ class Block:
     type: T.Type
     valid: Optional[jax.Array] = None
     dict_id: Optional[int] = None
+    # collection blocks only (ArrayType / MapType results, e.g. array_agg):
+    # data is (capacity, width), `lengths` the per-row element counts,
+    # `elem_valid` an optional per-element null mask, and `key_block` the
+    # companion keys column of a MAP (reference ArrayBlock/MapBlock)
+    lengths: Optional[jax.Array] = None
+    elem_valid: Optional[jax.Array] = None
+    key_block: Optional["Block"] = None
 
     @property
     def dictionary(self) -> Optional[Tuple[str, ...]]:
@@ -98,23 +105,56 @@ class Block:
 
     # -- pytree protocol --
     def tree_flatten(self):
-        if self.valid is None:
-            return (self.data,), (self.type, self.dict_id, False)
-        return (self.data, self.valid), (self.type, self.dict_id, True)
+        children = [self.data]
+        mask = 0
+        if self.valid is not None:
+            children.append(self.valid)
+            mask |= 1
+        if self.lengths is not None:
+            children.append(self.lengths)
+            mask |= 2
+        if self.elem_valid is not None:
+            children.append(self.elem_valid)
+            mask |= 4
+        if self.key_block is not None:
+            children.append(self.key_block)
+            mask |= 8
+        return tuple(children), (self.type, self.dict_id, mask)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        typ, dict_id, has_valid = aux
-        if has_valid:
-            data, valid = children
-        else:
-            (data,) = children
-            valid = None
-        return cls(data=data, type=typ, valid=valid, dict_id=dict_id)
+        typ, dict_id, mask = aux
+        it = iter(children)
+        data = next(it)
+        valid = next(it) if mask & 1 else None
+        lengths = next(it) if mask & 2 else None
+        elem_valid = next(it) if mask & 4 else None
+        key_block = next(it) if mask & 8 else None
+        return cls(
+            data=data, type=typ, valid=valid, dict_id=dict_id,
+            lengths=lengths, elem_valid=elem_valid, key_block=key_block,
+        )
 
     @property
     def capacity(self) -> int:
         return self.data.shape[0]
+
+    def take_rows(self, idx) -> "Block":
+        """Reindex every row-aligned array (gather/slice/permutation),
+        preserving collection companions (lengths/elem_valid/key_block)."""
+        return Block(
+            self.data[idx],
+            self.type,
+            None if self.valid is None else self.valid[idx],
+            self.dict_id,
+            lengths=None if self.lengths is None else self.lengths[idx],
+            elem_valid=(
+                None if self.elem_valid is None else self.elem_valid[idx]
+            ),
+            key_block=(
+                None if self.key_block is None else self.key_block.take_rows(idx)
+            ),
+        )
 
     def valid_mask(self) -> jax.Array:
         if self.valid is None:
@@ -251,12 +291,16 @@ class Page:
 
     # -- host materialization --
     def to_pylist(self) -> list:
-        """Materialize live rows as python tuples (decoding dictionaries)."""
+        """Materialize live rows as python tuples (decoding dictionaries;
+        collection blocks decode to lists / dicts)."""
         n = int(self.count)
         cols = []
         for b in self.blocks:
             data = np.asarray(b.data[:n])
             valid = None if b.valid is None else np.asarray(b.valid[:n])
+            if b.lengths is not None:
+                cols.append(_collection_pylist(b, data, valid, n))
+                continue
             col = []
             for i in range(n):
                 if valid is not None and not valid[i]:
@@ -269,6 +313,45 @@ class Page:
     def to_dict_of_numpy(self) -> dict:
         n = int(self.count)
         return {name: np.asarray(b.data[:n]) for name, b in zip(self.names, self.blocks)}
+
+
+def _collection_pylist(b: Block, data, valid, n: int) -> list:
+    """Decode an ArrayType / MapType block's rows to lists / dicts."""
+    lens = np.asarray(b.lengths[:n])
+    ev = None if b.elem_valid is None else np.asarray(b.elem_valid[:n])
+    if isinstance(b.type, T.MapType):
+        kb = b.key_block
+        kdata = np.asarray(kb.data[:n])
+        kt, vt = b.type.key, b.type.value
+        col = []
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                col.append(None)
+                continue
+            row = {}
+            for j in range(int(lens[i])):
+                k = kt.to_python(kdata[i, j], kb.dictionary)
+                if ev is not None and not ev[i, j]:
+                    row[k] = None
+                else:
+                    row[k] = vt.to_python(data[i, j], b.dictionary)
+            col.append(row)
+        return col
+    et = b.type.element
+    col = []
+    for i in range(n):
+        if valid is not None and not valid[i]:
+            col.append(None)
+            continue
+        col.append(
+            [
+                None
+                if ev is not None and not ev[i, j]
+                else et.to_python(data[i, j], b.dictionary)
+                for j in range(int(lens[i]))
+            ]
+        )
+    return col
 
 
 def _to_block(value) -> Block:
@@ -297,13 +380,26 @@ def _infer_type(arr: np.ndarray) -> T.Type:
 def _pad_block(b: Block, capacity: int) -> Block:
     n = b.capacity
     pad = capacity - n
-    data = jnp.concatenate(
-        [b.data, jnp.zeros((pad,) + b.data.shape[1:], b.data.dtype)]
+
+    def padarr(x, fill_bool=False):
+        if x is None:
+            return None
+        z = (
+            jnp.zeros((pad,) + x.shape[1:], x.dtype)
+            if not fill_bool
+            else jnp.zeros((pad,) + x.shape[1:], jnp.bool_)
+        )
+        return jnp.concatenate([x, z])
+
+    return Block(
+        padarr(b.data),
+        b.type,
+        padarr(b.valid, True),
+        b.dict_id,
+        lengths=padarr(b.lengths),
+        elem_valid=padarr(b.elem_valid, True),
+        key_block=None if b.key_block is None else _pad_block(b.key_block, capacity),
     )
-    valid = None
-    if b.valid is not None:
-        valid = jnp.concatenate([b.valid, jnp.zeros((pad,), jnp.bool_)])
-    return Block(data, b.type, valid, b.dict_id)
 
 
 def round_capacity(n: int, minimum: int = 16) -> int:

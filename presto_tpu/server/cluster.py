@@ -102,7 +102,12 @@ class HttpScheduler:
         if not workers:
             raise TaskFailure("no active workers")
         all_tasks: List[Tuple[str, str]] = []
-        query_id = query_id or f"q_{next(self._task_ids)}"
+        if query_id is None:
+            import uuid
+
+            # unique across sessions sharing these workers: per-query
+            # memory accounting must never merge two queries
+            query_id = f"q_{uuid.uuid4().hex[:12]}"
         try:
             fragment, specs = self._cut(root)
             sources = self._resolve_sources(

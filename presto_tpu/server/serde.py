@@ -62,9 +62,12 @@ def serialize_page(
     cols = []
     buffers = []
     dict_payloads = {}
-    for name, b in zip(page.names, page.blocks):
+
+    def encode_block(name, b):
         data = np.asarray(b.data[:n])
         valid = None if b.valid is None else np.asarray(b.valid[:n])
+        lengths = None if b.lengths is None else np.asarray(b.lengths[:n])
+        ev = None if b.elem_valid is None else np.asarray(b.elem_valid[:n])
         entry = {
             "name": name,
             "type": _type_to_wire(b.type),
@@ -72,6 +75,8 @@ def serialize_page(
             "shape": list(data.shape),
             "valid": valid is not None,
             "dict_id": b.dict_id,
+            "lengths": lengths is not None,
+            "elem_valid": ev is not None,
         }
         if b.dict_id is not None:
             needs = cache is None or b.dict_id not in cache.sent
@@ -80,10 +85,19 @@ def serialize_page(
                 dict_payloads[str(b.dict_id)] = list(d)
                 if cache is not None:
                     cache.sent.add(b.dict_id)
-        cols.append(entry)
         buffers.append(data.tobytes())
         if valid is not None:
             buffers.append(valid.tobytes())
+        if lengths is not None:
+            buffers.append(lengths.astype(np.int32).tobytes())
+        if ev is not None:
+            buffers.append(ev.tobytes())
+        if b.key_block is not None:
+            entry["key"] = encode_block(f"{name}$keys", b.key_block)
+        return entry
+
+    for name, b in zip(page.names, page.blocks):
+        cols.append(encode_block(name, b))
     header = json.dumps(
         {"count": n, "columns": cols, "dictionaries": dict_payloads}
     ).encode()
@@ -161,13 +175,23 @@ def deserialize_page(
     n = header["count"]
     blocks = []
     names = []
-    for col in header["columns"]:
+    import jax.numpy as jnp
+
+    def decode_block(col):
         typ = _type_from_wire(col["type"])
         arr = np.frombuffer(read_buf(), dtype=np.dtype(col["dtype"]))
         arr = arr.reshape(col["shape"])
         valid = None
         if col["valid"]:
             valid = np.frombuffer(read_buf(), dtype=np.bool_)
+        lengths = None
+        if col.get("lengths"):
+            lengths = np.frombuffer(read_buf(), dtype=np.int32)
+        ev = None
+        if col.get("elem_valid"):
+            ev = np.frombuffer(read_buf(), dtype=np.bool_).reshape(
+                col["shape"][:2]
+            )
         dict_id = col["dict_id"]
         local_dict = None
         if dict_id is not None:
@@ -183,15 +207,20 @@ def deserialize_page(
                 raise KeyError(
                     f"dictionary {dict_id} not in payload and no cache"
                 )
-        import jax.numpy as jnp
-
-        blocks.append(
-            Block(
-                jnp.asarray(arr),
-                typ,
-                None if valid is None else jnp.asarray(valid),
-                local_dict,
-            )
+        key_block = None
+        if col.get("key") is not None:
+            key_block = decode_block(col["key"])
+        return Block(
+            jnp.asarray(arr),
+            typ,
+            None if valid is None else jnp.asarray(valid),
+            local_dict,
+            lengths=None if lengths is None else jnp.asarray(lengths),
+            elem_valid=None if ev is None else jnp.asarray(ev),
+            key_block=key_block,
         )
+
+    for col in header["columns"]:
+        blocks.append(decode_block(col))
         names.append(col["name"])
     return Page.from_blocks(blocks, names, count=n)

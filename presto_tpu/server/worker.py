@@ -70,7 +70,7 @@ class WorkerMemoryPool:
         self._cond = threading.Condition()
 
     def reserve(self, query_id: str, nbytes: int, abort: threading.Event,
-                timeout: float = 60.0) -> None:
+                timeout: float = 600.0) -> None:
         if self.limit is None:
             with self._cond:
                 self.reserved += nbytes
@@ -143,7 +143,7 @@ class OutputBuffers:
         self._cond = threading.Condition()
 
     def put(self, buffer_id: int, data: bytes,
-            timeout: float = 60.0) -> None:
+            timeout: float = 600.0) -> None:
         deadline = time.time() + timeout
         with self._cond:
             while self.bound is not None and self._unacked + len(data) > max(
@@ -571,15 +571,8 @@ def _split_to_bound(page: Page, bound: Optional[int]):
         return
     for start in range(0, n, max_rows):
         stop = min(start + max_rows, n)
-        blocks = tuple(
-            Block(
-                b.data[start:stop],
-                b.type,
-                None if b.valid is None else b.valid[start:stop],
-                b.dict_id,
-            )
-            for b in page.blocks
-        )
+        idx = slice(start, stop)
+        blocks = tuple(b.take_rows(idx) for b in page.blocks)
         yield Page(blocks, page.names, stop - start)
 
 

@@ -795,15 +795,41 @@ class Parser:
                 distinct = True
             else:
                 self.accept_kw("all")
-            args.append(self.parse_expr())
+            args.append(self._parse_arg())
             while self.accept(","):
-                args.append(self.parse_expr())
+                args.append(self._parse_arg())
             self.expect(")")
             return self._call_suffix(name, args, distinct, is_star)
         else:
             return self._call_suffix(name, args, distinct, is_star)
         self.expect(")")
         return self._call_suffix(name, args, distinct, is_star)
+
+    def _parse_arg(self) -> t.Node:
+        """Function argument: lambda `x -> e` / `(x, y) -> e`, or a
+        plain expression."""
+        if self.tok.kind == "ident" and self.peek().kind == "->":
+            param = self.ident()
+            self.expect("->")
+            return t.LambdaExpr((param,), self.parse_expr())
+        if self.tok.kind == "(":
+            # lookahead for "(p [, p...]) ->"
+            j = self.i + 1
+            params = []
+            ok = False
+            while self.tokens[j].kind == "ident":
+                params.append(self.tokens[j].text)
+                j += 1
+                if self.tokens[j].kind == ",":
+                    j += 1
+                    continue
+                if self.tokens[j].kind == ")" and self.tokens[j + 1].kind == "->":
+                    ok = True
+                break
+            if ok and params:
+                self.i = j + 2  # past ') ->'
+                return t.LambdaExpr(tuple(params), self.parse_expr())
+        return self.parse_expr()
 
     def _call_suffix(self, name, args, distinct, is_star) -> t.Node:
         filt = None

@@ -39,10 +39,16 @@ from . import tree as t
 AGG_FUNCS = {
     "count", "sum", "avg", "min", "max", "checksum", "approx_distinct",
     "min_by", "max_by", "approx_percentile",
+    "array_agg", "map_agg", "histogram",
 }
 
 # aggregates planned by rewriting onto the core set (reference: many of
 # operator/aggregation/*'s 100+ functions decompose into sum/count states)
+LAMBDA_FUNCS = {
+    "transform", "filter", "reduce", "zip_with",
+    "any_match", "all_match", "none_match",
+}
+
 REWRITE_AGG_FUNCS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "count_if", "bool_and", "bool_or", "every", "arbitrary",
@@ -999,19 +1005,16 @@ class Planner:
             fname = call.name
             orig_call = call
             if fname == "approx_distinct":
-                # exact distinct count satisfies the approx contract
-                # (reference ApproximateCountDistinctAggregations is an
-                # HLL estimate; this engine dedupes exactly instead). The
-                # optional second argument is the max standard error —
-                # meaningless for an exact count, so it is dropped.
+                # real HyperLogLog estimate (reference
+                # ApproximateCountDistinctAggregations + airlift HLL) with
+                # mergeable register partials for the distributed path.
+                # The optional second argument (max standard error) is
+                # dropped: the engine runs one register width (p=10).
                 if not 1 <= len(call.args) <= 2:
                     raise PlanningError(
                         "approx_distinct takes 1 or 2 arguments"
                     )
-                call = dataclasses.replace(
-                    call, name="count", distinct=True, args=call.args[:1]
-                )
-                fname = "count"
+                call = dataclasses.replace(call, args=call.args[:1])
             if fname in REWRITE_AGG_FUNCS:
                 agg_map[call] = self._rewrite_aggregate(call, sctx, aggs)
                 continue
@@ -1079,6 +1082,21 @@ class Planner:
                 spec = AggSpec(
                     "percentile", e, self.channel(fname), e.type,
                     input2=ir.Literal(frac, T.DOUBLE),
+                )
+            elif fname == "map_agg":
+                if len(call.args) != 2:
+                    raise PlanningError("map_agg takes 2 arguments")
+                if call.distinct:
+                    raise PlanningError("map_agg does not support DISTINCT")
+                k = sctx.translate(call.args[0])
+                v = sctx.translate(call.args[1])
+                if filt is not None:
+                    k = ir.Call(
+                        "if", (filt, k, ir.Literal(None, k.type)), k.type
+                    )
+                spec = AggSpec(
+                    "map_agg", k, self.channel(fname),
+                    T.MapType(k.type, v.type), input2=v,
                 )
             elif fname in ("min_by", "max_by"):
                 if len(call.args) != 2:
@@ -2255,6 +2273,79 @@ class SelectContext:
         args.append(else_)
         return ir.Call("case", tuple(args), out_t)
 
+    def _translate_lambda(self, lam: t.LambdaExpr, param_types) -> ir.Lambda:
+        """Bind lambda params as synthetic channels visible to the body
+        (reference: LambdaExpression scoping in ExpressionAnalyzer)."""
+        if len(lam.params) != len(param_types):
+            raise PlanningError(
+                f"lambda takes {len(lam.params)} parameters, "
+                f"{len(param_types)} expected"
+            )
+        chans = tuple(self.p.channel(p) for p in lam.params)
+        fields = [
+            FieldRef(None, p, ch, ty)
+            for p, ch, ty in zip(lam.params, chans, param_types)
+        ]
+        inner = SelectContext(
+            self.p, [Scope(fields)] + list(self.scopes), self.outer,
+            self.ctes, self.holder,
+        )
+        body = inner._tr(lam.body)
+        return ir.Lambda(chans, body, tuple(param_types))
+
+    def _lambda_function(self, ast: t.FunctionCall) -> ir.RowExpression:
+        """Higher-order functions over arrays (reference
+        operator/scalar/ArrayTransformFunction.java & friends)."""
+        name = ast.name
+
+        def elem(e: ir.RowExpression) -> T.Type:
+            if not isinstance(e.type, T.ArrayType):
+                raise PlanningError(f"{name} expects an array argument")
+            return e.type.element
+
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match"):
+            if len(ast.args) != 2 or not isinstance(ast.args[1], t.LambdaExpr):
+                raise PlanningError(f"{name}(array, lambda) expected")
+            arr = self._tr(ast.args[0])
+            lam = self._translate_lambda(ast.args[1], (elem(arr),))
+            if name == "transform":
+                out = T.ArrayType(lam.body.type)
+            elif name == "filter":
+                out = arr.type
+            else:
+                out = T.BOOLEAN
+            return ir.Call(name, (arr, lam), out)
+        if name == "zip_with":
+            if len(ast.args) != 3 or not isinstance(ast.args[2], t.LambdaExpr):
+                raise PlanningError("zip_with(array, array, lambda) expected")
+            a = self._tr(ast.args[0])
+            b = self._tr(ast.args[1])
+            lam = self._translate_lambda(ast.args[2], (elem(a), elem(b)))
+            return ir.Call(
+                "zip_with", (a, b, lam), T.ArrayType(lam.body.type)
+            )
+        if name == "reduce":
+            if len(ast.args) != 4 or not all(
+                isinstance(a, t.LambdaExpr) for a in ast.args[2:]
+            ):
+                raise PlanningError(
+                    "reduce(array, initialState, inputFn, outputFn) expected"
+                )
+            arr = self._tr(ast.args[0])
+            init = self._tr(ast.args[1])
+            input_fn = self._translate_lambda(
+                ast.args[2], (init.type, elem(arr))
+            )
+            output_fn = self._translate_lambda(
+                ast.args[3], (input_fn.body.type,)
+            )
+            return ir.Call(
+                "reduce", (arr, init, input_fn, output_fn),
+                output_fn.body.type,
+            )
+        raise PlanningError(f"unsupported higher-order function {name}")
+
     def _function(self, ast: t.FunctionCall) -> ir.RowExpression:
         name = ast.name
         if name in AGG_FUNCS or name in REWRITE_AGG_FUNCS:
@@ -2282,6 +2373,10 @@ class SelectContext:
                 if arg not in cur:
                     value |= 1 << (n_args - 1 - i)
             return ir.Literal(value, T.BIGINT)
+        if name in LAMBDA_FUNCS or any(
+            isinstance(a, t.LambdaExpr) for a in ast.args
+        ):
+            return self._lambda_function(ast)
         args = tuple(self._tr(a) for a in ast.args)
         if name == "ceiling":
             name = "ceil"

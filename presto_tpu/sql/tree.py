@@ -140,6 +140,15 @@ class Like(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class LambdaExpr(Node):
+    """`x -> body` / `(x, y) -> body` — argument to higher-order
+    functions (reference sql/tree/LambdaExpression.java)."""
+
+    params: Tuple[str, ...]
+    body: Node
+
+
+@dataclasses.dataclass(frozen=True)
 class FunctionCall(Node):
     name: str  # lowercase
     args: Tuple[Node, ...]

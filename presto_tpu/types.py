@@ -302,6 +302,30 @@ class ArrayType(Type):
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """MAP(key, value) (reference spi/type/MapType.java). Like arrays,
+    maps live in expression values and collection-aggregate RESULT blocks
+    (values in data + lengths, keys in a companion key block); map-typed
+    table columns are not supported."""
+
+    key: Type = None  # type: ignore[assignment]
+    value: Type = None  # type: ignore[assignment]
+    name: ClassVar[str] = "map"
+
+    @property
+    def storage_dtype(self):
+        return self.value.storage_dtype
+
+    def display(self) -> str:
+        return f"map({self.key}, {self.value})"
+
+    def to_python(self, storage_value, dictionary=None):
+        raise TypeError(
+            "map rows are decoded by Page.to_pylist via the key block"
+        )
+
+
 # Singletons
 BIGINT = BigintType()
 INTEGER = IntegerType()
@@ -379,6 +403,19 @@ def parse_type(text: str) -> Type:
         return CharType(max_length=int(s[len("char(") : -1]))
     if s.startswith("array(") and s.endswith(")"):
         return ArrayType(parse_type(s[len("array(") : -1]))
+    if s.startswith("map(") and s.endswith(")"):
+        inner = s[len("map(") : -1]
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return MapType(
+                    parse_type(inner[:i]), parse_type(inner[i + 1 :])
+                )
+        raise ValueError(f"malformed map type: {text!r}")
     raise ValueError(f"unknown type: {text!r}")
 
 

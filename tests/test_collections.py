@@ -1,0 +1,244 @@
+"""Higher-order functions, MAP type, collection aggregates, HyperLogLog
+(reference: operator/scalar/ArrayTransformFunction.java & lambda friends,
+MapConstructor/MapFunctions, aggregation/ArrayAggregationFunction,
+MapAggregationFunction, HistogramAggregation,
+ApproximateCountDistinctAggregations + airlift HLL)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    cat = MemoryCatalog(
+        {
+            "t": Page.from_dict(
+                {
+                    "g": np.array([1, 1, 2, 2, 2, 3], dtype=np.int64),
+                    "v": np.array([10, 20, 30, 30, 40, 50], dtype=np.int64),
+                    "s": ["a", "b", "c", "c", "d", "e"],
+                }
+            )
+        }
+    )
+    return Session(cat)
+
+
+def one(session, expr):
+    return session.query(f"select {expr} x from t limit 1").rows()[0][0]
+
+
+# -- lambdas ---------------------------------------------------------------
+
+
+def test_transform(session):
+    assert one(session, "element_at(transform(array[1,2,3], x -> x * x), 3)") == 9
+
+
+def test_transform_uses_outer_column(session):
+    rows = session.query(
+        "select element_at(transform(array[100], x -> x + v), 1) e "
+        "from t order by v"
+    ).rows()
+    assert [r[0] for r in rows] == [110, 120, 130, 130, 140, 150]
+
+
+def test_filter_lambda(session):
+    assert one(
+        session, "cardinality(filter(array[1,2,3,4,5,6], x -> x % 3 = 0))"
+    ) == 2
+    assert one(
+        session,
+        "element_at(filter(array[5,1,8,2], x -> x > 1), 2)",
+    ) == 8  # order preserved
+
+
+def test_reduce(session):
+    assert one(
+        session, "reduce(array[1,2,3,4], 0, (s, x) -> s + x, s -> s)"
+    ) == 10
+    assert one(
+        session, "reduce(array[2,3,4], 1, (s, x) -> s * x, s -> s * 10)"
+    ) == 240
+
+
+def test_matches(session):
+    assert one(session, "any_match(array[1,2,3], x -> x > 2)") is True
+    assert one(session, "all_match(array[1,2,3], x -> x > 0)") is True
+    assert one(session, "none_match(array[1,2,3], x -> x > 9)") is True
+    assert one(session, "any_match(array[1,2,3], x -> x > 9)") is False
+
+
+def test_zip_with(session):
+    assert one(
+        session,
+        "reduce(zip_with(array[1,2,3], array[10,20,30], (a, b) -> a * b), "
+        "0, (s, x) -> s + x, s -> s)",
+    ) == 140
+
+
+def test_lambda_over_strings(session):
+    assert one(
+        session,
+        "reduce(transform(split('x,yy,zzz', ','), e -> length(e)), "
+        "0, (s, x) -> s + x, s -> s)",
+    ) == 6
+
+
+# -- maps ------------------------------------------------------------------
+
+
+def test_map_constructor_and_lookup(session):
+    assert one(
+        session, "element_at(map(array['a','b'], array[1,2]), 'b')"
+    ) == 2
+    assert one(
+        session, "element_at(map(array['a','b'], array[1,2]), 'zz')"
+    ) is None
+    assert one(session, "cardinality(map(array['a','b'], array[1,2]))") == 2
+
+
+def test_map_keys_values(session):
+    assert one(
+        session, "element_at(map_keys(map(array['p','q'], array[7,8])), 1)"
+    ) == "p"
+    assert one(
+        session, "element_at(map_values(map(array['p','q'], array[7,8])), 2)"
+    ) == 8
+
+
+# -- collection aggregates -------------------------------------------------
+
+
+def test_array_agg_grouped(session):
+    rows = session.query(
+        "select g, array_agg(v) a from t group by g order by g"
+    ).rows()
+    assert [(g, sorted(a)) for g, a in rows] == [
+        (1, [10, 20]),
+        (2, [30, 30, 40]),
+        (3, [50]),
+    ]
+
+
+def test_histogram_grouped(session):
+    rows = session.query(
+        "select g, histogram(v) h from t group by g order by g"
+    ).rows()
+    assert rows == [
+        (1, {10: 1, 20: 1}),
+        (2, {30: 2, 40: 1}),
+        (3, {50: 1}),
+    ]
+
+
+def test_map_agg_grouped(session):
+    rows = session.query(
+        "select g, map_agg(s, v) m from t group by g order by g"
+    ).rows()
+    assert rows == [
+        (1, {"a": 10, "b": 20}),
+        (2, {"c": 30, "d": 40}),
+        (3, {"e": 50}),
+    ]
+
+
+def test_array_agg_global_and_unnest_roundtrip(session):
+    (row,) = session.query("select array_agg(v) a from t").rows()
+    assert sorted(row[0]) == [10, 20, 30, 30, 40, 50]
+
+
+def test_array_agg_width_overflow_adapts():
+    # groups larger than the initial 128-element collection width force
+    # the adaptive retry (the $collect_need protocol)
+    n = 3000
+    cat = MemoryCatalog(
+        {
+            "big": Page.from_dict(
+                {
+                    "g": (np.arange(n) % 3).astype(np.int64),
+                    "v": np.arange(n, dtype=np.int64),
+                }
+            )
+        }
+    )
+    rows = Session(cat).query(
+        "select g, cardinality(array_agg(v)) c from big group by g order by g"
+    ).rows()
+    assert rows == [(0, 1000), (1, 1000), (2, 1000)]
+
+
+# -- HyperLogLog approx_distinct ------------------------------------------
+
+
+def test_approx_distinct_accuracy():
+    n = 200_000
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 50_000, n)
+    cat = MemoryCatalog(
+        {"u": Page.from_dict({"v": vals.astype(np.int64)})}
+    )
+    s = Session(cat)
+    exact = s.query("select count(distinct v) c from u").rows()[0][0]
+    est = s.query("select approx_distinct(v) c from u").rows()[0][0]
+    assert abs(est - exact) / exact < 0.05, (est, exact)
+
+
+def test_approx_distinct_grouped_vs_exact():
+    cat = TpchCatalog(sf=0.01)
+    s = Session(cat)
+    exact = dict(
+        s.query(
+            "select l_returnflag, count(distinct l_orderkey) c "
+            "from lineitem group by l_returnflag"
+        ).rows()
+    )
+    got = s.query(
+        "select l_returnflag, approx_distinct(l_orderkey) c "
+        "from lineitem group by l_returnflag"
+    ).rows()
+    for g, est in got:
+        assert abs(est - exact[g]) / exact[g] < 0.10, (g, est, exact[g])
+
+
+def test_approx_distinct_distributed_mesh():
+    """Mergeable HLL partials over the 8-device mesh: the distributed
+    estimate must EQUAL the single-node estimate (register merge is
+    exact) and stay near the true count."""
+    from presto_tpu.parallel.mesh import default_mesh
+
+    cat = TpchCatalog(sf=0.01)
+    local = Session(cat)
+    dist = Session(cat, mesh=default_mesh(8))
+    sql = (
+        "select l_returnflag, approx_distinct(l_orderkey) ad "
+        "from lineitem group by l_returnflag order by l_returnflag"
+    )
+    want = local.query(sql).rows()
+    got = dist.query(sql).rows()
+    assert got == want
+    exact = dict(
+        local.query(
+            "select l_returnflag, count(distinct l_orderkey) c "
+            "from lineitem group by l_returnflag"
+        ).rows()
+    )
+    for g, est in got:
+        assert abs(est - exact[g]) / exact[g] < 0.10
+
+
+def test_approx_distinct_streaming():
+    cat = TpchCatalog(sf=0.01)
+    s = Session(cat, streaming=True, batch_rows=4096)
+    got = s.query(
+        "select approx_distinct(l_orderkey) c from lineitem"
+    ).rows()[0][0]
+    want = Session(cat).query(
+        "select approx_distinct(l_orderkey) c from lineitem"
+    ).rows()[0][0]
+    assert got == want  # partial-register merge == one-shot registers
