@@ -40,10 +40,13 @@ class ExecutionError(RuntimeError):
 
 class Executor:
     def __init__(self, catalog, shrink: bool = True, jit: bool = True,
-                 collector=None):
+                 collector=None, pallas_groupby: bool = False):
         self.catalog = catalog
         self.shrink = shrink
         self.jit = jit
+        # route eligible small-G aggregations through the Pallas kernel
+        # (ops/pallas_groupby.py; session property `pallas_groupby`)
+        self.pallas_groupby = pallas_groupby
         # (plan node, static params) -> jitted kernel; the analog of the
         # reference caching compiled PageProcessors per plan
         # (LocalExecutionPlanner compiles once, Drivers reuse)
@@ -169,6 +172,15 @@ class Executor:
                 lambda: lambda p: global_aggregate(p, node.aggs, node.mask),
             )
             return fn(page)
+        if self.pallas_groupby:
+            from ..ops.pallas_groupby import maybe_grouped_aggregate
+
+            out = maybe_grouped_aggregate(
+                page, node.group_exprs, node.group_names, node.aggs,
+                node.mask,
+            )
+            if out is not None:
+                return self._shrink(out)
         # groups <= live rows; guess low and retry with the true group count
         # (returned regardless of the bound) on overflow — the adaptive-
         # capacity pattern used by all static-shape operators here

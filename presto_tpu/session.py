@@ -46,6 +46,7 @@ SESSION_PROPERTIES = {
     "batch_rows": int,            # streaming scan batch size
     "memory_budget": int,         # device-memory budget (bytes)
     "query_priority": int,        # resource-group query_priority policy
+    "pallas_groupby": _parse_bool,  # small-G aggregation via the Pallas kernel
 }
 
 
@@ -84,6 +85,7 @@ class Session:
         memory_budget=None,
         access_control=None,
         user: str = "user",
+        pallas_groupby: bool = False,
     ):
         self.access_control = access_control
         self.user = user
@@ -105,6 +107,10 @@ class Session:
         self.streaming = streaming
         self.batch_rows = batch_rows
         self.memory_budget = memory_budget
+        self.pallas_groupby = pallas_groupby
+        local = getattr(self.executor, "local", self.executor)
+        if hasattr(local, "pallas_groupby"):
+            local.pallas_groupby = pallas_groupby
 
     def with_properties(self, props: dict) -> "Session":
         """A sibling session with per-query property overrides applied
@@ -134,6 +140,9 @@ class Session:
                 memory_budget=engine.get("memory_budget", self.memory_budget),
                 access_control=self.access_control,
                 user=self.user,
+                pallas_groupby=engine.get(
+                    "pallas_groupby", self.pallas_groupby
+                ),
             )
             cache[key] = derived
         return derived
