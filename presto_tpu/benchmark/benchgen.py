@@ -1,0 +1,234 @@
+"""Deterministic dual host/device TPC-H benchmark data generator.
+
+Round-4 field finding: the axon TPU tunnel wedges on bulk host->device
+transfers (an SF1 lineitem upload of ~340 MB hung the tunnel hard enough
+that even `jax.devices()` stopped responding for every later process).
+The benchmark therefore never ships data to the chip at all: every column
+is a pure function of the row index through a splitmix64 counter RNG, so
+the DEVICE PATH generates its input on-device under `jit` (transfers:
+a few scalars), and the CPU BASELINE generates bit-identical columns with
+the numpy twin of the same code. This mirrors how the reference's
+benchmark connector generates synthetic pages worker-side from splits
+instead of shipping them (presto-tpch/src/main/java/com/facebook/presto/
+tpch/TpchPageSourceProvider ... via io.airlift.tpch; BenchmarkQueryRunner
+.java:55) — generation-at-the-operator is the MPP-native (and here
+TPU-native) way to feed a benchmark.
+
+Distributions follow connectors/tpch.py (TPC-H spec shapes: §4.2.3
+pricing formulas, date windows, returnflag/linestatus rules) with one
+simplification for static shapes under jit: every order has exactly 4
+lineitems (the spec's 1..7 uniform has mean 4), so order rollups are a
+reshape instead of a ragged segment-sum. The SQL-path oracle tests keep
+using connectors/tpch.py — this module feeds benchmarks only.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page
+
+STARTDATE = 8035  # 1992-01-01
+CURRENTDATE = 9298  # 1995-06-17
+ENDDATE = 10591  # 1998-12-31
+
+DEC12_2 = T.DecimalType(12, 2)
+DEC4_2 = T.DecimalType(4, 2)
+
+_RF_POOL = ("A", "N", "R")
+_LS_POOL = ("F", "O")
+
+LINES_PER_ORDER = 4
+
+# name -> (Type, dictionary pool | None); the static schema so callers can
+# test coverage without running a generator
+SCHEMAS: Dict[str, Dict[str, Tuple[T.Type, Optional[tuple]]]] = {
+    "lineitem": {
+        "l_orderkey": (T.BIGINT, None),
+        "l_partkey": (T.BIGINT, None),
+        "l_suppkey": (T.BIGINT, None),
+        "l_linenumber": (T.BIGINT, None),
+        "l_quantity": (DEC12_2, None),
+        "l_extendedprice": (DEC12_2, None),
+        "l_discount": (DEC4_2, None),
+        "l_tax": (DEC4_2, None),
+        "l_returnflag": (T.VARCHAR, _RF_POOL),
+        "l_linestatus": (T.VARCHAR, _LS_POOL),
+        "l_shipdate": (T.DATE, None),
+        "l_receiptdate": (T.DATE, None),
+    },
+    "orders": {
+        "o_orderkey": (T.BIGINT, None),
+        "o_custkey": (T.BIGINT, None),
+        "o_totalprice": (DEC12_2, None),
+        "o_orderdate": (T.DATE, None),
+    },
+}
+
+
+def _u64(xp, stream: int, i):
+    """splitmix64 finalizer over (stream, row-index) counters — identical
+    bit stream from the numpy and jax.numpy implementations."""
+    base = (stream * 0xA0761D6478BD642F) & 0xFFFFFFFFFFFFFFFF  # python-int wrap
+    z = (i + xp.uint64(base)) * xp.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> xp.uint64(30))) * xp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> xp.uint64(27))) * xp.uint64(0x94D049BB133111EB)
+    return z ^ (z >> xp.uint64(31))
+
+
+def _uni(xp, stream: int, i, lo: int, hi: int):
+    """Uniform int64 in [lo, hi) (modulo bias is irrelevant here and, more
+    to the point, identical across the twins)."""
+    return (lo + _u64(xp, stream, i) % xp.uint64(hi - lo)).astype(xp.int64)
+
+
+def _retail_price_cents(xp, partkey):
+    # p_retailprice = 90000 + ((pk/10) mod 20001) + 100*(pk mod 1000)  (§4.2.3)
+    pk = partkey
+    return 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
+
+
+def _sizes(sf: float) -> Dict[str, int]:
+    n_orders = max(int(1_500_000 * sf), 8)
+    return {
+        "orders": n_orders,
+        "lineitem": n_orders * LINES_PER_ORDER,
+        "customer": max(int(150_000 * sf), 4),
+        "part": max(int(200_000 * sf), 4),
+        "supplier": max(int(10_000 * sf), 2),
+    }
+
+
+class _Memo:
+    """Compute shared intermediates once per generation call."""
+
+    def __init__(self):
+        self.vals = {}
+
+    def get(self, key, fn):
+        if key not in self.vals:
+            self.vals[key] = fn()
+        return self.vals[key]
+
+
+def _gen_lineitem(xp, sf: float, columns: Sequence[str]):
+    s = _sizes(sf)
+    n = s["lineitem"]
+    m = _Memo()
+    i = lambda: m.get("i", lambda: xp.arange(n, dtype=xp.uint64))
+    order = lambda: m.get("order", lambda: i() // xp.uint64(LINES_PER_ORDER))
+    partkey = lambda: m.get("pk", lambda: _uni(xp, 3, i(), 1, s["part"] + 1))
+    qty = lambda: m.get("qty", lambda: _uni(xp, 4, i(), 1, 51))
+    orderdate = lambda: m.get(
+        "od", lambda: _uni(xp, 7, order(), STARTDATE, ENDDATE - 151 + 1)
+    )
+    shipdate = lambda: m.get(
+        "ship", lambda: orderdate() + _uni(xp, 8, i(), 1, 122)
+    )
+    receiptdate = lambda: m.get(
+        "rcpt", lambda: shipdate() + _uni(xp, 9, i(), 1, 31)
+    )
+    fns = {
+        "l_orderkey": lambda: order().astype(xp.int64) + 1,
+        "l_partkey": partkey,
+        "l_suppkey": lambda: _uni(xp, 12, i(), 1, s["supplier"] + 1),
+        "l_linenumber": lambda: (i() % xp.uint64(LINES_PER_ORDER)).astype(xp.int64)
+        + 1,
+        "l_quantity": lambda: qty() * 100,
+        "l_extendedprice": lambda: qty() * _retail_price_cents(xp, partkey()),
+        "l_discount": lambda: _uni(xp, 5, i(), 0, 11),
+        "l_tax": lambda: _uni(xp, 6, i(), 0, 9),
+        "l_returnflag": lambda: xp.where(
+            receiptdate() <= CURRENTDATE,
+            xp.where(_u64(xp, 10, i()) % xp.uint64(2) == 0, 0, 2),
+            1,
+        ).astype(xp.int32),
+        "l_linestatus": lambda: (shipdate() > CURRENTDATE).astype(xp.int32),
+        "l_shipdate": lambda: shipdate().astype(xp.int32),
+        "l_receiptdate": lambda: receiptdate().astype(xp.int32),
+    }
+    return {c: fns[c]() for c in columns}
+
+
+def _gen_orders(xp, sf: float, columns: Sequence[str]):
+    s = _sizes(sf)
+    n = s["orders"]
+    m = _Memo()
+    o = lambda: m.get("o", lambda: xp.arange(n, dtype=xp.uint64))
+
+    def totalprice():
+        # per-order sum of gross over its 4 lines, using the same streams
+        # the lineitem twin uses, so the rollup is consistent
+        li = xp.arange(n * LINES_PER_ORDER, dtype=xp.uint64)
+        pk = _uni(xp, 3, li, 1, s["part"] + 1)
+        qty = _uni(xp, 4, li, 1, 51)
+        price = qty * _retail_price_cents(xp, pk)
+        disc = _uni(xp, 5, li, 0, 11)
+        tax = _uni(xp, 6, li, 0, 9)
+        net = price * (100 - disc) // 100
+        gross = net * (100 + tax) // 100
+        return gross.reshape(n, LINES_PER_ORDER).sum(axis=1)
+
+    fns = {
+        "o_orderkey": lambda: o().astype(xp.int64) + 1,
+        "o_custkey": lambda: _uni(xp, 11, o(), 1, s["customer"] + 1),
+        "o_totalprice": totalprice,
+        "o_orderdate": lambda: _uni(
+            xp, 7, o(), STARTDATE, ENDDATE - 151 + 1
+        ).astype(xp.int32),
+    }
+    return {c: fns[c]() for c in columns}
+
+
+_GENERATORS = {"lineitem": _gen_lineitem, "orders": _gen_orders}
+
+
+def supports(table: str, columns: Sequence[str]) -> bool:
+    return table in SCHEMAS and all(c in SCHEMAS[table] for c in columns)
+
+
+def numpy_columns(
+    table: str, sf: float, columns: Sequence[str]
+) -> Dict[str, np.ndarray]:
+    """Host twin: {name: numpy array} bit-identical to the device page."""
+    return _GENERATORS[table](np, sf, tuple(columns))
+
+
+_PAGE_CACHE: Dict[tuple, Page] = {}
+
+
+def device_page(
+    table: str, sf: float, columns: Sequence[str], pad_to: Optional[int] = None
+) -> Page:
+    """Generate the requested columns ON DEVICE (one jit call, no bulk
+    host->device transfer) and wrap them as an engine Page."""
+    import jax
+
+    columns = tuple(columns)
+    key = (table, sf, columns, pad_to, jax.default_backend())
+    if key in _PAGE_CACHE:
+        return _PAGE_CACHE[key]
+    schema = SCHEMAS[table]
+
+    def gen():
+        import jax.numpy as jnp
+
+        cols = _GENERATORS[table](jnp, sf, columns)
+        return tuple(
+            cols[c].astype(schema[c][0].storage_dtype) for c in columns
+        )
+
+    arrays = jax.jit(gen)()
+    from ..page import intern_dictionary
+
+    blocks = {}
+    for c, arr in zip(columns, arrays):
+        typ, pool = schema[c]
+        did = intern_dictionary(tuple(pool)) if pool is not None else None
+        blocks[c] = Block(arr, typ, None, did)
+    page = Page.from_dict(blocks, pad_to=pad_to)
+    _PAGE_CACHE[key] = page
+    return page

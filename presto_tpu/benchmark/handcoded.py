@@ -39,6 +39,15 @@ Q6_COLUMNS = ("l_quantity", "l_extendedprice", "l_discount", "l_shipdate")
 
 
 def _table_page(name: str, sf: float, columns, pad_to: Optional[int] = None) -> Page:
+    """Benchmark pages are GENERATED ON DEVICE via benchgen whenever it
+    covers the requested columns — the axon tunnel wedges on bulk
+    host->device uploads (see benchgen docstring), so the hand-coded
+    benchmark paths must never ship table data to the chip. Unsupported
+    columns fall back to the host tpch connector (transfer)."""
+    from . import benchgen
+
+    if benchgen.supports(name, columns):
+        return benchgen.device_page(name, sf, tuple(columns), pad_to=pad_to)
     t = tpch.table(name, sf)
     data = {}
     for c in columns:

@@ -43,6 +43,50 @@ class Bench:
     note: str = ""
 
 
+# Peak HBM bandwidth by device kind (bytes/s), for utilization accounting —
+# the MFU analog of a scan-bound engine: achieved streaming bandwidth
+# (input bytes read per kernel pass / elapsed) over the chip's peak. Values
+# from public TPU system specs (cloud.google.com/tpu/docs/system-architecture).
+_PEAK_HBM_BPS = {
+    "TPU v5 lite": 819e9,  # v5e: 16 GiB HBM2 @ 819 GB/s
+    "TPU v5e": 819e9,
+    "TPU v5": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v3": 900e9,
+    "TPU v2": 700e9,
+}
+
+
+def _peak_hbm_bps() -> Optional[float]:
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    # longest prefix first, so e.g. "TPU v5p" matches its own entry and
+    # not the shorter "TPU v5"
+    for prefix in sorted(_PEAK_HBM_BPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return _PEAK_HBM_BPS[prefix]
+    return None
+
+
+def _arg_bytes(args) -> int:
+    """Input working set per run: bytes of every device/host array in args
+    (Pages, Blocks, raw arrays). This is the bytes READ by one streaming
+    pass; kernels that also write large outputs (sort, join) achieve more
+    traffic than this accounts for, so hbm_read_pct is a lower bound."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(args):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None and hasattr(leaf, "dtype") and hasattr(leaf, "size"):
+            nbytes = leaf.size * leaf.dtype.itemsize
+        if nbytes:
+            total += int(nbytes)
+    return total
+
+
 def _chain(x, acc):
     """Inject a zero-valued dependency on the carried accumulator into an
     input array, forcing serial execution of chained runs."""
@@ -485,6 +529,7 @@ def run_suite(
 
     results: List[Dict] = []
     errors: Dict[str, str] = {}
+    peak_bps = _peak_hbm_bps()
     for name, ctor in DEVICE_BENCHES.items():
         if only and name not in only:
             continue
@@ -497,6 +542,12 @@ def run_suite(
                 "rows_per_s": round(b.rows / sec),
                 "ms": round(sec * 1e3, 3),
             }
+            nbytes = _arg_bytes(b.args)
+            if nbytes:
+                r["read_bytes"] = nbytes
+                r["read_GBps"] = round(nbytes / sec / 1e9, 2)
+                if peak_bps:
+                    r["hbm_read_pct"] = round(100 * nbytes / sec / peak_bps, 1)
             if b.note:
                 r["note"] = b.note
             results.append(r)
@@ -519,7 +570,9 @@ def run_suite(
     return {
         "suite": "operator_micro",
         "backend": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
         "n_devices": len(jax.devices()),
+        "peak_hbm_GBps": round(peak_bps / 1e9) if peak_bps else None,
         "sf": sf,
         "results": results,
         "errors": errors,

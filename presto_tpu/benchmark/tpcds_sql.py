@@ -1899,4 +1899,286 @@ order by lochierarchy desc,
          rank_within_parent
 limit 100
 """,
+    11: """
+with year_total as
+  (select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name,
+          c_preferred_cust_flag customer_preferred_cust_flag,
+          c_birth_country customer_birth_country,
+          c_login customer_login,
+          c_email_address customer_email_address,
+          d_year dyear,
+          sum(ss_ext_list_price - ss_ext_discount_amt) year_total,
+          's' sale_type
+   from customer, store_sales, date_dim
+   where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name,
+            c_preferred_cust_flag, c_birth_country, c_login,
+            c_email_address, d_year
+   union all
+   select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name,
+          c_preferred_cust_flag customer_preferred_cust_flag,
+          c_birth_country customer_birth_country,
+          c_login customer_login,
+          c_email_address customer_email_address,
+          d_year dyear,
+          sum(ws_ext_list_price - ws_ext_discount_amt) year_total,
+          'w' sale_type
+   from customer, web_sales, date_dim
+   where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name,
+            c_preferred_cust_flag, c_birth_country, c_login,
+            c_email_address, d_year)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_email_address
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's'
+  and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's'
+  and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.dyear = 2001
+  and t_s_secyear.dyear = 2002
+  and t_w_firstyear.dyear = 2001
+  and t_w_secyear.dyear = 2002
+  and t_s_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else 0.0 end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else 0.0 end
+order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_email_address
+limit 100
+""",
+    4: """
+with year_total as
+  (select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name,
+          c_preferred_cust_flag customer_preferred_cust_flag,
+          c_birth_country customer_birth_country,
+          c_login customer_login,
+          c_email_address customer_email_address,
+          d_year dyear,
+          sum(((ss_ext_list_price - ss_ext_wholesale_cost
+                - ss_ext_discount_amt) + ss_ext_sales_price) / 2)
+            year_total,
+          's' sale_type
+   from customer, store_sales, date_dim
+   where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name,
+            c_preferred_cust_flag, c_birth_country, c_login,
+            c_email_address, d_year
+   union all
+   select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name,
+          c_preferred_cust_flag customer_preferred_cust_flag,
+          c_birth_country customer_birth_country,
+          c_login customer_login,
+          c_email_address customer_email_address,
+          d_year dyear,
+          sum(((cs_ext_list_price - cs_ext_wholesale_cost
+                - cs_ext_discount_amt) + cs_ext_sales_price) / 2)
+            year_total,
+          'c' sale_type
+   from customer, catalog_sales, date_dim
+   where c_customer_sk = cs_bill_customer_sk
+     and cs_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name,
+            c_preferred_cust_flag, c_birth_country, c_login,
+            c_email_address, d_year
+   union all
+   select c_customer_id customer_id, c_first_name customer_first_name,
+          c_last_name customer_last_name,
+          c_preferred_cust_flag customer_preferred_cust_flag,
+          c_birth_country customer_birth_country,
+          c_login customer_login,
+          c_email_address customer_email_address,
+          d_year dyear,
+          sum(((ws_ext_list_price - ws_ext_wholesale_cost
+                - ws_ext_discount_amt) + ws_ext_sales_price) / 2)
+            year_total,
+          'w' sale_type
+   from customer, web_sales, date_dim
+   where c_customer_sk = ws_bill_customer_sk
+     and ws_sold_date_sk = d_date_sk
+     and d_year in (2001, 2002)
+   group by c_customer_id, c_first_name, c_last_name,
+            c_preferred_cust_flag, c_birth_country, c_login,
+            c_email_address, d_year)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_c_secyear.customer_id
+  and t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.sale_type = 's'
+  and t_c_firstyear.sale_type = 'c'
+  and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's'
+  and t_c_secyear.sale_type = 'c'
+  and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.dyear = 2001
+  and t_s_secyear.dyear = 2002
+  and t_c_firstyear.dyear = 2001
+  and t_c_secyear.dyear = 2002
+  and t_w_firstyear.dyear = 2001
+  and t_w_secyear.dyear = 2002
+  and t_s_firstyear.year_total > 0
+  and t_c_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else null end
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+      > case when t_w_firstyear.year_total > 0
+             then t_w_secyear.year_total / t_w_firstyear.year_total
+             else null end
+order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_preferred_cust_flag
+limit 100
+""",
+    63: """
+select *
+from (select i_manager_id,
+             sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price)) over (partition by i_manager_id)
+               avg_monthly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205, 1206,
+                            1207, 1208, 1209, 1210, 1211)
+        and ((i_category in ('Books', 'Children', 'Electronics')
+              and i_class in ('books class 01', 'books class 04',
+                              'children class 02', 'electronics class 03'))
+             or (i_category in ('Women', 'Music', 'Men')
+                 and i_class in ('women class 01', 'music class 02',
+                                 'men class 03', 'men class 04')))
+      group by i_manager_id, d_moy) tmp1
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+""",
+    76: """
+select channel, col_name, d_year, d_qoy, i_category,
+       count(*) sales_cnt, sum(ext_sales_price) sales_amt
+from (select 'store' as channel, 'ss_store_sk' col_name, d_year, d_qoy,
+             i_category, ss_ext_sales_price ext_sales_price
+      from store_sales, item, date_dim
+      where ss_store_sk is null
+        and ss_sold_date_sk = d_date_sk
+        and ss_item_sk = i_item_sk
+      union all
+      select 'web' as channel, 'ws_ship_customer_sk' col_name, d_year,
+             d_qoy, i_category, ws_ext_sales_price ext_sales_price
+      from web_sales, item, date_dim
+      where ws_ship_customer_sk is null
+        and ws_sold_date_sk = d_date_sk
+        and ws_item_sk = i_item_sk
+      union all
+      select 'catalog' as channel, 'cs_ship_addr_sk' col_name, d_year,
+             d_qoy, i_category, cs_ext_sales_price ext_sales_price
+      from catalog_sales, item, date_dim
+      where cs_ship_addr_sk is null
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk) foo
+group by channel, col_name, d_year, d_qoy, i_category
+order by channel, col_name, d_year, d_qoy, i_category
+limit 100
+""",
+    71: """
+select i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+from item,
+     (select ws_ext_sales_price as ext_price,
+             ws_sold_date_sk as sold_date_sk,
+             ws_item_sk as sold_item_sk,
+             ws_sold_time_sk as time_sk
+      from web_sales, date_dim
+      where d_date_sk = ws_sold_date_sk and d_moy = 11 and d_year = 1999
+      union all
+      select cs_ext_sales_price as ext_price,
+             cs_sold_date_sk as sold_date_sk,
+             cs_item_sk as sold_item_sk,
+             cs_sold_time_sk as time_sk
+      from catalog_sales, date_dim
+      where d_date_sk = cs_sold_date_sk and d_moy = 11 and d_year = 1999
+      union all
+      select ss_ext_sales_price as ext_price,
+             ss_sold_date_sk as sold_date_sk,
+             ss_item_sk as sold_item_sk,
+             ss_sold_time_sk as time_sk
+      from store_sales, date_dim
+      where d_date_sk = ss_sold_date_sk and d_moy = 11
+        and d_year = 1999) tmp,
+     time_dim
+where sold_item_sk = i_item_sk
+  and i_manager_id = 1
+  and time_sk = t_time_sk
+  and (t_meal_time = 'breakfast' or t_meal_time = 'dinner')
+group by i_brand, i_brand_id, t_hour, t_minute
+order by ext_price desc, i_brand_id, t_hour, t_minute
+""",
+    61: """
+select promotions, total,
+       cast(promotions as double) / cast(total as double) * 100
+from (select sum(ss_ext_sales_price) promotions
+      from store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_promo_sk = p_promo_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk
+        and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5
+        and i_category = 'Jewelry'
+        and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+             or p_channel_tv = 'Y')
+        and s_gmt_offset = -5
+        and d_year = 1998
+        and d_moy = 11) promotional_sales,
+     (select sum(ss_ext_sales_price) total
+      from store_sales, store, date_dim, customer, customer_address,
+           item
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk
+        and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5
+        and i_category = 'Jewelry'
+        and s_gmt_offset = -5
+        and d_year = 1998
+        and d_moy = 11) all_sales
+order by promotions, total
+limit 100
+""",
 }

@@ -185,8 +185,15 @@ def _dec(arr, scale=2, precision=7) -> Column:
     )
 
 
-def _sk(arr) -> Column:
-    return Column(np.asarray(arr).astype(np.int64), T.BIGINT)
+def _sk(arr, valid=None) -> Column:
+    return Column(np.asarray(arr).astype(np.int64), T.BIGINT, None, valid)
+
+
+def _sk_nullable(arr, rng, frac=0.04) -> Column:
+    """Fact FK with a NULL fraction (dsdgen leaves a few % of fact foreign
+    keys null; Q76 aggregates exactly those rows)."""
+    a = np.asarray(arr)
+    return _sk(a, valid=rng.random(len(a)) >= frac)
 
 
 def _int(arr) -> Column:
@@ -981,7 +988,7 @@ def gen_store_sales(sf: float) -> Table:
             "ss_cdemo_sk": _sk(t_cdemo[ticket]),
             "ss_hdemo_sk": _sk(t_hdemo[ticket]),
             "ss_addr_sk": _sk(t_addr[ticket]),
-            "ss_store_sk": _sk(t_store[ticket]),
+            "ss_store_sk": _sk_nullable(t_store[ticket], rng),
             "ss_promo_sk": _sk(rng.integers(0, d["promo"], n)),
             "ss_ticket_number": _sk(ticket),
             "ss_quantity": _int(qty),
@@ -1032,7 +1039,10 @@ def gen_store_returns(sf: float) -> Table:
             "sr_cdemo_sk": _sk(ss.columns["ss_cdemo_sk"].data[idx]),
             "sr_hdemo_sk": _sk(ss.columns["ss_hdemo_sk"].data[idx]),
             "sr_addr_sk": _sk(ss.columns["ss_addr_sk"].data[idx]),
-            "sr_store_sk": _sk(ss.columns["ss_store_sk"].data[idx]),
+            "sr_store_sk": _sk(
+                ss.columns["ss_store_sk"].data[idx],
+                valid=ss.columns["ss_store_sk"].valid[idx],
+            ),
             "sr_reason_sk": _sk(rng.integers(0, d["reason"], n)),
             "sr_ticket_number": _sk(ss.columns["ss_ticket_number"].data[idx]),
             "sr_return_quantity": _int(qty),
@@ -1074,7 +1084,7 @@ def gen_catalog_sales(sf: float) -> Table:
             "cs_ship_customer_sk": _sk(o_cust[order]),
             "cs_ship_cdemo_sk": _sk(rng.integers(0, d["cd"], n)),
             "cs_ship_hdemo_sk": _sk(rng.integers(0, d["hd"], n)),
-            "cs_ship_addr_sk": _sk(rng.integers(0, d["addr"], n)),
+            "cs_ship_addr_sk": _sk_nullable(rng.integers(0, d["addr"], n), rng),
             "cs_call_center_sk": _sk(rng.integers(0, d["call_center"], n)),
             "cs_catalog_page_sk": _sk(rng.integers(0, d["catalog_page"], n)),
             "cs_ship_mode_sk": _sk(rng.integers(0, d["ship_mode"], n)),
@@ -1191,7 +1201,7 @@ def gen_web_sales(sf: float) -> Table:
             "ws_bill_cdemo_sk": _sk(rng.integers(0, d["cd"], n)),
             "ws_bill_hdemo_sk": _sk(rng.integers(0, d["hd"], n)),
             "ws_bill_addr_sk": _sk(rng.integers(0, d["addr"], n)),
-            "ws_ship_customer_sk": _sk(o_cust[order]),
+            "ws_ship_customer_sk": _sk_nullable(o_cust[order], rng),
             "ws_ship_cdemo_sk": _sk(rng.integers(0, d["cd"], n)),
             "ws_ship_hdemo_sk": _sk(rng.integers(0, d["hd"], n)),
             "ws_ship_addr_sk": _sk(o_addr[order]),

@@ -198,6 +198,7 @@ class Column:
     data: np.ndarray
     type: T.Type
     dictionary: Optional[object] = None  # tuple or LazyDict
+    valid: Optional[np.ndarray] = None  # bool mask; None = all valid
 
 
 @dataclasses.dataclass
@@ -214,7 +215,8 @@ class Table:
         blocks, names = [], []
         for name, c in self.columns.items():
             arr = c.data[start:stop]
-            blk = Block.from_numpy(arr, c.type, dictionary=c.dictionary)
+            v = None if c.valid is None else c.valid[start:stop]
+            blk = Block.from_numpy(arr, c.type, valid=v, dictionary=c.dictionary)
             blocks.append(blk)
             names.append(name)
         n = stop - start

@@ -62,8 +62,11 @@ class Val:
         return None if self.dict_id is None else dictionary_by_id(self.dict_id)
 
     def valid_mask(self):
+        # validity is per ROW: long-decimal data is (n, 2) lanes but the
+        # mask must stay (n,) (a 2-D all-true mask poisons every
+        # row-shaped jnp.where it later meets)
         if self.valid is None:
-            return jnp.ones(self.data.shape, jnp.bool_)
+            return jnp.ones(self.data.shape[:1], jnp.bool_)
         return self.valid
 
 

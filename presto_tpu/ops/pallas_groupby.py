@@ -20,10 +20,11 @@ Eligibility (maybe_grouped_aggregate returns None otherwise): every
 group key is a small-domain dictionary/boolean column, G <= 32, every
 aggregate is count/count_star/sum/avg/min/max over integral storage.
 
-DEPLOYMENT: the axon tunnel cannot execute Mosaic kernels, so CI
-validates in interpret mode against the XLA path; on directly-attached
-TPU hardware flip it on per query with the `pallas_groupby` session
-property (Session(pallas_groupby=True) or X-Presto-Session).
+DEPLOYMENT: Mosaic kernels execute through the axon tunnel (round-4
+verification, TPU_STATUS.md §1). CPU CI validates in interpret mode
+against the XLA path; on a TPU backend flip it on per query with the
+`pallas_groupby` session property (Session(pallas_groupby=True) or
+X-Presto-Session).
 """
 
 from __future__ import annotations

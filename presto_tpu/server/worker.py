@@ -628,7 +628,16 @@ def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True):
         except urllib.error.HTTPError as e:
             if e.code == 503:  # producer still running: long-poll again
                 continue
-            raise
+            # surface the UPSTREAM failure cause (e.g. a low-memory kill),
+            # not a bare HTTP 500 — the coordinator matches on the message
+            # (reference: HttpPageBufferClient propagates the task error)
+            try:
+                detail = js.loads(e.read()).get("error") or str(e)
+            except Exception:  # noqa: BLE001
+                detail = str(e)
+            raise RuntimeError(
+                f"upstream task {task_id} results fetch failed: {detail}"
+            ) from None
         if payload.get("page"):
             yield b64.b64decode(payload["page"])
             token += 1

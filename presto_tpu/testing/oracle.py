@@ -27,6 +27,13 @@ from ..connectors import tpch
 
 
 def _decode_column(col: tpch.Column) -> list:
+    vals = _decode_values(col)
+    if col.valid is not None:
+        vals = [v if ok else None for v, ok in zip(vals, col.valid.tolist())]
+    return vals
+
+
+def _decode_values(col: tpch.Column) -> list:
     if isinstance(col.type, T.VarcharType):
         d = col.dictionary
         codes = col.data.tolist()

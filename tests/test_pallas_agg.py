@@ -1,8 +1,9 @@
 """Hand-written Pallas Q1 kernel vs the XLA composition (exact match).
 
-Runs in interpret mode on the CPU test mesh (the axon TPU tunnel cannot
-execute Mosaic kernels — ops/pallas_agg.py docstring); correctness of the
-limb decomposition and per-block combine is fully exercised either way."""
+Runs in interpret mode on the CPU test mesh; on a TPU backend the same
+kernel compiles under Mosaic (verified on-chip round 4, TPU_STATUS.md §1)
+and bench.py times it. Correctness of the limb decomposition and
+per-block combine is fully exercised either way."""
 
 from presto_tpu.benchmark.handcoded import (
     lineitem_q1_page,

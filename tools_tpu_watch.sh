@@ -1,0 +1,26 @@
+#!/bin/bash
+# TPU-recovery watcher: the axon relay (the only path to the TPU) died
+# mid-round; poll its ports and, the moment it is back, run the
+# low-transfer bench and persist TPU_BENCH.json (judge directive 1b).
+cd /root/repo || exit 1
+LOG=/tmp/tpu_watch.log
+STAMP=/tmp/tpu_watch.start
+touch "$STAMP"
+echo "$(date -u +%FT%TZ) watcher start" >> $LOG
+while true; do
+  if (echo > /dev/tcp/127.0.0.1/8082) 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) relay port open" >> $LOG
+    if timeout 180 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; jnp.ones(128).block_until_ready(); print('alive')" >> $LOG 2>&1; then
+      echo "$(date -u +%FT%TZ) TPU ALIVE - running bench" >> $LOG
+      BENCH_INIT_ATTEMPTS=2 BENCH_INIT_TIMEOUT=180 timeout 2400 python bench.py >> $LOG 2>&1
+      # only a FRESH artifact (newer than watcher start) counts as evidence
+      if [ TPU_BENCH.json -nt "$STAMP" ] && \
+         python -c "import json;d=json.load(open('TPU_BENCH.json'));assert d['result']['backend']=='tpu'" 2>/dev/null; then
+        echo "$(date -u +%FT%TZ) TPU_BENCH.json captured - watcher done" >> $LOG
+        exit 0
+      fi
+      echo "$(date -u +%FT%TZ) bench did not produce fresh tpu artifact" >> $LOG
+    fi
+  fi
+  sleep 90
+done
