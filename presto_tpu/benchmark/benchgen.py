@@ -82,7 +82,7 @@ def _u64(xp, stream: int, i):
 def _uni(xp, stream: int, i, lo: int, hi: int):
     """Uniform int64 in [lo, hi) (modulo bias is irrelevant here and, more
     to the point, identical across the twins)."""
-    return (lo + _u64(xp, stream, i) % xp.uint64(hi - lo)).astype(xp.int64)
+    return (_u64(xp, stream, i) % xp.uint64(hi - lo)).astype(xp.int64) + lo
 
 
 def _retail_price_cents(xp, partkey):

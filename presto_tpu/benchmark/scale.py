@@ -58,17 +58,24 @@ Q18_SHAPE = (
 QUERIES = {"q1": Q1, "q6": Q6, "q3": Q3, "q18_shape": Q18_SHAPE}
 
 
-class ChunkedLineitemCatalog:
-    """lineitem-only catalog generating rows ON DEMAND in chunk-seeded
-    batches — the SF100 scan source. Host RAM holds at most ~2 chunks;
-    data is deterministic per (sf, chunk) so re-scans and digests agree
-    (reference: the connector split contract — splits are independently
-    regeneratable)."""
+_SEGMENTS = ("AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY")
+_STARTDATE, _ENDDATE = 8035, 10591  # 1992-01-01 .. 1998-12-31 (days)
+
+
+class ChunkedTpchCatalog:
+    """lineitem/orders/customer catalog generating rows ON DEMAND in
+    chunked batches — the SF100 scan source. Every column is a pure
+    function of the row index (benchgen's splitmix64 counter streams), so
+    lineitem and orders agree on per-order attributes WITHOUT shared
+    state, host RAM holds at most ~2 chunks, and re-scans are
+    deterministic (reference: the connector split contract — splits are
+    independently regeneratable). Three tables make SF100 Q3
+    (customer x orders x lineitem join + group + topN) streamable."""
 
     name = "tpch_chunked"
     CHUNK_ORDERS = 1 << 21  # ~2M orders -> ~8.4M lineitem rows per chunk
 
-    _SCHEMA = {
+    _LI_SCHEMA = {
         "l_orderkey": T.BIGINT,
         "l_quantity": T.DecimalType(12, 2),
         "l_extendedprice": T.DecimalType(12, 2),
@@ -78,14 +85,33 @@ class ChunkedLineitemCatalog:
         "l_linestatus": T.VARCHAR,
         "l_shipdate": T.DATE,
     }
+    _ORD_SCHEMA = {
+        "o_orderkey": T.BIGINT,
+        "o_custkey": T.BIGINT,
+        "o_totalprice": T.DecimalType(12, 2),
+        "o_orderdate": T.DATE,
+        "o_shippriority": T.BIGINT,
+    }
+    _CUST_SCHEMA = {
+        "c_custkey": T.BIGINT,
+        "c_mktsegment": T.VARCHAR,
+        "c_acctbal": T.DecimalType(12, 2),
+    }
+    _DICTS = {
+        "l_returnflag": ("A", "N", "R"),
+        "l_linestatus": ("F", "O"),
+        "c_mktsegment": _SEGMENTS,
+    }
 
     def __init__(self, sf: float):
         self.sf = sf
         self.n_orders = int(1_500_000 * sf)
+        self.n_cust = max(int(150_000 * sf), 2)
         n_chunks = -(-self.n_orders // self.CHUNK_ORDERS)
         # deterministic per-order line counts -> exact chunk row offsets
         # (one cheap vectorized pass; 150M orders ~ seconds)
-        counts = np.empty(n_chunks, np.int64)
+        counts = np.empty(max(n_chunks, 1), np.int64)
+        counts[:] = 0
         for c in range(n_chunks):
             o0, o1 = self._order_range(c)
             counts[c] = self._lines_for(np.arange(o0, o1)).sum()
@@ -95,21 +121,33 @@ class ChunkedLineitemCatalog:
     # -- metadata (planner Catalog protocol) --
 
     def table_names(self) -> List[str]:
-        return ["lineitem"]
+        return ["lineitem", "orders", "customer"]
+
+    def _schema_for(self, table: str):
+        return {
+            "lineitem": self._LI_SCHEMA,
+            "orders": self._ORD_SCHEMA,
+            "customer": self._CUST_SCHEMA,
+        }[table]
 
     def schema(self, table: str):
-        return dict(self._SCHEMA)
+        return dict(self._schema_for(table))
 
     def row_count(self, table: str) -> int:
-        return int(self._offsets[-1])
+        if table == "lineitem":
+            return int(self._offsets[-1])
+        return self.n_orders if table == "orders" else self.n_cust
 
     def exact_row_count(self, table: str) -> int:
-        return int(self._offsets[-1])
+        return self.row_count(table)
 
     def unique_columns(self, table: str):
-        return []
+        return {
+            "orders": [("o_orderkey",)],
+            "customer": [("c_custkey",)],
+        }.get(table, [])
 
-    # -- generation --
+    # -- stateless per-index column functions --
 
     def _order_range(self, chunk: int) -> Tuple[int, int]:
         o0 = chunk * self.CHUNK_ORDERS
@@ -121,36 +159,61 @@ class ChunkedLineitemCatalog:
         h = (order_idx.astype(np.uint64) * np.uint64(2654435761)) >> np.uint64(7)
         return (h % np.uint64(7)).astype(np.int64) + 1
 
-    def _chunk(self, c: int) -> dict:
+    @staticmethod
+    def _u(stream: int, i: np.ndarray, lo: int, hi: int) -> np.ndarray:
+        from .benchgen import _uni
+
+        return _uni(np, stream, i.astype(np.uint64), lo, hi)
+
+    def _orderdate(self, order_idx: np.ndarray) -> np.ndarray:
+        return self._u(7, order_idx, _STARTDATE, _ENDDATE - 151 + 1)
+
+    def _custkey(self, order_idx: np.ndarray) -> np.ndarray:
+        return self._u(11, order_idx, 1, self.n_cust + 1)
+
+    def _li_chunk(self, c: int) -> dict:
         got = self._cache.get(c)
         if got is not None:
             return got
         o0, o1 = self._order_range(c)
         order_idx = np.arange(o0, o1)
         lines = self._lines_for(order_idx)
-        n = int(lines.sum())
-        rng = np.random.default_rng([6001, c])
-        STARTDATE, ENDDATE = 8035, 10591  # 1992-01-01 .. 1998-12-31 (days)
-        orderdate = rng.integers(STARTDATE, ENDDATE - 151 + 1, o1 - o0)
-        l_orderdate = np.repeat(orderdate, lines)
-        qty = rng.integers(1, 51, n).astype(np.int64)
+        li = int(self._offsets[c]) + np.arange(int(lines.sum()))
+        l_orderdate = np.repeat(self._orderdate(order_idx), lines)
+        qty = self._u(4, li, 1, 51)
         cols = {
             "l_orderkey": np.repeat(order_idx + 1, lines),
             "l_quantity": qty * 100,
             "l_extendedprice": (90_000 + (qty * 100_000) % 110_001) * qty // 100,
-            "l_discount": rng.integers(0, 11, n).astype(np.int64),
-            "l_tax": rng.integers(0, 9, n).astype(np.int64),
-            "l_returnflag": rng.integers(0, 3, n).astype(np.int32),
-            "l_linestatus": rng.integers(0, 2, n).astype(np.int32),
-            "l_shipdate": (l_orderdate + rng.integers(1, 122, n)).astype(
+            "l_discount": self._u(5, li, 0, 11),
+            "l_tax": self._u(6, li, 0, 9),
+            "l_returnflag": self._u(10, li, 0, 3).astype(np.int32),
+            "l_linestatus": self._u(13, li, 0, 2).astype(np.int32),
+            "l_shipdate": (l_orderdate + self._u(8, li, 1, 122)).astype(
                 np.int32
             ),
         }
-        got = cols
-        self._cache[c] = got
+        self._cache[c] = cols
         if len(self._cache) > 2:  # keep host RAM bounded
             self._cache.pop(next(iter(self._cache)))
-        return got
+        return cols
+
+    def _range_cols(self, table: str, start: int, stop: int) -> dict:
+        """orders/customer columns for a row range, generated directly."""
+        i = np.arange(start, stop)
+        if table == "orders":
+            return {
+                "o_orderkey": i + 1,
+                "o_custkey": self._custkey(i),
+                "o_totalprice": self._u(15, i, 100, 60_000_000),
+                "o_orderdate": self._orderdate(i).astype(np.int32),
+                "o_shippriority": np.zeros(len(i), np.int64),
+            }
+        return {
+            "c_custkey": i + 1,
+            "c_mktsegment": self._u(14, i, 0, 5).astype(np.int32),
+            "c_acctbal": self._u(16, i, -99999, 1_000_000),
+        }
 
     def page(self, table: str):
         raise MemoryError(
@@ -162,37 +225,47 @@ class ChunkedLineitemCatalog:
              columns=None, predicate=None):
         from ..page import Block, Page, _pad_block
 
+        schema = self._schema_for(table)
         stop = min(stop, self.row_count(table))
         count = max(stop - start, 0)
-        names = list(columns) if columns is not None else list(self._SCHEMA)
-        c0 = int(np.searchsorted(self._offsets, start, "right")) - 1
-        c1 = int(np.searchsorted(self._offsets, max(stop - 1, start), "right")) - 1
-        pieces = {nm: [] for nm in names}
-        for c in range(max(c0, 0), max(c1, c0) + 1):
-            cols = self._chunk(c)
-            lo = max(start - int(self._offsets[c]), 0)
-            hi = min(stop - int(self._offsets[c]),
-                     int(self._offsets[c + 1] - self._offsets[c]))
-            for nm in names:
-                pieces[nm].append(cols[nm][lo:hi])
+        names = list(columns) if columns is not None else list(schema)
+        if table == "lineitem":
+            c0 = int(np.searchsorted(self._offsets, start, "right")) - 1
+            c1 = int(
+                np.searchsorted(self._offsets, max(stop - 1, start), "right")
+            ) - 1
+            pieces = {nm: [] for nm in names}
+            for c in range(max(c0, 0), max(c1, c0) + 1):
+                cols = self._li_chunk(c)
+                lo = max(start - int(self._offsets[c]), 0)
+                hi = min(stop - int(self._offsets[c]),
+                         int(self._offsets[c + 1] - self._offsets[c]))
+                for nm in names:
+                    pieces[nm].append(cols[nm][lo:hi])
+            data_by_name = {
+                nm: (
+                    np.concatenate(pieces[nm])
+                    if pieces[nm]
+                    else np.empty(0, np.int64)
+                )
+                for nm in names
+            }
+        else:
+            cols = self._range_cols(table, start, max(stop, start))
+            data_by_name = {nm: cols[nm] for nm in names}
         blocks = []
         for nm in names:
-            data = (
-                np.concatenate(pieces[nm])
-                if pieces[nm]
-                else np.empty(0, np.int64)
+            blk = Block.from_numpy(
+                data_by_name[nm], schema[nm], dictionary=self._DICTS.get(nm)
             )
-            typ = self._SCHEMA[nm]
-            dictionary = None
-            if nm == "l_returnflag":
-                dictionary = ("A", "N", "R")
-            elif nm == "l_linestatus":
-                dictionary = ("F", "O")
-            blk = Block.from_numpy(data, typ, dictionary=dictionary)
             if pad_to is not None and pad_to > count:
                 blk = _pad_block(blk, pad_to)
             blocks.append(blk)
         return Page.from_blocks(blocks, names, count=count)
+
+
+# back-compat alias (pre-round-4 name, lineitem-only then)
+ChunkedLineitemCatalog = ChunkedTpchCatalog
 
 
 def run_scale(
@@ -235,15 +308,17 @@ def run_scale(
 
 def run_sf100(
     sf: float = 100.0,
-    queries=("q6", "q1"),
+    queries=("q6", "q1", "q3"),
     memory_budget: int = 512 << 20,
     batch_rows: int = 1 << 22,
 ) -> dict:
-    """Q1/Q6 at SF100 over batched chunk-generated scans: the table never
-    exists anywhere in full — each batch is generated, scanned, reduced."""
+    """Q1/Q6/Q3 at SF100 over batched chunk-generated scans: the tables
+    never exist anywhere in full — each batch is generated, scanned, and
+    reduced (Q3 streams lineitem against a spill-bounded
+    customer x orders build side)."""
     from ..session import Session
 
-    cat = ChunkedLineitemCatalog(sf)
+    cat = ChunkedTpchCatalog(sf)
     sess = Session(
         cat, streaming=True, batch_rows=batch_rows,
         memory_budget=memory_budget,

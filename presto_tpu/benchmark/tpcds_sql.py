@@ -2146,6 +2146,1149 @@ where sold_item_sk = i_item_sk
 group by i_brand, i_brand_id, t_hour, t_minute
 order by ext_price desc, i_brand_id, t_hour, t_minute
 """,
+    14: """
+with cross_items as
+  (select i_item_sk ss_item_sk
+   from item,
+        (select iss.i_brand_id brand_id, iss.i_class_id class_id,
+                iss.i_category_id category_id
+         from store_sales, item iss, date_dim d1
+         where ss_item_sk = iss.i_item_sk
+           and ss_sold_date_sk = d1.d_date_sk
+           and d1.d_year between 1999 and 2001
+         intersect
+         select ics.i_brand_id, ics.i_class_id, ics.i_category_id
+         from catalog_sales, item ics, date_dim d2
+         where cs_item_sk = ics.i_item_sk
+           and cs_sold_date_sk = d2.d_date_sk
+           and d2.d_year between 1999 and 2001
+         intersect
+         select iws.i_brand_id, iws.i_class_id, iws.i_category_id
+         from web_sales, item iws, date_dim d3
+         where ws_item_sk = iws.i_item_sk
+           and ws_sold_date_sk = d3.d_date_sk
+           and d3.d_year between 1999 and 2001) sub
+   where i_brand_id = brand_id
+     and i_class_id = class_id
+     and i_category_id = category_id),
+ avg_sales as
+  (select avg(quantity * list_price) average_sales
+   from (select ss_quantity quantity, ss_list_price list_price
+         from store_sales, date_dim
+         where ss_sold_date_sk = d_date_sk
+           and d_year between 1999 and 2001
+         union all
+         select cs_quantity quantity, cs_list_price list_price
+         from catalog_sales, date_dim
+         where cs_sold_date_sk = d_date_sk
+           and d_year between 1999 and 2001
+         union all
+         select ws_quantity quantity, ws_list_price list_price
+         from web_sales, date_dim
+         where ws_sold_date_sk = d_date_sk
+           and d_year between 1999 and 2001) x)
+select channel, i_brand_id, i_class_id, i_category_id,
+       sum(sales) as sum_sales, sum(number_sales) as number_sales
+from (select 'store' channel, i_brand_id, i_class_id, i_category_id,
+             sum(ss_quantity * ss_list_price) sales,
+             count(*) number_sales
+      from store_sales, item, date_dim
+      where ss_item_sk in (select ss_item_sk from cross_items)
+        and ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(ss_quantity * ss_list_price) >
+             (select average_sales from avg_sales)
+      union all
+      select 'catalog' channel, i_brand_id, i_class_id, i_category_id,
+             sum(cs_quantity * cs_list_price) sales,
+             count(*) number_sales
+      from catalog_sales, item, date_dim
+      where cs_item_sk in (select ss_item_sk from cross_items)
+        and cs_item_sk = i_item_sk
+        and cs_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(cs_quantity * cs_list_price) >
+             (select average_sales from avg_sales)
+      union all
+      select 'web' channel, i_brand_id, i_class_id, i_category_id,
+             sum(ws_quantity * ws_list_price) sales,
+             count(*) number_sales
+      from web_sales, item, date_dim
+      where ws_item_sk in (select ss_item_sk from cross_items)
+        and ws_item_sk = i_item_sk
+        and ws_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(ws_quantity * ws_list_price) >
+             (select average_sales from avg_sales)) y
+group by rollup (channel, i_brand_id, i_class_id, i_category_id)
+order by channel, i_brand_id, i_class_id, i_category_id
+limit 100
+""",
+    23: """
+with frequent_ss_items as
+  (select substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+          d_date solddate, count(*) cnt
+   from store_sales, date_dim, item
+   where ss_sold_date_sk = d_date_sk
+     and ss_item_sk = i_item_sk
+     and d_year in (2000, 2001, 2002, 2003)
+   group by substr(i_item_desc, 1, 30), i_item_sk, d_date
+   having count(*) > 4),
+ max_store_sales as
+  (select max(csales) tpcds_cmax
+   from (select c_customer_sk,
+                sum(ss_quantity * ss_sales_price) csales
+         from store_sales, customer, date_dim
+         where ss_customer_sk = c_customer_sk
+           and ss_sold_date_sk = d_date_sk
+           and d_year in (2000, 2001, 2002, 2003)
+         group by c_customer_sk) a),
+ best_ss_customer as
+  (select c_customer_sk, sum(ss_quantity * ss_sales_price) ssales
+   from store_sales, customer
+   where ss_customer_sk = c_customer_sk
+   group by c_customer_sk
+   having sum(ss_quantity * ss_sales_price) >
+          (50 / 100.0) * (select * from max_store_sales))
+select sum(sales)
+from (select cs_quantity * cs_list_price sales
+      from catalog_sales, date_dim
+      where d_year = 2000 and d_moy = 2
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk in (select item_sk from frequent_ss_items)
+        and cs_bill_customer_sk in (select c_customer_sk
+                                    from best_ss_customer)
+      union all
+      select ws_quantity * ws_list_price sales
+      from web_sales, date_dim
+      where d_year = 2000 and d_moy = 2
+        and ws_sold_date_sk = d_date_sk
+        and ws_item_sk in (select item_sk from frequent_ss_items)
+        and ws_bill_customer_sk in (select c_customer_sk
+                                    from best_ss_customer)) x
+limit 100
+""",
+    64: """
+with cs_ui as
+  (select cs_item_sk,
+          sum(cs_ext_list_price) as sale,
+          sum(cr_refunded_cash + cr_reversed_charge + cr_store_credit)
+            as refund
+   from catalog_sales, catalog_returns
+   where cs_item_sk = cr_item_sk
+     and cs_order_number = cr_order_number
+   group by cs_item_sk
+   having sum(cs_ext_list_price) >
+          2 * sum(cr_refunded_cash + cr_reversed_charge
+                  + cr_store_credit)),
+ cross_sales as
+  (select i_product_name product_name, i_item_sk item_sk,
+          s_store_name store_name, s_zip store_zip,
+          ad1.ca_street_number b_street_number,
+          ad1.ca_street_name b_street_name,
+          ad1.ca_city b_city, ad1.ca_zip b_zip,
+          ad2.ca_street_number c_street_number,
+          ad2.ca_street_name c_street_name,
+          ad2.ca_city c_city, ad2.ca_zip c_zip,
+          d1.d_year as syear, d2.d_year as fsyear, d3.d_year s2year,
+          count(*) cnt,
+          sum(ss_wholesale_cost) s1, sum(ss_list_price) s2,
+          sum(ss_coupon_amt) s3
+   from store_sales, store_returns, cs_ui, date_dim d1, date_dim d2,
+        date_dim d3, store, customer, customer_demographics cd1,
+        customer_demographics cd2, promotion,
+        household_demographics hd1, household_demographics hd2,
+        customer_address ad1, customer_address ad2, income_band ib1,
+        income_band ib2, item
+   where ss_store_sk = s_store_sk
+     and ss_sold_date_sk = d1.d_date_sk
+     and ss_item_sk = i_item_sk
+     and ss_customer_sk = c_customer_sk
+     and ss_cdemo_sk = cd1.cd_demo_sk
+     and ss_hdemo_sk = hd1.hd_demo_sk
+     and ss_addr_sk = ad1.ca_address_sk
+     and ss_item_sk = sr_item_sk
+     and ss_ticket_number = sr_ticket_number
+     and ss_item_sk = cs_ui.cs_item_sk
+     and c_current_cdemo_sk = cd2.cd_demo_sk
+     and c_current_hdemo_sk = hd2.hd_demo_sk
+     and c_current_addr_sk = ad2.ca_address_sk
+     and c_first_sales_date_sk = d2.d_date_sk
+     and c_first_shipto_date_sk = d3.d_date_sk
+     and ss_promo_sk = p_promo_sk
+     and hd1.hd_income_band_sk = ib1.ib_income_band_sk
+     and hd2.hd_income_band_sk = ib2.ib_income_band_sk
+     and cd1.cd_marital_status <> cd2.cd_marital_status
+     and i_color in ('purple', 'spring', 'powder', 'navy', 'slate',
+                     'cream')
+     and i_current_price between 64 and 74
+     and i_current_price between 65 and 79
+   group by i_product_name, i_item_sk, s_store_name, s_zip,
+            ad1.ca_street_number, ad1.ca_street_name, ad1.ca_city,
+            ad1.ca_zip, ad2.ca_street_number, ad2.ca_street_name,
+            ad2.ca_city, ad2.ca_zip, d1.d_year, d2.d_year, d3.d_year)
+select cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.b_street_number, cs1.b_street_name, cs1.b_city, cs1.b_zip,
+       cs1.c_street_number, cs1.c_street_name, cs1.c_city, cs1.c_zip,
+       cs1.syear, cs1.cnt,
+       cs1.s1 as s11, cs1.s2 as s21, cs1.s3 as s31,
+       cs2.s1 as s12, cs2.s2 as s22, cs2.s3 as s32,
+       cs2.syear as syear2, cs2.cnt as cnt2
+from cross_sales cs1, cross_sales cs2
+where cs1.item_sk = cs2.item_sk
+  and cs1.syear = 1999
+  and cs2.syear = 2000
+  and cs2.cnt <= cs1.cnt
+  and cs1.store_name = cs2.store_name
+  and cs1.store_zip = cs2.store_zip
+order by cs1.product_name, cs1.store_name, cs2.cnt, cs1.s1, cs2.s1
+""",
+    24: """
+with ssales as
+  (select c_last_name, c_first_name, s_store_name, ca_state, s_state,
+          i_color, i_current_price, i_manager_id, i_units, i_size,
+          sum(ss_net_paid) netpaid
+   from store_sales, store_returns, store, item, customer,
+        customer_address
+   where ss_ticket_number = sr_ticket_number
+     and ss_item_sk = sr_item_sk
+     and ss_customer_sk = c_customer_sk
+     and ss_item_sk = i_item_sk
+     and ss_store_sk = s_store_sk
+     and c_birth_country = upper(ca_country)
+     and s_zip = ca_zip
+     and s_market_id = 8
+   group by c_last_name, c_first_name, s_store_name, ca_state, s_state,
+            i_color, i_current_price, i_manager_id, i_units, i_size)
+select c_last_name, c_first_name, s_store_name, sum(netpaid) paid
+from ssales
+where i_color = 'pale'
+group by c_last_name, c_first_name, s_store_name
+having sum(netpaid) > (select 0.05 * avg(netpaid) from ssales)
+order by c_last_name, c_first_name, s_store_name
+""",
+    54: """
+with my_customers as
+  (select distinct c_customer_sk, c_current_addr_sk
+   from (select cs_sold_date_sk sold_date_sk,
+                cs_bill_customer_sk customer_sk,
+                cs_item_sk item_sk
+         from catalog_sales
+         union all
+         select ws_sold_date_sk sold_date_sk,
+                ws_bill_customer_sk customer_sk,
+                ws_item_sk item_sk
+         from web_sales) cs_or_ws_sales, item, date_dim, customer
+   where sold_date_sk = d_date_sk
+     and item_sk = i_item_sk
+     and i_category = 'Women'
+     and i_class = 'women class 01'
+     and c_customer_sk = cs_or_ws_sales.customer_sk
+     and d_moy = 12
+     and d_year = 1998),
+ my_revenue as
+  (select c_customer_sk, sum(ss_ext_sales_price) as revenue
+   from my_customers, store_sales, customer_address, store, date_dim
+   where c_current_addr_sk = ca_address_sk
+     and ca_county = s_county
+     and ca_state = s_state
+     and ss_customer_sk = c_customer_sk
+     and ss_sold_date_sk = d_date_sk
+     and ss_store_sk = s_store_sk
+     and d_month_seq between (select distinct d_month_seq + 1
+                              from date_dim
+                              where d_year = 1998 and d_moy = 12)
+                         and (select distinct d_month_seq + 3
+                              from date_dim
+                              where d_year = 1998 and d_moy = 12)
+   group by c_customer_sk),
+ segments as
+  (select cast((revenue / 50) as integer) as segment from my_revenue)
+select segment, count(*) as num_customers, segment * 50 as segment_base
+from segments
+group by segment
+order by segment, num_customers
+limit 100
+""",
+    44: """
+select asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from (select *
+      from (select item_sk, rank() over (order by rank_col asc) rnk
+            from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+                  from store_sales ss1
+                  where ss_store_sk = 2
+                  group by ss_item_sk
+                  having avg(ss_net_profit) > 0.9 *
+                    (select avg(ss_net_profit) rank_col
+                     from store_sales
+                     where ss_store_sk = 2 and ss_addr_sk is null
+                     group by ss_store_sk)) v1) v11
+      where rnk < 11) asceding,
+     (select *
+      from (select item_sk, rank() over (order by rank_col desc) rnk
+            from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+                  from store_sales ss1
+                  where ss_store_sk = 2
+                  group by ss_item_sk
+                  having avg(ss_net_profit) > 0.9 *
+                    (select avg(ss_net_profit) rank_col
+                     from store_sales
+                     where ss_store_sk = 2 and ss_addr_sk is null
+                     group by ss_store_sk)) v2) v21
+      where rnk < 11) descending,
+     item i1, item i2
+where asceding.rnk = descending.rnk
+  and i1.i_item_sk = asceding.item_sk
+  and i2.i_item_sk = descending.item_sk
+order by asceding.rnk
+""",
+    49: """
+select channel, item, return_ratio, return_rank, currency_rank
+from (select 'web' as channel, web.item, web.return_ratio,
+             web.return_rank, web.currency_rank
+      from (select item, return_ratio, currency_ratio,
+                   rank() over (order by return_ratio) as return_rank,
+                   rank() over (order by currency_ratio) as currency_rank
+            from (select ws.ws_item_sk as item,
+                         (cast(sum(coalesce(wr.wr_return_quantity, 0))
+                               as decimal(15,4)) /
+                          cast(sum(coalesce(ws.ws_quantity, 0))
+                               as decimal(15,4))) as return_ratio,
+                         (cast(sum(coalesce(wr.wr_return_amt, 0))
+                               as decimal(15,4)) /
+                          cast(sum(coalesce(ws.ws_net_paid, 0))
+                               as decimal(15,4))) as currency_ratio
+                  from web_sales ws
+                       left outer join web_returns wr
+                         on (ws.ws_order_number = wr.wr_order_number
+                             and ws.ws_item_sk = wr.wr_item_sk),
+                       date_dim
+                  where wr.wr_return_amt > 10000
+                    and ws.ws_net_profit > 1
+                    and ws.ws_net_paid > 0
+                    and ws.ws_quantity > 0
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy = 12
+                  group by ws.ws_item_sk) in_web) web
+      where (web.return_rank <= 10 or web.currency_rank <= 10)
+      union
+      select 'catalog' as channel, catalog.item, catalog.return_ratio,
+             catalog.return_rank, catalog.currency_rank
+      from (select item, return_ratio, currency_ratio,
+                   rank() over (order by return_ratio) as return_rank,
+                   rank() over (order by currency_ratio) as currency_rank
+            from (select cs.cs_item_sk as item,
+                         (cast(sum(coalesce(cr.cr_return_quantity, 0))
+                               as decimal(15,4)) /
+                          cast(sum(coalesce(cs.cs_quantity, 0))
+                               as decimal(15,4))) as return_ratio,
+                         (cast(sum(coalesce(cr.cr_return_amount, 0))
+                               as decimal(15,4)) /
+                          cast(sum(coalesce(cs.cs_net_paid, 0))
+                               as decimal(15,4))) as currency_ratio
+                  from catalog_sales cs
+                       left outer join catalog_returns cr
+                         on (cs.cs_order_number = cr.cr_order_number
+                             and cs.cs_item_sk = cr.cr_item_sk),
+                       date_dim
+                  where cr.cr_return_amount > 10000
+                    and cs.cs_net_profit > 1
+                    and cs.cs_net_paid > 0
+                    and cs.cs_quantity > 0
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy = 12
+                  group by cs.cs_item_sk) in_cat) catalog
+      where (catalog.return_rank <= 10 or catalog.currency_rank <= 10)
+      union
+      select 'store' as channel, store.item, store.return_ratio,
+             store.return_rank, store.currency_rank
+      from (select item, return_ratio, currency_ratio,
+                   rank() over (order by return_ratio) as return_rank,
+                   rank() over (order by currency_ratio) as currency_rank
+            from (select sts.ss_item_sk as item,
+                         (cast(sum(coalesce(sr.sr_return_quantity, 0))
+                               as decimal(15,4)) /
+                          cast(sum(coalesce(sts.ss_quantity, 0))
+                               as decimal(15,4))) as return_ratio,
+                         (cast(sum(coalesce(sr.sr_return_amt, 0))
+                               as decimal(15,4)) /
+                          cast(sum(coalesce(sts.ss_net_paid, 0))
+                               as decimal(15,4))) as currency_ratio
+                  from store_sales sts
+                       left outer join store_returns sr
+                         on (sts.ss_ticket_number = sr.sr_ticket_number
+                             and sts.ss_item_sk = sr.sr_item_sk),
+                       date_dim
+                  where sr.sr_return_amt > 10000
+                    and sts.ss_net_profit > 1
+                    and sts.ss_net_paid > 0
+                    and sts.ss_quantity > 0
+                    and ss_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy = 12
+                  group by sts.ss_item_sk) in_store) store
+      where (store.return_rank <= 10 or store.currency_rank <= 10)) sq1
+order by 1, 4, 5, 2
+limit 100
+""",
+    51: """
+with web_v1 as
+  (select ws_item_sk item_sk, d_date,
+          sum(sum(ws_sales_price))
+            over (partition by ws_item_sk order by d_date
+                  rows between unbounded preceding and current row)
+            cume_sales
+   from web_sales, date_dim
+   where ws_sold_date_sk = d_date_sk
+     and d_month_seq between 1200 and 1211
+     and ws_item_sk is not null
+   group by ws_item_sk, d_date),
+ store_v1 as
+  (select ss_item_sk item_sk, d_date,
+          sum(sum(ss_sales_price))
+            over (partition by ss_item_sk order by d_date
+                  rows between unbounded preceding and current row)
+            cume_sales
+   from store_sales, date_dim
+   where ss_sold_date_sk = d_date_sk
+     and d_month_seq between 1200 and 1211
+     and ss_item_sk is not null
+   group by ss_item_sk, d_date)
+select *
+from (select item_sk, d_date, web_sales, store_sales,
+             max(web_sales)
+               over (partition by item_sk order by d_date
+                     rows between unbounded preceding and current row)
+               web_cumulative,
+             max(store_sales)
+               over (partition by item_sk order by d_date
+                     rows between unbounded preceding and current row)
+               store_cumulative
+      from (select case when web.item_sk is not null then web.item_sk
+                        else store.item_sk end item_sk,
+                   case when web.d_date is not null then web.d_date
+                        else store.d_date end d_date,
+                   web.cume_sales web_sales,
+                   store.cume_sales store_sales
+            from web_v1 web
+                 full outer join store_v1 store
+                   on (web.item_sk = store.item_sk
+                       and web.d_date = store.d_date)) x) y
+where web_cumulative > store_cumulative
+order by item_sk, d_date
+limit 100
+""",
+    58: """
+with ss_items as
+  (select i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+   from store_sales, item, date_dim
+   where ss_item_sk = i_item_sk
+     and d_date in (select d_date
+                    from date_dim
+                    where d_week_seq = (select d_week_seq
+                                        from date_dim
+                                        where d_date = date '2000-01-03'))
+     and ss_sold_date_sk = d_date_sk
+   group by i_item_id),
+ cs_items as
+  (select i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+   from catalog_sales, item, date_dim
+   where cs_item_sk = i_item_sk
+     and d_date in (select d_date
+                    from date_dim
+                    where d_week_seq = (select d_week_seq
+                                        from date_dim
+                                        where d_date = date '2000-01-03'))
+     and cs_sold_date_sk = d_date_sk
+   group by i_item_id),
+ ws_items as
+  (select i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+   from web_sales, item, date_dim
+   where ws_item_sk = i_item_sk
+     and d_date in (select d_date
+                    from date_dim
+                    where d_week_seq = (select d_week_seq
+                                        from date_dim
+                                        where d_date = date '2000-01-03'))
+     and ws_sold_date_sk = d_date_sk
+   group by i_item_id)
+select ss_items.item_id, ss_item_rev,
+       cast(ss_item_rev as double)
+         / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 ss_dev,
+       cs_item_rev,
+       cast(cs_item_rev as double)
+         / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 cs_dev,
+       ws_item_rev,
+       cast(ws_item_rev as double)
+         / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average
+from ss_items, cs_items, ws_items
+where ss_items.item_id = cs_items.item_id
+  and ss_items.item_id = ws_items.item_id
+  and ss_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+  and ss_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+  and cs_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+  and cs_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+  and ws_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+  and ws_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+order by item_id, ss_item_rev
+limit 100
+""",
+    67: """
+select *
+from (select i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() over (partition by i_category
+                          order by sumsales desc) rk
+      from (select i_category, i_class, i_brand, i_product_name,
+                   d_year, d_qoy, d_moy, s_store_id,
+                   sum(coalesce(ss_sales_price * ss_quantity, 0))
+                     sumsales
+            from store_sales, date_dim, store, item
+            where ss_sold_date_sk = d_date_sk
+              and ss_item_sk = i_item_sk
+              and ss_store_sk = s_store_sk
+              and d_month_seq between 1200 and 1211
+            group by rollup (i_category, i_class, i_brand,
+                             i_product_name, d_year, d_qoy, d_moy,
+                             s_store_id)) dw1) dw2
+where rk <= 100
+order by i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales, rk
+limit 100
+""",
+    5: """
+with ssr as
+  (select s_store_id,
+          sum(sales_price) as sales, sum(profit) as profit,
+          sum(return_amt) as returns1, sum(net_loss) as profit_loss
+   from (select ss_store_sk as store_sk, ss_sold_date_sk as date_sk,
+                ss_ext_sales_price as sales_price,
+                ss_net_profit as profit,
+                cast(0 as decimal(7,2)) as return_amt,
+                cast(0 as decimal(7,2)) as net_loss
+         from store_sales
+         union all
+         select sr_store_sk as store_sk, sr_returned_date_sk as date_sk,
+                cast(0 as decimal(7,2)) as sales_price,
+                cast(0 as decimal(7,2)) as profit,
+                sr_return_amt as return_amt,
+                sr_net_loss as net_loss
+         from store_returns) salesreturns, date_dim, store
+   where date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '14' day)
+     and store_sk = s_store_sk
+   group by s_store_id),
+ csr as
+  (select cp_catalog_page_id,
+          sum(sales_price) as sales, sum(profit) as profit,
+          sum(return_amt) as returns1, sum(net_loss) as profit_loss
+   from (select cs_catalog_page_sk as page_sk,
+                cs_sold_date_sk as date_sk,
+                cs_ext_sales_price as sales_price,
+                cs_net_profit as profit,
+                cast(0 as decimal(7,2)) as return_amt,
+                cast(0 as decimal(7,2)) as net_loss
+         from catalog_sales
+         union all
+         select cr_catalog_page_sk as page_sk,
+                cr_returned_date_sk as date_sk,
+                cast(0 as decimal(7,2)) as sales_price,
+                cast(0 as decimal(7,2)) as profit,
+                cr_return_amount as return_amt,
+                cr_net_loss as net_loss
+         from catalog_returns) salesreturns, date_dim, catalog_page
+   where date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '14' day)
+     and page_sk = cp_catalog_page_sk
+   group by cp_catalog_page_id),
+ wsr as
+  (select web_site_id,
+          sum(sales_price) as sales, sum(profit) as profit,
+          sum(return_amt) as returns1, sum(net_loss) as profit_loss
+   from (select ws_web_site_sk as wsr_web_site_sk,
+                ws_sold_date_sk as date_sk,
+                ws_ext_sales_price as sales_price,
+                ws_net_profit as profit,
+                cast(0 as decimal(7,2)) as return_amt,
+                cast(0 as decimal(7,2)) as net_loss
+         from web_sales
+         union all
+         select ws_web_site_sk as wsr_web_site_sk,
+                wr_returned_date_sk as date_sk,
+                cast(0 as decimal(7,2)) as sales_price,
+                cast(0 as decimal(7,2)) as profit,
+                wr_return_amt as return_amt,
+                wr_net_loss as net_loss
+         from web_returns
+              left outer join web_sales
+                on (wr_item_sk = ws_item_sk
+                    and wr_order_number = ws_order_number))
+        salesreturns, date_dim, web_site
+   where date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '14' day)
+     and wsr_web_site_sk = web_site_sk
+   group by web_site_id)
+select channel, id, sum(sales) as sales, sum(returns1) as returns1,
+       sum(profit) as profit
+from (select 'store channel' as channel, 'store' || s_store_id as id,
+             sales, returns1, (profit - profit_loss) as profit
+      from ssr
+      union all
+      select 'catalog channel' as channel,
+             'catalog_page' || cp_catalog_page_id as id,
+             sales, returns1, (profit - profit_loss) as profit
+      from csr
+      union all
+      select 'web channel' as channel, 'web_site' || web_site_id as id,
+             sales, returns1, (profit - profit_loss) as profit
+      from wsr) x
+group by rollup (channel, id)
+order by channel, id
+limit 100
+""",
+    75: """
+with all_sales as
+  (select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+          sum(sales_cnt) as sales_cnt, sum(sales_amt) as sales_amt
+   from (select d_year, i_brand_id, i_class_id, i_category_id,
+                i_manufact_id,
+                cs_quantity - coalesce(cr_return_quantity, 0)
+                  as sales_cnt,
+                cs_ext_sales_price - coalesce(cr_return_amount, 0.0)
+                  as sales_amt
+         from catalog_sales
+              join item on i_item_sk = cs_item_sk
+              join date_dim on d_date_sk = cs_sold_date_sk
+              left join catalog_returns
+                on (cs_order_number = cr_order_number
+                    and cs_item_sk = cr_item_sk)
+         where i_category = 'Books'
+         union
+         select d_year, i_brand_id, i_class_id, i_category_id,
+                i_manufact_id,
+                ss_quantity - coalesce(sr_return_quantity, 0)
+                  as sales_cnt,
+                ss_ext_sales_price - coalesce(sr_return_amt, 0.0)
+                  as sales_amt
+         from store_sales
+              join item on i_item_sk = ss_item_sk
+              join date_dim on d_date_sk = ss_sold_date_sk
+              left join store_returns
+                on (ss_ticket_number = sr_ticket_number
+                    and ss_item_sk = sr_item_sk)
+         where i_category = 'Books'
+         union
+         select d_year, i_brand_id, i_class_id, i_category_id,
+                i_manufact_id,
+                ws_quantity - coalesce(wr_return_quantity, 0)
+                  as sales_cnt,
+                ws_ext_sales_price - coalesce(wr_return_amt, 0.0)
+                  as sales_amt
+         from web_sales
+              join item on i_item_sk = ws_item_sk
+              join date_dim on d_date_sk = ws_sold_date_sk
+              left join web_returns
+                on (ws_order_number = wr_order_number
+                    and ws_item_sk = wr_item_sk)
+         where i_category = 'Books') sales_detail
+   group by d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+select prev_yr.d_year as prev_year, curr_yr.d_year as year1,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id, prev_yr.sales_cnt as prev_yr_cnt,
+       curr_yr.sales_cnt as curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt as sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt as sales_amt_diff
+from all_sales curr_yr, all_sales prev_yr
+where curr_yr.i_brand_id = prev_yr.i_brand_id
+  and curr_yr.i_class_id = prev_yr.i_class_id
+  and curr_yr.i_category_id = prev_yr.i_category_id
+  and curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  and curr_yr.d_year = 2002
+  and prev_yr.d_year = 2001
+  and cast(curr_yr.sales_cnt as decimal(17,2))
+      / cast(prev_yr.sales_cnt as decimal(17,2)) < 0.9
+order by sales_cnt_diff, sales_amt_diff
+limit 100
+""",
+    77: """
+with ss as
+  (select s_store_sk, sum(ss_ext_sales_price) as sales,
+          sum(ss_net_profit) as profit
+   from store_sales, date_dim, store
+   where ss_sold_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and ss_store_sk = s_store_sk
+   group by s_store_sk),
+ sr as
+  (select s_store_sk, sum(sr_return_amt) as returns1,
+          sum(sr_net_loss) as profit_loss
+   from store_returns, date_dim, store
+   where sr_returned_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and sr_store_sk = s_store_sk
+   group by s_store_sk),
+ cs as
+  (select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+          sum(cs_net_profit) as profit
+   from catalog_sales, date_dim
+   where cs_sold_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+   group by cs_call_center_sk),
+ cr as
+  (select sum(cr_return_amount) as returns1,
+          sum(cr_net_loss) as profit_loss
+   from catalog_returns, date_dim
+   where cr_returned_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)),
+ ws as
+  (select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
+          sum(ws_net_profit) as profit
+   from web_sales, date_dim, web_page
+   where ws_sold_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and ws_web_page_sk = wp_web_page_sk
+   group by wp_web_page_sk),
+ wr as
+  (select wp_web_page_sk, sum(wr_return_amt) as returns1,
+          sum(wr_net_loss) as profit_loss
+   from web_returns, date_dim, web_page
+   where wr_returned_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and wr_web_page_sk = wp_web_page_sk
+   group by wp_web_page_sk)
+select channel, id, sum(sales) as sales, sum(returns1) as returns1,
+       sum(profit) as profit
+from (select 'store channel' as channel, ss.s_store_sk as id, sales,
+             coalesce(returns1, 0) as returns1,
+             (profit - coalesce(profit_loss, 0)) as profit
+      from ss left join sr on ss.s_store_sk = sr.s_store_sk
+      union all
+      select 'catalog channel' as channel, cs_call_center_sk as id,
+             sales, returns1, (profit - profit_loss) as profit
+      from cs, cr
+      union all
+      select 'web channel' as channel, ws.wp_web_page_sk as id, sales,
+             coalesce(returns1, 0) as returns1,
+             (profit - coalesce(profit_loss, 0)) as profit
+      from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk) x
+group by rollup (channel, id)
+order by channel, id
+limit 100
+""",
+    78: """
+with ws as
+  (select d_year as ws_sold_year, ws_item_sk,
+          ws_bill_customer_sk ws_customer_sk,
+          sum(ws_quantity) ws_qty,
+          sum(ws_wholesale_cost) ws_wc,
+          sum(ws_sales_price) ws_sp
+   from web_sales
+        left join web_returns on wr_order_number = ws_order_number
+                             and ws_item_sk = wr_item_sk
+        join date_dim on ws_sold_date_sk = d_date_sk
+   where wr_order_number is null
+   group by d_year, ws_item_sk, ws_bill_customer_sk),
+ cs as
+  (select d_year as cs_sold_year, cs_item_sk,
+          cs_bill_customer_sk cs_customer_sk,
+          sum(cs_quantity) cs_qty,
+          sum(cs_wholesale_cost) cs_wc,
+          sum(cs_sales_price) cs_sp
+   from catalog_sales
+        left join catalog_returns on cr_order_number = cs_order_number
+                                 and cs_item_sk = cr_item_sk
+        join date_dim on cs_sold_date_sk = d_date_sk
+   where cr_order_number is null
+   group by d_year, cs_item_sk, cs_bill_customer_sk),
+ ss as
+  (select d_year as ss_sold_year, ss_item_sk,
+          ss_customer_sk,
+          sum(ss_quantity) ss_qty,
+          sum(ss_wholesale_cost) ss_wc,
+          sum(ss_sales_price) ss_sp
+   from store_sales
+        left join store_returns on sr_ticket_number = ss_ticket_number
+                               and ss_item_sk = sr_item_sk
+        join date_dim on ss_sold_date_sk = d_date_sk
+   where sr_ticket_number is null
+   group by d_year, ss_item_sk, ss_customer_sk)
+select ss_sold_year, ss_item_sk, ss_customer_sk,
+       round(cast(ss_qty as double)
+             / (coalesce(ws_qty, 0) + coalesce(cs_qty, 0)), 2) ratio,
+       ss_qty store_qty, ss_wc store_wholesale_cost,
+       ss_sp store_sales_price,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0) other_chan_qty,
+       coalesce(ws_wc, 0) + coalesce(cs_wc, 0)
+         other_chan_wholesale_cost,
+       coalesce(ws_sp, 0) + coalesce(cs_sp, 0) other_chan_sales_price
+from ss
+     left join ws on (ws_sold_year = ss_sold_year
+                      and ws_item_sk = ss_item_sk
+                      and ws_customer_sk = ss_customer_sk)
+     left join cs on (cs_sold_year = ss_sold_year
+                      and cs_item_sk = ss_item_sk
+                      and cs_customer_sk = ss_customer_sk)
+where (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)
+  and ss_sold_year = 2000
+order by ss_sold_year, ss_item_sk, ss_customer_sk, ss_qty desc,
+         ss_wc desc, ss_sp desc, other_chan_qty,
+         other_chan_wholesale_cost, other_chan_sales_price, ratio
+limit 100
+""",
+    80: """
+with ssr as
+  (select s_store_id as store_id,
+          sum(ss_ext_sales_price) as sales,
+          sum(coalesce(sr_return_amt, 0)) as returns1,
+          sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit
+   from store_sales
+        left outer join store_returns
+          on (ss_item_sk = sr_item_sk
+              and ss_ticket_number = sr_ticket_number),
+        date_dim, store, item, promotion
+   where ss_sold_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and ss_store_sk = s_store_sk
+     and ss_item_sk = i_item_sk
+     and i_current_price > 50
+     and ss_promo_sk = p_promo_sk
+     and p_channel_tv = 'N'
+   group by s_store_id),
+ csr as
+  (select cp_catalog_page_id as catalog_page_id,
+          sum(cs_ext_sales_price) as sales,
+          sum(coalesce(cr_return_amount, 0)) as returns1,
+          sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit
+   from catalog_sales
+        left outer join catalog_returns
+          on (cs_item_sk = cr_item_sk
+              and cs_order_number = cr_order_number),
+        date_dim, catalog_page, item, promotion
+   where cs_sold_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and cs_catalog_page_sk = cp_catalog_page_sk
+     and cs_item_sk = i_item_sk
+     and i_current_price > 50
+     and cs_promo_sk = p_promo_sk
+     and p_channel_tv = 'N'
+   group by cp_catalog_page_id),
+ wsr as
+  (select web_site_id,
+          sum(ws_ext_sales_price) as sales,
+          sum(coalesce(wr_return_amt, 0)) as returns1,
+          sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit
+   from web_sales
+        left outer join web_returns
+          on (ws_item_sk = wr_item_sk
+              and ws_order_number = wr_order_number),
+        date_dim, web_site, item, promotion
+   where ws_sold_date_sk = d_date_sk
+     and d_date between date '2000-08-23'
+                    and (date '2000-08-23' + interval '30' day)
+     and ws_web_site_sk = web_site_sk
+     and ws_item_sk = i_item_sk
+     and i_current_price > 50
+     and ws_promo_sk = p_promo_sk
+     and p_channel_tv = 'N'
+   group by web_site_id)
+select channel, id, sum(sales) as sales, sum(returns1) as returns1,
+       sum(profit) as profit
+from (select 'store channel' as channel, 'store' || store_id as id,
+             sales, returns1, profit
+      from ssr
+      union all
+      select 'catalog channel' as channel,
+             'catalog_page' || catalog_page_id as id,
+             sales, returns1, profit
+      from csr
+      union all
+      select 'web channel' as channel, 'web_site' || web_site_id as id,
+             sales, returns1, profit
+      from wsr) x
+group by rollup (channel, id)
+order by channel, id
+limit 100
+""",
+    8: """
+select s_store_name, sum(ss_net_profit)
+from store_sales, date_dim, store,
+     (select ca_zip
+      from ((select substr(ca_zip, 1, 5) ca_zip
+             from customer_address
+             where substr(ca_zip, 1, 5) in
+               ('00158', '00162', '00174', '00189', '00203', '00215',
+                '00225', '00236', '00246', '00259', '00267', '00274',
+                '00289', '00298', '00304', '00312', '00324', '00337',
+                '00348', '00356', '00364', '00371', '00386', '00395',
+                '00408', '00416', '00428', '00439', '00447', '00458',
+                '00467', '00475', '00487', '00498', '00507', '00518',
+                '00526', '00537', '00548', '00559'))
+            intersect
+            (select ca_zip
+             from (select substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+                   from customer_address, customer
+                   where ca_address_sk = c_current_addr_sk
+                     and c_preferred_cust_flag = 'Y'
+                   group by ca_zip
+                   having count(*) > 10) a1)) a2) v1
+where ss_store_sk = s_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 1998
+  and substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+group by s_store_name
+order by s_store_name
+limit 100
+""",
+    17: """
+select i_item_id, i_item_desc, s_state,
+       count(ss_quantity) as store_sales_quantitycount,
+       avg(ss_quantity) as store_sales_quantityave,
+       stddev_samp(ss_quantity) as store_sales_quantitystdev,
+       stddev_samp(ss_quantity) / avg(ss_quantity)
+         as store_sales_quantitycov,
+       count(sr_return_quantity) as store_returns_quantitycount,
+       avg(sr_return_quantity) as store_returns_quantityave,
+       stddev_samp(sr_return_quantity) as store_returns_quantitystdev,
+       stddev_samp(sr_return_quantity) / avg(sr_return_quantity)
+         as store_returns_quantitycov,
+       count(cs_quantity) as catalog_sales_quantitycount,
+       avg(cs_quantity) as catalog_sales_quantityave,
+       stddev_samp(cs_quantity) / avg(cs_quantity)
+         as catalog_sales_quantitystdev,
+       stddev_samp(cs_quantity) / avg(cs_quantity)
+         as catalog_sales_quantitycov
+from store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+where d1.d_quarter_name = '2000Q1'
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_quarter_name in ('2000Q1', '2000Q2', '2000Q3')
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_quarter_name in ('2000Q1', '2000Q2', '2000Q3')
+group by i_item_id, i_item_desc, s_state
+order by i_item_id, i_item_desc, s_state
+limit 100
+""",
+    39: """
+with inv as
+  (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy, stdev,
+          mean, case mean when 0 then null else stdev / mean end cov
+   from (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+                stddev_samp(inv_quantity_on_hand) stdev,
+                avg(inv_quantity_on_hand) mean
+         from inventory, item, warehouse, date_dim
+         where inv_item_sk = i_item_sk
+           and inv_warehouse_sk = w_warehouse_sk
+           and inv_date_sk = d_date_sk
+           and d_year = 2001
+         group by w_warehouse_name, w_warehouse_sk, i_item_sk,
+                  d_moy) foo
+   where case mean when 0 then 0 else stdev / mean end > 1)
+select inv1.w_warehouse_sk w1, inv1.i_item_sk i1, inv1.d_moy m1,
+       inv1.mean mean1, inv1.cov cov1,
+       inv2.w_warehouse_sk w2, inv2.i_item_sk i2, inv2.d_moy m2,
+       inv2.mean mean2, inv2.cov cov2
+from inv inv1, inv inv2
+where inv1.i_item_sk = inv2.i_item_sk
+  and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  and inv1.d_moy = 1
+  and inv2.d_moy = 2
+order by inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+         inv1.cov, inv2.d_moy, inv2.mean, inv2.cov
+""",
+    72: """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+       sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+       count(*) total_cnt
+from catalog_sales
+join inventory on (cs_item_sk = inv_item_sk)
+join warehouse on (w_warehouse_sk = inv_warehouse_sk)
+join item on (i_item_sk = cs_item_sk)
+join customer_demographics on (cs_bill_cdemo_sk = cd_demo_sk)
+join household_demographics on (cs_bill_hdemo_sk = hd_demo_sk)
+join date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)
+join date_dim d2 on (inv_date_sk = d2.d_date_sk)
+join date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)
+left outer join promotion on (cs_promo_sk = p_promo_sk)
+left outer join catalog_returns on (cr_item_sk = cs_item_sk
+                                    and cr_order_number = cs_order_number)
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and d3.d_date > d1.d_date + interval '5' day
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 1999
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
+limit 100
+""",
+    66: """
+select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+       w_country, ship_carriers, year1,
+       sum(jan_sales) as jan_sales, sum(feb_sales) as feb_sales,
+       sum(mar_sales) as mar_sales, sum(apr_sales) as apr_sales,
+       sum(may_sales) as may_sales, sum(jun_sales) as jun_sales,
+       sum(jul_sales) as jul_sales, sum(aug_sales) as aug_sales,
+       sum(sep_sales) as sep_sales, sum(oct_sales) as oct_sales,
+       sum(nov_sales) as nov_sales, sum(dec_sales) as dec_sales,
+       sum(jan_net) as jan_net, sum(feb_net) as feb_net,
+       sum(mar_net) as mar_net, sum(apr_net) as apr_net,
+       sum(may_net) as may_net, sum(jun_net) as jun_net,
+       sum(jul_net) as jul_net, sum(aug_net) as aug_net,
+       sum(sep_net) as sep_net, sum(oct_net) as oct_net,
+       sum(nov_net) as nov_net, sum(dec_net) as dec_net
+from (select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state, w_country, 'DHL,BARIAN' as ship_carriers,
+             d_year as year1,
+             sum(case when d_moy = 1 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as jan_sales,
+             sum(case when d_moy = 2 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as feb_sales,
+             sum(case when d_moy = 3 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as mar_sales,
+             sum(case when d_moy = 4 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as apr_sales,
+             sum(case when d_moy = 5 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as may_sales,
+             sum(case when d_moy = 6 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as jun_sales,
+             sum(case when d_moy = 7 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as jul_sales,
+             sum(case when d_moy = 8 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as aug_sales,
+             sum(case when d_moy = 9 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as sep_sales,
+             sum(case when d_moy = 10 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as oct_sales,
+             sum(case when d_moy = 11 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as nov_sales,
+             sum(case when d_moy = 12 then ws_ext_sales_price * ws_quantity
+                      else 0 end) as dec_sales,
+             sum(case when d_moy = 1 then ws_net_paid * ws_quantity
+                      else 0 end) as jan_net,
+             sum(case when d_moy = 2 then ws_net_paid * ws_quantity
+                      else 0 end) as feb_net,
+             sum(case when d_moy = 3 then ws_net_paid * ws_quantity
+                      else 0 end) as mar_net,
+             sum(case when d_moy = 4 then ws_net_paid * ws_quantity
+                      else 0 end) as apr_net,
+             sum(case when d_moy = 5 then ws_net_paid * ws_quantity
+                      else 0 end) as may_net,
+             sum(case when d_moy = 6 then ws_net_paid * ws_quantity
+                      else 0 end) as jun_net,
+             sum(case when d_moy = 7 then ws_net_paid * ws_quantity
+                      else 0 end) as jul_net,
+             sum(case when d_moy = 8 then ws_net_paid * ws_quantity
+                      else 0 end) as aug_net,
+             sum(case when d_moy = 9 then ws_net_paid * ws_quantity
+                      else 0 end) as sep_net,
+             sum(case when d_moy = 10 then ws_net_paid * ws_quantity
+                      else 0 end) as oct_net,
+             sum(case when d_moy = 11 then ws_net_paid * ws_quantity
+                      else 0 end) as nov_net,
+             sum(case when d_moy = 12 then ws_net_paid * ws_quantity
+                      else 0 end) as dec_net
+      from web_sales, warehouse, date_dim, time_dim, ship_mode
+      where ws_warehouse_sk = w_warehouse_sk
+        and ws_sold_date_sk = d_date_sk
+        and ws_sold_time_sk = t_time_sk
+        and ws_ship_mode_sk = sm_ship_mode_sk
+        and d_year = 2001
+        and t_time between 30838 and 30838 + 28800
+        and sm_carrier in ('DHL', 'BARIAN')
+      group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+               w_state, w_country, d_year
+      union all
+      select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state, w_country, 'DHL,BARIAN' as ship_carriers,
+             d_year as year1,
+             sum(case when d_moy = 1 then cs_sales_price * cs_quantity
+                      else 0 end) as jan_sales,
+             sum(case when d_moy = 2 then cs_sales_price * cs_quantity
+                      else 0 end) as feb_sales,
+             sum(case when d_moy = 3 then cs_sales_price * cs_quantity
+                      else 0 end) as mar_sales,
+             sum(case when d_moy = 4 then cs_sales_price * cs_quantity
+                      else 0 end) as apr_sales,
+             sum(case when d_moy = 5 then cs_sales_price * cs_quantity
+                      else 0 end) as may_sales,
+             sum(case when d_moy = 6 then cs_sales_price * cs_quantity
+                      else 0 end) as jun_sales,
+             sum(case when d_moy = 7 then cs_sales_price * cs_quantity
+                      else 0 end) as jul_sales,
+             sum(case when d_moy = 8 then cs_sales_price * cs_quantity
+                      else 0 end) as aug_sales,
+             sum(case when d_moy = 9 then cs_sales_price * cs_quantity
+                      else 0 end) as sep_sales,
+             sum(case when d_moy = 10 then cs_sales_price * cs_quantity
+                      else 0 end) as oct_sales,
+             sum(case when d_moy = 11 then cs_sales_price * cs_quantity
+                      else 0 end) as nov_sales,
+             sum(case when d_moy = 12 then cs_sales_price * cs_quantity
+                      else 0 end) as dec_sales,
+             sum(case when d_moy = 1 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as jan_net,
+             sum(case when d_moy = 2 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as feb_net,
+             sum(case when d_moy = 3 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as mar_net,
+             sum(case when d_moy = 4 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as apr_net,
+             sum(case when d_moy = 5 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as may_net,
+             sum(case when d_moy = 6 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as jun_net,
+             sum(case when d_moy = 7 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as jul_net,
+             sum(case when d_moy = 8 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as aug_net,
+             sum(case when d_moy = 9 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as sep_net,
+             sum(case when d_moy = 10 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as oct_net,
+             sum(case when d_moy = 11 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as nov_net,
+             sum(case when d_moy = 12 then cs_net_paid_inc_tax * cs_quantity
+                      else 0 end) as dec_net
+      from catalog_sales, warehouse, date_dim, time_dim, ship_mode
+      where cs_warehouse_sk = w_warehouse_sk
+        and cs_sold_date_sk = d_date_sk
+        and cs_sold_time_sk = t_time_sk
+        and cs_ship_mode_sk = sm_ship_mode_sk
+        and d_year = 2001
+        and t_time between 30838 and 30838 + 28800
+        and sm_carrier in ('DHL', 'BARIAN')
+      group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+               w_state, w_country, d_year) x
+group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+         w_country, ship_carriers, year1
+order by w_warehouse_name
+limit 100
+""",
     61: """
 select promotions, total,
        cast(promotions as double) / cast(total as double) * 100

@@ -534,7 +534,11 @@ def gen_customer(sf: float) -> Table:
             "c_birth_day": _int(rng.integers(1, 29, n)),
             "c_birth_month": _int(rng.integers(1, 13, n)),
             "c_birth_year": _int(rng.integers(1930, 1993, n)),
-            "c_birth_country": _pool(rng, n, _COUNTRIES),
+            # dsdgen stores birth country UPPERCASE (Q24 joins it against
+            # upper(ca_country))
+            "c_birth_country": _pool(
+                rng, n, tuple(c.upper() for c in _COUNTRIES)
+            ),
             "c_login": _ids("login", n),
             "c_email_address": _ids("email", n),
             "c_last_review_date_sk": _sk(
@@ -987,7 +991,7 @@ def gen_store_sales(sf: float) -> Table:
             "ss_customer_sk": _sk(t_cust[ticket]),
             "ss_cdemo_sk": _sk(t_cdemo[ticket]),
             "ss_hdemo_sk": _sk(t_hdemo[ticket]),
-            "ss_addr_sk": _sk(t_addr[ticket]),
+            "ss_addr_sk": _sk_nullable(t_addr[ticket], rng),
             "ss_store_sk": _sk_nullable(t_store[ticket], rng),
             "ss_promo_sk": _sk(rng.integers(0, d["promo"], n)),
             "ss_ticket_number": _sk(ticket),
@@ -1038,7 +1042,10 @@ def gen_store_returns(sf: float) -> Table:
             "sr_customer_sk": _sk(ss.columns["ss_customer_sk"].data[idx]),
             "sr_cdemo_sk": _sk(ss.columns["ss_cdemo_sk"].data[idx]),
             "sr_hdemo_sk": _sk(ss.columns["ss_hdemo_sk"].data[idx]),
-            "sr_addr_sk": _sk(ss.columns["ss_addr_sk"].data[idx]),
+            "sr_addr_sk": _sk(
+                ss.columns["ss_addr_sk"].data[idx],
+                valid=ss.columns["ss_addr_sk"].valid[idx],
+            ),
             "sr_store_sk": _sk(
                 ss.columns["ss_store_sk"].data[idx],
                 valid=ss.columns["ss_store_sk"].valid[idx],

@@ -1,0 +1,104 @@
+"""Pattern-matching substrate for optimizer rules.
+
+Re-designed equivalent of the reference's presto-matching module
+(presto-matching/src/main/java/com/facebook/presto/matching/: Pattern,
+Matcher, Captures — consumed by the 81 iterative rules). The TPU build's
+plan nodes are frozen dataclasses, so a pattern is a plain predicate tree:
+node-class check + property predicates + per-child sub-patterns, with
+named captures collected into a dict. No bytecode, no reflection — a
+pattern match is one recursive function call.
+
+    P = pattern(N.Limit).child(pattern(N.Sort).capture("sort")).capture("limit")
+    caps = P.match(node)      # {"limit": node, "sort": node.child} | None
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence, Tuple, Type
+
+from . import nodes as N
+
+
+Captures = Dict[str, N.PlanNode]
+
+
+@dataclasses.dataclass(frozen=True)
+class Pattern:
+    node_types: Tuple[type, ...]  # () = any node
+    predicates: Tuple[Callable[[N.PlanNode], bool], ...] = ()
+    child_patterns: Tuple[Tuple[int, "Pattern"], ...] = ()  # (child idx, sub)
+    capture_name: Optional[str] = None
+
+    def matching(self, pred: Callable[[N.PlanNode], bool]) -> "Pattern":
+        return dataclasses.replace(
+            self, predicates=self.predicates + (pred,)
+        )
+
+    def child(self, sub: "Pattern", index: int = 0) -> "Pattern":
+        return dataclasses.replace(
+            self, child_patterns=self.child_patterns + ((index, sub),)
+        )
+
+    def capture(self, name: str) -> "Pattern":
+        return dataclasses.replace(self, capture_name=name)
+
+    def match(self, node: N.PlanNode) -> Optional[Captures]:
+        caps: Captures = {}
+        return caps if self._match_into(node, caps) else None
+
+    def _match_into(self, node: N.PlanNode, caps: Captures) -> bool:
+        if self.node_types and not isinstance(node, self.node_types):
+            return False
+        for pred in self.predicates:
+            if not pred(node):
+                return False
+        kids = node.children
+        for idx, sub in self.child_patterns:
+            if idx >= len(kids) or not sub._match_into(kids[idx], caps):
+                return False
+        if self.capture_name is not None:
+            caps[self.capture_name] = node
+        return True
+
+
+def pattern(*node_types: Type[N.PlanNode]) -> Pattern:
+    return Pattern(tuple(node_types))
+
+
+def any_node() -> Pattern:
+    return Pattern(())
+
+
+# ---------------------------------------------------------------------------
+# plan-assertion DSL (reference sql/planner/assertions/PlanMatchPattern):
+# tests assert on the SHAPE of an optimized plan
+# ---------------------------------------------------------------------------
+
+
+def assert_plan(node: N.PlanNode, shape) -> None:
+    """`shape` is a nested tuple (NodeType, pred_or_None, *child_shapes);
+    NodeType may be a type or tuple of types; pred is an optional
+    node->bool. Raises AssertionError with the offending subtree."""
+    if not isinstance(shape, tuple):
+        shape = (shape,)
+    node_type, rest = shape[0], shape[1:]
+    pred = None
+    if rest and (rest[0] is None or callable(rest[0])) and not (
+        isinstance(rest[0], tuple) or isinstance(rest[0], type)
+    ):
+        pred, rest = rest[0], rest[1:]
+    if not isinstance(node, node_type):
+        raise AssertionError(
+            f"expected {node_type} got {type(node).__name__}: {node}"
+        )
+    if pred is not None and not pred(node):
+        raise AssertionError(f"predicate failed on {node}")
+    kids = node.children
+    if len(rest) != len(kids):
+        raise AssertionError(
+            f"{type(node).__name__}: expected {len(rest)} children, "
+            f"has {len(kids)}"
+        )
+    for sub, kid in zip(rest, kids):
+        assert_plan(kid, sub)

@@ -218,6 +218,9 @@ def fuse_filter_into_aggregates(node: N.PlanNode) -> N.PlanNode:
 
 
 def optimize(root: N.PlanNode) -> N.PlanNode:
+    from .rules import rewrite
+
+    root = rewrite(root)  # iterative rule pass (plan/rules.py)
     root = fuse_filter_into_aggregates(root)
     if isinstance(root, N.Output):
         return prune_columns(root, set(root.channels))

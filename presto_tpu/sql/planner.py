@@ -1677,13 +1677,34 @@ class FromPlanner:
             )
         )
 
+    def _plan_join_side(self, rel) -> RelationPlan:
+        """One side of an outer join may itself be a join tree (e.g.
+        `a join b on .. left outer join c on ..` associates the whole
+        inner-join chain as the outer join's left side): plan inner/cross
+        chains through a nested FromPlanner (keeping the greedy join
+        order), and nested outer joins recursively."""
+        if isinstance(rel, t.Join) and rel.kind in ("cross", "inner"):
+            sub = FromPlanner(self.p, self.outer, self.ctes)
+            sub.add_relation(rel)
+            node, scope = sub.assemble(None)
+            # assemble() is what classifies ON conjuncts: only AFTER it can
+            # we see subquery conjuncts, which nothing would consume here
+            if sub.subquery_conjuncts or sub.unnests:
+                raise PlanningError(
+                    "subqueries/UNNEST inside a joined ON-side not supported"
+                )
+            return RelationPlan(node, scope)
+        if isinstance(rel, t.Join):
+            return self._plan_outer_join(rel).plan
+        return self.p.plan_relation(rel, self.outer, self.ctes)
+
     def _plan_outer_join(self, rel: t.Join) -> PoolItem:
         kind = rel.kind
         if kind == "right":
             rel = t.Join("left", rel.right, rel.left, rel.condition, rel.using)
             kind = "left"
-        left = self.p.plan_relation(rel.left, self.outer, self.ctes)
-        right = self.p.plan_relation(rel.right, self.outer, self.ctes)
+        left = self._plan_join_side(rel.left)
+        right = self._plan_join_side(rel.right)
         combined = Scope(left.scope.fields + right.scope.fields)
         ctx = SelectContext(self.p, [combined], self.outer, self.ctes, None)
         left_chs = {f.channel for f in left.scope.fields}
@@ -1853,7 +1874,13 @@ class FromPlanner:
                 plan = N.Filter(plan, e)
             return plan, combined
 
-        # greedy assembly
+        # greedy assembly with COSTED ALTERNATIVES: build a complete greedy
+        # order from each of the two smallest-estimate start relations,
+        # cost each full order as the sum of estimated intermediate rows
+        # (the dominant exchange+build volume on TPU), keep the cheaper —
+        # the reference compares alternative join orders with
+        # CostComparator inside ReorderJoins (sql/planner/iterative/rule/
+        # ReorderJoins.java); two greedy seeds is the bounded analog.
         n_items = len(self.pool)
         if n_items == 1:
             plan = self.pool[0].plan.node
@@ -1863,93 +1890,106 @@ class FromPlanner:
 
         from ..plan.stats import join_output_rows
 
-        remaining = set(range(n_items))
-        start = min(remaining, key=lambda i: self.pool[i].estimate)
-        joined = {start}
-        remaining.discard(start)
-        plan = self.pool[start].plan.node
-        cur_stats = self.pool[start].stats
-        applied_res: set = set()
+        def build_order(start: int) -> Tuple[N.PlanNode, float]:
+            remaining = set(range(n_items))
+            joined = {start}
+            remaining.discard(start)
+            plan = self.pool[start].plan.node
+            cur_stats = self.pool[start].stats
+            applied_res: set = set()
+            cost = 0.0
 
-        def edge_keys(nxt: int):
-            """(tree-side, candidate-side) key expression lists."""
-            lkeys, rkeys = [], []
-            for (i, j, a, b) in edges:
-                if i in joined and j == nxt:
-                    lkeys.append(a)
-                    rkeys.append(b)
-                elif j in joined and i == nxt:
-                    lkeys.append(b)
-                    rkeys.append(a)
-            return lkeys, rkeys
+            def edge_keys(nxt: int):
+                """(tree-side, candidate-side) key expression lists."""
+                lkeys, rkeys = [], []
+                for (i, j, a, b) in edges:
+                    if i in joined and j == nxt:
+                        lkeys.append(a)
+                        rkeys.append(b)
+                    elif j in joined and i == nxt:
+                        lkeys.append(b)
+                        rkeys.append(a)
+                return lkeys, rkeys
 
-        while remaining:
-            # candidates connected by an edge; pick the one whose join
-            # with the current tree has the smallest estimated OUTPUT
-            # (reference ReorderJoins cost comparison)
-            cand = set()
-            for (i, j, _, _) in edges:
-                if i in joined and j in remaining:
-                    cand.add(j)
-                if j in joined and i in remaining:
-                    cand.add(i)
+            while remaining:
+                # candidates connected by an edge; pick the one whose join
+                # with the current tree has the smallest estimated OUTPUT
+                # (reference ReorderJoins cost comparison)
+                cand = set()
+                for (i, j, _, _) in edges:
+                    if i in joined and j in remaining:
+                        cand.add(j)
+                    if j in joined and i in remaining:
+                        cand.add(i)
 
-            def join_est(c: int) -> float:
-                lk, rk = edge_keys(c)
-                return join_output_rows(
-                    cur_stats, self.pool[c].stats, lk, rk, "inner"
-                )
+                def join_est(c: int) -> float:
+                    lk, rk = edge_keys(c)
+                    return join_output_rows(
+                        cur_stats, self.pool[c].stats, lk, rk, "inner"
+                    )
 
-            if cand:
-                nxt = min(cand, key=lambda i: (join_est(i), self.pool[i].estimate))
-            else:
-                nxt = min(remaining, key=lambda i: self.pool[i].estimate)
-            lkeys, rkeys = edge_keys(nxt)
-            rnode = self.pool[nxt].plan.node
-            # build side = smaller estimated side (reference: CBO flips the
-            # join so the hash build is the cheaper input), except keep a
-            # UNIQUE build side — the n:1 fast path beats a smaller build
-            tree_rows = cur_stats.rows if cur_stats else 1e9
-            cand_rows = self.pool[nxt].estimate
-            unique_r = _build_side_unique(rnode, rkeys, self.p.catalog)
-            if not unique_r and cand_rows > tree_rows and lkeys:
-                unique_l = _build_side_unique(plan, lkeys, self.p.catalog)
-                plan = N.Join(
-                    "inner",
-                    rnode,
-                    plan,
-                    tuple(rkeys),
-                    tuple(lkeys),
-                    None,
-                    unique_l,
-                )
-            else:
-                plan = N.Join(
-                    "inner",
-                    plan,
-                    rnode,
-                    tuple(lkeys),
-                    tuple(rkeys),
-                    None,
-                    unique_r,
-                )
-            joined.add(nxt)
-            remaining.discard(nxt)
-            cur_stats = self._stats(plan)
-            # apply residuals that became fully available
-            joined_channels = set()
-            for i in joined:
-                joined_channels |= self.pool[i].channels
+                if cand:
+                    nxt = min(
+                        cand,
+                        key=lambda i: (join_est(i), self.pool[i].estimate),
+                    )
+                else:
+                    nxt = min(remaining, key=lambda i: self.pool[i].estimate)
+                lkeys, rkeys = edge_keys(nxt)
+                rnode = self.pool[nxt].plan.node
+                # build side = smaller estimated side (reference: CBO flips
+                # the join so the hash build is the cheaper input), except
+                # keep a UNIQUE build side — the n:1 fast path beats a
+                # smaller build
+                tree_rows = cur_stats.rows if cur_stats else 1e9
+                cand_rows = self.pool[nxt].estimate
+                unique_r = _build_side_unique(rnode, rkeys, self.p.catalog)
+                if not unique_r and cand_rows > tree_rows and lkeys:
+                    unique_l = _build_side_unique(plan, lkeys, self.p.catalog)
+                    plan = N.Join(
+                        "inner",
+                        rnode,
+                        plan,
+                        tuple(rkeys),
+                        tuple(lkeys),
+                        None,
+                        unique_l,
+                    )
+                else:
+                    plan = N.Join(
+                        "inner",
+                        plan,
+                        rnode,
+                        tuple(lkeys),
+                        tuple(rkeys),
+                        None,
+                        unique_r,
+                    )
+                joined.add(nxt)
+                remaining.discard(nxt)
+                cur_stats = self._stats(plan)
+                cost += cur_stats.rows if cur_stats else 0.0
+                # apply residuals that became fully available
+                for k, (owners, e) in enumerate(residuals):
+                    if k in applied_res:
+                        continue
+                    if owners <= joined:
+                        plan = N.Filter(plan, e)
+                        applied_res.add(k)
             for k, (owners, e) in enumerate(residuals):
-                if k in applied_res:
-                    continue
-                if owners <= joined:
+                if k not in applied_res:
                     plan = N.Filter(plan, e)
-                    applied_res.add(k)
-        for k, (owners, e) in enumerate(residuals):
-            if k not in applied_res:
-                plan = N.Filter(plan, e)
-        return finish(plan)
+            return plan, cost
+
+        by_size = sorted(range(n_items), key=lambda i: self.pool[i].estimate)
+        starts = by_size[: (2 if n_items > 2 else 1)]
+        best_plan: Optional[N.PlanNode] = None
+        best_cost = float("inf")
+        for s in starts:
+            cand_plan, cand_cost = build_order(s)
+            if cand_cost < best_cost:
+                best_plan, best_cost = cand_plan, cand_cost
+        return finish(best_plan)
 
     def _record_correlation(self, e: ir.RowExpression, refs: set, inner_chs: set):
         """Route a conjunct referencing outer channels to the enclosing

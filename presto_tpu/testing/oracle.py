@@ -26,6 +26,33 @@ from ..connectors import tpch
 # ---------------------------------------------------------------------------
 
 
+class _VarSamp:
+    """Welford online variance (sample)."""
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def step(self, v):
+        if v is None:
+            return
+        v = float(v)
+        self.n += 1
+        d = v - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (v - self.mean)
+
+    def finalize(self):
+        return self.m2 / (self.n - 1) if self.n > 1 else None
+
+
+class _StdDevSamp(_VarSamp):
+    def finalize(self):
+        var = super().finalize()
+        return None if var is None else var**0.5
+
+
 def _decode_column(col: tpch.Column) -> list:
     vals = _decode_values(col)
     if col.valid is not None:
@@ -83,6 +110,10 @@ class SqliteOracle:
         source=tpch,
     ):
         self.conn = sqlite3.connect(":memory:")
+        # SQLite has no stddev family; register Welford aggregates so
+        # TPC-DS Q17/Q39 oracle SQL can stay the spec text
+        self.conn.create_aggregate("stddev_samp", 1, _StdDevSamp)
+        self.conn.create_aggregate("var_samp", 1, _VarSamp)
         for name in tables or source.TABLE_NAMES:
             t = source.table(name, sf)
             cols = list(t.columns.keys())

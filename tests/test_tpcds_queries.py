@@ -77,7 +77,54 @@ def _rollup_level_union(aggs_sql, cols, body, level_alias):
         )
     return " union all ".join(parts)
 
+def _rollup_channel_oracle(qid):
+    """Q5/Q77/Q80 shape: WITH ctes + `select channel, id, sums group by
+    rollup(channel, id)` — rebuild the final select as the UNION ALL of
+    rollup levels for SQLite."""
+    txt = QUERIES[qid]
+    head, tail = txt.rsplit("select channel, id,", 1)
+    body = tail[tail.index("from (") : tail.rindex(") x") + 3]
+    return head + _expand_rollup(
+        "sum(sales) as sales, sum(returns1) as returns1,"
+        " sum(profit) as profit",
+        ["channel", "id"],
+        body,
+        "order by channel nulls last, id nulls last limit 100",
+    )
+
+
 ORACLE_SQL = {
+    # SQLite gives cast(... as decimal) INTEGER affinity, making the spec's
+    # ratio an integer division — force real division in the oracle
+    75: QUERIES[75].replace("as decimal(17,2))", "as real)"),
+    49: QUERIES[49].replace("as decimal(15,4))", "as real)"),
+    # engine casts decimal->int with HALF_UP; SQLite cast truncates
+    54: QUERIES[54].replace(
+        "cast((revenue / 50) as integer)",
+        "cast(round(revenue / 50.0) as integer)",
+    ),
+    # SQLite refuses the spec's ambiguous output-alias ORDER BY
+    58: QUERIES[58].replace(
+        "order by item_id, ss_item_rev",
+        "order by ss_items.item_id, ss_item_rev",
+    ),
+    5: _rollup_channel_oracle(5),
+    77: _rollup_channel_oracle(77),
+    80: _rollup_channel_oracle(80),
+    # SQLite rejects parenthesized members of a compound SELECT
+    8: QUERIES[8]
+    .replace("from ((select substr", "from (select substr")
+    .replace(
+        "'00559'))\n            intersect\n            (select ca_zip",
+        "'00559')\n            intersect\n            select ca_zip",
+    )
+    .replace("> 10) a1)) a2) v1", "> 10) a1) a2) v1"),
+    # SQLite can't add an interval to a date COLUMN (the transpiler only
+    # folds literal date arithmetic); d_date is stored as ISO text
+    72: QUERIES[72].replace(
+        "d3.d_date > d1.d_date + interval '5' day",
+        "d3.d_date > date(d1.d_date, '+5 day')",
+    ),
     18: _expand_rollup(
         "avg(cast(cs_quantity as double)) agg1,"
         " avg(cast(cs_list_price as double)) agg2,"
@@ -173,6 +220,52 @@ from ({_rollup_level_union(
 order by lochierarchy desc,
          case when lochierarchy = 0 then i_category end nulls last,
          rank_within_parent
+limit 100
+"""
+
+
+_q14_head, _q14_tail = QUERIES[14].rsplit(
+    "select channel, i_brand_id, i_class_id, i_category_id,", 1
+)
+_q14_body = _q14_tail[_q14_tail.index("from (") : _q14_tail.rindex(") y") + 3]
+ORACLE_SQL[14] = _q14_head + _expand_rollup(
+    "sum(sales) as sum_sales, sum(number_sales) as number_sales",
+    ["channel", "i_brand_id", "i_class_id", "i_category_id"],
+    _q14_body,
+    "order by channel nulls last, i_brand_id nulls last,"
+    " i_class_id nulls last, i_category_id nulls last limit 100",
+)
+
+_Q67_COLS = [
+    "i_category", "i_class", "i_brand", "i_product_name", "d_year",
+    "d_qoy", "d_moy", "s_store_id",
+]
+_Q67_BODY = (
+    "from store_sales, date_dim, store, item "
+    "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+    "and ss_store_sk = s_store_sk and d_month_seq between 1200 and 1211"
+)
+_q67_parts = []
+for _k in range(len(_Q67_COLS), -1, -1):
+    _sel = [
+        (c if i < _k else f"null as {c}") for i, c in enumerate(_Q67_COLS)
+    ]
+    _gb = f" group by {', '.join(_Q67_COLS[:_k])}" if _k else ""
+    _q67_parts.append(
+        f"select {', '.join(_sel)}, "
+        f"sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales "
+        f"{_Q67_BODY}{_gb}"
+    )
+ORACLE_SQL[67] = f"""
+select * from
+ (select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales,
+         rank() over (partition by i_category order by sumsales desc) rk
+  from ({' union all '.join(_q67_parts)}) dw1) dw2
+where rk <= 100
+order by i_category nulls last, i_class nulls last, i_brand nulls last,
+         i_product_name nulls last, d_year nulls last, d_qoy nulls last,
+         d_moy nulls last, s_store_id nulls last, sumsales, rk
 limit 100
 """
 
