@@ -1,0 +1,515 @@
+"""Hive-analog warehouse connector: partitioned + bucketed parquet tables.
+
+Re-designed equivalent of the reference's flagship presto-hive connector
+(46,771 LoC): directory-per-partition layout with a JSON "metastore"
+(reference CachingHiveMetastore), partition pruning at scan time
+(reference BackgroundHiveSplitLoader + HivePartitionManager), and
+bucketed-by-key files enabling co-located bucket joins and bucket-at-a-
+time grouped execution (reference HiveBucketing.java +
+HiveNodePartitioningProvider; execution/Lifespan.java:26-38 +
+PipelineExecutionStrategy.GROUPED_EXECUTION).
+
+TPU-first shape: a partition is a FILE-PRUNING unit (plan/scan-time, host
+metadata only — nothing reaches the device for pruned partitions); a
+bucket is a MEMORY-BOUNDING unit (the streaming executor joins bucket i
+end-to-end before bucket i+1, so the build side resident in HBM is
+1/bucket_count of the table). Files are parquet via the same pyarrow
+host-decode path as connectors/parquet.py.
+
+Layout under `root/`:
+
+    <table>/_table.json                      # schema + partitioning spec
+    <table>/<pcol>=<val>/part-00000.parquet  # unbucketed partition data
+    <table>/<pcol>=<val>/bucket-00007.parquet# bucketed: one file per bucket
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page, _pad_block
+from .parquet import _arrow_to_type, _type_to_arrow, build_sorted_dictionary
+from .spi import Predicate, WritableConnector, WriteError
+
+
+def _type_name(t: T.Type) -> str:
+    return str(t)
+
+
+def _type_from_name(s: str) -> T.Type:
+    return T.parse_type(s)
+
+
+def bucket_of_values(values: List, count: int) -> np.ndarray:
+    """Deterministic bucket assignment (reference HiveBucketing.
+    getHashedBucketNumber): ints via splitmix-style mixing, strings via
+    crc32 — both sides of a co-located join agree because both were
+    written through this function."""
+    n = len(values[0]) if values else 0
+    acc = np.zeros(n, np.uint64)
+    for col in values:
+        a = np.asarray(col)
+        if a.dtype.kind in "iu":
+            h = (a.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15)) * np.uint64(
+                0xBF58476D1CE4E5B9
+            )
+            h ^= h >> np.uint64(31)
+        else:
+            h = np.array(
+                [zlib.crc32(str(v).encode()) for v in col], np.uint64
+            )
+        acc = (acc * np.uint64(31)) ^ h
+    return (acc % np.uint64(count)).astype(np.int64)
+
+
+@dataclasses.dataclass
+class _FileEntry:
+    path: str
+    partition: Tuple[Tuple[str, str], ...]  # ((col, raw string value), ...)
+    bucket: Optional[int]
+    rows: int
+
+
+class HiveCatalog(WritableConnector):
+    """root: warehouse directory. Tables are created via
+    `create_partitioned_table` (the DDL-properties analog) or the plain
+    WritableConnector surface (unpartitioned)."""
+
+    name = "hive"
+
+    def __init__(self, root: str):
+        import pyarrow.parquet as pq
+
+        self.root = root
+        self._pq = pq
+        os.makedirs(root, exist_ok=True)
+        self._meta: Dict[str, dict] = {}
+        self._manifest: Dict[str, List[_FileEntry]] = {}
+        self._dicts: Dict[Tuple[str, str], tuple] = {}
+        # decoded-table LRU: batched scans re-visit the same file many
+        # times (batch_rows << file rows); without this every batch decodes
+        # the whole parquet file again — O(rows^2/batch) I/O
+        self._tbl_cache: Dict[Tuple[str, tuple], object] = {}
+        # pruning observability (surfaced via EXPLAIN ANALYZE scan detail)
+        self.last_scan_files_read = 0
+        self.last_scan_files_skipped = 0
+        for t in os.listdir(root):
+            if os.path.isfile(self._meta_path(t)):
+                self._load_table(t)
+
+    # -- metastore --
+
+    def _meta_path(self, table: str) -> str:
+        return os.path.join(self.root, table, "_table.json")
+
+    def _load_table(self, table: str) -> None:
+        with open(self._meta_path(table)) as f:
+            self._meta[table] = json.load(f)
+        self._scan_manifest(table)
+
+    def _save_meta(self, table: str) -> None:
+        with open(self._meta_path(table), "w") as f:
+            json.dump(self._meta[table], f, indent=1)
+
+    def _scan_manifest(self, table: str) -> None:
+        meta = self._meta[table]
+        pcols = meta["partitioned_by"]
+        entries: List[_FileEntry] = []
+        base = os.path.join(self.root, table)
+
+        def walk(d: str, parts: Tuple[Tuple[str, str], ...], depth: int):
+            if depth == len(pcols):
+                for fn in sorted(os.listdir(d)):
+                    if not fn.endswith(".parquet"):
+                        continue
+                    bucket = None
+                    if fn.startswith("bucket-"):
+                        bucket = int(fn[len("bucket-"):-len(".parquet")])
+                    path = os.path.join(d, fn)
+                    rows = self._pq.ParquetFile(path).metadata.num_rows
+                    entries.append(_FileEntry(path, parts, bucket, rows))
+                return
+            want = pcols[depth] + "="
+            for sub in sorted(os.listdir(d)):
+                if sub.startswith(want):
+                    walk(
+                        os.path.join(d, sub),
+                        parts + ((pcols[depth], sub[len(want):]),),
+                        depth + 1,
+                    )
+
+        walk(base, (), 0)
+        self._manifest[table] = entries
+
+    # -- DDL --
+
+    def create_partitioned_table(
+        self,
+        table: str,
+        schema: Dict[str, T.Type],
+        partitioned_by: Sequence[str] = (),
+        bucketed_by: Sequence[str] = (),
+        bucket_count: int = 0,
+    ) -> None:
+        if table in self._meta:
+            raise WriteError(f"table {table} exists")
+        for c in list(partitioned_by) + list(bucketed_by):
+            if c not in schema:
+                raise WriteError(f"unknown partition/bucket column {c!r}")
+        if bool(bucketed_by) != bool(bucket_count):
+            raise WriteError("bucketed_by requires bucket_count and vice versa")
+        os.makedirs(os.path.join(self.root, table), exist_ok=True)
+        self._meta[table] = {
+            "schema": {c: _type_name(t) for c, t in schema.items()},
+            "partitioned_by": list(partitioned_by),
+            "bucketed_by": list(bucketed_by),
+            "bucket_count": int(bucket_count),
+        }
+        self._save_meta(table)
+        self._manifest[table] = []
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        self.create_partitioned_table(table, schema)
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        self.create_table(
+            table, {n: b.type for n, b in zip(page.names, page.blocks)}
+        )
+        self.append(table, page)
+
+    def drop_table(self, table: str) -> None:
+        import shutil
+
+        if table not in self._meta:
+            raise WriteError(f"unknown table {table}")
+        shutil.rmtree(os.path.join(self.root, table))
+        prefix = os.path.join(self.root, table) + os.sep
+        self._tbl_cache = {
+            k: v for k, v in self._tbl_cache.items()
+            if not k[0].startswith(prefix)
+        }
+        self._meta.pop(table)
+        self._manifest.pop(table, None)
+        self._dicts = {
+            k: v for k, v in self._dicts.items() if k[0] != table
+        }
+
+    # -- writes --
+
+    def _page_host_columns(self, table: str, page: Page) -> Dict[str, list]:
+        """Decode a result Page to host python/numpy values per column."""
+        rows = page.to_pylist()
+        return {
+            n: [r[i] for r in rows] for i, n in enumerate(page.names)
+        }
+
+    def append(self, table: str, page: Page) -> None:
+        import pyarrow as pa
+
+        meta = self._meta.get(table)
+        if meta is None:
+            raise WriteError(f"unknown table {table}")
+        schema = self.schema(table)
+        if list(page.names) != list(schema):
+            raise WriteError(
+                f"insert columns {page.names} != table columns "
+                f"{tuple(schema)}"
+            )
+        cols = self._page_host_columns(table, page)
+        n = int(page.count)
+        pcols = meta["partitioned_by"]
+        bcols = meta["bucketed_by"]
+        bcount = meta["bucket_count"]
+
+        # partition key per row (raw string form for the directory name)
+        if pcols:
+            pkeys = list(zip(*[[str(v) for v in cols[c]] for c in pcols]))
+        else:
+            pkeys = [()] * n
+        buckets = (
+            bucket_of_values([cols[c] for c in bcols], bcount)
+            if bcols
+            else np.zeros(n, np.int64)
+        )
+        import collections
+
+        groups: Dict[tuple, List[int]] = collections.defaultdict(list)
+        for i in range(n):
+            groups[(pkeys[i], int(buckets[i]) if bcols else None)].append(i)
+
+        arrow_schema = pa.schema(
+            [(c, _type_to_arrow(t)) for c, t in schema.items()]
+        )
+        for (pkey, bucket), idxs in groups.items():
+            d = os.path.join(self.root, table)
+            for c, v in zip(pcols, pkey):
+                d = os.path.join(d, f"{c}={v}")
+            os.makedirs(d, exist_ok=True)
+            if bucket is None:
+                seq = len(
+                    [f for f in os.listdir(d) if f.startswith("part-")]
+                )
+                path = os.path.join(d, f"part-{seq:05d}.parquet")
+            else:
+                path = os.path.join(d, f"bucket-{bucket:05d}.parquet")
+            arrays = [
+                pa.array([cols[c][i] for i in idxs], _type_to_arrow(t))
+                for c, t in schema.items()
+            ]
+            tbl = pa.Table.from_arrays(arrays, schema=arrow_schema)
+            if os.path.exists(path):
+                old = self._pq.read_table(path)
+                tbl = pa.concat_tables([old, tbl])
+            self._pq.write_table(tbl, path, row_group_size=1 << 17)
+        self._dicts = {
+            k: v for k, v in self._dicts.items() if k[0] != table
+        }
+        prefix = os.path.join(self.root, table) + os.sep
+        self._tbl_cache = {
+            k: v for k, v in self._tbl_cache.items()
+            if not k[0].startswith(prefix)
+        }
+        self._scan_manifest(table)
+
+    def replace(self, table: str, page: Page) -> None:
+        meta = dict(self._meta[table])
+        self.drop_table(table)
+        self._meta[table] = meta
+        os.makedirs(os.path.join(self.root, table), exist_ok=True)
+        self._save_meta(table)
+        self._manifest[table] = []
+        self.append(table, page)
+
+    # -- metadata --
+
+    def table_names(self) -> List[str]:
+        return sorted(self._meta)
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        return {
+            c: _type_from_name(s)
+            for c, s in self._meta[table]["schema"].items()
+        }
+
+    def row_count(self, table: str) -> int:
+        return sum(e.rows for e in self._manifest[table])
+
+    def exact_row_count(self, table: str) -> int:
+        return self.row_count(table)
+
+    def unique_columns(self, table: str):
+        return []
+
+    def bucketing(self, table: str) -> Optional[Tuple[Tuple[str, ...], int]]:
+        """(bucket columns, bucket count) when the table is bucketed —
+        the grouped-execution contract consumed by the streaming
+        executor (reference ConnectorBucketNodeMap)."""
+        meta = self._meta.get(table)
+        if not meta or not meta["bucketed_by"]:
+            return None
+        return tuple(meta["bucketed_by"]), meta["bucket_count"]
+
+    def bucket_row_ranges(self, table: str, bucket: int) -> List[Tuple[int, int]]:
+        """Global [start, stop) row ranges holding the given bucket."""
+        out = []
+        off = 0
+        for e in self._manifest[table]:
+            if e.bucket == bucket:
+                out.append((off, off + e.rows))
+            off += e.rows
+        return out
+
+    # -- partition pruning --
+
+    def _prune(self, table: str, predicate: Optional[Predicate]):
+        """Manifest entries surviving the predicate's constraints on
+        partition columns (plan-time file pruning — reference
+        HivePartitionManager.getPartitions). `predicate` is the SPI hint
+        list [(source_column, op, value), ...]."""
+        entries = self._manifest[table]
+        if not predicate:
+            return entries, 0
+        import datetime as pydt
+
+        schema = self.schema(table)
+
+        def pval(col: str, raw: str):
+            t = schema[col]
+            if isinstance(t, T.DateType):
+                try:
+                    return pydt.date.fromisoformat(raw)
+                except ValueError:
+                    return raw
+            if isinstance(t, T.VarcharType):
+                return raw
+            try:
+                return float(raw) if "." in raw else int(raw)
+            except ValueError:
+                return raw
+
+        ops = {
+            "eq": lambda a, b: a == b,
+            "lt": lambda a, b: a < b,
+            "le": lambda a, b: a <= b,
+            "gt": lambda a, b: a > b,
+            "ge": lambda a, b: a >= b,
+        }
+        kept = []
+        skipped = 0
+        for e in entries:
+            vals = {c: pval(c, raw) for c, raw in e.partition}
+            ok = True
+            for col, op, v in predicate:
+                if col not in vals or op not in ops:
+                    continue
+                try:
+                    if not ops[op](vals[col], v):
+                        ok = False
+                        break
+                except TypeError:
+                    continue
+            if ok:
+                kept.append(e)
+            else:
+                skipped += 1
+        return kept, skipped
+
+    # -- reads --
+
+    def page(self, table: str) -> Page:
+        return self.scan(table, 0, self.row_count(table))
+
+    def _dictionary(self, table: str, column: str):
+        key = (table, column)
+        d = self._dicts.get(key)
+        if d is None:
+            import pyarrow as pa
+
+            chunks = []
+            for e in self._manifest[table]:
+                pf = self._pq.ParquetFile(e.path)
+                if column in pf.schema_arrow.names:
+                    chunks.append(pf.read(columns=[column]).column(0))
+            col = (
+                pa.chunked_array(chunks)
+                if chunks
+                else pa.chunked_array([pa.array([], pa.string())])
+            )
+            d = build_sorted_dictionary(col)
+            self._dicts[key] = d
+        return d
+
+    def scan(
+        self,
+        table: str,
+        start: int,
+        stop: int,
+        pad_to: Optional[int] = None,
+        columns: Optional[List[str]] = None,
+        predicate: Optional[Predicate] = None,
+    ) -> Page:
+        """Slice of the manifest-ordered concatenation of files; files in
+        PRUNED partitions contribute no rows (they cannot satisfy the
+        predicate) — the range simply comes back short."""
+        schema = self.schema(table)
+        names = list(columns) if columns is not None else list(schema)
+        kept, skipped = self._prune(table, predicate)
+        kept_set = {id(e) for e in kept}
+        self.last_scan_files_read = len(kept)
+        self.last_scan_files_skipped = skipped
+
+        pieces: List[Dict[str, np.ndarray]] = []
+        off = 0
+        for e in self._manifest[table]:
+            e_start, e_stop = off, off + e.rows
+            off = e_stop
+            lo, hi = max(start, e_start), min(stop, e_stop)
+            if lo >= hi or id(e) not in kept_set:
+                continue
+            ck = (e.path, tuple(names))
+            tbl = self._tbl_cache.get(ck)
+            if tbl is None:
+                tbl = self._pq.ParquetFile(e.path).read(columns=names)
+                self._tbl_cache[ck] = tbl
+                while len(self._tbl_cache) > 2:  # bound host RAM
+                    self._tbl_cache.pop(next(iter(self._tbl_cache)))
+            sl = tbl.slice(lo - e_start, hi - lo)
+            piece: Dict[str, np.ndarray] = {}
+            for c in names:
+                piece[c] = sl.column(c)
+            pieces.append(piece)
+
+        blocks = []
+        total = sum(len(p[names[0]]) for p in pieces) if pieces else 0
+        for c in names:
+            t = schema[c]
+            if isinstance(t, T.VarcharType):
+                sorted_d, d_arr = self._dictionary(table, c)
+                codes = []
+                valids = []
+                for p in pieces:
+                    vals = p[c].to_pylist()
+                    codes.append(
+                        np.searchsorted(
+                            d_arr, np.array(
+                                [v if v is not None else "" for v in vals],
+                                object,
+                            )
+                        ).astype(np.int32)
+                    )
+                    valids.append(
+                        np.array([v is not None for v in vals], bool)
+                    )
+                data = (
+                    np.concatenate(codes) if codes else np.empty(0, np.int32)
+                )
+                valid = (
+                    np.concatenate(valids) if valids else np.empty(0, bool)
+                )
+                blk = Block.from_numpy(
+                    data, t,
+                    valid=None if valid.all() else valid,
+                    dictionary=sorted_d,
+                )
+            else:
+                arrs = []
+                valids = []
+                for p in pieces:
+                    a = p[c]
+                    npv = a.to_numpy(zero_copy_only=False)
+                    if isinstance(t, T.DecimalType):
+                        npv = np.array(
+                            [
+                                0 if v is None else int(v.scaleb(t.scale))
+                                for v in a.to_pylist()
+                            ],
+                            np.int64,
+                        )
+                    elif isinstance(t, T.DateType):
+                        npv = np.asarray(npv, "datetime64[D]").astype(
+                            np.int32
+                        )
+                    valids.append(~np.asarray(a.is_null()))
+                    arrs.append(npv)
+                if arrs:
+                    data = np.concatenate(arrs)
+                    valid = np.concatenate(valids)
+                else:
+                    data = np.empty(0, t.storage_dtype if hasattr(t, "storage_dtype") else np.int64)
+                    valid = np.empty(0, bool)
+                if isinstance(t, T.DateType):
+                    data = data.astype(np.int32)
+                blk = Block.from_numpy(
+                    data, t, valid=None if valid.all() else valid
+                )
+            if pad_to is not None and pad_to > total:
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, names, count=total)

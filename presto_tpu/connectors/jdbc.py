@@ -1,0 +1,301 @@
+"""JDBC-federation connector framework over SQLite.
+
+Re-designed equivalent of the reference's presto-base-jdbc (3,676 LoC:
+BaseJdbcClient metadata/splits/SQL generation, QueryBuilder pushdown)
+with presto-sqlite standing in for the thin vendor subclasses
+(presto-mysql/-postgresql/-redshift/-sqlserver are ~150-320 LoC each on
+top of the base). The external system here is a SQLite database file —
+the one relational engine baked into this image — which exercises the
+full federation surface:
+
+* metadata from the remote catalog (sqlite_master + PRAGMA table_info);
+* PROJECTION pushdown: only requested columns appear in generated SQL;
+* PREDICATE pushdown: SPI hint conjuncts compile into the remote WHERE
+  (reference QueryBuilder.buildSql); the engine still applies the full
+  filter to delivered batches, so pushdown is a pure row-volume win;
+* batched scans as LIMIT/OFFSET windows over a rowid-stable order (the
+  reference's split ranges).
+
+`MultiCatalog` federates several catalogs into one session namespace so
+remote tables join against native ones (the reference achieves this with
+per-catalog connector instances inside one metadata manager).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page, _pad_block
+from .spi import Connector, Predicate
+
+
+_AFFINITY = {
+    "INTEGER": T.BIGINT,
+    "INT": T.BIGINT,
+    "BIGINT": T.BIGINT,
+    "SMALLINT": T.BIGINT,
+    "TINYINT": T.BIGINT,
+    "REAL": T.DOUBLE,
+    "DOUBLE": T.DOUBLE,
+    "FLOAT": T.DOUBLE,
+    "NUMERIC": T.DOUBLE,
+    "DECIMAL": T.DOUBLE,
+    "TEXT": T.VARCHAR,
+    "VARCHAR": T.VARCHAR,
+    "CHAR": T.VARCHAR,
+    "CLOB": T.VARCHAR,
+    "BOOLEAN": T.BOOLEAN,
+    "DATE": T.DATE,
+}
+
+
+def _decl_to_type(decl: Optional[str]) -> T.Type:
+    if not decl:
+        return T.VARCHAR
+    head = decl.split("(")[0].strip().upper()
+    for key, t in _AFFINITY.items():
+        if key in head:
+            return t
+    return T.VARCHAR
+
+
+class SqliteCatalog(Connector):
+    """path: SQLite database file (or ':memory:' with an existing
+    connection via `conn`)."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str = ":memory:",
+                 conn: Optional[sqlite3.Connection] = None):
+        self.conn = conn or sqlite3.connect(path)
+        self.query_log: List[str] = []  # generated remote SQL (tests/EXPLAIN)
+        self._schemas: Dict[str, Dict[str, T.Type]] = {}
+        self._dicts: Dict[Tuple[str, str], tuple] = {}
+
+    def _exec(self, sql: str, params=()):
+        self.query_log.append(sql)
+        return self.conn.execute(sql, params)
+
+    # -- metadata (reference BaseJdbcClient.getTableNames/getColumns) --
+
+    def table_names(self) -> List[str]:
+        cur = self._exec(
+            "SELECT name FROM sqlite_master WHERE type = 'table' "
+            "AND name NOT LIKE 'sqlite_%' ORDER BY name"
+        )
+        return [r[0] for r in cur.fetchall()]
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        s = self._schemas.get(table)
+        if s is None:
+            cur = self._exec(f'PRAGMA table_info("{table}")')
+            s = {r[1]: _decl_to_type(r[2]) for r in cur.fetchall()}
+            if not s:
+                raise KeyError(f"unknown remote table {table!r}")
+            self._schemas[table] = s
+        return dict(s)
+
+    def row_count(self, table: str) -> int:
+        return self._exec(f'SELECT count(*) FROM "{table}"').fetchone()[0]
+
+    def exact_row_count(self, table: str) -> int:
+        return self.row_count(table)
+
+    def unique_columns(self, table: str):
+        out = []
+        # INTEGER PRIMARY KEY is the rowid alias — present in table_info's
+        # pk column but absent from index_list
+        pk = [
+            r[1]
+            for r in self._exec(f'PRAGMA table_info("{table}")').fetchall()
+            if r[5]
+        ]
+        if len(pk) == 1:
+            out.append((pk[0],))
+        for r in self._exec(f'PRAGMA index_list("{table}")').fetchall():
+            if r[2]:  # unique index
+                cols = [
+                    c[2]
+                    for c in self._exec(
+                        f'PRAGMA index_info("{r[1]}")'
+                    ).fetchall()
+                ]
+                out.append(tuple(cols))
+        return out
+
+    # -- SQL generation (reference QueryBuilder) --
+
+    @staticmethod
+    def _compile_predicate(
+        predicate: Optional[Predicate], schema: Dict[str, T.Type]
+    ) -> Tuple[str, list]:
+        if not predicate:
+            return "", []
+        ops = {"eq": "=", "lt": "<", "le": "<=", "gt": ">", "ge": ">="}
+        clauses, params = [], []
+        for col, op, v in predicate:
+            if col not in schema or op not in ops:
+                continue
+            if hasattr(v, "isoformat"):  # datetime.date
+                v = v.isoformat()
+            if not isinstance(v, (int, float, str)):
+                continue
+            clauses.append(f'"{col}" {ops[op]} ?')
+            params.append(v)
+        return (" WHERE " + " AND ".join(clauses), params) if clauses else ("", [])
+
+    def _dictionary(self, table: str, column: str):
+        key = (table, column)
+        d = self._dicts.get(key)
+        if d is None:
+            cur = self._exec(
+                f'SELECT DISTINCT "{column}" FROM "{table}" '
+                f'WHERE "{column}" IS NOT NULL'
+            )
+            entries = tuple(sorted(str(r[0]) for r in cur.fetchall()))
+            d = (entries, np.array(entries, object))
+            self._dicts[key] = d
+        return d
+
+    # -- data --
+
+    def page(self, table: str) -> Page:
+        return self.scan(table, 0, self.row_count(table))
+
+    def scan(
+        self,
+        table: str,
+        start: int,
+        stop: int,
+        pad_to: Optional[int] = None,
+        columns: Optional[List[str]] = None,
+        predicate: Optional[Predicate] = None,
+    ) -> Page:
+        schema = self.schema(table)
+        names = list(columns) if columns is not None else list(schema)
+        where, params = self._compile_predicate(predicate, schema)
+        col_sql = ", ".join(f'"{c}"' for c in names)
+        limit = max(stop - start, 0)
+        sql = (
+            f'SELECT {col_sql} FROM "{table}"{where} '
+            f"ORDER BY rowid LIMIT {limit} OFFSET {start}"
+        )
+        rows = self._exec(sql, params).fetchall()
+        n = len(rows)
+        blocks = []
+        for i, c in enumerate(names):
+            t = schema[c]
+            vals = [r[i] for r in rows]
+            valid = np.array([v is not None for v in vals], bool)
+            if isinstance(t, T.VarcharType):
+                sorted_d, d_arr = self._dictionary(table, c)
+                data = np.searchsorted(
+                    d_arr,
+                    np.array(
+                        [str(v) if v is not None else "" for v in vals],
+                        object,
+                    ),
+                ).astype(np.int32)
+                data = np.clip(data, 0, max(len(sorted_d) - 1, 0))
+                blk = Block.from_numpy(
+                    data, t,
+                    valid=None if valid.all() else valid,
+                    dictionary=sorted_d or ("",),
+                )
+            elif isinstance(t, T.DateType):
+                import datetime as pydt
+
+                days = np.array(
+                    [
+                        (pydt.date.fromisoformat(v) - pydt.date(1970, 1, 1)).days
+                        if isinstance(v, str)
+                        else (v if v is not None else 0)
+                        for v in vals
+                    ],
+                    np.int32,
+                )
+                blk = Block.from_numpy(
+                    days, t, valid=None if valid.all() else valid
+                )
+            elif isinstance(t, T.DoubleType):
+                data = np.array(
+                    [float(v) if v is not None else 0.0 for v in vals],
+                    np.float64,
+                )
+                blk = Block.from_numpy(
+                    data, t, valid=None if valid.all() else valid
+                )
+            elif isinstance(t, T.BooleanType):
+                data = np.array(
+                    [bool(v) if v is not None else False for v in vals], bool
+                )
+                blk = Block.from_numpy(
+                    data, t, valid=None if valid.all() else valid
+                )
+            else:
+                data = np.array(
+                    [int(v) if v is not None else 0 for v in vals], np.int64
+                )
+                blk = Block.from_numpy(
+                    data, t, valid=None if valid.all() else valid
+                )
+            if pad_to is not None and pad_to > n:
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, names, count=n)
+
+
+class MultiCatalog(Connector):
+    """Federates member catalogs into one flat session namespace
+    (collisions resolve to the FIRST member; the reference mounts each
+    connector under its own catalog name inside MetadataManager —
+    flat-name federation is the minimal equivalent for joins across
+    systems)."""
+
+    name = "federated"
+
+    def __init__(self, members: List[Connector]):
+        self.members = list(members)
+
+    def _owner(self, table: str) -> Connector:
+        for m in self.members:
+            if table in m.table_names():
+                return m
+        raise KeyError(f"unknown table {table!r}")
+
+    def table_names(self) -> List[str]:
+        out: List[str] = []
+        for m in self.members:
+            for t in m.table_names():
+                if t not in out:
+                    out.append(t)
+        return out
+
+    def schema(self, table: str):
+        return self._owner(table).schema(table)
+
+    def row_count(self, table: str) -> int:
+        return self._owner(table).row_count(table)
+
+    def exact_row_count(self, table: str) -> int:
+        return self._owner(table).exact_row_count(table)
+
+    def unique_columns(self, table: str):
+        return self._owner(table).unique_columns(table)
+
+    def column_stats(self, table: str, column: str):
+        return self._owner(table).column_stats(table, column)
+
+    def page(self, table: str) -> Page:
+        return self._owner(table).page(table)
+
+    def scan(self, table: str, start: int, stop: int, pad_to=None,
+             columns=None, predicate=None):
+        return self._owner(table).scan(
+            table, start, stop, pad_to=pad_to, columns=columns,
+            predicate=predicate,
+        )

@@ -30,6 +30,7 @@ class NodeStats:
     rows_out: int = 0
     retries: int = 0
     out_bytes: int = 0  # device bytes of the node's output page (last call)
+    detail: str = ""  # connector-provided annotation (e.g. file pruning)
 
     def line(self) -> str:
         ms = self.wall_s * 1e3
@@ -43,6 +44,8 @@ class NodeStats:
             parts.append(f"{self.calls} calls")
         if self.retries:
             parts.append(f"{self.retries} retries")
+        if self.detail:
+            parts.append(self.detail)
         return "[" + ", ".join(parts) + "]"
 
 

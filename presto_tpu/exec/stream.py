@@ -403,12 +403,20 @@ class StreamingExecutor:
             if n > 0 or start == 0:
                 yield self._rename_scan(node, src)
             start += B
-            if total is not None:
-                if start >= total:
-                    return
-            elif n < B:
-                # short batch marks the table end — only valid without
-                # pruning (predicate hints can legally shorten any batch)
+            done = (start >= total) if total is not None else (n < B)
+            # n < B only marks table end without pruning (predicate hints
+            # can legally shorten any batch)
+            if done:
+                # surface connector pruning in EXPLAIN ANALYZE (reference:
+                # the hive split source reports skipped partitions)
+                skipped = getattr(
+                    self.catalog, "last_scan_files_skipped", None
+                )
+                if skipped and self.collector is not None:
+                    read = getattr(self.catalog, "last_scan_files_read", 0)
+                    self.collector.stats_for(node).detail = (
+                        f"files: {read} read, {skipped} pruned"
+                    )
                 return
 
     @staticmethod
@@ -451,7 +459,122 @@ class StreamingExecutor:
         page = batches[0] if len(batches) == 1 else concat_pages(batches)
         return "device", (page, held)
 
+    def _bucket_side_info(self, side: N.PlanNode):
+        """(scan_node, wrappers, (bucket_cols, count)) when `side` is a
+        Filter/Project chain over a TableScan of a BUCKETED table
+        (reference: bucketed table detection feeding
+        GROUPED_EXECUTION/Lifespan scheduling)."""
+        wrappers = []
+        n = side
+        while isinstance(n, (N.Filter, N.Project)):
+            wrappers.append(n)
+            n = n.child
+        if not isinstance(n, N.TableScan):
+            return None
+        bucketing = getattr(self.catalog, "bucketing", None)
+        if bucketing is None:
+            return None
+        spec = bucketing(n.table)
+        if spec is None:
+            return None
+        return n, tuple(reversed(wrappers)), spec
+
+    def _grouped_join_spec(self, node: N.Join):
+        """Detect a co-located bucket join: both sides bucketed with the
+        same bucket count, and the equi-join keys are exactly the bucket
+        columns (single-column buckets — the common spec)."""
+        li = self._bucket_side_info(node.left)
+        ri = self._bucket_side_info(node.right)
+        if li is None or ri is None:
+            return None
+        (lscan, lwrap, (lcols, lcount)) = li
+        (rscan, rwrap, (rcols, rcount)) = ri
+        if lcount != rcount or len(lcols) != 1 or len(rcols) != 1:
+            return None
+
+        def key_matches(keys, scan, bucket_col):
+            src = {ch: col for ch, col, _ in scan.columns}
+            for k in keys:
+                if isinstance(k, ir.ColumnRef) and src.get(k.name) == bucket_col:
+                    return True
+            return False
+
+        if not key_matches(node.left_keys, lscan, lcols[0]):
+            return None
+        if not key_matches(node.right_keys, rscan, rcols[0]):
+            return None
+        return (lscan, lwrap), (rscan, rwrap), lcount
+
+    def _stream_side_bucket(
+        self, scan_node: N.TableScan, wrappers, bucket: int
+    ) -> Iterator[Page]:
+        """Batches of ONE bucket of a side, with its Filter/Project chain
+        re-applied per batch."""
+        cols = [col for _, col, _ in scan_node.columns]
+        for lo, hi in self.catalog.bucket_row_ranges(scan_node.table, bucket):
+            for s in range(lo, hi, self.batch_rows):
+                src = self.catalog.scan(
+                    scan_node.table, s, min(s + self.batch_rows, hi),
+                    columns=cols,
+                )
+                page = self._rename_scan(scan_node, src)
+                for w in wrappers:
+                    page = self.local.exec_node(w, page)
+                yield page
+
+    def _grouped_bucket_join(self, node: N.Join, spec) -> Iterator[Page]:
+        """Bucket-at-a-time execution (reference Lifespan.driverGroup +
+        PipelineExecutionStrategy.GROUPED_EXECUTION): bucket i's build and
+        probe run end-to-end before bucket i+1, bounding resident HBM to
+        one bucket's build side."""
+        (lscan, lwrap), (rscan, rwrap), count = spec
+        right_names = tuple(n for n, _ in node.right.fields)
+        for b in range(count):
+            build_batches = [
+                p
+                for p in self._stream_side_bucket(rscan, rwrap, b)
+                if int(p.count) > 0
+            ]
+            if not build_batches:
+                continue  # inner join: an empty build bucket matches nothing
+            # a skewed bucket can still exceed the budget: probe it in
+            # build sub-chunks (inner joins distribute over build chunks —
+            # the same contract as the host-offload path)
+            chunks: List[List[Page]] = [[]]
+            held = 0
+            for p in build_batches:
+                nb = page_device_bytes(p)
+                if chunks[-1] and not self.pool.can_reserve(held + nb):
+                    chunks.append([])
+                    held = 0
+                chunks[-1].append(p)
+                held += nb
+            for chunk in chunks:
+                build_page = (
+                    chunk[0] if len(chunk) == 1 else concat_pages(chunk)
+                )
+                nb = page_device_bytes(build_page)
+                self.pool.reserve(nb, f"bucket {b} build side")
+                try:
+                    yield from self._probe_stream(
+                        node,
+                        build_page,
+                        right_names,
+                        probe=self._stream_side_bucket(lscan, lwrap, b),
+                    )
+                finally:
+                    self.pool.free(nb)
+
     def _stream_join(self, node: N.Join) -> Iterator[Page]:
+        # grouped execution covers INNER joins (a LEFT join with an empty
+        # build bucket would need schema-only null extension)
+        grouped = (
+            self._grouped_join_spec(node) if node.kind == "inner" else None
+        )
+        if grouped is not None:
+            self.spill_events.append("grouped_bucket_join")
+            yield from self._grouped_bucket_join(node, grouped)
+            return
         kind, side = self._collect_side(node.right)
         right_names = tuple(n for n, _ in node.right.fields)
         if kind == "device":
@@ -485,10 +608,10 @@ class StreamingExecutor:
                 self.pool.free(nb)
 
     def _probe_stream(
-        self, node: N.Join, right_page: Page, right_names
+        self, node: N.Join, right_page: Page, right_names, probe=None
     ) -> Iterator[Page]:
         bs = build(right_page, node.right_keys)
-        for batch in self.stream(node.left):
+        for batch in (probe if probe is not None else self.stream(node.left)):
             if node.unique_build:
                 out = join_n1(
                     batch, bs, node.left_keys, right_names, right_names,

@@ -2083,3 +2083,8 @@ def _sequence(a: Val, b: Val, *rest, out_type: T.Type) -> Val:
     data = jnp.broadcast_to(row[None, :], (cap, len(values)))
     lengths = jnp.full(cap, n_elem, jnp.int32)
     return Val(data, None, out_type, lengths=lengths)
+
+
+# breadth families (math/bitwise/string/digest/array/json tail) register on
+# import — keep last so they can reuse every helper above
+from . import functions_ext  # noqa: E402,F401  (registration side effects)

@@ -45,6 +45,7 @@ SUPPORTED = (
     "min_by", "max_by", "percentile",
     "array_agg", "map_agg", "histogram",
     "approx_distinct", "hll_registers", "hll_merge",
+    "qsketch", "qsketch_merge",
 )
 
 
@@ -549,7 +550,8 @@ def grouped_aggregate_direct(
     by_keys = _eval_by_keys(page, aggs)
     for spec, v, bk in zip(aggs, ins, by_keys):
         if spec.func in COLLECTION_AGGS or spec.func in (
-            "approx_distinct", "hll_registers", "hll_merge"
+            "approx_distinct", "hll_registers", "hll_merge",
+            "qsketch", "qsketch_merge",
         ):
             raise NotImplementedError(
                 f"{spec.func} runs through the SORT aggregation strategy"
@@ -727,6 +729,24 @@ def grouped_aggregate_sorted(
             blocks.append(Block(regs, T.ArrayType(T.TINYINT), None))
             names.append(spec.name)
             continue
+        if spec.func in ("qsketch", "qsketch_merge"):
+            from . import qsketch as qs
+
+            data_s = v.data[order]
+            contributes = live_s if v.valid is None else (
+                live_s & v.valid[order]
+            )
+            if spec.func == "qsketch":
+                sk = qs.group_sketch(
+                    data_s, contributes, gid_s, max_groups + 1
+                )[:max_groups]
+            else:
+                sk = qs.merge_sketches(
+                    data_s, contributes, gid_s, max_groups + 1
+                )[:max_groups]
+            blocks.append(Block(sk, T.ArrayType(T.BIGINT), None))
+            names.append(spec.name)
+            continue
         if spec.func in ("min_by", "max_by", "percentile"):
             v_sorted = Val(
                 v.data[order],
@@ -831,6 +851,25 @@ class HllPost:
         return self.reg_col
 
 
+@dataclasses.dataclass(frozen=True)
+class QSketchPost:
+    """Post-exchange step: name = percentile read off the merged quantile
+    sketch (ops/qsketch.py — the mergeable approx_percentile path)."""
+
+    name: str
+    sketch_col: str
+    fraction: float
+    output_type: T.Type
+
+    @property
+    def sum_col(self):
+        return self.sketch_col
+
+    @property
+    def cnt_col(self):
+        return self.sketch_col
+
+
 def decompose_partial(aggs: Sequence[AggSpec]):
     """Returns (partial_specs, final_specs, post_steps, final_keep_names).
 
@@ -865,6 +904,19 @@ def decompose_partial(aggs: Sequence[AggSpec]):
                 AggSpec("hll_merge", ColumnRef(r_name, reg_t), r_name, reg_t)
             )
             post.append(HllPost(a.name, r_name))
+        elif a.func == "percentile":
+            # distributed approx_percentile goes through the MERGEABLE
+            # log-histogram sketch (ops/qsketch.py) instead of exact
+            # per-node selection — the qdigest role (reference
+            # ApproximateLongPercentileAggregations + QuantileDigest)
+            sk_t = T.ArrayType(T.BIGINT)
+            s_name = f"{a.name}$qsk"
+            frac = float(a.input2.value)
+            partial.append(AggSpec("qsketch", a.input, s_name, sk_t))
+            final.append(
+                AggSpec("qsketch_merge", ColumnRef(s_name, sk_t), s_name, sk_t)
+            )
+            post.append(QSketchPost(a.name, s_name, frac, a.output_type))
         else:
             raise KeyError(f"cannot decompose aggregate {a.func!r}")
     return tuple(partial), tuple(final), tuple(post)
@@ -892,6 +944,20 @@ def apply_avg_post(page: Page, aggs: Sequence[AggSpec], post: Sequence[AvgPost])
         if isinstance(p, HllPost):
             regs = page.block(p.reg_col).data
             blocks.append(Block(hll_estimate(regs), T.BIGINT, None))
+            names.append(a.name)
+            continue
+        if isinstance(p, QSketchPost):
+            from . import qsketch as qs
+
+            sk = page.block(p.sketch_col).data
+            vals = qs.percentile_value(sk, p.fraction)
+            valid = jnp.sum(sk, axis=1) > 0
+            out_t = p.output_type
+            if T.is_floating(out_t):
+                data = vals.astype(out_t.storage_dtype)
+            else:
+                data = jnp.round(vals).astype(out_t.storage_dtype)
+            blocks.append(Block(data, out_t, valid))
             names.append(a.name)
             continue
         s = page.block(p.sum_col).data
@@ -924,7 +990,8 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
             names.append(spec.name)
             continue
         if spec.func in COLLECTION_AGGS or spec.func in (
-            "approx_distinct", "hll_registers", "hll_merge"
+            "approx_distinct", "hll_registers", "hll_merge",
+            "qsketch", "qsketch_merge",
         ):
             gid0 = jnp.zeros(page.capacity, jnp.int32)
             live0 = live
@@ -956,6 +1023,21 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
             elif spec.func == "hll_merge":
                 regs = hll_merge_registers(v_s.data, live0[order0], gid_s0, 2)[:1]
                 blk = Block(regs, T.ArrayType(T.TINYINT), None)
+            elif spec.func in ("qsketch", "qsketch_merge"):
+                from . import qsketch as qs
+
+                contributes0 = live0[order0] if v.valid is None else (
+                    live0[order0] & v_s.valid_mask()
+                )
+                if spec.func == "qsketch":
+                    sk = qs.group_sketch(
+                        v_s.data, contributes0, gid_s0, 2
+                    )[:1]
+                else:
+                    sk = qs.merge_sketches(
+                        v_s.data, contributes0, gid_s0, 2
+                    )[:1]
+                blk = Block(sk, T.ArrayType(T.BIGINT), None)
             else:
                 contributes0 = live0[order0] if v.valid is None else (
                     live0[order0] & v_s.valid_mask()

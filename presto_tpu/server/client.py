@@ -17,16 +17,36 @@ class QueryError(RuntimeError):
 
 
 class Client:
-    def __init__(self, server_uri: str, timeout: float = 30.0):
+    def __init__(self, server_uri: str, timeout: float = 30.0,
+                 user: Optional[str] = None, password: Optional[str] = None,
+                 cafile: Optional[str] = None):
+        """user/password: Basic credentials for an authenticating
+        coordinator; cafile: CA bundle pinning an https coordinator
+        (reference StatementClient auth + OkHttp TLS setup)."""
         self.server = server_uri.rstrip("/")
         self.timeout = timeout
+        self.user = user
+        self.password = password
+        self._ssl_ctx = None
+        if self.server.startswith("https"):
+            from .auth import client_ssl_context
+
+            self._ssl_ctx = client_ssl_context(cafile)
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None):
         import urllib.error
 
         req = urllib.request.Request(url, data=body, method=method)
+        if self.user is not None and self.password is not None:
+            from .auth import basic_auth_header
+
+            req.add_header(
+                "Authorization", basic_auth_header(self.user, self.password)
+            )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl_ctx
+            ) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
             # coordinator errors carry JSON bodies (404 unknown query,

@@ -53,7 +53,7 @@ class CoordinatorServer:
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: int = 1, resource_groups=None,
                  selectors=None, listeners=None, node_manager=None,
-                 access_control=None):
+                 access_control=None, authenticator=None, tls=None):
         # expose system.runtime.* through the served session's catalog
         # (reference connector/system/; the user's own session is untouched).
         # Duck-typed sessions (HttpClusterSession) are served as-is — they
@@ -86,6 +86,8 @@ class CoordinatorServer:
             self.syscat.node_manager = node_manager
         self.started_at = time.time()
         self.shutting_down = False
+        self.authenticator = authenticator
+        self.tls = tls
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -93,6 +95,40 @@ class CoordinatorServer:
 
             def log_message(self, fmt, *args):  # quiet
                 pass
+
+            def _authenticate(self):
+                """With an authenticator installed, the principal comes
+                from Basic credentials and X-Presto-User must match it —
+                the header alone is no longer trusted (reference
+                server/security + password authenticators). Returns the
+                authenticated user, or None after sending 401."""
+                if outer.authenticator is None:
+                    return self.headers.get("X-Presto-User", "user")
+                from .auth import AuthenticationError, parse_basic_auth
+
+                creds = parse_basic_auth(self.headers.get("Authorization"))
+                if creds is None:
+                    self.send_response(401)
+                    self.send_header(
+                        "WWW-Authenticate", 'Basic realm="presto"'
+                    )
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return None
+                try:
+                    principal = outer.authenticator.authenticate(*creds)
+                except AuthenticationError as e:
+                    self._send(401, {"error": str(e)})
+                    return None
+                asserted = self.headers.get("X-Presto-User")
+                if asserted and asserted != principal:
+                    self._send(
+                        403,
+                        {"error": f"user {asserted!r} does not match "
+                                  f"authenticated principal {principal!r}"},
+                    )
+                    return None
+                return principal
 
             # -- helpers --
             def _send(self, code: int, payload, content_type="application/json"):
@@ -118,7 +154,9 @@ class CoordinatorServer:
                         self._send(503, {"error": "shutting down"})
                         return
                     sql = self._read_body().decode()
-                    user = self.headers.get("X-Presto-User", "user")
+                    user = self._authenticate()
+                    if user is None:
+                        return
                     source = self.headers.get("X-Presto-Source")
                     props_hdr = self.headers.get("X-Presto-Session", "")
                     try:
@@ -138,6 +176,13 @@ class CoordinatorServer:
 
             def do_GET(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
+                # health/status stays unauthenticated (load balancers +
+                # cluster heartbeats); every data-bearing surface requires
+                # the principal
+                if parts[:2] not in (["v1", "info"], ["v1", "status"]) and (
+                    self._authenticate() is None
+                ):
+                    return
                 qs = {}
                 if "?" in self.path:
                     for kv in self.path.split("?", 1)[1].split("&"):
@@ -218,6 +263,8 @@ class CoordinatorServer:
                 self._send(404, {"error": "not found"})
 
             def do_DELETE(self):
+                if self._authenticate() is None:
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if parts[:2] == ["v1", "statement"] and len(parts) == 3:
                     ok = outer.manager.cancel(parts[2])
@@ -228,6 +275,10 @@ class CoordinatorServer:
             def do_PUT(self):
                 if self.path == "/v1/info/state":
                     body = self._read_body().decode().strip().strip('"')
+                    # shutdown is privileged: authenticate first (body is
+                    # already drained so a 401 leaves the stream clean)
+                    if self._authenticate() is None:
+                        return
                     if body == "SHUTTING_DOWN":
                         outer.shutting_down = True  # drain: reject new queries
                         self._send(200, {"state": "SHUTTING_DOWN"})
@@ -235,9 +286,17 @@ class CoordinatorServer:
                 self._send(404, {"error": "not found"})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls is not None:
+            from .auth import server_ssl_context
+
+            certfile, keyfile = tls
+            self._httpd.socket = server_ssl_context(
+                certfile, keyfile
+            ).wrap_socket(self._httpd.socket, server_side=True)
         self.host, self.port = self._httpd.server_address
+        self.scheme = "https" if tls is not None else "http"
         if self.syscat is not None:
-            self.syscat.self_uri = f"http://{self.host}:{self.port}"
+            self.syscat.self_uri = f"{self.scheme}://{self.host}:{self.port}"
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -298,7 +357,7 @@ state {"SHUTTING_DOWN" if self.shutting_down else "ACTIVE"}</p>
         }
 
     def _query_results(self, info, token: int) -> dict:
-        base = f"http://{self.host}:{self.port}"
+        base = f"{self.scheme}://{self.host}:{self.port}"
         out = {
             "id": info.query_id,
             "infoUri": f"{base}/v1/query/{info.query_id}",
@@ -331,4 +390,4 @@ state {"SHUTTING_DOWN" if self.shutting_down else "ACTIVE"}</p>
 
     @property
     def uri(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        return f"{self.scheme}://{self.host}:{self.port}"

@@ -53,6 +53,7 @@ REWRITE_AGG_FUNCS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "count_if", "bool_and", "bool_or", "every", "arbitrary",
     "geometric_mean", "covar_samp", "covar_pop", "corr",
+    "skewness", "kurtosis",
 }
 
 _BINOP_FN = {
@@ -1186,6 +1187,98 @@ class Planner:
             var = c("divide", num, nd)
             out = var if fname == "var_pop" else c("sqrt", var)
             return null_if_under(n, 1, out)
+        if fname in ("skewness", "kurtosis"):
+            # central moments from raw power sums (reference
+            # CentralMomentsAggregation): m2/m3/m4 are SUMS of centered
+            # powers; skewness = sqrt(n) m3 / m2^1.5, kurtosis (excess)
+            # = n m4 / m2^2 - 3; NULL under 3 (resp. 4) rows
+            x = masked(ir.cast(sctx.translate(call.args[0]), D))
+            s1 = emit("sum", x, "s1")
+            s2 = emit("sum", c("multiply", x, x), "s2")
+            s3 = emit("sum", c("multiply", c("multiply", x, x), x), "s3")
+            n = emit("count", x, "cnt")
+            nd = ir.cast(n, D)
+            m2 = c("subtract", s2, c("divide", c("multiply", s1, s1), nd))
+            if fname == "skewness":
+                m3 = c(
+                    "add",
+                    c(
+                        "subtract",
+                        s3,
+                        c(
+                            "divide",
+                            c("multiply", dlit(3.0), c("multiply", s1, s2)),
+                            nd,
+                        ),
+                    ),
+                    c(
+                        "divide",
+                        c(
+                            "multiply",
+                            dlit(2.0),
+                            c("multiply", s1, c("multiply", s1, s1)),
+                        ),
+                        c("multiply", nd, nd),
+                    ),
+                )
+                out = c(
+                    "divide",
+                    c("multiply", c("sqrt", nd), m3),
+                    c("power", m2, dlit(1.5)),
+                )
+                return null_if_under(n, 3, out)
+            s4 = emit(
+                "sum",
+                c("multiply", c("multiply", x, x), c("multiply", x, x)),
+                "s4",
+            )
+            m4 = c(
+                "subtract",
+                c(
+                    "add",
+                    c(
+                        "subtract",
+                        s4,
+                        c(
+                            "divide",
+                            c("multiply", dlit(4.0), c("multiply", s1, s3)),
+                            nd,
+                        ),
+                    ),
+                    c(
+                        "divide",
+                        c(
+                            "multiply",
+                            dlit(6.0),
+                            c("multiply", c("multiply", s1, s1), s2),
+                        ),
+                        c("multiply", nd, nd),
+                    ),
+                ),
+                c(
+                    "divide",
+                    c(
+                        "multiply",
+                        dlit(3.0),
+                        c(
+                            "multiply",
+                            c("multiply", s1, s1),
+                            c("multiply", s1, s1),
+                        ),
+                    ),
+                    c("multiply", nd, c("multiply", nd, nd)),
+                ),
+            )
+            out = c(
+                "subtract",
+                c(
+                    "divide",
+                    c("multiply", nd, m4),
+                    c("multiply", m2, m2),
+                ),
+                dlit(3.0),
+            )
+            return null_if_under(n, 4, out)
         if fname == "count_if":
             p = sctx.translate(call.args[0])
             inp = masked(
