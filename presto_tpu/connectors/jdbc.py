@@ -185,6 +185,46 @@ class SqliteCatalog(Connector):
             f"ORDER BY rowid LIMIT {limit} OFFSET {start}"
         )
         rows = self._exec(sql, params).fetchall()
+        return self._rows_to_page(table, rows, names, schema, pad_to)
+
+    def supports_index(self, table: str, column: str) -> bool:
+        """True when the remote side can serve point lookups on `column`
+        (the ConnectorIndex capability, reference spi ConnectorResolvedIndex
+        + operator/index/IndexLoader): any indexed or primary-key column."""
+        for cols in self.unique_columns(table):
+            if cols == (column,):
+                return True
+        for r in self._exec(f'PRAGMA index_list("{table}")').fetchall():
+            cols = [
+                c[2]
+                for c in self._exec(f'PRAGMA index_info("{r[1]}")').fetchall()
+            ]
+            if cols == [column]:
+                return True
+        return False
+
+    def index_lookup(self, table: str, column: str, keys, columns):
+        """Rows whose `column` is in `keys` — the index-join fetch
+        (reference IndexLoader.streamIndexDataForSingleKey): generated SQL
+        uses IN batches instead of a full scan."""
+        schema = self.schema(table)
+        names = list(columns) if columns is not None else list(schema)
+        col_sql = ", ".join(f'"{c}"' for c in names)
+        rows = []
+        ks = list(keys)
+        for i in range(0, len(ks), 500):  # SQLite bind-parameter budget
+            chunk = ks[i : i + 500]
+            marks = ", ".join("?" * len(chunk))
+            rows.extend(
+                self._exec(
+                    f'SELECT {col_sql} FROM "{table}" '
+                    f'WHERE "{column}" IN ({marks})',
+                    [k.item() if hasattr(k, "item") else k for k in chunk],
+                ).fetchall()
+            )
+        return self._rows_to_page(table, rows, names, schema, None)
+
+    def _rows_to_page(self, table, rows, names, schema, pad_to):
         n = len(rows)
         blocks = []
         for i, c in enumerate(names):
@@ -299,3 +339,11 @@ class MultiCatalog(Connector):
             table, start, stop, pad_to=pad_to, columns=columns,
             predicate=predicate,
         )
+
+    def supports_index(self, table: str, column: str) -> bool:
+        m = self._owner(table)
+        fn = getattr(m, "supports_index", None)
+        return bool(fn and fn(table, column))
+
+    def index_lookup(self, table: str, column: str, keys, columns):
+        return self._owner(table).index_lookup(table, column, keys, columns)

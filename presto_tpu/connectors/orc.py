@@ -6,9 +6,16 @@ orc/OrcRecordReader.java:70) collapsed the same way as the parquet
 connector: pyarrow.orc decodes stripes on the host, the shared
 arrow_table_to_page maps them onto the engine's Block layout (dictionary
 strings over a cached file-level sorted dictionary, decimal128 as two
-lanes). The scan maps row ranges onto stripes (the stripe is the ORC
-row-group analog); pyarrow exposes no per-stripe column statistics, so
-predicate hints are accepted but not used for pruning.
+lanes).
+
+Stripe statistics + pruning (reference TupleDomainOrcPredicate +
+StripeReader's row-group index): pyarrow's Python API exposes stripe
+COUNTS but not their column statistics, so the connector maintains a
+`<file>.stats.json` SIDECAR — per-stripe row counts and column min/max,
+written alongside files this catalog writes and derived once (then
+cached) for foreign files. scan() uses it twice: stripe offsets come
+from the sidecar (no decode of pre-range stripes), and stripes whose
+min/max refute a predicate hint are skipped entirely.
 """
 
 from __future__ import annotations
@@ -52,8 +59,24 @@ class OrcCatalog(FileWriteMixin, WritableConnector):
             self._files[table] = f
         return f
 
+    def _invalidate(self, table: str) -> None:
+        super()._invalidate(table)
+        cache = getattr(self, "_stripe_stats_cache", None)
+        if cache is not None:
+            cache.pop(table, None)
+
     def _encode_write(self, arrow_table, path: str) -> None:
         self._orc.write_table(arrow_table, path)
+        # emit the stripe-statistics sidecar with the file, so readers
+        # never pay the derive-by-reading pass for files we wrote
+        import json
+
+        try:
+            stats = _derive_stripe_stats(self._orc.ORCFile(path))
+            with open(path + ".stats.json", "w") as fh:
+                json.dump(stats, fh)
+        except OSError:
+            pass
 
     def _read_all(self, table: str):
         return self._file(table).read()
@@ -94,6 +117,82 @@ class OrcCatalog(FileWriteMixin, WritableConnector):
     def page(self, table: str) -> Page:
         return self.scan(table, 0, self.row_count(table))
 
+    def _stats_path(self, table: str) -> str:
+        return self.paths[table] + ".stats.json"
+
+    def stripe_stats(self, table: str) -> List[dict]:
+        """[{rows, min: {col: v}, max: {col: v}}, ...] per stripe, from
+        the sidecar (written by our writer / derived once for foreign
+        files). Values are JSON-native; dates serialize as ISO strings,
+        which order correctly under string comparison."""
+        cache = getattr(self, "_stripe_stats_cache", None)
+        if cache is None:
+            cache = self._stripe_stats_cache = {}
+        got = cache.get(table)
+        if got is not None:
+            return got
+        import json
+        import os
+
+        path = self.paths[table]
+        side = self._stats_path(table)
+        if os.path.exists(side) and os.path.getmtime(side) >= os.path.getmtime(path):
+            with open(side) as fh:
+                got = json.load(fh)
+        else:
+            got = _derive_stripe_stats(self._orc.ORCFile(path))
+            try:
+                with open(side, "w") as fh:
+                    json.dump(got, fh)
+            except OSError:
+                pass  # read-only location: keep in memory only
+        cache[table] = got
+        return got
+
+    @staticmethod
+    def _stripe_refuted(st: dict, predicate: Predicate) -> bool:
+        """True when the stripe's min/max refute ANY conjunct (reference
+        TupleDomainOrcPredicate.matches)."""
+        import decimal as _dec
+
+        def numeric_bound(b, v):
+            # decimal bounds are stored as exact strings; re-parse them
+            # when compared against a numeric hint value
+            if isinstance(b, str) and isinstance(
+                v, (int, float, _dec.Decimal)
+            ):
+                try:
+                    return _dec.Decimal(b)
+                except _dec.InvalidOperation:
+                    return b
+            return b
+
+        for col, op, value in predicate:
+            mn = st["min"].get(col)
+            mx = st["max"].get(col)
+            if mn is None or mx is None:
+                continue
+            if hasattr(value, "isoformat"):
+                value = value.isoformat()
+            if isinstance(value, bool):
+                value = int(value)
+            mn = numeric_bound(mn, value)
+            mx = numeric_bound(mx, value)
+            try:
+                if op == "eq" and (value < mn or value > mx):
+                    return True
+                if op == "lt" and mn >= value:
+                    return True
+                if op == "le" and mn > value:
+                    return True
+                if op == "gt" and mx <= value:
+                    return True
+                if op == "ge" and mx < value:
+                    return True
+            except TypeError:
+                continue  # incomparable: keep the stripe
+        return False
+
     def scan(
         self,
         table: str,
@@ -114,31 +213,72 @@ class OrcCatalog(FileWriteMixin, WritableConnector):
                 tb, names, 0, pad_to,
                 lambda name: self._dictionary(table, name),
             )
-        # map [start, stop) onto stripes
+        stats = self.stripe_stats(table)
         pieces = []
         offset = 0
-        for s in range(f.nstripes):
-            if offset >= stop:
-                break
-            # pyarrow exposes stripe boundaries only by reading; stripes
-            # before `start` are read and dropped (no stripe metadata API)
-            st = f.read_stripe(s, columns=names)
-            s_start, s_stop = offset, offset + st.num_rows
+        read = skipped = 0
+        for s, st in enumerate(stats):
+            s_start, s_stop = offset, offset + st["rows"]
             offset = s_stop
-            if s_stop <= start:
+            if s_stop <= start or s_start >= stop:
                 continue
+            if predicate and self._stripe_refuted(st, predicate):
+                skipped += 1
+                continue
+            read += 1
+            tbl = f.read_stripe(s, columns=names)
             lo = max(start - s_start, 0)
-            hi = min(stop - s_start, st.num_rows)
+            hi = min(stop - s_start, tbl.num_rows)
             if hi > lo:
-                pieces.append(st.slice(lo, hi - lo))
+                pieces.append(tbl.slice(lo, hi - lo))
+        # pruning observability (stream executor surfaces these counters
+        # in EXPLAIN ANALYZE; units here are STRIPES)
+        self.last_scan_files_read = read
+        self.last_scan_files_skipped = skipped
         if pieces:
             tb = pa.Table.from_batches(pieces)
         else:
-            tb = f.read(columns=names).slice(0, 0)
+            # every overlapping stripe pruned: schema-only empty table
+            tb = f.schema.empty_table().select(names)
         return arrow_table_to_page(
             tb, names, tb.num_rows, pad_to,
             lambda name: self._dictionary(table, name),
         )
+
+
+def _derive_stripe_stats(f) -> List[dict]:
+    """Read each stripe once and record rows + per-column min/max for
+    primitive columns (the sidecar payload)."""
+    import pyarrow.compute as pc
+
+    out = []
+    for s in range(f.nstripes):
+        tbl = f.read_stripe(s)
+        mins: Dict[str, object] = {}
+        maxs: Dict[str, object] = {}
+        for name in tbl.schema.names:
+            col = tbl.column(name) if hasattr(tbl, "column") else None
+            try:
+                mm = pc.min_max(col)
+                mn = mm["min"].as_py()
+                mx = mm["max"].as_py()
+            except Exception:  # noqa: BLE001 - non-orderable column
+                continue
+            for label, v in (("min", mn), ("max", mx)):
+                if v is None:
+                    continue
+                if hasattr(v, "isoformat"):
+                    v = v.isoformat()
+                elif str(type(v).__name__) == "Decimal":
+                    # floats would round the bound and could prune stripes
+                    # containing boundary rows — keep decimals exact; the
+                    # comparator re-parses (hints carry Decimal values)
+                    v = str(v)
+                elif isinstance(v, (bytes, bytearray)):
+                    continue
+                (mins if label == "min" else maxs)[name] = v
+        out.append({"rows": tbl.num_rows, "min": mins, "max": maxs})
+    return out
 
 
 def write_table_orc(page, path: str, stripe_size: int = 1 << 16):

@@ -394,10 +394,23 @@ class StreamingExecutor:
             # drop the (optional) hint rather than risk dropped rows
             predicate = None
         start = 0
+        read_total = skipped_total = 0
         while True:
             src = scan(
                 node.table, start, start + B, pad_to=B,
                 columns=cols, predicate=predicate,
+            )
+            # connector pruning counters are per scan CALL; take the max
+            # across batches — exact for partition pruning (every call sees
+            # the full file set) and a per-batch high-water for stripe
+            # pruning (each call only sees its range)
+            skipped_total = max(
+                skipped_total,
+                getattr(self.catalog, "last_scan_files_skipped", 0) or 0,
+            )
+            read_total = max(
+                read_total,
+                getattr(self.catalog, "last_scan_files_read", 0) or 0,
             )
             n = int(src.count)
             if n > 0 or start == 0:
@@ -409,13 +422,10 @@ class StreamingExecutor:
             if done:
                 # surface connector pruning in EXPLAIN ANALYZE (reference:
                 # the hive split source reports skipped partitions)
-                skipped = getattr(
-                    self.catalog, "last_scan_files_skipped", None
-                )
-                if skipped and self.collector is not None:
-                    read = getattr(self.catalog, "last_scan_files_read", 0)
+                if skipped_total and self.collector is not None:
                     self.collector.stats_for(node).detail = (
-                        f"files: {read} read, {skipped} pruned"
+                        f"files: {read_total} read, "
+                        f"{skipped_total} pruned"
                     )
                 return
 
@@ -565,7 +575,64 @@ class StreamingExecutor:
                 finally:
                     self.pool.free(nb)
 
+    def _index_join_spec(self, node: N.Join):
+        """Index join (reference operator/index/ IndexLoader +
+        IndexJoinOptimizer): when the build side is a bare TableScan of a
+        connector that can serve point lookups on the single equi-key,
+        fetch ONLY the build rows matching each probe batch's keys instead
+        of scanning the build table."""
+        if not isinstance(node.right, N.TableScan):
+            return None
+        if len(node.right_keys) != 1 or len(node.left_keys) != 1:
+            return None
+        rkey, lkey = node.right_keys[0], node.left_keys[0]
+        if not isinstance(rkey, ir.ColumnRef) or not isinstance(
+            lkey, ir.ColumnRef
+        ):
+            return None
+        # block values are ENCODED (varchar = dictionary codes, date = day
+        # offsets) — only integral keys survive the trip to remote SQL
+        if not (T.is_integral(rkey.type) and T.is_integral(lkey.type)):
+            return None
+        scan = node.right
+        src = {ch: col for ch, col, _ in scan.columns}
+        col = src.get(rkey.name)
+        supports = getattr(self.catalog, "supports_index", None)
+        if col is None or supports is None or not supports(scan.table, col):
+            return None
+        # cost gate (reference IndexJoinOptimizer): point lookups beat a
+        # build-side scan only when the build table is large relative to a
+        # probe batch's worth of keys
+        if self.catalog.row_count(scan.table) < 4 * self.batch_rows:
+            return None
+        return scan, col, lkey.name
+
+    def _stream_index_join(self, node: N.Join, spec) -> Iterator[Page]:
+        scan, index_col, probe_ch = spec
+        right_names = tuple(n for n, _ in node.right.fields)
+        cols = [col for _, col, _ in scan.columns]
+        for batch in self.stream(node.left):
+            blk = batch.block(probe_ch)
+            m = int(batch.count)
+            keys = np.asarray(blk.data[:m])
+            if blk.valid is not None:
+                keys = keys[np.asarray(blk.valid[:m])]
+            keys = np.unique(keys)
+            rows = self.catalog.index_lookup(
+                scan.table, index_col, keys.tolist(), cols
+            )
+            build_page = self._rename_scan(scan, rows)
+            yield from self._probe_stream(
+                node, build_page, right_names, probe=iter([batch])
+            )
+
     def _stream_join(self, node: N.Join) -> Iterator[Page]:
+        if node.kind == "inner":
+            idx = self._index_join_spec(node)
+            if idx is not None:
+                self.spill_events.append("index_join")
+                yield from self._stream_index_join(node, idx)
+                return
         # grouped execution covers INNER joins (a LEFT join with an empty
         # build bucket would need schema-only null extension)
         grouped = (

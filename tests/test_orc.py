@@ -57,3 +57,35 @@ def test_streaming_from_orc(catalogs):
     got = Session(oc, streaming=True, batch_rows=512).query(sql).rows()
     want = Session(tpch).query(sql).rows()
     assert got == want
+
+
+def test_stripe_stats_pruning(tmp_path):
+    """Sidecar stripe statistics prune stripes the predicate refutes
+    (reference TupleDomainOrcPredicate): a range filter over a sorted
+    column must read only the overlapping stripes."""
+    import numpy as np
+
+    from presto_tpu.connectors.orc import OrcCatalog, write_table_orc
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    n = 40_000
+    page = Page.from_dict(
+        {"k": np.arange(n, dtype=np.int64), "v": np.arange(n) % 97}
+    )
+    path = str(tmp_path / "sorted.orc")
+    write_table_orc(page, path, stripe_size=1 << 14)
+    cat = OrcCatalog({"t": path})
+    stats = cat.stripe_stats("t")
+    assert len(stats) > 3, "need multiple stripes for a pruning test"
+    assert sum(s["rows"] for s in stats) == n
+    sess = Session(cat, streaming=True, batch_rows=4096)
+    rows = sess.query(
+        "select count(*) c, sum(v) s from t where k >= 38000"
+    ).rows()
+    assert rows[0][0] == 2000
+    assert rows[0][1] == sum(k % 97 for k in range(38000, n))
+    assert cat.last_scan_files_skipped > 0
+    # the sidecar round-trips through disk
+    cat2 = OrcCatalog({"t": path})
+    assert cat2.stripe_stats("t") == stats
