@@ -1026,3 +1026,80 @@ def _word_stem(a: Val, out_type: T.Type) -> Val:
         return s
 
     return _dict_transform(a, stem)
+
+
+# ---------------------------------------------------------------------------
+# geospatial toolkit (reference presto-geospatial / GeoFunctions.java +
+# presto-geospatial-toolkit) — POINT-only subset, TPU-first: a point is an
+# expression-layer ARRAY(DOUBLE) [x, y] (the same lanes-representation the
+# engine uses for arrays), so every function below is a fused jnp kernel.
+# Polygon/linestring machinery (Esri geometry, R-tree spatial joins) is out
+# of scope without the Esri library.
+# ---------------------------------------------------------------------------
+
+
+@register("st_point", lambda ts: T.ArrayType(T.DOUBLE))
+def _st_point(x: Val, y: Val, out_type: T.Type) -> Val:
+    xd = _as_float(x)
+    yd = _as_float(y)
+    if xd.ndim == 0:
+        xd = xd[None]
+    if yd.ndim == 0:
+        yd = yd[None]
+    n = max(xd.shape[0], yd.shape[0])
+    xd = jnp.broadcast_to(xd, (n,))
+    yd = jnp.broadcast_to(yd, (n,))
+    data = jnp.stack([xd, yd], axis=1)
+    return Val(
+        data,
+        and_valid(x.valid, y.valid),
+        T.ArrayType(T.DOUBLE),
+        lengths=jnp.full((n,), 2, jnp.int32),
+    )
+
+
+def _point_xy(p: Val, what: str):
+    if p.lengths is None or p.data.shape[1] < 2:
+        raise TypeError(f"{what} requires a POINT (st_point) value")
+    return p.data[:, 0], p.data[:, 1]
+
+
+@register("st_x", _double_infer)
+def _st_x(p: Val, out_type: T.Type) -> Val:
+    x, _ = _point_xy(p, "st_x")
+    return Val(x, p.valid, T.DOUBLE)
+
+
+@register("st_y", _double_infer)
+def _st_y(p: Val, out_type: T.Type) -> Val:
+    _, y = _point_xy(p, "st_y")
+    return Val(y, p.valid, T.DOUBLE)
+
+
+@register("st_distance", _double_infer)
+def _st_distance(a: Val, b: Val, out_type: T.Type) -> Val:
+    ax, ay = _point_xy(a, "st_distance")
+    bx, by = _point_xy(b, "st_distance")
+    d = jnp.sqrt((ax - bx) ** 2 + (ay - by) ** 2)
+    return Val(d, and_valid(a.valid, b.valid), T.DOUBLE)
+
+
+@register("great_circle_distance", _double_infer)
+def _great_circle_distance(
+    lat1: Val, lon1: Val, lat2: Val, lon2: Val, out_type: T.Type
+) -> Val:
+    """Haversine distance in KILOMETERS (reference GeoFunctions.
+    greatCircleDistance — same Earth radius constant)."""
+    r = 6371.01
+    p1, l1 = jnp.radians(_as_float(lat1)), jnp.radians(_as_float(lon1))
+    p2, l2 = jnp.radians(_as_float(lat2)), jnp.radians(_as_float(lon2))
+    h = (
+        jnp.sin((p2 - p1) / 2) ** 2
+        + jnp.cos(p1) * jnp.cos(p2) * jnp.sin((l2 - l1) / 2) ** 2
+    )
+    d = 2 * r * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+    return Val(
+        d,
+        and_valid(lat1.valid, lon1.valid, lat2.valid, lon2.valid),
+        T.DOUBLE,
+    )

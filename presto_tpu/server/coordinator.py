@@ -53,7 +53,8 @@ class CoordinatorServer:
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: int = 1, resource_groups=None,
                  selectors=None, listeners=None, node_manager=None,
-                 access_control=None, authenticator=None, tls=None):
+                 access_control=None, authenticator=None, tls=None,
+                 impersonation_principals=()):
         # expose system.runtime.* through the served session's catalog
         # (reference connector/system/; the user's own session is untouched).
         # Duck-typed sessions (HttpClusterSession) are served as-is — they
@@ -88,6 +89,10 @@ class CoordinatorServer:
         self.shutting_down = False
         self.authenticator = authenticator
         self.tls = tls
+        # principals allowed to run queries AS another user (reference:
+        # principal-to-user impersonation rules in SystemAccessControl) —
+        # how an authenticating proxy forwards its clients' identities
+        self.impersonation_principals = frozenset(impersonation_principals)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -122,6 +127,8 @@ class CoordinatorServer:
                     return None
                 asserted = self.headers.get("X-Presto-User")
                 if asserted and asserted != principal:
+                    if principal in outer.impersonation_principals:
+                        return asserted  # e.g. the proxy's clients
                     self._send(
                         403,
                         {"error": f"user {asserted!r} does not match "
@@ -246,6 +253,16 @@ class CoordinatorServer:
                         content_type="text/html; charset=utf-8",
                     )
                     return
+                if parts[:1] == ["query"] and len(parts) == 2:
+                    page = outer._render_query_detail(parts[1])
+                    if page is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    self._send(
+                        200, page.encode(),
+                        content_type="text/html; charset=utf-8",
+                    )
+                    return
                 if parts == ["v1", "resourceGroupState"]:
                     self._send(
                         200,
@@ -316,7 +333,9 @@ class CoordinatorServer:
             q = html.escape(info.sql.replace("\n", " ")[:120])
             err = html.escape((info.error or "").strip().split("\n")[-1][:120])
             rows.append(
-                f"<tr class='{info.state.lower()}'><td>{info.query_id}</td>"
+                f"<tr class='{info.state.lower()}'>"
+                f"<td><a href='/query/{info.query_id}'>{info.query_id}</a>"
+                f"</td>"
                 f"<td>{info.state}</td><td>{html.escape(info.user)}</td>"
                 f"<td>{elapsed:.2f}s</td><td><code>{q}</code>"
                 f"{'<br><small>' + err + '</small>' if err else ''}</td></tr>"
@@ -343,6 +362,43 @@ state {"SHUTTING_DOWN" if self.shutting_down else "ACTIVE"}</p>
 <h2>Resource groups</h2>
 <table><tr><th>group</th><th>running</th><th>queued</th><th>cpu used</th></tr>
 {groups}</table></body></html>"""
+
+    def _render_query_detail(self, query_id: str) -> Optional[str]:
+        """Per-query page: SQL, state, plan tree, error (reference webapp
+        query.html/plan.html views, server-rendered)."""
+        import html
+
+        info = self.manager.get(query_id)
+        if info is None:
+            return None
+        if info.plan is None and info.error is None:
+            try:  # same lazy render as the /v1/query/{id} endpoint
+                info.plan = self.manager.session.explain(info.sql)
+            except Exception:  # noqa: BLE001 - plan render is advisory
+                pass
+        elapsed = (info.finished_at or time.time()) - info.created_at
+        plan = html.escape(info.plan or "(plan not recorded)")
+        err = (
+            f"<h2>Error</h2><pre class='err'>{html.escape(info.error)}</pre>"
+            if info.error
+            else ""
+        )
+        return f"""<!doctype html><html><head><meta charset="utf-8">
+<title>{query_id}</title><style>
+body{{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}}
+pre{{background:#fff;border:1px solid #ddd;padding:1em;overflow:auto;
+font-size:13px}} .err{{background:#fde8e8}}
+.meta td{{padding:4px 12px 4px 0}}</style></head><body>
+<p><a href="/">&larr; queries</a></p>
+<h1>{query_id}</h1>
+<table class="meta">
+<tr><td>state</td><td><b>{info.state}</b></td></tr>
+<tr><td>user</td><td>{html.escape(info.user)}</td></tr>
+<tr><td>elapsed</td><td>{elapsed:.2f}s</td></tr>
+</table>
+<h2>SQL</h2><pre>{html.escape(info.sql)}</pre>
+<h2>Plan</h2><pre>{plan}</pre>
+{err}</body></html>"""
 
     # -- protocol payloads --
 

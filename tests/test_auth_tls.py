@@ -124,3 +124,62 @@ def test_health_stays_open(pwfile):
         assert "uptime" in info or info
     finally:
         srv.stop()
+
+
+def test_proxy_forwards_and_rewrites(pwfile, tmp_path):
+    """presto-proxy analog: client authenticates to the PROXY; the proxy
+    holds the coordinator credentials and rewrites nextUri so paging stays
+    on the proxy."""
+    from presto_tpu.server.proxy import ProxyServer
+
+    coord = CoordinatorServer(
+        Session(TpchCatalog(sf=0.001)),
+        authenticator=FilePasswordAuthenticator(pwfile),
+        # the proxy's backend principal may impersonate its clients
+        impersonation_principals={"alice"},
+    ).start()
+    proxy_pw = str(tmp_path / "proxy_pw")
+    FilePasswordAuthenticator.write(proxy_pw, {"carol": "pass3"})
+    proxy = ProxyServer(
+        coord.uri,
+        authenticator=FilePasswordAuthenticator(proxy_pw),
+        backend_user="alice",
+        backend_password="open-sesame",
+    ).start()
+    try:
+        # client knows only proxy credentials; coordinator creds stay
+        # server-side
+        cols, rows = Client(
+            proxy.uri, user="carol", password="pass3"
+        ).execute("select count(*) c from nation")
+        assert rows == [[25]]
+        with pytest.raises(QueryError, match="401"):
+            Client(proxy.uri, user="carol", password="bad").execute(
+                "select 1 from region limit 1"
+            )
+        # the query ran AS the proxy-authenticated client, not as the
+        # backend principal
+        qs = Client(proxy.uri, user="carol", password="pass3").queries()
+        assert isinstance(qs, list) and qs
+        detail = Client(
+            proxy.uri, user="carol", password="pass3"
+        )._request("GET", f"{proxy.uri}/v1/query")
+        assert detail
+        infos = coord.manager.list_queries()
+        assert all(i.user == "carol" for i in infos), [
+            i.user for i in infos
+        ]
+    finally:
+        proxy.stop()
+        coord.stop()
+
+
+def test_proxy_502_when_backend_down(tmp_path):
+    from presto_tpu.server.proxy import ProxyServer
+
+    proxy = ProxyServer("http://127.0.0.1:1").start()
+    try:
+        with pytest.raises(QueryError, match="502"):
+            Client(proxy.uri).execute("select 1")
+    finally:
+        proxy.stop()

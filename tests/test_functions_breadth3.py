@@ -124,3 +124,21 @@ def test_function_surface_total():
     )
     assert len(F.FUNCTIONS) >= 205
     assert total >= 260, total
+
+
+def test_geospatial_points():
+    sess3 = Session(MemoryCatalog({"g": Page.from_dict({
+        "x1": np.array([0.0, 3.0]), "y1": np.array([0.0, 4.0]),
+        "lat1": np.array([36.12, 0.0]), "lon1": np.array([-86.67, 0.0]),
+        "lat2": np.array([33.94, 0.0]), "lon2": np.array([-118.40, 90.0]),
+    })}))
+    def q(sql):
+        return sess3.query(sql).rows()
+
+    assert q("select st_x(st_point(x1, y1)) from g")[0][0] == 0.0
+    assert q("select st_y(st_point(x1, y1)) from g")[1][0] == 4.0
+    d = q("select st_distance(st_point(0.0, 0.0), st_point(x1, y1)) from g")
+    assert d[1][0] == 5.0
+    gc = q("select great_circle_distance(lat1, lon1, lat2, lon2) from g")
+    assert abs(gc[0][0] - 2886.4) < 1.0  # BNA-LAX, the reference's doc example
+    assert abs(gc[1][0] - 6371.01 * 3.141592653589793 / 2) < 0.5

@@ -230,3 +230,31 @@ def test_query_history_bounded_and_delete_purges():
     last = ids[-1]
     assert mgr.cancel(last) is True  # purge of a finished query
     assert mgr.get(last) is None
+
+
+def test_query_detail_page():
+    from presto_tpu.connectors.tpch import TpchCatalog
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.session import Session
+    import urllib.request
+
+    srv = CoordinatorServer(Session(TpchCatalog(sf=0.001))).start()
+    try:
+        from presto_tpu.server.client import Client
+
+        c = Client(srv.uri)
+        c.execute("select count(*) from region")
+        qid = c.queries()[0]["queryId"]
+        with urllib.request.urlopen(
+            f"{srv.uri}/query/{qid}", timeout=10
+        ) as r:
+            page = r.read().decode()
+        assert "Plan" in page and "select count(*)" in page
+        assert "FINISHED" in page
+        assert "TableScan" in page  # the recorded plan tree renders
+        import urllib.error
+
+        with __import__("pytest").raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{srv.uri}/query/nope", timeout=10)
+    finally:
+        srv.stop()
