@@ -1,0 +1,143 @@
+"""Macro-benchmark suite definitions — the benchto-benchmarks analog.
+
+Re-designed equivalent of presto-benchto-benchmarks' YAML suite files
+(presto-benchto-benchmarks/src/main/resources/benchmarks/presto/
+tpch.yaml:1-16, tpcds.yaml, distributed_sort.yaml): each suite names its
+data source + scale factors, query set, run counts and prewarms, and a
+frequency for scheduled execution. Declarative python dicts instead of
+YAML (no external deps); `run()` executes a suite in-process through a
+Session (the LocalQueryRunner mode) or against a live coordinator
+through benchmark/driver.py (the Benchto agent mode).
+
+    python -m presto_tpu.benchmark.suites --suite tpch --sf 0.1
+    python -m presto_tpu.benchmark.suites --suite tpch --server http://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List, Optional
+
+from .tpch_sql import QUERIES as TPCH_QUERIES
+from .tpcds_sql import QUERIES as TPCDS_QUERIES
+
+# mirror of the reference's suite protocol constants (tpch.yaml:1-16):
+# 6 measured runs + 2 prewarms, weekly frequency
+SUITES: Dict[str, dict] = {
+    "tpch": {
+        "datasource": "tpch",
+        "scale_factors": [1.0, 10.0, 100.0],  # ref: sf300/sf1000/sf3000 ORC
+        "queries": sorted(TPCH_QUERIES),
+        "runs": 6,
+        "prewarms": 2,
+        "frequency_days": 7,
+    },
+    "tpcds": {
+        "datasource": "tpcds",
+        "scale_factors": [1.0, 10.0],  # ref: sf10..sf10000 ORC
+        "queries": sorted(TPCDS_QUERIES),
+        "runs": 6,
+        "prewarms": 2,
+        "frequency_days": 7,
+    },
+    "distributed_sort": {
+        "datasource": "tpch",
+        "scale_factors": [1.0, 100.0],  # ref: sf100..sf3000
+        "queries": ["sort_1col", "sort_6col"],
+        "extra_sql": {
+            "sort_1col": (
+                "select * from lineitem order by l_shipdate limit 10"
+            ),
+            "sort_6col": (
+                "select * from lineitem order by l_returnflag, l_linestatus,"
+                " l_shipdate, l_quantity, l_discount, l_orderkey limit 10"
+            ),
+        },
+        "runs": 2,
+        "prewarms": 1,
+        "frequency_days": 7,
+    },
+}
+
+
+def _sql_for(suite: dict, qname) -> str:
+    extra = suite.get("extra_sql", {})
+    if qname in extra:
+        return extra[qname]
+    src = TPCH_QUERIES if suite["datasource"] == "tpch" else TPCDS_QUERIES
+    return src[qname]
+
+
+def run(
+    name: str,
+    sf: float = 0.1,
+    server: Optional[str] = None,
+    queries: Optional[List[str]] = None,
+    runs: Optional[int] = None,
+) -> dict:
+    """Execute one suite at one scale factor; returns per-query wall-ms
+    percentiles in the driver's shape."""
+    suite = SUITES[name]
+    qnames = queries or suite["queries"]
+    n_runs = runs if runs is not None else suite["runs"]
+    qmap = {str(q): _sql_for(suite, q) for q in qnames}
+    from .driver import run_suite
+
+    if server is not None:
+        from ..verifier import RestTarget
+
+        target = RestTarget(server)
+    else:
+        # in-process = the LocalQueryRunner mode, through the SAME driver
+        # protocol as the live-cluster path (verifier.SessionTarget wraps
+        # a Session with the target interface)
+        from ..session import Session
+        from ..verifier import SessionTarget
+
+        if suite["datasource"] == "tpch":
+            from ..connectors.tpch import TpchCatalog
+
+            target = SessionTarget(Session(TpchCatalog(sf=sf)))
+        else:
+            from ..connectors.tpcds import TpcdsCatalog
+
+            target = SessionTarget(Session(TpcdsCatalog(sf=sf)))
+    benches = run_suite(
+        target, qmap, runs=n_runs, warmup=suite["prewarms"]
+    )
+    return {
+        "suite": name,
+        "sf": sf,
+        "queries": {
+            b.name: {
+                "rows": b.rows,
+                "p50_ms": round(b.percentile(50), 1),
+                "p90_ms": round(b.percentile(90), 1),
+                "error": b.error,
+            }
+            for b in benches
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--suite", choices=sorted(SUITES), default="tpch")
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--server", default=None)
+    ap.add_argument("--queries", nargs="*", default=None)
+    ap.add_argument("--runs", type=int, default=None)
+    args = ap.parse_args(argv)
+    qs = None
+    if args.queries:
+        qs = [int(q) if q.isdigit() else q for q in args.queries]
+    out = run(args.suite, sf=args.sf, server=args.server, queries=qs,
+              runs=args.runs)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

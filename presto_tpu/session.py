@@ -112,6 +112,15 @@ class Session:
         if hasattr(local, "pallas_groupby"):
             local.pallas_groupby = pallas_groupby
 
+    def _swap_catalog(self, catalog) -> None:
+        """Point the session AND its executors at a different catalog
+        (transaction overlay enter/exit)."""
+        self.catalog = catalog
+        self.executor.catalog = catalog
+        local = getattr(self.executor, "local", None)
+        if local is not None:
+            local.catalog = catalog
+
     def with_properties(self, props: dict) -> "Session":
         """A sibling session with per-query property overrides applied
         (reference: Session.withSystemProperty). Non-engine properties
@@ -189,7 +198,7 @@ class Session:
         if isinstance(
             ast,
             (t.CreateTable, t.DropTable, t.Insert, t.Delete, t.ShowTables,
-             t.ShowColumns),
+             t.ShowColumns, t.StartTransaction, t.Commit, t.Rollback),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
@@ -295,6 +304,28 @@ class Session:
                 }
             )
             return QueryResult(pg, ("Column", "Type"))
+        if isinstance(ast, t.StartTransaction):
+            if getattr(self, "_txn", None) is not None:
+                raise ValueError("transaction already in progress")
+            from .exec.transaction import TransactionCatalog
+
+            self._txn_base = self.catalog
+            self._txn = TransactionCatalog(self._writable())
+            self._swap_catalog(self._txn)
+            return self._row_count_result(0)
+        if isinstance(ast, (t.Commit, t.Rollback)):
+            txn = getattr(self, "_txn", None)
+            if txn is None:
+                raise ValueError("no transaction in progress")
+            try:
+                if isinstance(ast, t.Commit):
+                    txn.commit()
+                else:
+                    txn.rollback()
+            finally:
+                self._swap_catalog(self._txn_base)
+                self._txn = None
+            return self._row_count_result(0)
         if isinstance(ast, t.CreateTable):
             return self._create_table(ast)
         if isinstance(ast, t.DropTable):

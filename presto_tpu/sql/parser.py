@@ -42,6 +42,7 @@ KEYWORDS = {
     "unbounded", "preceding", "following", "current", "row", "filter",
     "explain", "analyze", "show", "tables", "columns", "substring", "for",
     "create", "drop", "insert", "into", "delete", "values", "table",
+    "start", "transaction", "begin", "commit", "rollback", "work",
 }
 
 
@@ -166,6 +167,20 @@ class Parser:
                 self.finish()
                 return t.ShowColumns(name)
             self.error("expected TABLES or COLUMNS")
+        if self.accept_kw("begin") or (
+            self.accept_kw("start") and self.expect_kw("transaction") is None
+        ):
+            self.accept_kw("work") or self.accept_kw("transaction")
+            self.finish()
+            return t.StartTransaction()
+        if self.accept_kw("commit"):
+            self.accept_kw("work")
+            self.finish()
+            return t.Commit()
+        if self.accept_kw("rollback"):
+            self.accept_kw("work")
+            self.finish()
+            return t.Rollback()
         if self.accept_kw("create"):
             stmt = self.parse_create()
             self.finish()
@@ -934,6 +949,7 @@ _NONRESERVED = {
     "date", "timestamp", "interval", "year", "month", "day", "hour", "minute",
     "second", "quarter", "first", "last", "tables", "columns", "show", "row",
     "range", "rows", "filter", "analyze", "substring",
+    "start", "transaction", "begin", "commit", "rollback", "work",
 }
 
 

@@ -310,6 +310,21 @@ class CreateTable(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class DropTable(Node):
     name: str
     if_exists: bool = False

@@ -183,3 +183,20 @@ def test_benchmark_driver(server, tmp_path):
          "queries": {"n": "select count(*) from nation"}}
     ))
     assert main(["--server", server.uri, str(suite)]) == 0
+
+
+def test_benchmark_suites_definitions_and_run():
+    """benchto-benchmarks analog (ref tpch.yaml protocol: 6 runs + 2
+    prewarms, weekly): suite definitions carry the reference protocol and
+    execute in-process."""
+    from presto_tpu.benchmark.suites import SUITES, run
+
+    assert SUITES["tpch"]["runs"] == 6 and SUITES["tpch"]["prewarms"] == 2
+    assert SUITES["tpch"]["frequency_days"] == 7
+    assert len(SUITES["tpcds"]["queries"]) == 99
+    out = run("tpch", sf=0.005, queries=[1, 6], runs=1)
+    assert set(out["queries"]) == {"1", "6"}
+    for q in out["queries"].values():
+        assert q["p50_ms"] > 0 and q["rows"] > 0 and not q["error"]
+    out2 = run("distributed_sort", sf=0.005, queries=["sort_1col"], runs=1)
+    assert out2["queries"]["sort_1col"]["rows"] == 10
