@@ -109,7 +109,9 @@ def run(
     )
     return {
         "suite": name,
-        "sf": sf,
+        # server mode runs at whatever scale the coordinator's catalog was
+        # started with — reporting the client-side flag would mislabel
+        "sf": None if server is not None else sf,
         "queries": {
             b.name: {
                 "rows": b.rows,

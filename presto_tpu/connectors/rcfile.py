@@ -1,0 +1,339 @@
+"""RCFile connector: row-columnar files -> device Pages.
+
+Re-designed equivalent of presto-rcfile (7,271 LoC: RcFileReader/Writer
+with text and binary column encodings). RCFile's layout — row groups
+holding column-major chunks, a sync marker between groups, per-chunk
+lengths — is implemented here directly (no Hadoop): the WRITER produces
+files with the classic structure (magic, version, metadata, sync-
+delimited row groups of length-prefixed column chunks, binary-encoded
+values) and the READER maps row ranges onto row groups by the stored
+row counts, decoding only requested columns — the same columnar-skip
+property the reference's RcFileReader exploits.
+
+Encodings (the binary/lazy-binary serde subset this engine's types
+need): int64/int32 little-endian fixed width, float64, bool bytes,
+dates as int32 days, decimals as scaled int64, varchar as utf-8 with
+u32 offsets. A JSON header row carries the schema (the reference stores
+it in file metadata key/values the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page, _pad_block
+from .spi import Connector, Predicate, WritableConnector, WriteError
+
+_MAGIC = b"RCF\x01tpu"
+_SYNC = b"\xde\xad\xbe\xef\xf0\x0d\xca\xfe" * 2  # 16-byte sync marker
+_ROWS_PER_GROUP = 1 << 16
+
+
+def _type_name(t: T.Type) -> str:
+    return str(t)
+
+
+def _encode_column(blk_data: np.ndarray, typ: T.Type, valid) -> bytes:
+    if isinstance(typ, T.VarcharType):
+        # blk_data here is a python list of strings ("" for NULL slots)
+        blob = b"".join(s.encode("utf-8") for s in blk_data)
+        offs = np.zeros(len(blk_data) + 1, np.uint32)
+        np.cumsum(
+            [len(s.encode("utf-8")) for s in blk_data], out=offs[1:]
+        )
+        payload = offs.tobytes() + blob
+    else:
+        payload = np.ascontiguousarray(blk_data).tobytes()
+    vbits = (
+        np.packbits(np.asarray(valid, bool)).tobytes()
+        if valid is not None
+        else b""
+    )
+    return struct.pack("<II", len(payload), len(vbits)) + payload + vbits
+
+
+def _decode_column(
+    buf: bytes, off: int, typ: T.Type, n: int
+) -> Tuple[object, Optional[np.ndarray], int]:
+    plen, vlen = struct.unpack_from("<II", buf, off)
+    off += 8
+    payload = buf[off : off + plen]
+    off += plen
+    valid = None
+    if vlen:
+        bits = np.frombuffer(buf[off : off + vlen], np.uint8)
+        valid = np.unpackbits(bits)[:n].astype(bool)
+        off += vlen
+    if isinstance(typ, T.VarcharType):
+        offs = np.frombuffer(payload[: 4 * (n + 1)], np.uint32)
+        blob = payload[4 * (n + 1):]
+        vals = [
+            blob[offs[i]: offs[i + 1]].decode("utf-8") for i in range(n)
+        ]
+        return vals, valid, off
+    dt = np.dtype(typ.storage_dtype.__name__ if hasattr(typ.storage_dtype, "__name__") else typ.storage_dtype)
+    data = np.frombuffer(payload, dt, count=n)
+    return data, valid, off
+
+
+class RcFileCatalog(WritableConnector):
+    """tables: {name: rcfile path}; with `directory` set the catalog is
+    writable (CTAS/INSERT/DELETE produce .rcf files)."""
+
+    name = "rcfile"
+    _ext = "rcf"
+
+    def __init__(self, tables: Dict[str, str],
+                 directory: Optional[str] = None):
+        self.paths = dict(tables)
+        self.directory = directory
+        self._meta_cache: Dict[str, dict] = {}
+
+    # -- file structure --
+
+    def _read_header(self, table: str) -> dict:
+        got = self._meta_cache.get(table)
+        if got is not None:
+            return got
+        path = self.paths[table]
+        with open(path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise WriteError(f"{path}: not an rcfile")
+            (hlen,) = struct.unpack("<I", f.read(4))
+            header = json.loads(f.read(hlen))
+            groups = []
+            off = f.tell()
+            data = f.read()
+        # group directory: scan sync markers (the reference seeks the
+        # same way; counts are stored per group right after the sync)
+        pos = 0
+        while pos < len(data):
+            if data[pos : pos + len(_SYNC)] != _SYNC:
+                raise WriteError(f"{path}: lost sync at {off + pos}")
+            pos += len(_SYNC)
+            n, glen = struct.unpack_from("<II", data, pos)
+            pos += 8
+            groups.append({"rows": n, "offset": off + pos, "length": glen})
+            pos += glen
+        header["groups"] = groups
+        self._meta_cache[table] = header
+        return header
+
+    # -- metadata --
+
+    def table_names(self) -> List[str]:
+        return list(self.paths)
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        h = self._read_header(table)
+        return {c: T.parse_type(s) for c, s in h["schema"].items()}
+
+    def row_count(self, table: str) -> int:
+        return sum(g["rows"] for g in self._read_header(table)["groups"])
+
+    def exact_row_count(self, table: str) -> int:
+        return self.row_count(table)
+
+    def unique_columns(self, table: str):
+        return []
+
+    # -- reads --
+
+    def page(self, table: str) -> Page:
+        return self.scan(table, 0, self.row_count(table))
+
+    def scan(self, table: str, start: int, stop: int, pad_to=None,
+             columns=None, predicate=None) -> Page:
+        h = self._read_header(table)
+        schema = self.schema(table)
+        names = list(columns) if columns is not None else list(schema)
+        col_order = list(schema)
+        stop = min(stop, self.row_count(table))
+        count = max(stop - start, 0)
+        pieces: Dict[str, list] = {c: [] for c in names}
+        vpieces: Dict[str, list] = {c: [] for c in names}
+        path = self.paths[table]
+        with open(path, "rb") as f:
+            offset = 0
+            for g in h["groups"]:
+                g_start, g_stop = offset, offset + g["rows"]
+                offset = g_stop
+                lo, hi = max(start, g_start), min(stop, g_stop)
+                if lo >= hi:
+                    continue
+                f.seek(g["offset"])
+                buf = f.read(g["length"])
+                pos = 0
+                for c in col_order:
+                    # column chunks are length-prefixed: skip unrequested
+                    # columns WITHOUT decoding (the row-columnar win)
+                    if c not in pieces:
+                        plen, vlen = struct.unpack_from("<II", buf, pos)
+                        pos += 8 + plen + vlen
+                        continue
+                    vals, valid, pos = _decode_column(
+                        buf, pos, schema[c], g["rows"]
+                    )
+                    sl = slice(lo - g_start, hi - g_start)
+                    pieces[c].append(vals[sl])
+                    vpieces[c].append(
+                        valid[sl]
+                        if valid is not None
+                        else np.ones(hi - lo, bool)
+                    )
+        blocks = []
+        for c in names:
+            typ = schema[c]
+            vs = pieces[c]
+            valid = (
+                np.concatenate(vpieces[c]) if vpieces[c] else np.ones(0, bool)
+            )
+            if isinstance(typ, T.VarcharType):
+                flat: List[str] = []
+                for p in vs:
+                    flat.extend(p)
+                vals = [
+                    s if ok else None for s, ok in zip(flat, valid.tolist())
+                ]
+                blk = Block.from_strings(vals)
+            else:
+                data = (
+                    np.concatenate(vs)
+                    if vs
+                    else np.empty(0, np.int64)
+                )
+                blk = Block.from_numpy(
+                    data, typ,
+                    valid=None if valid.all() else valid,
+                )
+            if pad_to is not None and pad_to > count:
+                blk = _pad_block(blk, pad_to)
+            blocks.append(blk)
+        return Page.from_blocks(blocks, names, count=count)
+
+    # -- writes --
+
+    def _write_path(self, table: str) -> str:
+        if table in self.paths:
+            return self.paths[table]
+        if self.directory is None:
+            raise WriteError("rcfile catalog is read-only (no directory)")
+        path = os.path.join(self.directory, f"{table}.{self._ext}")
+        self.paths[table] = path
+        return path
+
+    def _page_columns(self, page: Page):
+        """(per-column python/numpy values, valid arrays) from a Page."""
+        rows = page.to_pylist()
+        cols = {}
+        for i, (name, blk) in enumerate(zip(page.names, page.blocks)):
+            vals = [r[i] for r in rows]
+            valid = np.array([v is not None for v in vals], bool)
+            cols[name] = (vals, None if valid.all() else valid)
+        return cols
+
+    def write_pages(self, table: str, page: Page) -> None:
+        import datetime
+        import decimal
+
+        path = self._write_path(table)
+        schema = {
+            n: b.type for n, b in zip(page.names, page.blocks)
+        }
+        cols = self._page_columns(page)
+        n = int(page.count)
+        header = {
+            "schema": {c: _type_name(t) for c, t in schema.items()},
+        }
+        hjson = json.dumps(header).encode()
+        with open(path, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<I", len(hjson)))
+            f.write(hjson)
+            for g0 in range(0, max(n, 1), _ROWS_PER_GROUP):
+                g1 = min(g0 + _ROWS_PER_GROUP, n)
+                if n == 0:
+                    g1 = 0
+                chunks = []
+                for c, t in schema.items():
+                    vals, valid = cols[c]
+                    gv = vals[g0:g1]
+                    gvalid = None if valid is None else valid[g0:g1]
+                    if isinstance(t, T.VarcharType):
+                        enc = [v if v is not None else "" for v in gv]
+                    elif isinstance(t, T.DecimalType):
+                        enc = np.array(
+                            [
+                                int(
+                                    (v if isinstance(v, decimal.Decimal)
+                                     else decimal.Decimal(str(v)))
+                                    .scaleb(t.scale)
+                                )
+                                if v is not None
+                                else 0
+                                for v in gv
+                            ],
+                            np.int64,
+                        )
+                    elif isinstance(t, T.DateType):
+                        epoch = datetime.date(1970, 1, 1)
+                        def _days(v):
+                            if v is None:
+                                return 0
+                            if isinstance(v, np.datetime64):
+                                return int(
+                                    v.astype("datetime64[D]").astype(int)
+                                )
+                            return (v - epoch).days
+                        enc = np.array([_days(v) for v in gv], np.int32)
+                    else:
+                        dt = np.dtype(t.storage_dtype.__name__ if hasattr(t.storage_dtype, "__name__") else t.storage_dtype)
+                        fill = 0 if dt.kind in "iub" else 0.0
+                        enc = np.array(
+                            [v if v is not None else fill for v in gv], dt
+                        )
+                    chunks.append(_encode_column(enc, t, gvalid))
+                body = b"".join(chunks)
+                f.write(_SYNC)
+                f.write(struct.pack("<II", g1 - g0, len(body)))
+                f.write(body)
+                if n == 0:
+                    break
+        self._meta_cache.pop(table, None)
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        from ..ops.union import empty_page
+
+        if table in self.paths:
+            raise WriteError(f"table {table} exists")
+        self.write_pages(table, empty_page(schema))
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        if table in self.paths:
+            raise WriteError(f"table {table} exists")
+        self.write_pages(table, page)
+
+    def append(self, table: str, page: Page) -> None:
+        from ..ops.union import concat_pages
+
+        cur = self.page(table)
+        merged = page if int(cur.count) == 0 else concat_pages([cur, page])
+        self.write_pages(table, merged)
+
+    def replace(self, table: str, page: Page) -> None:
+        self.write_pages(table, page)
+
+    def drop_table(self, table: str) -> None:
+        path = self.paths.pop(table, None)
+        if path is None:
+            raise WriteError(f"unknown table {table}")
+        self._meta_cache.pop(table, None)
+        if os.path.exists(path):
+            os.remove(path)

@@ -140,7 +140,12 @@ class TransactionCatalog(WritableConnector):
             raise WriteError(f"unknown table {table}")
         if table in self._created:
             self._created.remove(table)
-            self._staged.pop(table, None)
+            if table in self._dropped_base:
+                # the name shadowed a dropped BASE table: keep the drop
+                # visible in-transaction (base must not resurface)
+                self._staged[table] = None
+            else:
+                self._staged.pop(table, None)
             return
         self._staged[table] = None
         self._dropped_base.add(table)

@@ -125,26 +125,28 @@ class QueryManager:
             self._events[qid] = threading.Event()
             self._expire_locked()
         self.events.fire_created(info)
-        # multi-statement transactions are SESSION-scoped (an overlay
-        # catalog swapped into one Session, exec/transaction.py); the REST
-        # Session is shared across clients and worker threads, so a BEGIN
-        # here would entangle every concurrent client's reads and writes.
-        # The reference scopes wire transactions with X-Presto-Transaction
-        # handles — unsupported here, so fail the query rather than corrupt.
-        head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
-        if head in ("begin", "start", "commit", "rollback"):
-            info.state = FAILED
-            info.error = (
-                "multi-statement transactions are not supported over the "
-                "shared REST session; use an in-process Session"
-            )
-            info.finished_at = time.time()
-            ev = self._events.get(qid)
-            if ev is not None:
-                ev.set()
-            self.events.fire_completed(info)
-            return info
         try:
+            # multi-statement transactions are SESSION-scoped (an overlay
+            # catalog swapped into one Session, exec/transaction.py); the
+            # REST Session is shared across clients and worker threads, so
+            # a BEGIN here would entangle every client's reads and writes.
+            # The reference scopes wire transactions with
+            # X-Presto-Transaction handles — unsupported here, so reject
+            # by PARSING (a first-token sniff is bypassed by ';'/comments)
+            try:
+                from ..sql import parser as _p
+                from ..sql import tree as _t
+
+                ast = _p.parse(sql)
+            except Exception:  # noqa: BLE001 - surfaces at execution
+                ast = None
+            if isinstance(
+                ast, (_t.StartTransaction, _t.Commit, _t.Rollback)
+            ):
+                raise QueryRejected(
+                    "multi-statement transactions are not supported over "
+                    "the shared REST session; use an in-process Session"
+                )
             self.groups.submit(info)
         except QueryRejected as e:
             info.state = FAILED

@@ -243,3 +243,30 @@ def test_metastore_survives_reopen(warehouse):
     assert sorted(wh2.page("p").to_pylist()) == sorted(
         wh.page("p").to_pylist()
     )
+
+
+def test_scaled_writers(warehouse):
+    """Writer parallelism scales with insert volume (reference
+    ScaledWriterScheduler): small inserts stay single-writer, large
+    multi-partition inserts fan out, results identical."""
+    wh = warehouse
+    wh.create_partitioned_table(
+        "sw", {"p": T.BIGINT, "v": T.BIGINT}, partitioned_by=["p"]
+    )
+    wh.append(
+        "sw", Page.from_dict({"p": np.arange(4) % 4, "v": np.arange(4)})
+    )
+    assert wh.last_write_writers == 1
+    n = 60_000
+    rng = np.random.default_rng(1)
+    wh.append(
+        "sw",
+        Page.from_dict(
+            {"p": rng.integers(0, 8, n), "v": rng.integers(0, 100, n)}
+        ),
+    )
+    assert wh.last_write_writers > 1
+    assert wh.row_count("sw") == n + 4
+    sess = Session(wh)
+    total = sess.query("select sum(v) from sw").rows()[0][0]
+    assert int(total) > 0

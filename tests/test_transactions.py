@@ -94,3 +94,27 @@ def test_drop_then_recreate_in_one_txn(sess):
     sess.query("create table t as select 42 v from (values (1)) x(a)")
     sess.query("commit")
     assert sess.query("select v from t").rows() == [(42,)]
+
+
+def test_rest_rejects_sneaky_txn_statements():
+    from presto_tpu.connectors.tpch import TpchCatalog
+    from presto_tpu.server.client import Client, QueryError
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    srv = CoordinatorServer(Session(TpchCatalog(sf=0.001))).start()
+    try:
+        for sneaky in ("begin;", "  BEGIN", "start transaction;"):
+            with pytest.raises(QueryError, match="transactions"):
+                Client(srv.uri).execute(sneaky)
+    finally:
+        srv.stop()
+
+
+def test_drop_recreate_drop_stays_dropped(sess):
+    sess.query("begin")
+    sess.query("drop table t")
+    sess.query("create table t as select 1 v from (values (1)) x(a)")
+    sess.query("drop table t")
+    assert "t" not in [r[0] for r in sess.query("show tables").rows()]
+    sess.query("commit")
+    assert "t" not in sess.catalog.table_names()
