@@ -193,7 +193,7 @@ def test_benchmark_suites_definitions_and_run():
 
     assert SUITES["tpch"]["runs"] == 6 and SUITES["tpch"]["prewarms"] == 2
     assert SUITES["tpch"]["frequency_days"] == 7
-    assert len(SUITES["tpcds"]["queries"]) == 99
+    assert len(SUITES["tpcds"]["queries"]) >= 99
     out = run("tpch", sf=0.005, queries=[1, 6], runs=1)
     assert set(out["queries"]) == {"1", "6"}
     for q in out["queries"].values():
