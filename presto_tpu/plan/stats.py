@@ -35,12 +35,37 @@ DEFAULT_RANGE_SELECTIVITY = 0.35
 
 @dataclasses.dataclass(frozen=True)
 class ColumnStats:
-    """Reference: spi/statistics/ColumnStatistics."""
+    """Reference: spi/statistics/ColumnStatistics (+ the equi-depth
+    histogram the reference derives in FilterStatsCalculator via
+    StatisticRange — here carried explicitly)."""
 
     ndv: Optional[float] = None
     min: Optional[float] = None  # logical value; None = unknown/varchar
     max: Optional[float] = None
     null_fraction: float = 0.0
+    # equi-depth boundaries (logical values at quantiles 0..1): rank of a
+    # value interpolates to a selectivity without a uniformity assumption
+    histogram: Optional[Tuple[float, ...]] = None
+
+    def fraction_below(self, x: float) -> Optional[float]:
+        """P[col <= x] over non-null rows, from the histogram when
+        present, else linear between min/max."""
+        h = self.histogram
+        if h and len(h) >= 2:
+            import bisect
+
+            b = len(h) - 1
+            i = bisect.bisect_right(h, x)
+            if i == 0:
+                return 0.0
+            if i > b:
+                return 1.0
+            lo, hi = h[i - 1], h[i]
+            inner = 0.0 if hi <= lo else (x - lo) / (hi - lo)
+            return ((i - 1) + min(max(inner, 0.0), 1.0)) / b
+        if self.min is None or self.max is None or self.max <= self.min:
+            return None
+        return min(max((x - self.min) / (self.max - self.min), 0.0), 1.0)
 
     def cap_ndv(self, rows: float) -> "ColumnStats":
         if self.ndv is None or self.ndv <= rows:
@@ -276,18 +301,34 @@ def _conjunct_selectivity(e, cols: Dict[str, ColumnStats]) -> float:
             or cs.max <= cs.min
         ):
             return DEFAULT_RANGE_SELECTIVITY
-        width = cs.max - cs.min
+        # histogram-aware rank interpolation (reference
+        # FilterStatsCalculator range estimation; equi-depth histogram
+        # replaces the uniformity assumption where the sample derived
+        # one). Fractions are CONDITIONED on the current [min, max] —
+        # earlier conjuncts narrow min/max but keep the full-table
+        # histogram, so renormalize to the surviving mass.
+        f_lo = cs.fraction_below(cs.min) or 0.0
+        f_hi = cs.fraction_below(cs.max)
+        f_hi = 1.0 if f_hi is None else f_hi
+        mass = max(f_hi - f_lo, 1e-12)
+
+        def cond_below(x: float) -> float:
+            f = cs.fraction_below(min(max(x, cs.min), cs.max))
+            if f is None:
+                return DEFAULT_RANGE_SELECTIVITY
+            return min(max((f - f_lo) / mass, 0.0), 1.0)
+
         if op == "between":
             lo, hi = lit
-            frac = (min(hi, cs.max) - max(lo, cs.min)) / width
+            frac = max(cond_below(hi) - cond_below(lo), 0.0)
             cols[col.name] = dataclasses.replace(
                 cs, min=max(lo, cs.min), max=min(hi, cs.max)
             )
         elif op in ("lt", "le"):
-            frac = (min(lit, cs.max) - cs.min) / width
+            frac = cond_below(lit)
             cols[col.name] = dataclasses.replace(cs, max=min(lit, cs.max))
         else:
-            frac = (cs.max - max(lit, cs.min)) / width
+            frac = 1.0 - cond_below(lit)
             cols[col.name] = dataclasses.replace(cs, min=max(lit, cs.min))
         return nn * min(max(frac, 0.0), 1.0)
     if op == "like":
@@ -398,9 +439,17 @@ def stats_from_column(
         ndv = d * (total_rows / n)
     scale = getattr(typ, "scale", None)
     div = float(10**scale) if scale else 1.0
+    hist = None
+    if data.size >= 64:
+        # 32-bucket equi-depth boundaries from the sample (reference:
+        # the StatisticRange-based estimates FilterStatsCalculator makes;
+        # an explicit histogram replaces the uniformity assumption)
+        qs = np.quantile(data, np.linspace(0.0, 1.0, 33))
+        hist = tuple(float(q) / div for q in qs)
     return ColumnStats(
         ndv=ndv,
         min=float(data.min()) / div,
         max=float(data.max()) / div,
         null_fraction=null_fraction,
+        histogram=hist,
     )

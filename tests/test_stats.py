@@ -177,3 +177,59 @@ def test_explain_shows_estimates(tpch):
         "select l_orderkey from lineitem where l_quantity < 10"
     )
     assert "{est:" in text and "rows}" in text
+
+
+def test_histogram_selectivity_beats_uniform_on_skew():
+    """Equi-depth histograms (round 4) estimate skewed ranges where the
+    uniform min/max interpolation is badly wrong (reference
+    FilterStatsCalculator's StatisticRange estimates)."""
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.plan.stats import ColumnStats, stats_from_column
+
+    # heavy skew: 95% of values in [0, 10], tail to 10_000
+    rng = np.random.default_rng(0)
+    data = np.concatenate(
+        [
+            rng.integers(0, 11, 95_000),
+            rng.integers(11, 10_001, 5_000),
+        ]
+    )
+    cs = stats_from_column(data, None, T.BIGINT, None, len(data))
+    assert cs.histogram is not None and len(cs.histogram) == 33
+    # P[x <= 10] is ~0.95; uniform interpolation would claim ~0.1%
+    frac = cs.fraction_below(10.0)
+    assert 0.90 <= frac <= 1.0, frac
+    uniform = ColumnStats(min=cs.min, max=cs.max)
+    assert (uniform.fraction_below(10.0) or 0.0) < 0.01
+    # monotone and bounded
+    assert cs.fraction_below(cs.min - 1) == 0.0
+    assert cs.fraction_below(cs.max + 1) == 1.0
+
+
+def test_stacked_range_conjuncts_condition_on_narrowed_range():
+    """a >= 5000 AND a < 6000 over uniform [0, 10000] must estimate ~10%,
+    not 30% (the second conjunct renormalizes to the narrowed range)."""
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.expr import ir
+    from presto_tpu.plan.stats import _conjunct_selectivity, stats_from_column
+
+    data = np.random.default_rng(1).integers(0, 10_001, 100_000)
+    cs = stats_from_column(data, None, T.BIGINT, None, len(data))
+    cols = {"a": cs}
+    a = ir.ColumnRef("a", T.BIGINT)
+
+    def call(op, v):
+        return ir.Call(op, (a, ir.Literal(v, T.BIGINT)), T.BOOLEAN)
+
+    s1 = _conjunct_selectivity(call("ge", 5000), cols)
+    s2 = _conjunct_selectivity(call("lt", 6000), cols)
+    assert 0.07 <= s1 * s2 <= 0.13, (s1, s2)
+    # contradictory ranges collapse toward zero
+    cols2 = {"a": cs}
+    t1 = _conjunct_selectivity(call("ge", 5000), cols2)
+    t2 = _conjunct_selectivity(call("lt", 4000), cols2)
+    assert t1 * t2 <= 0.01, (t1, t2)
