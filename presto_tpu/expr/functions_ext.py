@@ -1103,3 +1103,151 @@ def _great_circle_distance(
         and_valid(lat1.valid, lon1.valid, lat2.valid, lon2.valid),
         T.DOUBLE,
     )
+
+
+# ---------------------------------------------------------------------------
+# map tail + binary/json/date leftovers (reference MapConcatFunction,
+# JsonFunctions.jsonParse, VarbinaryFunctions to/from_big_endian_64,
+# ColorFunctions.render, DateTimeFunctions timezone accessors)
+# ---------------------------------------------------------------------------
+
+
+@register("map_concat", lambda ts: ts[0])
+def _map_concat(a: Val, *rest: Val, out_type: T.Type) -> Val:
+    """Union of maps; on duplicate keys the LAST map wins (reference
+    MapConcatFunction, variadic). Static-width: concatenate lanes, unify
+    varchar dictionaries, then mask earlier occurrences of later keys."""
+    out = a
+    for b in rest:
+        out = _map_concat2(out, b)
+    return out
+
+
+def _map_concat2(a: Val, b: Val) -> Val:
+    from .functions import unify_dictionaries
+
+    if a.keys is None or b.keys is None:
+        raise TypeError("map_concat requires map values")
+    ka, kb = a.keys, b.keys
+    k_did = ka.dict_id
+    kda, kdb = ka.data, kb.data
+    if ka.dict_id != kb.dict_id and (
+        ka.dict_id is not None or kb.dict_id is not None
+    ):
+        kda, kdb, k_did = unify_dictionaries(ka, kb)
+    v_did = a.dict_id
+    vda, vdb = a.data, b.data
+    if a.dict_id != b.dict_id and (
+        a.dict_id is not None or b.dict_id is not None
+    ):
+        vda, vdb, v_did = unify_dictionaries(a, b)
+    wa, wb = kda.shape[1], kdb.shape[1]
+    kdata = jnp.concatenate([kda, kdb], axis=1)
+    vdata = jnp.concatenate(
+        [vda, vdb.astype(vda.dtype) if vda.dtype != vdb.dtype else vdb],
+        axis=1,
+    )
+    in_a = jnp.arange(wa)[None, :] < a.lengths[:, None]
+    in_b = jnp.arange(wb)[None, :] < b.lengths[:, None]
+    live = jnp.concatenate([in_a, in_b], axis=1)
+    ev_a = (
+        a.elem_valid
+        if a.elem_valid is not None
+        else jnp.ones(vda.shape[:2], bool)
+    )
+    ev_b = (
+        b.elem_valid
+        if b.elem_valid is not None
+        else jnp.ones(vdb.shape[:2], bool)
+    )
+    ev = jnp.concatenate([ev_a, ev_b], axis=1)
+    # kill an entry when any LATER live entry has the same key
+    eq = kdata[:, :, None] == kdata[:, None, :]
+    later = jnp.arange(wa + wb)[None, :] > jnp.arange(wa + wb)[:, None]
+    dup = jnp.any(eq & later[None] & live[:, None, :], axis=2)
+    keep = live & ~dup
+    # compact kept entries to the front
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    kdata = jnp.take_along_axis(kdata, order, axis=1)
+    vdata = jnp.take_along_axis(vdata, order, axis=1)
+    ev = jnp.take_along_axis(ev, order, axis=1)
+    lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    keys = Val(kdata, None, ka.type, k_did, lengths=lens)
+    return Val(
+        vdata,
+        and_valid(a.valid, b.valid),
+        a.type,
+        v_did,
+        lengths=lens,
+        elem_valid=ev,
+        keys=keys,
+    )
+
+
+@register("json_parse", _varchar_infer)
+def _json_parse(a: Val, out_type: T.Type) -> Val:
+    """Validate + canonicalize JSON text (reference jsonParse returning
+    the JSON type; this engine's JSON values are canonical strings)."""
+
+    def f(s: str):
+        try:
+            return (
+                json.dumps(json.loads(s), separators=(",", ":")),
+                True,
+            )
+        except ValueError:
+            return "", False
+
+    return _dict_transform_nullable(a, f)
+
+
+@register("to_big_endian_64", _varchar_infer)
+def _to_big_endian_64(a: Val, out_type: T.Type) -> Val:
+    """bigint -> 8-byte big-endian, surfaced as 16 hex chars (binary
+    rides the string layer here, see module docstring)."""
+    v = _require_literal(
+        a, "to_big_endian_64 value (column inputs unsupported: unbounded "
+           "output dictionary)"
+    )
+    s = int(v).to_bytes(8, "big", signed=True).hex().upper()
+    return Val(
+        jnp.zeros(a.data.shape, jnp.int32),
+        a.valid,
+        T.VARCHAR,
+        intern_dictionary((s,)),
+        literal=s,
+    )
+
+
+@register("from_big_endian_64", _bigint_infer)
+def _from_big_endian_64(a: Val, out_type: T.Type) -> Val:
+    def f(s: str):
+        if len(s) != 16:  # exactly 8 bytes (reference raises on != 8)
+            return 0, False
+        try:
+            return int.from_bytes(bytes.fromhex(s), "big", signed=True), True
+        except ValueError:
+            return 0, False
+
+    from .functions import _dict_table_nullable
+
+    return _dict_table_nullable(a, f, np.int64, T.BIGINT)
+
+
+@register("render", _varchar_infer)
+def _render(b: Val, *rest, out_type: T.Type) -> Val:
+    """render(boolean) -> ✓ / ✗ (reference ColorFunctions.render)."""
+    d = ("✓", "✗")  # already sorted (U+2713 < U+2717)
+    codes = jnp.where(b.data.astype(bool), jnp.int32(0), jnp.int32(1))
+    return Val(codes, b.valid, T.VARCHAR, intern_dictionary(d))
+
+
+@register("timezone_hour", _bigint_infer)
+def _timezone_hour(a: Val, out_type: T.Type) -> Val:
+    """This engine's temporal values are UTC (no session zones): 0."""
+    return Val(jnp.zeros(a.data.shape[:1], jnp.int64), a.valid, T.BIGINT)
+
+
+@register("timezone_minute", _bigint_infer)
+def _timezone_minute(a: Val, out_type: T.Type) -> Val:
+    return Val(jnp.zeros(a.data.shape[:1], jnp.int64), a.valid, T.BIGINT)

@@ -142,3 +142,60 @@ def test_geospatial_points():
     gc = q("select great_circle_distance(lat1, lon1, lat2, lon2) from g")
     assert abs(gc[0][0] - 2886.4) < 1.0  # BNA-LAX, the reference's doc example
     assert abs(gc[1][0] - 6371.01 * 3.141592653589793 / 2) < 0.5
+
+
+def test_functions_ext_batch3():
+    from presto_tpu import types as T
+
+    sess4 = Session(MemoryCatalog({"t4": Page.from_dict({
+        "j": ['{"b": 2, "a": 1}', "[3,1]", "nope"],
+        "n": np.array([1, -2, 255], np.int64),
+        "f": (np.array([True, False, True]), T.BOOLEAN),
+    })}))
+    def q(sql):
+        return sess4.query(sql).rows()
+
+    assert q("select json_parse(j) from t4 where n = 1")[0][0] == '{"b":2,"a":1}'
+    assert q("select json_parse(j) from t4 where n = 255")[0][0] is None
+    assert q("select to_big_endian_64(255) from t4 limit 1")[0][0] == "00000000000000FF"
+    assert q("select from_big_endian_64(to_big_endian_64(255)) from t4 limit 1")[0][0] == 255
+    assert q("select render(f) from t4 order by n")[0][0] == "✗"
+    assert q("select render(f) from t4 order by n")[2][0] == "✓"
+    assert q("select timezone_hour(n) from t4 limit 1")[0][0] == 0
+    m = q("select element_at(map_concat(map(array[1,2], array[10,20]),"
+          " map(array[2,3], array[99,30])), 2) from t4 limit 1")
+    assert m[0][0] == 99  # second map wins on duplicate keys
+    m2 = q("select cardinality(map_concat(map(array[1,2], array[10,20]),"
+           " map(array[2,3], array[99,30]))) from t4 limit 1")
+    assert m2[0][0] == 3
+
+
+def test_map_concat_edge_cases():
+    from presto_tpu import types as T
+
+    sess5 = Session(MemoryCatalog({"t5": Page.from_dict({
+        "n": np.array([1], np.int64),
+    })}))
+    def q(sql):
+        return sess5.query(sql).rows()
+
+    # varchar keys from DIFFERENT dictionaries unify
+    assert q("select element_at(map_concat(map(array['a'], array[10]),"
+             " map(array['b'], array[20])), 'a') from t5")[0][0] == 10
+    assert q("select element_at(map_concat(map(array['a'], array[10]),"
+             " map(array['b'], array[20])), 'b') from t5")[0][0] == 20
+    assert q("select cardinality(map_concat(map(array['a'], array[10]),"
+             " map(array['b'], array[20]))) from t5")[0][0] == 2
+    # varchar VALUES unify too
+    assert q("select element_at(map_concat(map(array[1], array['x']),"
+             " map(array[2], array['y'])), 2) from t5")[0][0] == "y"
+    # NULL values survive
+    assert q("select element_at(map_concat(map(array[1],"
+             " array[cast(null as bigint)]), map(array[2], array[20])), 1)"
+             " from t5")[0][0] is None
+    # variadic
+    assert q("select cardinality(map_concat(map(array[1], array[1]),"
+             " map(array[2], array[2]), map(array[3], array[3])))"
+             " from t5")[0][0] == 3
+    # malformed big-endian length -> NULL
+    assert q("select from_big_endian_64('FF') from t5")[0][0] is None
