@@ -1251,3 +1251,27 @@ def _timezone_hour(a: Val, out_type: T.Type) -> Val:
 @register("timezone_minute", _bigint_infer)
 def _timezone_minute(a: Val, out_type: T.Type) -> Val:
     return Val(jnp.zeros(a.data.shape[:1], jnp.int64), a.valid, T.BIGINT)
+
+
+# ---------------------------------------------------------------------------
+# ML scalars (reference presto-ml regress/classify over learned models)
+# ---------------------------------------------------------------------------
+
+
+@register("regress", _double_infer)
+def _regress(features: Val, model: Val, out_type: T.Type) -> Val:
+    """regress(features, model): dot(features, weights) + intercept —
+    model is the ARRAY(DOUBLE) produced by learn_linear_regression."""
+    from ..ops import mlreg
+
+    if features.lengths is None or model.lengths is None:
+        raise TypeError("regress takes (features array, model array)")
+    fdata = mlreg.logical_values(features.data, features.type)
+    mdata = mlreg.logical_values(model.data, model.type)
+    mlens = model.lengths
+    n = fdata.shape[0]
+    if mdata.shape[0] == 1 and n > 1:
+        mdata = jnp.broadcast_to(mdata, (n, mdata.shape[1]))
+        mlens = jnp.broadcast_to(mlens, (n,))
+    out = mlreg.predict(fdata, features.lengths, mdata, mlens)
+    return Val(out, and_valid(features.valid, model.valid), T.DOUBLE)

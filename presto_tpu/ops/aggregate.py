@@ -46,6 +46,7 @@ SUPPORTED = (
     "array_agg", "map_agg", "histogram",
     "approx_distinct", "hll_registers", "hll_merge",
     "qsketch", "qsketch_merge",
+    "linreg", "linreg_acc", "linreg_merge",
 )
 
 
@@ -552,6 +553,7 @@ def grouped_aggregate_direct(
         if spec.func in COLLECTION_AGGS or spec.func in (
             "approx_distinct", "hll_registers", "hll_merge",
             "qsketch", "qsketch_merge",
+            "linreg", "linreg_acc", "linreg_merge",
         ):
             raise NotImplementedError(
                 f"{spec.func} runs through the SORT aggregation strategy"
@@ -747,6 +749,44 @@ def grouped_aggregate_sorted(
             blocks.append(Block(sk, T.ArrayType(T.BIGINT), None))
             names.append(spec.name)
             continue
+        if spec.func in ("linreg", "linreg_acc", "linreg_merge"):
+            from . import mlreg
+
+            contributes = live_s if v.valid is None else (
+                live_s & v.valid[order]
+            )
+            if spec.func == "linreg_merge":
+                acc = mlreg.merge_accumulators(
+                    v.data[order], contributes, gid_s, max_groups + 1
+                )[:max_groups]
+            else:
+                lab = bk
+                lab_data = mlreg.logical_values(lab.data, lab.type)[order]
+                if lab.valid is not None:
+                    contributes = contributes & lab.valid[order]
+                lens = (
+                    v.lengths[order]
+                    if getattr(v, "lengths", None) is not None
+                    else jnp.full(
+                        v.data.shape[0], v.data.shape[1], jnp.int32
+                    )
+                )
+                acc = mlreg.group_accumulate(
+                    mlreg.logical_values(v.data, v.type)[order], lens,
+                    lab_data, contributes, gid_s, max_groups + 1,
+                )[:max_groups]
+            valid_g = None
+            if spec.func == "linreg":
+                acc, has = mlreg.solve_weights(acc)
+                valid_g = has  # empty group -> NULL model
+            blocks.append(
+                Block(
+                    acc, T.ArrayType(T.DOUBLE), valid_g,
+                    lengths=jnp.full(acc.shape[0], acc.shape[1], jnp.int32),
+                )
+            )
+            names.append(spec.name)
+            continue
         if spec.func in ("min_by", "max_by", "percentile"):
             v_sorted = Val(
                 v.data[order],
@@ -852,6 +892,22 @@ class HllPost:
 
 
 @dataclasses.dataclass(frozen=True)
+class LinRegPost:
+    """Post-exchange step: solve merged normal equations into weights."""
+
+    name: str
+    acc_col: str
+
+    @property
+    def sum_col(self):
+        return self.acc_col
+
+    @property
+    def cnt_col(self):
+        return self.acc_col
+
+
+@dataclasses.dataclass(frozen=True)
 class QSketchPost:
     """Post-exchange step: name = percentile read off the merged quantile
     sketch (ops/qsketch.py — the mergeable approx_percentile path)."""
@@ -917,6 +973,19 @@ def decompose_partial(aggs: Sequence[AggSpec]):
                 AggSpec("qsketch_merge", ColumnRef(s_name, sk_t), s_name, sk_t)
             )
             post.append(QSketchPost(a.name, s_name, frac, a.output_type))
+        elif a.func == "linreg":
+            # mergeable normal-equation accumulators (ops/mlreg.py)
+            acc_t = T.ArrayType(T.DOUBLE)
+            m_name = f"{a.name}$lr"
+            partial.append(
+                AggSpec("linreg_acc", a.input, m_name, acc_t,
+                        input2=a.input2)
+            )
+            final.append(
+                AggSpec("linreg_merge", ColumnRef(m_name, acc_t), m_name,
+                        acc_t)
+            )
+            post.append(LinRegPost(a.name, m_name))
         else:
             raise KeyError(f"cannot decompose aggregate {a.func!r}")
     return tuple(partial), tuple(final), tuple(post)
@@ -944,6 +1013,19 @@ def apply_avg_post(page: Page, aggs: Sequence[AggSpec], post: Sequence[AvgPost])
         if isinstance(p, HllPost):
             regs = page.block(p.reg_col).data
             blocks.append(Block(hll_estimate(regs), T.BIGINT, None))
+            names.append(a.name)
+            continue
+        if isinstance(p, LinRegPost):
+            from . import mlreg
+
+            acc = page.block(p.acc_col).data
+            w, has = mlreg.solve_weights(acc)
+            blocks.append(
+                Block(
+                    w, T.ArrayType(T.DOUBLE), has,
+                    lengths=jnp.full(w.shape[0], w.shape[1], jnp.int32),
+                )
+            )
             names.append(a.name)
             continue
         if isinstance(p, QSketchPost):
@@ -992,6 +1074,7 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
         if spec.func in COLLECTION_AGGS or spec.func in (
             "approx_distinct", "hll_registers", "hll_merge",
             "qsketch", "qsketch_merge",
+            "linreg", "linreg_acc", "linreg_merge",
         ):
             gid0 = jnp.zeros(page.capacity, jnp.int32)
             live0 = live
@@ -1038,6 +1121,40 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
                         v_s.data, contributes0, gid_s0, 2
                     )[:1]
                 blk = Block(sk, T.ArrayType(T.BIGINT), None)
+            elif spec.func in ("linreg", "linreg_acc", "linreg_merge"):
+                from . import mlreg
+
+                contributes0 = live0[order0] if v.valid is None else (
+                    live0[order0] & v_s.valid_mask()
+                )
+                if spec.func == "linreg_merge":
+                    acc = mlreg.merge_accumulators(
+                        v_s.data, contributes0, gid_s0, 2
+                    )[:1]
+                else:
+                    lab0 = bk
+                    lab_d = mlreg.logical_values(lab0.data, lab0.type)[order0]
+                    if lab0.valid is not None:
+                        contributes0 = contributes0 & lab0.valid[order0]
+                    lens0 = (
+                        v.lengths[order0]
+                        if getattr(v, "lengths", None) is not None
+                        else jnp.full(
+                            v_s.data.shape[0], v_s.data.shape[1], jnp.int32
+                        )
+                    )
+                    acc = mlreg.group_accumulate(
+                        mlreg.logical_values(v_s.data, v.type), lens0, lab_d,
+                        contributes0, gid_s0, 2,
+                    )[:1]
+                valid_g0 = None
+                if spec.func == "linreg":
+                    acc, has0 = mlreg.solve_weights(acc)
+                    valid_g0 = has0
+                blk = Block(
+                    acc, T.ArrayType(T.DOUBLE), valid_g0,
+                    lengths=jnp.full(acc.shape[0], acc.shape[1], jnp.int32),
+                )
             else:
                 contributes0 = live0[order0] if v.valid is None else (
                     live0[order0] & v_s.valid_mask()

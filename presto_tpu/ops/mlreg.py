@@ -1,0 +1,120 @@
+"""ML-in-SQL: linear regression as a mergeable aggregate.
+
+Re-designed equivalent of presto-ml (2,946 LoC: learn_regressor /
+learn_classifier aggregates + regress/classify scalars over libsvm
+models). TPU-first reduction: the MODEL is an ARRAY(DOUBLE) of weights
+(features..., intercept LAST) — no opaque binary blobs — and LEARNING is
+the normal-equations accumulation, which is exactly a segment-sum:
+
+    acc(group) = [ n | X^T y | vec(X^T X) ]   with X = [features, 1]
+
+Accumulators use a CANONICAL width (K_MAX features) regardless of the
+batch's trace-static array width, so partials from different batches /
+shards always align lane-for-lane and MERGE BY ADDITION (the same
+contract as ops/qsketch.py). Unused feature lanes contribute zeros; the
+ridge term keeps the per-group (K_MAX+1)^2 solve nonsingular, so absent
+features learn ~0 weights. `regress` evaluates a model against features
+as one fused dot product, reading the intercept at the model's LAST
+LIVE lane (models may be user-written literal arrays of any length).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+K_MAX = 15  # max feature lanes; canonical accumulator layout
+_M = K_MAX + 1  # + intercept
+ACC_WIDTH = 1 + _M + _M * _M
+_RIDGE = 1e-9
+
+
+def logical_values(data: jnp.ndarray, typ) -> jnp.ndarray:
+    """Array/scalar decimal storage -> logical float64 (regression inputs
+    may be decimal-scaled ints). Shared by learn + regress."""
+    d = data.astype(jnp.float64)
+    et = getattr(typ, "element", typ)
+    scale = getattr(et, "scale", None)
+    return d / (10**scale) if scale else d
+
+
+def group_accumulate(
+    features: jnp.ndarray,  # (n, k) float64 LOGICAL values
+    lengths: jnp.ndarray,  # (n,) per-row feature counts
+    label: jnp.ndarray,  # (n,) float64 logical
+    contributes: jnp.ndarray,  # (n,) bool
+    gid: jnp.ndarray,  # (n,) int32 sorted group ids
+    num_groups: int,
+) -> jnp.ndarray:
+    """Per-group flat normal-equation accumulators: (num_groups,
+    ACC_WIDTH), canonical layout independent of k."""
+    n, k = features.shape
+    if k > K_MAX:
+        raise ValueError(
+            f"learn_linear_regression supports up to {K_MAX} features, "
+            f"got {k}"
+        )
+    x = jnp.zeros((n, _M), jnp.float64)
+    lane_ok = jnp.arange(k)[None, :] < lengths[:, None]
+    x = x.at[:, :k].set(jnp.where(lane_ok, features, 0.0))
+    x = x.at[:, K_MAX].set(1.0)
+    # mask EXCLUDED rows with where (a 0-weight multiply would let their
+    # NaN/Inf storage poison the group — every aggregate masks this way)
+    x = jnp.where(contributes[:, None], x, 0.0)
+    y = jnp.where(contributes, label, 0.0)
+    w = contributes.astype(jnp.float64)
+    xty = x * y[:, None]  # (n, _M)
+    xtx = x[:, :, None] * x[:, None, :]  # (n, _M, _M)
+    flat = jnp.concatenate(
+        [w[:, None], xty, xtx.reshape(n, _M * _M)], axis=1
+    )
+    return jax.ops.segment_sum(flat, gid, num_segments=num_groups)
+
+
+def merge_accumulators(
+    accs: jnp.ndarray, contributes: jnp.ndarray, gid: jnp.ndarray,
+    num_groups: int,
+) -> jnp.ndarray:
+    rows = jnp.where(
+        contributes[:, None], accs[:, :ACC_WIDTH], 0.0
+    )
+    return jax.ops.segment_sum(rows, gid, num_segments=num_groups)
+
+
+def solve_weights(accs: jnp.ndarray):
+    """(G, ACC_WIDTH) accumulators -> ((G, _M) weights, (G,) has-rows).
+
+    Weight layout: [w_0 .. w_{K_MAX-1}, intercept]."""
+    g = accs.shape[0]
+    counts = accs[:, 0]
+    xty = accs[:, 1 : 1 + _M]
+    xtx = accs[:, 1 + _M :].reshape(g, _M, _M)
+    xtx = xtx + _RIDGE * jnp.eye(_M, dtype=xtx.dtype)[None]
+    w = jnp.linalg.solve(xtx, xty[..., None])[..., 0]
+    return w, counts > 0
+
+
+def predict(
+    features: jnp.ndarray,
+    flengths: jnp.ndarray,
+    model: jnp.ndarray,
+    mlengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """regress(features, model): dot(features, w) + intercept, honoring
+    BOTH sides' live lengths (the intercept is the model's last LIVE
+    lane — padded storage lanes are never read)."""
+    n, k = features.shape
+    mw = model.shape[1]
+    m = model.astype(jnp.float64)
+    f = features.astype(jnp.float64)
+    n_weights = jnp.maximum(mlengths - 1, 0)  # lanes before the intercept
+    use = jnp.minimum(n_weights, jnp.minimum(flengths, k))
+    lane = jnp.arange(min(k, mw))[None, :]
+    ok = lane < use[:, None]
+    dot = jnp.sum(
+        jnp.where(ok, f[:, : min(k, mw)] * m[:, : min(k, mw)], 0.0),
+        axis=1,
+    )
+    icpt_idx = jnp.clip(mlengths - 1, 0, mw - 1)
+    intercept = jnp.take_along_axis(m, icpt_idx[:, None], axis=1)[:, 0]
+    return dot + intercept

@@ -40,6 +40,7 @@ AGG_FUNCS = {
     "count", "sum", "avg", "min", "max", "checksum", "approx_distinct",
     "min_by", "max_by", "approx_percentile",
     "array_agg", "map_agg", "histogram",
+    "learn_linear_regression", "learn_regressor",
 }
 
 # aggregates planned by rewriting onto the core set (reference: many of
@@ -1083,6 +1084,34 @@ class Planner:
                 spec = AggSpec(
                     "percentile", e, self.channel(fname), e.type,
                     input2=ir.Literal(frac, T.DOUBLE),
+                )
+            elif fname in ("learn_linear_regression", "learn_regressor"):
+                # presto-ml's learn_regressor(label, features) — model =
+                # ARRAY(DOUBLE) weights via mergeable normal equations
+                # (ops/mlreg.py); features is an ARRAY(DOUBLE)
+                if len(call.args) != 2:
+                    raise PlanningError(
+                        f"{fname} takes (label, features)"
+                    )
+                if call.distinct:
+                    raise PlanningError(
+                        f"{fname} does not support DISTINCT"
+                    )
+                label = sctx.translate(call.args[0])
+                feats = sctx.translate(call.args[1])
+                if not isinstance(feats.type, T.ArrayType):
+                    raise PlanningError(
+                        f"{fname} features must be an array"
+                    )
+                if filt is not None:
+                    label = ir.Call(
+                        "if",
+                        (filt, label, ir.Literal(None, label.type)),
+                        label.type,
+                    )
+                spec = AggSpec(
+                    "linreg", feats, self.channel(fname),
+                    T.ArrayType(T.DOUBLE), input2=label,
                 )
             elif fname == "map_agg":
                 if len(call.args) != 2:

@@ -1,0 +1,170 @@
+"""ML-in-SQL (reference presto-ml learn_regressor/regress):
+learn_linear_regression aggregate (mergeable normal equations,
+ops/mlreg.py) + regress scalar, single-node / grouped / streaming /
+distributed."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+def _data(n=2000, seed=0):
+    """y = 3*x1 - 2*x2 + 5 + small noise; two groups with different
+    intercepts."""
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(0, 1, n)
+    x2 = rng.normal(0, 1, n)
+    g = rng.integers(0, 2, n)
+    y = 3 * x1 - 2 * x2 + 5 + 10 * g + rng.normal(0, 0.01, n)
+    return x1, x2, g, y
+
+
+@pytest.fixture()
+def sess():
+    x1, x2, g, y = _data()
+    return Session(
+        MemoryCatalog(
+            {
+                "obs": Page.from_dict(
+                    {"x1": x1, "x2": x2, "g": g.astype(np.int64), "y": y}
+                )
+            }
+        )
+    )
+
+
+def _weights(rows):
+    """Model layout: [w_0 .. w_{K_MAX-1}, intercept] — canonical width."""
+    w = [float(v) for v in rows]
+    return w
+
+
+def test_learn_and_regress_global(sess):
+    rows = sess.query(
+        "select learn_linear_regression(y, array[x1, x2]) m from obs"
+    ).rows()
+    w = _weights(rows[0][0])
+    from presto_tpu.ops.mlreg import K_MAX
+
+    assert len(w) == K_MAX + 1
+    # the global fit mixes two intercept groups: residual sd ~5 makes the
+    # coefficient standard error ~0.11 at n=2000
+    assert abs(w[0] - 3) < 0.4 and abs(w[1] + 2) < 0.4
+    assert abs(w[-1] - 10) < 0.5  # mean intercept of the two groups
+    assert all(abs(v) < 1e-6 for v in w[2:K_MAX])  # unused lanes ~0
+    # regress against literal weights
+    pred = sess.query(
+        "select avg(abs(y - regress(array[x1, x2],"
+        " array[3.0, -2.0, 10.0]))) from obs"
+    ).rows()
+    assert float(pred[0][0]) < 6.0  # group offset dominates the residual
+
+
+def test_learn_grouped(sess):
+    rows = sess.query(
+        "select g, learn_linear_regression(y, array[x1, x2]) m "
+        "from obs group by g order by g"
+    ).rows()
+    assert len(rows) == 2
+    w0 = _weights(rows[0][1])
+    w1 = _weights(rows[1][1])
+    assert abs(w0[-1] - 5) < 0.05
+    assert abs(w1[-1] - 15) < 0.05
+    for w in (w0, w1):
+        assert abs(w[0] - 3) < 0.05 and abs(w[1] + 2) < 0.05
+
+
+def test_streaming_matches_single_node(sess):
+    """Partial accumulators merge across batches (decompose_partial) and
+    land on the same weights."""
+    x1, x2, g, y = _data()
+    st = Session(
+        MemoryCatalog(
+            {
+                "obs": Page.from_dict(
+                    {"x1": x1, "x2": x2, "g": g.astype(np.int64), "y": y}
+                )
+            }
+        ),
+        streaming=True,
+        batch_rows=256,
+    )
+    sql = (
+        "select g, learn_linear_regression(y, array[x1, x2]) m "
+        "from obs group by g order by g"
+    )
+    want = sess.query(sql).rows()
+    got = st.query(sql).rows()
+    for (g1, m1), (g2, m2) in zip(want, got):
+        assert g1 == g2
+        for a, b in zip(_weights(m1), _weights(m2)):
+            assert abs(a - b) < 1e-6
+
+
+def test_nulls_excluded(sess):
+    rows = sess.query(
+        "select learn_linear_regression("
+        " case when x1 > 10 then null else y end, array[x1, x2]) "
+        "from obs"
+    ).rows()
+    w = _weights(rows[0][0])
+    assert abs(w[0] - 3) < 0.4  # no x1 > 10 in the data: same model
+
+
+def test_decimal_inputs_descale():
+    """Decimal-typed label/features learn the same logical model."""
+    n = 500
+    rng = np.random.default_rng(7)
+    x = rng.integers(-500, 500, n)  # decimal(6,2) storage: value x/100
+    y_logical = 4.0 * (x / 100.0) + 2.0
+    sess = Session(
+        MemoryCatalog(
+            {
+                "d": Page.from_dict(
+                    {
+                        "x": (x, T.DecimalType(6, 2)),
+                        "y": y_logical,
+                    }
+                )
+            }
+        )
+    )
+    rows = sess.query(
+        "select learn_linear_regression(y, array[x]) from d"
+    ).rows()
+    w = [float(v) for v in rows[0][0]]
+    assert abs(w[0] - 4.0) < 1e-6 and abs(w[-1] - 2.0) < 1e-6
+
+
+def test_empty_group_yields_null_model(sess):
+    rows = sess.query(
+        "select learn_linear_regression(y, array[x1, x2]) from obs "
+        "where x1 > 1e9"
+    ).rows()
+    assert rows[0][0] is None
+
+
+def test_regress_honors_model_length():
+    """A shorter model row in padded storage reads ITS OWN last live lane
+    as the intercept, not the padding."""
+    sess = Session(
+        MemoryCatalog(
+            {
+                "p": Page.from_dict(
+                    {"x": np.array([1.0, 1.0]), "w": np.array([2.0, 5.0])}
+                )
+            }
+        )
+    )
+    full = sess.query(
+        "select regress(array[x], array[2.0, 10.0]) from p limit 1"
+    ).rows()
+    assert float(full[0][0]) == 12.0  # 1*2 + 10
+    short = sess.query(
+        "select regress(array[x], array[5.0]) from p limit 1"
+    ).rows()
+    assert float(short[0][0]) == 5.0  # intercept-only model
