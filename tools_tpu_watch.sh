@@ -12,7 +12,7 @@ while true; do
     echo "$(date -u +%FT%TZ) relay port open" >> $LOG
     if timeout 180 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; jnp.ones(128).block_until_ready(); print('alive')" >> $LOG 2>&1; then
       echo "$(date -u +%FT%TZ) TPU ALIVE - running bench" >> $LOG
-      BENCH_INIT_ATTEMPTS=2 BENCH_INIT_TIMEOUT=180 timeout 2400 python bench.py >> $LOG 2>&1
+      BENCH_INIT_ATTEMPTS=2 BENCH_INIT_TIMEOUT=180 BENCH_PROBE_DEADLINE=360 timeout 2400 python bench.py >> $LOG 2>&1
       # only a FRESH artifact (newer than watcher start) counts as evidence
       if [ TPU_BENCH.json -nt "$STAMP" ] && \
          python -c "import json;d=json.load(open('TPU_BENCH.json'));assert d['result']['backend']=='tpu'" 2>/dev/null; then
