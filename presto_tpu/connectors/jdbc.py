@@ -232,15 +232,29 @@ class SqliteCatalog(Connector):
             vals = [r[i] for r in rows]
             valid = np.array([v is not None for v in vals], bool)
             if isinstance(t, T.VarcharType):
-                sorted_d, d_arr = self._dictionary(table, c)
-                data = np.searchsorted(
-                    d_arr,
-                    np.array(
-                        [str(v) if v is not None else "" for v in vals],
-                        object,
-                    ),
-                ).astype(np.int32)
-                data = np.clip(data, 0, max(len(sorted_d) - 1, 0))
+                strs = np.array(
+                    [str(v) if v is not None else "" for v in vals], object
+                )
+                for attempt in (0, 1):
+                    sorted_d, d_arr = self._dictionary(table, c)
+                    data = np.searchsorted(d_arr, strs).astype(np.int32)
+                    data = np.clip(data, 0, max(len(sorted_d) - 1, 0))
+                    miss = valid & (
+                        d_arr[data] != strs
+                        if len(sorted_d)
+                        else np.ones(len(strs), bool)
+                    )
+                    if not miss.any():
+                        break
+                    # the cached dictionary predates remotely-inserted
+                    # values: rebuild once rather than silently assigning
+                    # a wrong code (round-4 advisor)
+                    self._dicts.pop((table, c), None)
+                    if attempt:
+                        raise LookupError(
+                            f"varchar value absent from {table}.{c} "
+                            "dictionary after rebuild"
+                        )
                 blk = Block.from_numpy(
                     data, t,
                     valid=None if valid.all() else valid,

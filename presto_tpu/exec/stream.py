@@ -502,16 +502,21 @@ class StreamingExecutor:
         if lcount != rcount or len(lcols) != 1 or len(rcols) != 1:
             return None
 
-        def key_matches(keys, scan, bucket_col):
-            src = {ch: col for ch, col, _ in scan.columns}
-            for k in keys:
-                if isinstance(k, ir.ColumnRef) and src.get(k.name) == bucket_col:
-                    return True
-            return False
-
-        if not key_matches(node.left_keys, lscan, lcols[0]):
-            return None
-        if not key_matches(node.right_keys, rscan, rcols[0]):
+        lsrc = {ch: col for ch, col, _ in lscan.columns}
+        rsrc = {ch: col for ch, col, _ in rscan.columns}
+        # the two bucket columns must be PAIRED at the same equi-key index:
+        # checking each side independently would co-locate rows by
+        # DIFFERENT keys (round-4 advisor: a crossed multi-key join — left
+        # bucketed by k2, right by j1, on k1=j1 and k2=j2 — put matching
+        # rows in different buckets and silently dropped them)
+        paired = any(
+            isinstance(lk, ir.ColumnRef)
+            and isinstance(rk, ir.ColumnRef)
+            and lsrc.get(lk.name) == lcols[0]
+            and rsrc.get(rk.name) == rcols[0]
+            for lk, rk in zip(node.left_keys, node.right_keys)
+        )
+        if not paired:
             return None
         return (lscan, lwrap), (rscan, rwrap), lcount
 

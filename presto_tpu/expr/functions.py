@@ -1920,10 +1920,15 @@ def _element_at(a: Val, idx: Val, out_type: T.Type) -> Val:
     For MAP values, key lookup -> value or NULL."""
     if isinstance(a.type, T.MapType):
         return _map_element_at(a, idx, out_type)
-    if a.lengths is None:
+    if a.lengths is None and a.data.ndim != 2:
         raise TypeError("element_at requires an array value")
     i64 = idx.data.astype(jnp.int64)
-    lens = a.lengths.astype(jnp.int64)
+    if a.lengths is None:
+        # fixed-width array with no per-row lengths (e.g. an accumulator
+        # column rebuilt from an exchange): every lane is live
+        lens = jnp.full(a.data.shape[0], a.data.shape[1], jnp.int64)
+    else:
+        lens = a.lengths.astype(jnp.int64)
     pos = jnp.where(i64 < 0, lens + i64, i64 - 1)
     in_range = (pos >= 0) & (pos < lens)
     safe = jnp.clip(pos, 0, max(a.data.shape[1] - 1, 0)).astype(jnp.int32)

@@ -26,6 +26,7 @@ import unicodedata
 import zlib
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -386,13 +387,35 @@ def _array_min(a: Val, out_type: T.Type) -> Val:
     return Val(out, and_valid(a.valid, has), out_type, a.dict_id)
 
 
-def _dedup_sorted(a: Val, keep_order: bool = False):
-    """Sort elements per row (NULL/absent last), mark first occurrences."""
+def _sort_key(data: jnp.ndarray, live: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving int64 sort key; dead/NULL elements sort last.
+
+    Floats are bitcast to int64 with the negative range bit-reversed (the
+    IEEE754 total-order trick), so the key orders AND equality-compares
+    exactly like the original values — the element data itself is never
+    cast (round-4 advisor: the old int64 cast corrupted ARRAY(DOUBLE))."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # canonicalize -0.0 to +0.0 so signed zeros compare equal (an
+        # explicit where: XLA folds the usual `x + 0.0` idiom away)
+        f = data.astype(jnp.float64)
+        f = jnp.where(f == 0.0, 0.0, f)
+        b = jax.lax.bitcast_convert_type(f, jnp.int64)
+        sign = jnp.int64(-(2**63))
+        k = jnp.where(b < 0, (~b) ^ sign, b)
+    else:
+        k = data.astype(jnp.int64)
+    return jnp.where(live, k, jnp.iinfo(jnp.int64).max)
+
+
+def _dedup_sorted(a: Val):
+    """Sort elements per row (NULL/absent last), mark first occurrences.
+
+    Returns (sorted original data, sorted live mask, first-occurrence mask)."""
     live = _elem_live(a)
-    w = a.data.shape[1]
-    big = 2**62
-    key = jnp.where(live, a.data.astype(jnp.int64), big)
+    key = _sort_key(a.data, live)
     order = jnp.argsort(key, axis=1)
+    sdata = jnp.take_along_axis(a.data, order, axis=1)
+    slive = jnp.take_along_axis(live, order, axis=1)
     skey = jnp.take_along_axis(key, order, axis=1)
     first = jnp.concatenate(
         [
@@ -400,36 +423,37 @@ def _dedup_sorted(a: Val, keep_order: bool = False):
             skey[:, 1:] != skey[:, :-1],
         ],
         axis=1,
-    ) & (skey != big)
-    return key, order, skey, first
+    ) & slive
+    return sdata, slive, first
 
 
 @register("array_distinct", lambda ts: ts[0])
 def _array_distinct(a: Val, out_type: T.Type) -> Val:
-    key, order, skey, first = _dedup_sorted(a)
+    sdata, slive, first = _dedup_sorted(a)
     w = a.data.shape[1]
     # compact the kept elements to the front, preserving sorted order
     pos = jnp.cumsum(first.astype(jnp.int32), axis=1) - 1
-    out = jnp.full_like(skey, 0)
-    rows = jnp.arange(key.shape[0])[:, None]
+    rows = jnp.arange(sdata.shape[0])[:, None]
+    # rejects write 0 to slot w-1; safe because a row with any reject keeps
+    # at most w-1 elements, so no kept element ever lands in slot w-1
     scatter_pos = jnp.where(first, pos, w - 1)
-    out = out.at[rows, scatter_pos].set(jnp.where(first, skey, 0))
+    out = jnp.zeros_like(sdata)
+    out = out.at[rows, scatter_pos].set(jnp.where(first, sdata, 0))
     lens = jnp.sum(first, axis=1).astype(jnp.int32)
-    data = out.astype(a.data.dtype)
     return Val(
-        data, a.valid, a.type, a.dict_id, lengths=lens
+        out, a.valid, a.type, a.dict_id, lengths=lens
     )
 
 
 @register("array_sort", lambda ts: ts[0])
 def _array_sort(a: Val, out_type: T.Type) -> Val:
     live = _elem_live(a)
-    big = 2**62
-    key = jnp.where(live, a.data.astype(jnp.int64), big)
-    skey = jnp.sort(key, axis=1)
+    order = jnp.argsort(_sort_key(a.data, live), axis=1)
+    sdata = jnp.take_along_axis(a.data, order, axis=1)
+    slive = jnp.take_along_axis(live, order, axis=1)
     lens = jnp.sum(live, axis=1).astype(jnp.int32)
     return Val(
-        jnp.where(skey == big, 0, skey).astype(a.data.dtype),
+        jnp.where(slive, sdata, 0),
         a.valid,
         a.type,
         a.dict_id,

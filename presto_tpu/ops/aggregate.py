@@ -47,6 +47,7 @@ SUPPORTED = (
     "approx_distinct", "hll_registers", "hll_merge",
     "qsketch", "qsketch_merge",
     "linreg", "linreg_acc", "linreg_merge",
+    "cmoments", "cmoments_merge",
 )
 
 
@@ -554,6 +555,7 @@ def grouped_aggregate_direct(
             "approx_distinct", "hll_registers", "hll_merge",
             "qsketch", "qsketch_merge",
             "linreg", "linreg_acc", "linreg_merge",
+            "cmoments", "cmoments_merge",
         ):
             raise NotImplementedError(
                 f"{spec.func} runs through the SORT aggregation strategy"
@@ -747,6 +749,28 @@ def grouped_aggregate_sorted(
                     data_s, contributes, gid_s, max_groups + 1
                 )[:max_groups]
             blocks.append(Block(sk, T.ArrayType(T.BIGINT), None))
+            names.append(spec.name)
+            continue
+        if spec.func in ("cmoments", "cmoments_merge"):
+            from . import moments as mo
+
+            contributes = live_s if v.valid is None else (
+                live_s & v.valid[order]
+            )
+            if spec.func == "cmoments":
+                acc = mo.group_moments(
+                    v.data[order], contributes, gid_s, max_groups + 1
+                )[:max_groups]
+            else:
+                acc = mo.merge_moments(
+                    v.data[order], contributes, gid_s, max_groups + 1
+                )[:max_groups]
+            blocks.append(
+                Block(
+                    acc, T.ArrayType(T.DOUBLE), None,
+                    lengths=jnp.full(acc.shape[0], mo.ACC_WIDTH, jnp.int32),
+                )
+            )
             names.append(spec.name)
             continue
         if spec.func in ("linreg", "linreg_acc", "linreg_merge"):
@@ -973,6 +997,15 @@ def decompose_partial(aggs: Sequence[AggSpec]):
                 AggSpec("qsketch_merge", ColumnRef(s_name, sk_t), s_name, sk_t)
             )
             post.append(QSketchPost(a.name, s_name, frac, a.output_type))
+        elif a.func == "cmoments":
+            # mergeable central-moment accumulators (ops/moments.py):
+            # partial rows re-center on the merged mean at final time
+            acc_t = T.ArrayType(T.DOUBLE)
+            partial.append(a)
+            final.append(
+                AggSpec("cmoments_merge", ColumnRef(a.name, acc_t), a.name,
+                        acc_t)
+            )
         elif a.func == "linreg":
             # mergeable normal-equation accumulators (ops/mlreg.py)
             acc_t = T.ArrayType(T.DOUBLE)
@@ -1075,6 +1108,7 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
             "approx_distinct", "hll_registers", "hll_merge",
             "qsketch", "qsketch_merge",
             "linreg", "linreg_acc", "linreg_merge",
+            "cmoments", "cmoments_merge",
         ):
             gid0 = jnp.zeros(page.capacity, jnp.int32)
             live0 = live
@@ -1121,6 +1155,24 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
                         v_s.data, contributes0, gid_s0, 2
                     )[:1]
                 blk = Block(sk, T.ArrayType(T.BIGINT), None)
+            elif spec.func in ("cmoments", "cmoments_merge"):
+                from . import moments as mo
+
+                contributes0 = live0[order0] if v.valid is None else (
+                    live0[order0] & v_s.valid_mask()
+                )
+                if spec.func == "cmoments":
+                    acc = mo.group_moments(
+                        v_s.data, contributes0, gid_s0, 2
+                    )[:1]
+                else:
+                    acc = mo.merge_moments(
+                        v_s.data, contributes0, gid_s0, 2
+                    )[:1]
+                blk = Block(
+                    acc, T.ArrayType(T.DOUBLE), None,
+                    lengths=jnp.full(acc.shape[0], mo.ACC_WIDTH, jnp.int32),
+                )
             elif spec.func in ("linreg", "linreg_acc", "linreg_merge"):
                 from . import mlreg
 

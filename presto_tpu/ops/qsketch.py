@@ -18,10 +18,12 @@ bin = (sign, floor(log2 |x|), sub-bin). Properties:
   ~3%); the reference's qdigest bounds RANK error (default 1%) instead —
   a different but standard sketch contract (documented at the API edge).
 
-Layout (B = 2049 lanes of int64 per group):
+Layout (B = 3073 lanes of int64 per group; _POS = (_E_MAX-_E_MIN)*SUB
+= 1536):
   [0]                    exact zero
-  [1 .. 1024]            positives: 1 + e*SUB + sub,  e in [0, 63]
-  [1025 .. 2048]         negatives, mirrored
+  [1 .. 1536]            positives: 1 + (e - _E_MIN)*SUB + sub,
+                         e in [_E_MIN, _E_MAX) = [-32, 64)
+  [1537 .. 3072]         negatives, mirrored
 """
 
 from __future__ import annotations

@@ -155,8 +155,10 @@ class RelationPlan:
 
 
 class Planner:
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, views=None):
         self.catalog = catalog
+        self.views = views or {}  # name -> view query SQL text
+        self._view_stack: set = set()
         self._counter = itertools.count()
 
     def channel(self, base: str) -> str:
@@ -647,6 +649,30 @@ class Planner:
                 [
                     FieldRef(alias, n, f.channel, f.type)
                     for n, f in zip(names, sub.scope.fields)
+                ]
+            )
+            return RelationPlan(sub.node, scope)
+        if name in self.views:
+            # planner-time view expansion (reference StatementAnalyzer
+            # view resolution + execution/CreateViewTask.java): the stored
+            # query text is parsed and planned inline like a CTE
+            if name in self._view_stack:
+                raise PlanningError(f"view {name!r} is recursive")
+            from .parser import parse as _parse
+
+            vast = _parse(self.views[name])
+            if not isinstance(vast, t.Query):
+                raise PlanningError(f"view {name!r} is not a SELECT query")
+            self._view_stack.add(name)
+            try:
+                sub = self.plan_query(vast, outer, {})
+            finally:
+                self._view_stack.discard(name)
+            alias = rel.alias or rel.name
+            scope = Scope(
+                [
+                    FieldRef(alias, f.name, f.channel, f.type)
+                    for f in sub.scope.fields
                 ]
             )
             return RelationPlan(sub.node, scope)
@@ -1194,16 +1220,17 @@ class Planner:
             return ir.Call("if", (cond, value, ir.Literal(None, D)), D)
 
         def moments(arg_ast):
+            # stable M2 from the central-moments accumulator — the raw
+            # power-sum form (ss - s*s/n) cancels catastrophically for
+            # large-mean data, same failure class as skewness/kurtosis
             x = masked(ir.cast(sctx.translate(arg_ast), D))
-            s = emit("sum", x, "sum")
-            ss = emit("sum", c("multiply", x, x), "sumsq")
+            arr_t = T.ArrayType(D)
+            sp = AggSpec("cmoments", x, self.channel("mom"), arr_t)
+            aggs.append(sp)
+            mom = ir.ColumnRef(sp.name, arr_t)
             n = emit("count", x, "cnt")
             nd = ir.cast(n, D)
-            num = c(
-                "greatest",
-                c("subtract", ss, c("divide", c("multiply", s, s), nd)),
-                dlit(0.0),
-            )
+            num = ir.Call("element_at", (mom, ir.lit(3)), D)
             return n, nd, num
 
         if fname in ("stddev", "stddev_samp", "variance", "var_samp"):
@@ -1217,94 +1244,33 @@ class Planner:
             out = var if fname == "var_pop" else c("sqrt", var)
             return null_if_under(n, 1, out)
         if fname in ("skewness", "kurtosis"):
-            # central moments from raw power sums (reference
-            # CentralMomentsAggregation): m2/m3/m4 are SUMS of centered
-            # powers; skewness = sqrt(n) m3 / m2^1.5, kurtosis (excess)
-            # = n m4 / m2^2 - 3; NULL under 3 (resp. 4) rows
+            # stable central moments via the mergeable accumulator
+            # (ops/moments.py; reference CentralMomentsAggregation,
+            # operator/aggregation/AggregationUtils.java) — the old raw
+            # power-sum rewrite catastrophically cancelled for large-mean
+            # data (round-4 advisor: (nan, -inf) at mean ~1e9)
             x = masked(ir.cast(sctx.translate(call.args[0]), D))
-            s1 = emit("sum", x, "s1")
-            s2 = emit("sum", c("multiply", x, x), "s2")
-            s3 = emit("sum", c("multiply", c("multiply", x, x), x), "s3")
+            arr_t = T.ArrayType(D)
+            sp = AggSpec("cmoments", x, self.channel("mom"), arr_t)
+            aggs.append(sp)
+            mom = ir.ColumnRef(sp.name, arr_t)
             n = emit("count", x, "cnt")
             nd = ir.cast(n, D)
-            m2 = c("subtract", s2, c("divide", c("multiply", s1, s1), nd))
+
+            def elem(i):
+                return ir.Call("element_at", (mom, ir.lit(i)), D)
+
+            m2, m3, m4 = elem(3), elem(4), elem(5)
             if fname == "skewness":
-                m3 = c(
-                    "add",
-                    c(
-                        "subtract",
-                        s3,
-                        c(
-                            "divide",
-                            c("multiply", dlit(3.0), c("multiply", s1, s2)),
-                            nd,
-                        ),
-                    ),
-                    c(
-                        "divide",
-                        c(
-                            "multiply",
-                            dlit(2.0),
-                            c("multiply", s1, c("multiply", s1, s1)),
-                        ),
-                        c("multiply", nd, nd),
-                    ),
-                )
                 out = c(
                     "divide",
                     c("multiply", c("sqrt", nd), m3),
                     c("power", m2, dlit(1.5)),
                 )
                 return null_if_under(n, 3, out)
-            s4 = emit(
-                "sum",
-                c("multiply", c("multiply", x, x), c("multiply", x, x)),
-                "s4",
-            )
-            m4 = c(
-                "subtract",
-                c(
-                    "add",
-                    c(
-                        "subtract",
-                        s4,
-                        c(
-                            "divide",
-                            c("multiply", dlit(4.0), c("multiply", s1, s3)),
-                            nd,
-                        ),
-                    ),
-                    c(
-                        "divide",
-                        c(
-                            "multiply",
-                            dlit(6.0),
-                            c("multiply", c("multiply", s1, s1), s2),
-                        ),
-                        c("multiply", nd, nd),
-                    ),
-                ),
-                c(
-                    "divide",
-                    c(
-                        "multiply",
-                        dlit(3.0),
-                        c(
-                            "multiply",
-                            c("multiply", s1, s1),
-                            c("multiply", s1, s1),
-                        ),
-                    ),
-                    c("multiply", nd, c("multiply", nd, nd)),
-                ),
-            )
             out = c(
                 "subtract",
-                c(
-                    "divide",
-                    c("multiply", nd, m4),
-                    c("multiply", m2, m2),
-                ),
+                c("divide", c("multiply", nd, m4), c("multiply", m2, m2)),
                 dlit(3.0),
             )
             return null_if_under(n, 4, out)

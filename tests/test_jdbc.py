@@ -150,3 +150,28 @@ def test_index_join_fetches_only_matching_rows(db):
         if "big_dim" in q and "LIMIT" in q and " IN (" not in q
     ]
     assert not full_scans, full_scans
+
+
+def test_stale_dictionary_rebuilt_on_remote_insert(db):
+    """A varchar value inserted into the remote AFTER the dictionary cache
+    was built must decode correctly (cache rebuild), not silently map to a
+    wrong cached string (round-4 advisor)."""
+    cat = SqliteCatalog(db)
+    sess = Session(cat)
+    assert sorted(
+        r[0] for r in sess.query(
+            "select name from users where name is not null"
+        ).rows()
+    ) == ["ada", "bob", "cyd"]
+    conn = sqlite3.connect(db)
+    conn.execute(
+        "INSERT INTO users VALUES (5, 'zed', 1.0, '2023-01-01', 0)"
+    )
+    conn.commit()
+    conn.close()
+    got = sorted(
+        r[0] for r in sess.query(
+            "select name from users where name is not null"
+        ).rows()
+    )
+    assert got == ["ada", "bob", "cyd", "zed"]

@@ -1,0 +1,173 @@
+"""skewness/kurtosis via the mergeable central-moments accumulator
+(ops/moments.py; reference CentralMomentsAggregation) + the round-4
+advisor regressions: raw-power-sum cancellation and the array_sort
+int64-cast corruption of ARRAY(DOUBLE)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.page import Page
+from presto_tpu.parallel.mesh import default_mesh
+from presto_tpu.session import Session
+
+
+def _skew(x):
+    x = np.asarray(x, np.float64)
+    d = x - x.mean()
+    m2, m3 = (d**2).sum(), (d**3).sum()
+    return np.sqrt(len(x)) * m3 / m2**1.5
+
+
+def _kurt(x):
+    x = np.asarray(x, np.float64)
+    d = x - x.mean()
+    m2, m4 = (d**2).sum(), (d**4).sum()
+    return len(x) * m4 / m2**2 - 3.0
+
+
+def _sess(cols, mesh=None):
+    return Session(
+        MemoryCatalog({"t": Page.from_dict(cols)}), mesh=mesh
+    )
+
+
+def test_skew_kurt_basic():
+    v = np.array([1.0, 1.0, 1.0, 2.0, 10.0])
+    s = _sess({"v": v})
+    (sk, ku), = s.query("select skewness(v), kurtosis(v) from t").rows()
+    assert sk == pytest.approx(_skew(v), rel=1e-12)
+    assert ku == pytest.approx(_kurt(v), rel=1e-12)
+
+
+def test_skew_kurt_large_mean_no_cancellation():
+    # round-4 advisor: raw power sums returned (nan, -inf) here
+    v = np.array([1e9 + i for i in range(1, 11)])
+    s = _sess({"v": v})
+    (sk, ku), = s.query("select skewness(v), kurtosis(v) from t").rows()
+    assert sk == pytest.approx(0.0, abs=1e-6)
+    assert ku == pytest.approx(_kurt(np.arange(1, 11)), rel=1e-6)
+
+
+def test_skew_kurt_grouped_with_nulls():
+    g = np.array([1, 1, 1, 1, 2, 2, 2, 2, 2], dtype=np.int64)
+    v = np.array([3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0, 5.0])
+    s = _sess({"g": g, "v": v})
+    rows = s.query(
+        "select g, skewness(v), kurtosis(v) from t group by g order by g"
+    ).rows()
+    for gv, sk, ku in rows:
+        m = v[g == gv]
+        assert sk == pytest.approx(_skew(m), rel=1e-12)
+        assert ku == pytest.approx(_kurt(m), rel=1e-12)
+
+
+def test_skew_kurt_null_under_min_rows():
+    s = _sess({"v": np.array([1.0, 2.0])})
+    (sk, ku), = s.query("select skewness(v), kurtosis(v) from t").rows()
+    assert sk is None and ku is None
+    s3 = _sess({"v": np.array([1.0, 2.0, 4.0])})
+    (sk3, ku3), = s3.query("select skewness(v), kurtosis(v) from t").rows()
+    assert sk3 is not None and ku3 is None
+
+
+def test_skew_kurt_distributed_matches_single_node():
+    # exercises decompose_partial("cmoments") + merge_moments re-centering
+    rng = np.random.default_rng(7)
+    g = rng.integers(0, 5, 400)
+    v = 1e8 + rng.random(400) * 10  # large mean: merge must stay stable
+    dsess = _sess({"g": (g, T.BIGINT), "v": (v, T.DOUBLE)},
+                  mesh=default_mesh(8))
+    rows = dsess.query(
+        "select g, skewness(v), kurtosis(v) from t group by g order by g"
+    ).rows()
+    assert len(rows) == len(set(g.tolist()))
+    for gv, sk, ku in rows:
+        m = v[g == gv]
+        assert sk == pytest.approx(_skew(m), rel=1e-6, abs=1e-6)
+        assert ku == pytest.approx(_kurt(m), rel=1e-6)
+
+
+# -- round-4 advisor: ARRAY(DOUBLE) corruption by int64 sort keys --------
+
+
+@pytest.fixture(scope="module")
+def asession():
+    return _sess({"v": np.array([1], dtype=np.int64)})
+
+
+def one(session, expr):
+    return session.query(f"select {expr} x from t limit 1").rows()[0][0]
+
+
+def test_array_sort_double(asession):
+    assert one(
+        asession,
+        "array_sort(array[cast(2.5 as double), cast(3.75 as double),"
+        " cast(1.7 as double)])",
+    ) == [1.7, 2.5, 3.75]
+
+
+def test_array_sort_negative_double(asession):
+    assert one(
+        asession,
+        "array_sort(array[cast(-1.5 as double), cast(-2.75 as double),"
+        " cast(0 as double), cast(2.5 as double)])",
+    ) == [-2.75, -1.5, 0.0, 2.5]
+
+
+def test_array_distinct_double(asession):
+    assert one(
+        asession,
+        "array_distinct(array[cast(2.5 as double), cast(2.75 as double),"
+        " cast(2.5 as double)])",
+    ) == [2.5, 2.75]
+
+
+def test_array_set_ops_double(asession):
+    assert one(
+        asession,
+        "array_intersect(array[cast(1.5 as double), cast(2.5 as double)],"
+        " array[cast(2.5 as double)])",
+    ) == [2.5]
+    assert one(
+        asession,
+        "array_except(array[cast(1.5 as double), cast(2.5 as double),"
+        " cast(-0.5 as double)], array[cast(2.5 as double)])",
+    ) == [-0.5, 1.5]
+    assert one(
+        asession,
+        "array_union(array[cast(1.5 as double)],"
+        " array[cast(2.5 as double), cast(1.5 as double)])",
+    ) == [1.5, 2.5]
+
+
+def test_array_sort_decimal_preserved(asession):
+    from decimal import Decimal
+
+    assert one(asession, "array_sort(array[2.5, 3.75, 1.7])") == [
+        Decimal("1.70"),
+        Decimal("2.50"),
+        Decimal("3.75"),
+    ]
+
+
+def test_variance_family_large_mean(asession):
+    v = 1e9 + np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    s = _sess({"v": v})
+    row, = s.query(
+        "select var_samp(v), stddev(v), var_pop(v), stddev_pop(v) from t"
+    ).rows()
+    want = (
+        np.var(v, ddof=1), np.std(v, ddof=1), np.var(v), np.std(v)
+    )
+    for got, w in zip(row, want):
+        assert got == pytest.approx(w, rel=1e-12)
+
+
+def test_array_distinct_signed_zero(asession):
+    assert one(
+        asession,
+        "array_distinct(array[cast(0 as double), -cast(0 as double)])",
+    ) == [0.0]
