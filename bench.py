@@ -341,20 +341,18 @@ def main():
             details["q1_pallas_error"] = repr(e)[:300]
         persist()
 
-    # SQL path (parse -> plan -> execute, end-to-end wall incl. host syncs).
-    # The SQL catalog is host-generated, so its scan uploads table data; on
-    # the tunneled TPU that volume wedges the link (benchgen docstring), so
-    # cap the SQL scale factor there until the catalog grows a device-
-    # resident generation path.
+    # SQL path (parse -> plan -> execute, end-to-end wall incl. host syncs)
+    # over the DEVICE-RESIDENT catalog: scans generate batches on device
+    # (connectors/tpch_device.py), so the only tunnel traffic is scalars
+    # and the full scale factor runs on TPU — the round-4 BENCH_SQL_SF cap
+    # is gone.
     sql_sf = SF
-    if backend == "tpu":
-        sql_sf = min(SF, float(os.environ.get("BENCH_SQL_SF", "0.01")))
     if not quick:
         try:
-            from presto_tpu.connectors.tpch import TpchCatalog
+            from presto_tpu.connectors.tpch_device import DeviceTpchCatalog
             from presto_tpu.session import Session
 
-            cat = TpchCatalog(sf=sql_sf)
+            cat = DeviceTpchCatalog(sf=sql_sf)
             sess = Session(cat)
             q3 = (
                 "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev, "
@@ -366,13 +364,19 @@ def main():
                 "group by l_orderkey, o_orderdate, o_shippriority "
                 "order by rev desc, o_orderdate limit 10"
             )
-            sess.query(q3).rows()  # warm (compile + caches)
-            t0 = time.perf_counter()
-            sess.query(q3).rows()
-            details["q3_sql_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
-            details["q3_sql_sf"] = sql_sf
+            for name, sql in (("q1_sql_ms", None), ("q3_sql_ms", q3)):
+                if sql is None:
+                    from presto_tpu.benchmark.tpch_sql import QUERIES
+
+                    sql = QUERIES[1]
+                sess.query(sql).rows()  # warm (compile + caches)
+                t0 = time.perf_counter()
+                sess.query(sql).rows()
+                details[name] = round((time.perf_counter() - t0) * 1e3, 1)
+            details["sql_sf"] = sql_sf
+            persist()
         except Exception as e:  # noqa: BLE001
-            details["q3_error"] = repr(e)[:200]
+            details["sql_error"] = repr(e)[:200]
 
     # per-operator microbenchmark table (the JMH-analog suite): the artifact
     # carries per-kernel rows/s + achieved-HBM-bandwidth utilization on

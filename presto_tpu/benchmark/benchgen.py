@@ -40,6 +40,17 @@ DEC4_2 = T.DecimalType(4, 2)
 
 _RF_POOL = ("A", "N", "R")
 _LS_POOL = ("F", "O")
+_SEG_POOL = ("AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY")
+_BRAND_POOL = tuple(
+    f"Brand#{m}{x}" for m in range(1, 6) for x in range(1, 6)
+)  # already sorted lexically
+_CONTAINER_POOL = tuple(
+    sorted(
+        f"{a} {b}"
+        for a in ("JUMBO", "LG", "MED", "SM", "WRAP")
+        for b in ("BAG", "BOX", "CAN", "CASE", "DRUM", "JAR", "PACK", "PKG")
+    )
+)
 
 LINES_PER_ORDER = 4
 
@@ -65,6 +76,25 @@ SCHEMAS: Dict[str, Dict[str, Tuple[T.Type, Optional[tuple]]]] = {
         "o_custkey": (T.BIGINT, None),
         "o_totalprice": (DEC12_2, None),
         "o_orderdate": (T.DATE, None),
+        "o_shippriority": (T.BIGINT, None),
+    },
+    "customer": {
+        "c_custkey": (T.BIGINT, None),
+        "c_nationkey": (T.BIGINT, None),
+        "c_acctbal": (DEC12_2, None),
+        "c_mktsegment": (T.VARCHAR, _SEG_POOL),
+    },
+    "supplier": {
+        "s_suppkey": (T.BIGINT, None),
+        "s_nationkey": (T.BIGINT, None),
+        "s_acctbal": (DEC12_2, None),
+    },
+    "part": {
+        "p_partkey": (T.BIGINT, None),
+        "p_size": (T.BIGINT, None),
+        "p_retailprice": (DEC12_2, None),
+        "p_brand": (T.VARCHAR, _BRAND_POOL),
+        "p_container": (T.VARCHAR, _CONTAINER_POOL),
     },
 }
 
@@ -114,11 +144,13 @@ class _Memo:
         return self.vals[key]
 
 
-def _gen_lineitem(xp, sf: float, columns: Sequence[str]):
+def _gen_lineitem(xp, sf: float, columns: Sequence[str], idx=None):
     s = _sizes(sf)
     n = s["lineitem"]
     m = _Memo()
-    i = lambda: m.get("i", lambda: xp.arange(n, dtype=xp.uint64))
+    i = lambda: m.get(
+        "i", lambda: xp.arange(n, dtype=xp.uint64) if idx is None else idx
+    )
     order = lambda: m.get("order", lambda: i() // xp.uint64(LINES_PER_ORDER))
     partkey = lambda: m.get("pk", lambda: _uni(xp, 3, i(), 1, s["part"] + 1))
     qty = lambda: m.get("qty", lambda: _uni(xp, 4, i(), 1, 51))
@@ -153,16 +185,21 @@ def _gen_lineitem(xp, sf: float, columns: Sequence[str]):
     return {c: fns[c]() for c in columns}
 
 
-def _gen_orders(xp, sf: float, columns: Sequence[str]):
+def _gen_orders(xp, sf: float, columns: Sequence[str], idx=None):
     s = _sizes(sf)
     n = s["orders"]
     m = _Memo()
-    o = lambda: m.get("o", lambda: xp.arange(n, dtype=xp.uint64))
+    o = lambda: m.get(
+        "o", lambda: xp.arange(n, dtype=xp.uint64) if idx is None else idx
+    )
 
     def totalprice():
         # per-order sum of gross over its 4 lines, using the same streams
         # the lineitem twin uses, so the rollup is consistent
-        li = xp.arange(n * LINES_PER_ORDER, dtype=xp.uint64)
+        li = (
+            o()[:, None] * xp.uint64(LINES_PER_ORDER)
+            + xp.arange(LINES_PER_ORDER, dtype=xp.uint64)[None, :]
+        ).reshape(-1)
         pk = _uni(xp, 3, li, 1, s["part"] + 1)
         qty = _uni(xp, 4, li, 1, 51)
         price = qty * _retail_price_cents(xp, pk)
@@ -170,7 +207,7 @@ def _gen_orders(xp, sf: float, columns: Sequence[str]):
         tax = _uni(xp, 6, li, 0, 9)
         net = price * (100 - disc) // 100
         gross = net * (100 + tax) // 100
-        return gross.reshape(n, LINES_PER_ORDER).sum(axis=1)
+        return gross.reshape(-1, LINES_PER_ORDER).sum(axis=1)
 
     fns = {
         "o_orderkey": lambda: o().astype(xp.int64) + 1,
@@ -179,11 +216,63 @@ def _gen_orders(xp, sf: float, columns: Sequence[str]):
         "o_orderdate": lambda: _uni(
             xp, 7, o(), STARTDATE, ENDDATE - 151 + 1
         ).astype(xp.int32),
+        "o_shippriority": lambda: xp.zeros(o().shape, xp.int64),
     }
     return {c: fns[c]() for c in columns}
 
 
-_GENERATORS = {"lineitem": _gen_lineitem, "orders": _gen_orders}
+def _gen_customer(xp, sf: float, columns: Sequence[str], idx=None):
+    s = _sizes(sf)
+    i = xp.arange(s["customer"], dtype=xp.uint64) if idx is None else idx
+    fns = {
+        "c_custkey": lambda: i.astype(xp.int64) + 1,
+        "c_nationkey": lambda: _uni(xp, 21, i, 0, 25),
+        "c_acctbal": lambda: _uni(xp, 22, i, -99999, 1000000),
+        "c_mktsegment": lambda: (
+            _u64(xp, 23, i) % xp.uint64(len(_SEG_POOL))
+        ).astype(xp.int32),
+    }
+    return {c: fns[c]() for c in columns}
+
+
+def _gen_supplier(xp, sf: float, columns: Sequence[str], idx=None):
+    s = _sizes(sf)
+    i = xp.arange(s["supplier"], dtype=xp.uint64) if idx is None else idx
+    fns = {
+        "s_suppkey": lambda: i.astype(xp.int64) + 1,
+        "s_nationkey": lambda: _uni(xp, 31, i, 0, 25),
+        "s_acctbal": lambda: _uni(xp, 32, i, -99999, 1000000),
+    }
+    return {c: fns[c]() for c in columns}
+
+
+def _gen_part(xp, sf: float, columns: Sequence[str], idx=None):
+    s = _sizes(sf)
+    i = xp.arange(s["part"], dtype=xp.uint64) if idx is None else idx
+    pk = lambda: i.astype(xp.int64) + 1
+    fns = {
+        "p_partkey": pk,
+        "p_size": lambda: _uni(xp, 41, i, 1, 51),
+        "p_retailprice": lambda: _retail_price_cents(xp, pk()),
+        # brand code (m-1)*5+(x-1) with m,x uniform 1..5 — the sorted
+        # Brand#11..Brand#55 pool makes the code purely arithmetic
+        "p_brand": lambda: (
+            (_uni(xp, 42, i, 0, 5) * 5 + _uni(xp, 43, i, 0, 5))
+        ).astype(xp.int32),
+        "p_container": lambda: (
+            _u64(xp, 44, i) % xp.uint64(len(_CONTAINER_POOL))
+        ).astype(xp.int32),
+    }
+    return {c: fns[c]() for c in columns}
+
+
+_GENERATORS = {
+    "lineitem": _gen_lineitem,
+    "orders": _gen_orders,
+    "customer": _gen_customer,
+    "supplier": _gen_supplier,
+    "part": _gen_part,
+}
 
 
 def supports(table: str, columns: Sequence[str]) -> bool:
@@ -195,6 +284,49 @@ def numpy_columns(
 ) -> Dict[str, np.ndarray]:
     """Host twin: {name: numpy array} bit-identical to the device page."""
     return _GENERATORS[table](np, sf, tuple(columns))
+
+
+def numpy_columns_range(
+    table: str, sf: float, columns: Sequence[str], start: int, count: int
+) -> Dict[str, np.ndarray]:
+    """Host twin of device_range: rows [start, start+count)."""
+    idx = np.arange(start, start + count, dtype=np.uint64)
+    return _GENERATORS[table](np, sf, tuple(columns), idx=idx)
+
+
+_RANGE_FN_CACHE: Dict[tuple, object] = {}
+
+
+def device_range(
+    table: str, sf: float, columns: Sequence[str], start: int, count: int
+):
+    """Column arrays for rows [start, start+count) generated ON DEVICE.
+
+    The jit is cached per (table, columns, count) with `start` TRACED, so
+    a ranged catalog scan compiles once per batch shape and every batch
+    thereafter costs one scalar transfer — the device-resident equivalent
+    of the reference's worker-side split generation
+    (presto-tpch/.../TpchRecordSet.java: data originates where compute
+    runs, never crossing the coordinator link)."""
+    import jax
+    import jax.numpy as jnp
+
+    columns = tuple(columns)
+    key = (table, sf, columns, count, jax.default_backend())
+    fn = _RANGE_FN_CACHE.get(key)
+    if fn is None:
+        schema = SCHEMAS[table]
+
+        def gen(start_):
+            idx = start_ + jnp.arange(count, dtype=jnp.uint64)
+            cols = _GENERATORS[table](jnp, sf, columns, idx=idx)
+            return tuple(
+                cols[c].astype(schema[c][0].storage_dtype) for c in columns
+            )
+
+        fn = jax.jit(gen)
+        _RANGE_FN_CACHE[key] = fn
+    return fn(jnp.uint64(start))
 
 
 _PAGE_CACHE: Dict[tuple, Page] = {}
