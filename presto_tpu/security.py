@@ -92,6 +92,44 @@ class RuleBasedAccessControl(AccessControl):
         if self._privilege(user, table) not in (WRITE, ALL):
             raise AccessDeniedError(f"user {user!r} cannot write {table!r}")
 
+    # -- GRANT / REVOKE (reference execution/GrantTask.java /
+    # RevokeTask.java; grants become first-match rules, prepended so they
+    # override broader defaults) --
+
+    def check_can_grant(self, user: str, table: str) -> None:
+        if self._privilege(user, table) != ALL:
+            raise AccessDeniedError(
+                f"user {user!r} cannot change grants on {table!r}"
+            )
+
+    def grant(self, user: str, table: str, privilege: str) -> None:
+        priv = {"insert": WRITE, "update": WRITE, "delete": WRITE}.get(
+            privilege, privilege
+        )
+        if priv not in (SELECT, WRITE, ALL):
+            raise ValueError(f"unknown privilege {privilege!r}")
+        self.rules.insert(
+            0, AccessRule(priv, user=re.escape(user), table=re.escape(table))
+        )
+
+    def revoke(self, user: str, table: str, privilege: str) -> None:
+        """Drop the user to the highest privilege BELOW the revoked one on
+        the ladder none<select<write<all (write implies read here, as in
+        check_can_select_from_table), expressed as an explicit first-match
+        rule so a broader default cannot silently re-grant."""
+        priv = {"insert": WRITE, "update": WRITE, "delete": WRITE}.get(
+            privilege, privilege
+        )
+        eu, et = re.escape(user), re.escape(table)
+        self.rules = [
+            r for r in self.rules
+            if not (r.user == eu and r.table == et)
+        ]
+        ladder = [NONE, SELECT, WRITE, ALL]
+        cur = self._privilege(user, table)
+        new = ladder[min(ladder.index(cur), max(ladder.index(priv) - 1, 0))]
+        self.rules.insert(0, AccessRule(new, user=eu, table=et))
+
 
 def collect_tables(ast) -> List[str]:
     """Storage-table names referenced anywhere in a statement AST. CTE
@@ -179,15 +217,34 @@ def _names_to_check(name: str) -> List[str]:
     return [name] if bare == name else [name, bare]
 
 
-def enforce(access_control: AccessControl, user: str, ast) -> None:
+def enforce(access_control: AccessControl, user: str, ast,
+            views=None) -> None:
     """Run the checks a statement requires (reference: StatementAnalyzer
-    calling AccessControl per relation + DDL tasks checking writes)."""
+    calling AccessControl per relation + DDL tasks checking writes).
+
+    `views` ({name: view SQL}) enables INVOKER-style expansion: a table
+    reference that names a view is checked against the view's UNDERLYING
+    tables too, so a view cannot launder access to a protected table."""
     from .sql import tree as t
 
     access_control.check_can_execute_query(user)
-    for table in collect_tables(ast):
-        for n in _names_to_check(table):
-            access_control.check_can_select_from_table(user, n)
+
+    def check_select_closure(tables, seen=None):
+        seen = seen if seen is not None else set()
+        for table in tables:
+            for n in _names_to_check(table):
+                access_control.check_can_select_from_table(user, n)
+            bare = table.split(".")[-1]
+            if views and bare in views and bare not in seen:
+                seen.add(bare)
+                from .sql.parser import parse as _parse
+
+                check_select_closure(
+                    [x.lower() for x in collect_tables(_parse(views[bare]))],
+                    seen,
+                )
+
+    check_select_closure([x.lower() for x in collect_tables(ast)])
     if isinstance(ast, t.ShowColumns):
         # metadata reveals schema: same privilege as reading the table
         for n in _names_to_check(ast.table.lower()):
@@ -201,3 +258,37 @@ def enforce(access_control: AccessControl, user: str, ast) -> None:
     elif isinstance(ast, t.Delete):
         for n in _names_to_check(ast.table.lower()):
             access_control.check_can_write_table(user, n)
+    elif isinstance(ast, (t.RenameTable, t.RenameColumn, t.AddColumn,
+                          t.DropColumn)):
+        target = ast.name if isinstance(ast, t.RenameTable) else ast.table
+        for n in _names_to_check(target.lower()):
+            access_control.check_can_write_table(user, n)
+        if isinstance(ast, t.RenameTable):
+            for n in _names_to_check(ast.new_name.lower()):
+                access_control.check_can_write_table(user, n)
+    elif isinstance(ast, t.CreateView):
+        # creating a view is a catalog write on the view name, plus read
+        # rights over everything it selects from (INVOKER model)
+        for n in _names_to_check(ast.name.lower()):
+            access_control.check_can_write_table(user, n)
+        from .sql.parser import parse as _parse
+
+        check_select_closure(
+            [x.lower() for x in collect_tables(_parse(ast.query_sql))]
+        )
+    elif isinstance(ast, t.DropView):
+        for n in _names_to_check(ast.name.lower()):
+            access_control.check_can_write_table(user, n)
+    elif isinstance(ast, (t.CreateSchema, t.DropSchema)):
+        for n in _names_to_check(ast.name.lower()):
+            access_control.check_can_write_table(user, n)
+    elif isinstance(ast, (t.Grant, t.Revoke)):
+        # only a user holding ALL on the table may change its grants
+        # (reference AccessControl.checkCanGrantTablePrivilege)
+        check = getattr(access_control, "check_can_grant", None)
+        if check is not None:
+            check(user, ast.table.lower())
+    elif isinstance(ast, t.ExecutePrepared):
+        # the bound statement is enforced again at EXECUTE time in
+        # Session (the prepared SQL is an opaque string here)
+        pass

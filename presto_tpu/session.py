@@ -111,6 +111,12 @@ class Session:
         local = getattr(self.executor, "local", self.executor)
         if hasattr(local, "pallas_groupby"):
             local.pallas_groupby = pallas_groupby
+        # statement-layer state (shared BY REFERENCE with derived
+        # property-override sessions, see with_properties)
+        self.views: dict = {}  # name -> view query SQL
+        self.prepared: dict = {}  # name -> prepared statement SQL
+        self.schemas = {"default"}
+        self._session_overrides: dict = {}  # SET SESSION k = v
 
     def _swap_catalog(self, catalog) -> None:
         """Point the session AND its executors at a different catalog
@@ -135,6 +141,11 @@ class Session:
         if cache is None:
             cache = self._prop_sessions = {}
         derived = cache.get(key)
+        if derived is not None and derived.catalog is not self.catalog:
+            # the base session's catalog moved (transaction overlay
+            # enter/exit) after this derived session was cached — repoint
+            # it or reads would miss the transaction's own writes
+            derived._swap_catalog(self.catalog)
         if derived is None:
             if len(cache) >= 16:  # bound server memory: FIFO-evict
                 cache.pop(next(iter(cache)))
@@ -153,6 +164,10 @@ class Session:
                     "pallas_groupby", self.pallas_groupby
                 ),
             )
+            # statement-layer state is session-wide, not per-override
+            derived.views = self.views
+            derived.prepared = self.prepared
+            derived.schemas = self.schemas
             cache[key] = derived
         return derived
 
@@ -162,7 +177,7 @@ class Session:
             ast = ast.query
         if not isinstance(ast, t.Query):
             raise ValueError("only SELECT queries supported here")
-        planner = Planner(self.catalog)
+        planner = Planner(self.catalog, views=self.views)
         rp = planner.plan_query(ast, outer=None, ctes={})
         scope = rp.scope
         channels = tuple(f.channel for f in scope.fields)
@@ -194,15 +209,29 @@ class Session:
         if self.access_control is not None:
             from .security import enforce
 
-            enforce(self.access_control, effective, ast)
+            enforce(self.access_control, effective, ast, views=self.views)
         if isinstance(
             ast,
             (t.CreateTable, t.DropTable, t.Insert, t.Delete, t.ShowTables,
-             t.ShowColumns, t.StartTransaction, t.Commit, t.Rollback),
+             t.ShowColumns, t.StartTransaction, t.Commit, t.Rollback,
+             t.CreateView, t.DropView, t.ShowCreateView, t.CreateSchema,
+             t.DropSchema, t.ShowSchemas, t.Prepare, t.ExecutePrepared,
+             t.Deallocate, t.DescribeInput, t.DescribeOutput, t.SetSession,
+             t.ResetSession, t.ShowSession, t.RenameTable, t.RenameColumn,
+             t.AddColumn, t.DropColumn, t.Grant, t.Revoke),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
             return self._execute_statement(ast, effective)
+        if self._session_overrides:
+            # SET SESSION overrides route plain queries through the
+            # derived-session cache (reference: Session.withSystemProperty)
+            return self.with_properties(dict(self._session_overrides))._dispatch_query(
+                sql, ast, effective
+            )
+        return self._dispatch_query(sql, ast, effective)
+
+    def _dispatch_query(self, sql, ast, effective):
         node = self.plan(sql)
         if isinstance(ast, t.Explain):
             from .page import Page
@@ -238,7 +267,7 @@ class Session:
 
     def _run_query_ast(self, ast: t.Query):
         """Plan + execute a Query AST; returns (page, titles, scope)."""
-        planner = Planner(self.catalog)
+        planner = Planner(self.catalog, views=self.views)
         rp = planner.plan_query(ast, outer=None, ctes={})
         channels = tuple(f.channel for f in rp.scope.fields)
         titles = tuple(f.name for f in rp.scope.fields)
@@ -275,7 +304,9 @@ class Session:
             user = self.user
 
         if isinstance(ast, t.ShowTables):
-            names = sorted(self.catalog.table_names())
+            # views list alongside tables (reference ShowQueriesRewrite:
+            # information_schema.tables carries both)
+            names = sorted(set(self.catalog.table_names()) | set(self.views))
             if self.access_control is not None:
                 # filter out tables the user cannot read (reference
                 # SystemAccessControl.filterTables)
@@ -340,7 +371,260 @@ class Session:
             return self._insert(ast)
         if isinstance(ast, t.Delete):
             return self._delete(ast)
+
+        # -- views (reference execution/CreateViewTask.java,
+        # DropViewTask.java; expansion happens in the planner) --
+        if isinstance(ast, t.CreateView):
+            name = ast.name.lower()
+            if name in self.catalog.table_names():
+                raise ValueError(f"table {name!r} already exists")
+            if name in self.views and not ast.or_replace:
+                raise ValueError(f"view {name!r} already exists")
+            # validate now: the view text must parse AND plan
+            from .sql.parser import parse as _parse
+
+            vast = _parse(ast.query_sql)
+            if not isinstance(vast, t.Query):
+                raise ValueError("CREATE VIEW requires a SELECT query")
+            Planner(self.catalog, views=self.views).plan_query(
+                vast, outer=None, ctes={}
+            )
+            self.views[name] = ast.query_sql
+            return self._row_count_result(0)
+        if isinstance(ast, t.DropView):
+            name = ast.name.lower()
+            if name not in self.views:
+                if ast.if_exists:
+                    return self._row_count_result(0)
+                raise ValueError(f"view {name!r} does not exist")
+            del self.views[name]
+            return self._row_count_result(0)
+        if isinstance(ast, t.ShowCreateView):
+            name = ast.name.lower()
+            if name not in self.views:
+                raise ValueError(f"view {name!r} does not exist")
+            txt = f"CREATE VIEW {name} AS {self.views[name]}"
+            pg = Page.from_dict({"Create View": [txt]})
+            return QueryResult(pg, ("Create View",))
+
+        # -- schemas (reference CreateSchemaTask.java, DropSchemaTask) --
+        if isinstance(ast, t.CreateSchema):
+            name = ast.name.lower()
+            if name in self.schemas:
+                if ast.if_not_exists:
+                    return self._row_count_result(0)
+                raise ValueError(f"schema {name!r} already exists")
+            self.schemas.add(name)
+            return self._row_count_result(0)
+        if isinstance(ast, t.DropSchema):
+            name = ast.name.lower()
+            if name == "default":
+                raise ValueError("cannot drop the default schema")
+            if name not in self.schemas:
+                if ast.if_exists:
+                    return self._row_count_result(0)
+                raise ValueError(f"schema {name!r} does not exist")
+            held = [
+                tn for tn in self.catalog.table_names()
+                if tn.lower().startswith(name + ".")
+            ]
+            if held:
+                raise ValueError(f"schema {name!r} is not empty: {held}")
+            self.schemas.discard(name)
+            return self._row_count_result(0)
+        if isinstance(ast, t.ShowSchemas):
+            names = sorted(self.schemas)
+            pg = Page.from_dict({"Schema": names})
+            return QueryResult(pg, ("Schema",))
+
+        # -- prepared statements (reference execution/PrepareTask.java,
+        # DeallocateTask.java; DESCRIBE INPUT/OUTPUT statements) --
+        if isinstance(ast, t.Prepare):
+            from .sql.parser import parse as _parse
+
+            _parse(ast.statement_sql)  # must at least parse
+            self.prepared[ast.name.lower()] = ast.statement_sql
+            return self._row_count_result(0)
+        if isinstance(ast, t.Deallocate):
+            if self.prepared.pop(ast.name.lower(), None) is None:
+                raise ValueError(f"prepared statement {ast.name!r} not found")
+            return self._row_count_result(0)
+        if isinstance(ast, t.ExecutePrepared):
+            sql2 = self._prepared_sql(ast.name)
+            from .sql.parser import parse as _parse
+
+            past = _parse(sql2)
+            n_params = t.count_parameters(past)
+            if len(ast.params) != n_params:
+                raise ValueError(
+                    f"prepared statement {ast.name!r} expects {n_params} "
+                    f"parameters, got {len(ast.params)}"
+                )
+            bound = t.substitute_parameters(past, ast.params)
+            # the prepared text was an opaque string to the PREPARE-time
+            # check: the BOUND statement must pass the same enforcement a
+            # direct query would (EXECUTE is not a privilege bypass)
+            if self.access_control is not None:
+                from .security import enforce
+
+                enforce(self.access_control, user, bound, views=self.views)
+            if isinstance(bound, t.Query):
+                page, titles, _scope = self._run_query_ast(bound)
+                return QueryResult(page, titles)
+            return self._execute_statement(bound, user)
+        if isinstance(ast, t.DescribeInput):
+            sql2 = self._prepared_sql(ast.name)
+            from .sql.parser import parse as _parse
+
+            n_params = t.count_parameters(_parse(sql2))
+            import numpy as np
+
+            pg = Page.from_dict(
+                {
+                    "Position": np.arange(max(n_params, 1), dtype=np.int64),
+                    "Type": ["unknown"] * max(n_params, 1),
+                }
+            )
+            if n_params == 0:
+                pg = Page(pg.blocks, pg.names, 0)
+            return QueryResult(pg, ("Position", "Type"))
+        if isinstance(ast, t.DescribeOutput):
+            sql2 = self._prepared_sql(ast.name)
+            from .sql.parser import parse as _parse
+
+            past = _parse(sql2)
+            n_params = t.count_parameters(past)
+            past = t.substitute_parameters(
+                past, tuple(t.NullLiteral() for _ in range(n_params))
+            )
+            if not isinstance(past, t.Query):
+                pg = Page.from_dict({"Column": [None], "Type": [None]})
+                return QueryResult(
+                    Page(pg.blocks, pg.names, 0), ("Column", "Type")
+                )
+            planner = Planner(self.catalog, views=self.views)
+            rp = planner.plan_query(past, outer=None, ctes={})
+            pg = Page.from_dict(
+                {
+                    "Column": [f.name for f in rp.scope.fields],
+                    "Type": [str(f.type) for f in rp.scope.fields],
+                }
+            )
+            return QueryResult(pg, ("Column", "Type"))
+
+        # -- session properties (reference SetSessionTask.java,
+        # ResetSessionTask.java) --
+        if isinstance(ast, t.SetSession):
+            key = ast.name.lower()
+            if key not in SESSION_PROPERTIES:
+                raise ValueError(f"unknown session property {key!r}")
+            self._session_overrides[key] = SESSION_PROPERTIES[key](
+                str(self._literal_value(ast.value))
+            )
+            return self._row_count_result(0)
+        if isinstance(ast, t.ResetSession):
+            self._session_overrides.pop(ast.name.lower(), None)
+            return self._row_count_result(0)
+        if isinstance(ast, t.ShowSession):
+            rows = sorted(SESSION_PROPERTIES)
+            vals = [
+                str(self._session_overrides.get(k, "")) for k in rows
+            ]
+            pg = Page.from_dict({"Name": rows, "Value": vals})
+            return QueryResult(pg, ("Name", "Value"))
+
+        # -- ALTER TABLE (reference RenameTableTask.java,
+        # RenameColumnTask.java, AddColumnTask.java, DropColumnTask) --
+        if isinstance(ast, (t.RenameTable, t.RenameColumn, t.AddColumn,
+                            t.DropColumn)):
+            return self._alter_table(ast)
+
+        # -- GRANT / REVOKE wired into security.py (reference
+        # GrantTask.java, RevokeTask.java) --
+        if isinstance(ast, (t.Grant, t.Revoke)):
+            ac = self.access_control
+            if ac is None or not hasattr(ac, "grant"):
+                raise ValueError(
+                    "GRANT/REVOKE requires a mutable access control "
+                    "(security.RuleBasedAccessControl)"
+                )
+            table = ast.table.lower()
+            if isinstance(ast, t.Grant):
+                ac.grant(ast.grantee, table, ast.privilege)
+            else:
+                ac.revoke(ast.grantee, table, ast.privilege)
+            return self._row_count_result(0)
         raise ValueError(f"unsupported statement {type(ast).__name__}")
+
+    def _prepared_sql(self, name: str) -> str:
+        sql = self.prepared.get(name.lower())
+        if sql is None:
+            raise ValueError(f"prepared statement {name!r} not found")
+        return sql
+
+    @staticmethod
+    def _literal_value(node):
+        if isinstance(node, t.StringLiteral):
+            return node.value
+        if isinstance(node, t.NumberLiteral):
+            return node.text
+        if isinstance(node, t.BooleanLiteral):
+            return node.value
+        raise ValueError("SET SESSION requires a literal value")
+
+    def _alter_table(self, ast) -> QueryResult:
+        """ALTER TABLE against a writable connector: metadata-only ops are
+        implemented as a page rewrite + replace (the in-memory connectors
+        have no separate metadata store)."""
+        import numpy as np
+
+        from . import types as T
+        from .page import Block, Page
+
+        cat = self._writable()
+        name = (ast.name if isinstance(ast, t.RenameTable) else ast.table).lower()
+        if name not in cat.table_names():
+            raise ValueError(f"table {name!r} does not exist")
+        page = cat.page(name)
+        if isinstance(ast, t.RenameTable):
+            new = ast.new_name.lower()
+            if new in cat.table_names() or new in self.views:
+                raise ValueError(f"table {new!r} already exists")
+            cat.create_table_from_page(new, page)
+            cat.drop_table(name)
+            return self._row_count_result(0)
+        cols = list(page.names)
+        blocks = list(page.blocks)
+        if isinstance(ast, t.RenameColumn):
+            old = ast.name.lower()
+            new = ast.new_name.lower()
+            if old not in cols:
+                raise ValueError(f"column {old!r} does not exist")
+            if new in cols:
+                raise ValueError(f"column {new!r} already exists")
+            cols[cols.index(old)] = new
+        elif isinstance(ast, t.AddColumn):
+            cname = ast.column.name.lower()
+            if cname in cols:
+                raise ValueError(f"column {cname!r} already exists")
+            typ = T.parse_type(ast.column.type_name)
+            import jax.numpy as jnp
+
+            data = jnp.zeros(page.capacity, typ.storage_dtype)
+            valid = jnp.zeros(page.capacity, bool)  # all NULL
+            cols.append(cname)
+            blocks.append(Block(data, typ, valid))
+        elif isinstance(ast, t.DropColumn):
+            cname = ast.name.lower()
+            if cname not in cols:
+                raise ValueError(f"column {cname!r} does not exist")
+            if len(cols) == 1:
+                raise ValueError("cannot drop the only column")
+            i = cols.index(cname)
+            del cols[i]
+            del blocks[i]
+        cat.replace(name, Page(tuple(blocks), tuple(cols), page.count))
+        return self._row_count_result(0)
 
     def _create_table(self, ast: t.CreateTable) -> QueryResult:
         from . import types as T

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><=|>=|<>|!=|\|\||->|[=<>+\-*/%(),.;\[\]])
+  | (?P<op><=|>=|<>|!=|\|\||->|[=<>+\-*/%(),.;?\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -103,6 +103,7 @@ class Parser:
         self.sql = sql
         self.tokens = tokenize(sql)
         self.i = 0
+        self._param_count = 0  # `?` markers, indexed left-to-right
 
     # -- token helpers --
     @property
@@ -166,7 +167,18 @@ class Parser:
                 name = self.ident()
                 self.finish()
                 return t.ShowColumns(name)
-            self.error("expected TABLES or COLUMNS")
+            if self.accept_word("schemas"):
+                self.finish()
+                return t.ShowSchemas()
+            if self.accept_word("session"):
+                self.finish()
+                return t.ShowSession()
+            if self.accept_kw("create"):
+                self.expect_word("view")
+                name = self.ident()
+                self.finish()
+                return t.ShowCreateView(name)
+            self.error("expected TABLES, COLUMNS, SCHEMAS, SESSION or CREATE VIEW")
         if self.accept_kw("begin") or (
             self.accept_kw("start") and self.expect_kw("transaction") is None
         ):
@@ -186,11 +198,99 @@ class Parser:
             self.finish()
             return stmt
         if self.accept_kw("drop"):
+            if self.accept_word("view"):
+                if_exists = self._accept_if_exists()
+                name = self.ident()
+                self.finish()
+                return t.DropView(name, if_exists)
+            if self.accept_word("schema"):
+                if_exists = self._accept_if_exists()
+                name = self.ident()
+                self.finish()
+                return t.DropSchema(name, if_exists)
             self.expect_kw("table")
             if_exists = self._accept_if_exists()
             name = self.ident()
             self.finish()
             return t.DropTable(name, if_exists)
+        if self.at_word("alter"):
+            self.i += 1
+            self.expect_kw("table")
+            name = self.ident()
+            stmt = self.parse_alter_table_tail(name)
+            self.finish()
+            return stmt
+        if self.at_word("prepare"):
+            self.i += 1
+            name = self.ident()
+            self.expect_kw("from")
+            body = self._rest_of_statement()
+            self.finish()
+            return t.Prepare(name, body)
+        if self.at_word("execute") and self.peek().kind == "ident":
+            self.i += 1
+            name = self.ident()
+            params: Tuple[t.Node, ...] = ()
+            if self.accept_kw("using"):
+                ps = [self.parse_expr()]
+                while self.accept(","):
+                    ps.append(self.parse_expr())
+                params = tuple(ps)
+            self.finish()
+            return t.ExecutePrepared(name, params)
+        if self.at_word("deallocate"):
+            self.i += 1
+            self.expect_word("prepare")
+            name = self.ident()
+            self.finish()
+            return t.Deallocate(name)
+        if self.at_word("describe"):
+            self.i += 1
+            if self.accept_word("input"):
+                name = self.ident()
+                self.finish()
+                return t.DescribeInput(name)
+            self.expect_word("output")
+            name = self.ident()
+            self.finish()
+            return t.DescribeOutput(name)
+        if self.at_word("set") and self.peek().text.lower() == "session":
+            self.i += 2
+            name = self.ident()
+            while self.accept("."):
+                name += "." + self.ident()
+            self.expect("=")
+            value = self.parse_expr()
+            self.finish()
+            return t.SetSession(name, value)
+        if self.at_word("reset") and self.peek().text.lower() == "session":
+            self.i += 2
+            name = self.ident()
+            while self.accept("."):
+                name += "." + self.ident()
+            self.finish()
+            return t.ResetSession(name)
+        if self.at_word("grant") or self.at_word("revoke"):
+            is_grant = self.at_word("grant")
+            self.i += 1
+            priv = self.tok.text.lower()
+            self.i += 1
+            if priv == "all":
+                self.accept_word("privileges")
+            self.expect_kw("on")
+            self.accept_kw("table")
+            table = self.ident()
+            if is_grant:
+                self.expect_word("to")
+            else:
+                self.expect_kw("from")
+            grantee = self.ident()
+            self.finish()
+            return (
+                t.Grant(priv, table, grantee)
+                if is_grant
+                else t.Revoke(priv, table, grantee)
+            )
         if self.accept_kw("insert"):
             self.expect_kw("into")
             name = self.ident()
@@ -223,7 +323,74 @@ class Parser:
             return True
         return False
 
+    def accept_word(self, w: str) -> bool:
+        """Accept a CONTEXTUAL keyword: matches whether the tokenizer
+        classified it as kw or ident (statement heads like VIEW/PREPARE/
+        ALTER stay usable as identifiers elsewhere)."""
+        tk = self.tok
+        if (tk.kind == "kw" and tk.text == w) or (
+            tk.kind == "ident" and tk.text.lower() == w
+        ):
+            self.i += 1
+            return True
+        return False
+
+    def at_word(self, w: str) -> bool:
+        tk = self.tok
+        return (tk.kind == "kw" and tk.text == w) or (
+            tk.kind == "ident" and tk.text.lower() == w
+        )
+
+    def expect_word(self, w: str):
+        if not self.accept_word(w):
+            self.error(f"expected {w.upper()}")
+
+    def _rest_of_statement(self) -> str:
+        """Raw SQL text from the current token to end of input (PREPARE
+        body) — re-parsed at EXECUTE time with parameters bound."""
+        text = self.sql[self.tok.pos:].rstrip().rstrip(";")
+        self.i = len(self.tokens) - 1  # jump to eof
+        return text
+
+    def parse_alter_table_tail(self, name: str) -> t.Node:
+        if self.accept_word("rename"):
+            if self.accept_word("to"):
+                return t.RenameTable(name, self.ident())
+            self.expect_word("column")
+            old = self.ident()
+            self.expect_word("to")
+            return t.RenameColumn(name, old, self.ident())
+        if self.accept_word("add"):
+            self.expect_word("column")
+            cname = self.ident()
+            ctype = self.parse_type_name()
+            return t.AddColumn(name, t.ColumnDefinition(cname, ctype))
+        if self.accept_kw("drop"):
+            self.expect_word("column")
+            return t.DropColumn(name, self.ident())
+        self.error("expected RENAME, ADD COLUMN or DROP COLUMN")
+
     def parse_create(self) -> t.Node:
+        if self.accept_kw("or"):
+            self.expect_word("replace")
+            self.expect_word("view")
+            name = self.ident()
+            self.expect_kw("as")
+            body = self._rest_of_statement()
+            return t.CreateView(name, body, or_replace=True)
+        if self.accept_word("view"):
+            name = self.ident()
+            self.expect_kw("as")
+            body = self._rest_of_statement()
+            return t.CreateView(name, body, or_replace=False)
+        if self.accept_word("schema"):
+            if_not_exists = False
+            if self.tok.kind == "ident" and self.tok.text.lower() == "if":
+                self.i += 1
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            return t.CreateSchema(self.ident(), if_not_exists)
         self.expect_kw("table")
         if_not_exists = False
         if self.tok.kind == "ident" and self.tok.text.lower() == "if":
@@ -688,6 +855,11 @@ class Parser:
 
     def parse_primary(self) -> t.Node:
         tok = self.tok
+        if tok.kind == "?":
+            self.i += 1
+            idx = self._param_count
+            self._param_count += 1
+            return t.Parameter(idx)
         if (
             tok.kind == "ident"
             and tok.text.lower() == "array"

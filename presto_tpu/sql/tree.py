@@ -371,3 +371,172 @@ class Unnest(Node):
     alias: Optional[str] = None
     column_aliases: Tuple[str, ...] = ()
     ordinality: bool = False
+
+
+# -- views / schemas / prepared statements / session / DDL breadth ---------
+# (reference presto-main/.../execution/*Task.java: CreateViewTask,
+# PrepareTask, DeallocateTask, SetSessionTask, ResetSessionTask,
+# RenameTableTask, RenameColumnTask, AddColumnTask, DropColumnTask,
+# GrantTask, RevokeTask, CreateSchemaTask, DropSchemaTask)
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter(Node):
+    """A `?` placeholder; index assigned left-to-right from 0."""
+
+    index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateView(Node):
+    name: str
+    query_sql: str  # original text of the view query
+    or_replace: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Node):
+    name: str
+    if_exists: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCreateView(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateSchema(Node):
+    name: str
+    if_not_exists: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class DropSchema(Node):
+    name: str
+    if_exists: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSchemas(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    name: str
+    statement_sql: str  # raw text; re-parsed (with parameters) at EXECUTE
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutePrepared(Node):
+    name: str
+    params: Tuple[Node, ...]  # literal ASTs from USING
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DescribeInput(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DescribeOutput(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: Node  # literal
+
+
+@dataclasses.dataclass(frozen=True)
+class ResetSession(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RenameTable(Node):
+    name: str
+    new_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RenameColumn(Node):
+    table: str
+    name: str
+    new_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class AddColumn(Node):
+    table: str
+    column: "ColumnDefinition"
+
+
+@dataclasses.dataclass(frozen=True)
+class DropColumn(Node):
+    table: str
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Grant(Node):
+    privilege: str  # select | all | ...
+    table: str
+    grantee: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Revoke(Node):
+    privilege: str
+    table: str
+    grantee: str
+
+
+def substitute_parameters(node, params):
+    """Rebuild an AST with Parameter(i) replaced by params[i] (the literal
+    ASTs from EXECUTE ... USING) — reference sql/analyzer parameter
+    rewriting via Analysis.getParameters."""
+    if isinstance(node, Parameter):
+        if node.index >= len(params):
+            raise ValueError(
+                f"no value supplied for parameter {node.index + 1}"
+            )
+        return params[node.index]
+    if isinstance(node, Node):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = substitute_parameters(v, params)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(node, **changes) if changes else node
+    if isinstance(node, tuple):
+        newt = tuple(substitute_parameters(v, params) for v in node)
+        return newt if any(a is not b for a, b in zip(newt, node)) else node
+    return node
+
+
+def count_parameters(node) -> int:
+    """Highest Parameter index + 1 anywhere in the AST."""
+    if isinstance(node, Parameter):
+        return node.index + 1
+    n = 0
+    if isinstance(node, Node):
+        for f in dataclasses.fields(node):
+            n = max(n, count_parameters(getattr(node, f.name)))
+    elif isinstance(node, tuple):
+        for v in node:
+            n = max(n, count_parameters(v))
+    return n

@@ -1,0 +1,270 @@
+"""Views, schemas, prepared statements, SET SESSION, ALTER TABLE and
+GRANT/REVOKE (reference execution/*Task.java: CreateViewTask, PrepareTask,
+DeallocateTask, SetSessionTask, RenameTableTask, RenameColumnTask,
+AddColumnTask, DropColumnTask, GrantTask, RevokeTask, CreateSchemaTask)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.page import Page
+from presto_tpu.security import AccessDeniedError, RuleBasedAccessControl
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def session():
+    cat = MemoryCatalog(
+        {
+            "t": Page.from_dict(
+                {
+                    "g": np.array([1, 1, 2], dtype=np.int64),
+                    "v": np.array([10, 20, 30], dtype=np.int64),
+                }
+            )
+        }
+    )
+    return Session(cat)
+
+
+def q(session, sql):
+    return session.query(sql).rows()
+
+
+# -- views -----------------------------------------------------------------
+
+
+def test_view_roundtrip(session):
+    q(session, "create view v1 as select g, sum(v) sv from t group by g")
+    assert sorted(q(session, "select * from v1")) == [(1, 30), (2, 30)]
+    # views join with tables and each other
+    assert q(
+        session, "select count(*) from v1, t where v1.g = t.g"
+    ) == [(3,)]
+    q(session, "create view v2 as select sv from v1 where sv > 0")
+    assert sorted(q(session, "select * from v2")) == [(30,), (30,)]
+    assert ("v1",) in q(session, "show tables")
+    txt = q(session, "show create view v1")[0][0]
+    assert txt.startswith("CREATE VIEW v1 AS select")
+
+
+def test_view_replace_and_drop(session):
+    q(session, "create view v as select g from t")
+    with pytest.raises(ValueError):
+        q(session, "create view v as select v from t")
+    q(session, "create or replace view v as select v from t")
+    assert sorted(q(session, "select * from v")) == [(10,), (20,), (30,)]
+    q(session, "drop view v")
+    with pytest.raises(ValueError):
+        q(session, "drop view v")
+    q(session, "drop view if exists v")
+
+
+def test_view_name_collision_with_table(session):
+    with pytest.raises(ValueError):
+        q(session, "create view t as select 1 from t")
+
+
+def test_view_invalid_query_rejected_at_create(session):
+    with pytest.raises(Exception):
+        q(session, "create view bad as select nosuch from t")
+    assert ("bad",) not in q(session, "show tables")
+
+
+# -- schemas ---------------------------------------------------------------
+
+
+def test_schema_lifecycle(session):
+    q(session, "create schema s1")
+    assert ("s1",) in q(session, "show schemas")
+    with pytest.raises(ValueError):
+        q(session, "create schema s1")
+    q(session, "create schema if not exists s1")
+    q(session, "drop schema s1")
+    with pytest.raises(ValueError):
+        q(session, "drop schema s1")
+    q(session, "drop schema if exists s1")
+    with pytest.raises(ValueError):
+        q(session, "drop schema default")
+
+
+# -- prepared statements ---------------------------------------------------
+
+
+def test_prepare_execute_roundtrip(session):
+    q(session, "prepare p from select g, sum(v) s from t "
+               "where v > ? group by g order by g")
+    assert q(session, "describe input p") == [(0, "unknown")]
+    assert q(session, "describe output p") == [
+        ("g", "bigint"), ("s", "bigint")
+    ]
+    assert q(session, "execute p using 15") == [(1, 20), (2, 30)]
+    assert q(session, "execute p using 25") == [(2, 30)]
+    q(session, "deallocate prepare p")
+    with pytest.raises(ValueError):
+        q(session, "execute p using 15")
+
+
+def test_execute_param_count_mismatch(session):
+    q(session, "prepare p2 from select * from t where v > ? and g = ?")
+    with pytest.raises(ValueError):
+        q(session, "execute p2 using 1")
+    assert q(session, "execute p2 using 15, 2") == [(2, 30)]
+
+
+def test_prepare_string_parameter(session):
+    q(session, "prepare p3 from select upper(?) u from t limit 1")
+    assert q(session, "execute p3 using 'abc'") == [("ABC",)]
+
+
+# -- session properties ----------------------------------------------------
+
+
+def test_set_reset_session(session):
+    q(session, "set session batch_rows = 4096")
+    rows = dict(q(session, "show session"))
+    assert rows["batch_rows"] == "4096"
+    # queries still work through the derived session
+    assert q(session, "select count(*) from t") == [(3,)]
+    q(session, "reset session batch_rows")
+    assert dict(q(session, "show session"))["batch_rows"] == ""
+
+
+def test_set_session_unknown_property(session):
+    with pytest.raises(ValueError):
+        q(session, "set session nope = 1")
+
+
+# -- ALTER TABLE -----------------------------------------------------------
+
+
+def test_alter_table_columns(session):
+    q(session, "alter table t add column z bigint")
+    cols = [c for c, _ in q(session, "show columns from t")]
+    assert cols == ["g", "v", "z"]
+    # added column is NULL
+    assert q(session, "select count(z) from t") == [(0,)]
+    q(session, "alter table t rename column z to zz")
+    assert [c for c, _ in q(session, "show columns from t")][-1] == "zz"
+    q(session, "alter table t drop column zz")
+    assert [c for c, _ in q(session, "show columns from t")] == ["g", "v"]
+    with pytest.raises(ValueError):
+        q(session, "alter table t drop column nope")
+
+
+def test_alter_table_rename(session):
+    q(session, "alter table t rename to t2")
+    assert q(session, "select count(*) from t2") == [(3,)]
+    with pytest.raises(Exception):
+        q(session, "select count(*) from t")
+    q(session, "alter table t2 rename to t")
+
+
+# -- GRANT / REVOKE --------------------------------------------------------
+
+
+def test_grant_revoke_cycle():
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"v": np.array([1], dtype=np.int64)})}
+    )
+    ac = RuleBasedAccessControl([{"privileges": "all"}])
+    s = Session(cat, access_control=ac, user="admin")
+    s.query("revoke select on t from bob")
+    with pytest.raises(AccessDeniedError):
+        s.query("select * from t", user="bob")
+    s.query("grant select on table t to bob")
+    assert s.query("select * from t", user="bob").rows() == [(1,)]
+    # select does not confer write
+    with pytest.raises(AccessDeniedError):
+        s.query("delete from t", user="bob")
+    s.query("grant all on table t to bob")
+    s.query("delete from t where v = 0", user="bob")
+
+
+def test_grant_requires_mutable_access_control(session):
+    with pytest.raises(ValueError):
+        q(session, "grant select on t to bob")
+
+
+# -- security enforcement over the statement surface (round-5 review:
+# EXECUTE/GRANT/ALTER/view-expansion must not bypass access control) ----
+
+
+def _two_table_cat():
+    return MemoryCatalog(
+        {
+            "t": Page.from_dict({"v": np.array([1, 2], dtype=np.int64)}),
+            "secret": Page.from_dict(
+                {"s": np.array([42], dtype=np.int64)}
+            ),
+        }
+    )
+
+
+def test_execute_enforces_access_control():
+    ac = RuleBasedAccessControl(
+        [
+            {"privileges": "none", "user": "bob", "table": "secret"},
+            {"privileges": "all"},
+        ]
+    )
+    s = Session(_two_table_cat(), access_control=ac, user="admin")
+    s.query("prepare p from select * from secret")
+    assert s.query("execute p").rows() == [(42,)]
+    with pytest.raises(AccessDeniedError):
+        s.query("execute p", user="bob")
+
+
+def test_grant_requires_all_privilege():
+    ac = RuleBasedAccessControl(
+        [
+            {"privileges": "none", "user": "bob", "table": "secret"},
+            {"privileges": "all"},
+        ]
+    )
+    s = Session(_two_table_cat(), access_control=ac, user="admin")
+    with pytest.raises(AccessDeniedError):
+        s.query("grant all on secret to bob", user="bob")
+
+
+def test_alter_requires_write_privilege():
+    ro = RuleBasedAccessControl([{"privileges": "select"}])
+    s = Session(_two_table_cat(), access_control=ro, user="bob")
+    for sql in (
+        "alter table t drop column v",
+        "alter table t add column z bigint",
+        "alter table t rename to t9",
+        "create view vv as select * from t",
+        "create schema s9",
+    ):
+        with pytest.raises(AccessDeniedError):
+            s.query(sql)
+
+
+def test_view_does_not_launder_access():
+    ac = RuleBasedAccessControl(
+        [
+            {"privileges": "none", "user": "bob", "table": "secret"},
+            {"privileges": "all"},
+        ]
+    )
+    s = Session(_two_table_cat(), access_control=ac, user="alice")
+    s.query("create view v as select * from secret")
+    assert s.query("select * from v").rows() == [(42,)]
+    with pytest.raises(AccessDeniedError):
+        s.query("select * from v", user="bob")
+
+
+def test_session_override_sees_transaction_writes():
+    s = Session(_two_table_cat())
+    s.query("set session broadcast_threshold = 999")
+    s.query("begin")
+    s.query("insert into t values (3)")
+    assert s.query("select count(*) from t").rows() == [(3,)]
+    s.query("rollback")
+    assert s.query("select count(*) from t").rows() == [(2,)]
+
+
+def test_describe_input_no_parameters(session):
+    q(session, "prepare q0 from select 1 from t")
+    assert q(session, "describe input q0") == []
