@@ -40,12 +40,18 @@ class ExecutionError(RuntimeError):
 
 class Executor:
     def __init__(self, catalog, shrink: bool = True, jit: bool = True,
-                 collector=None, pallas_groupby: bool = False):
+                 collector=None, pallas_groupby=None):
         self.catalog = catalog
         self.shrink = shrink
         self.jit = jit
         # route eligible small-G aggregations through the Pallas kernel
-        # (ops/pallas_groupby.py; session property `pallas_groupby`)
+        # (ops/pallas_groupby.py). None = auto: DEFAULT ON for TPU
+        # backends — the reference's hot loop is its specialized group-by
+        # (MultiChannelGroupByHash.java:54) and ours must be the Mosaic
+        # path, not an opt-in — and OFF on CPU, where interpret mode
+        # would crawl. The `pallas_groupby` session property forces
+        # either way (resolved lazily so importing the executor never
+        # initializes a backend).
         self.pallas_groupby = pallas_groupby
         # (plan node, static params) -> jitted kernel; the analog of the
         # reference caching compiled PageProcessors per plan
@@ -172,6 +178,10 @@ class Executor:
                 lambda: lambda p: global_aggregate(p, node.aggs, node.mask),
             )
             return fn(page)
+        if self.pallas_groupby is None:
+            import jax
+
+            self.pallas_groupby = jax.default_backend() == "tpu"
         if self.pallas_groupby:
             from ..ops.pallas_groupby import maybe_grouped_aggregate
 

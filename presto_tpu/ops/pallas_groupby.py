@@ -46,9 +46,12 @@ MAX_CHANNELS = 128  # one output lane per channel
 _SUM_BOUND = 1 << 45  # |sum input| bound keeping block limb sums in int32
 
 
-def _kernel_factory(num_groups: int, num_channels: int, reduce_kinds):
+def _kernel_factory(num_groups: int, num_channels: int, reduce_kinds,
+                    dtype=jnp.int32):
     """Build the grid kernel for a (G, channels) plan. reduce_kinds[k] in
-    {'add', 'min', 'max'} selects the per-channel block reduction."""
+    {'add', 'min', 'max'} selects the per-channel block reduction.
+    dtype is the tile/channel element type: int32 for the exact limb
+    path, float32 for the hi/lo-split float64 path."""
 
     def kernel(cnt_ref, *refs):
         from jax.experimental import pallas as pl
@@ -63,10 +66,15 @@ def _kernel_factory(num_groups: int, num_channels: int, reduce_kinds):
         lanes = jax.lax.broadcasted_iota(jnp.int32, gid.shape, 1)
         live = ((base + rows + lanes) < cnt_ref[0]) & (live_ref[:] != 0)
 
-        zero = jnp.int32(0)
-        imax = jnp.int32(np.iinfo(np.int32).max)
-        imin = jnp.int32(np.iinfo(np.int32).min)
-        tile = jnp.zeros((PALLAS_MAX_GROUPS, 128), jnp.int32)
+        if dtype == jnp.int32:
+            zero = jnp.int32(0)
+            imax = jnp.int32(np.iinfo(np.int32).max)
+            imin = jnp.int32(np.iinfo(np.int32).min)
+        else:
+            zero = dtype(0)
+            imax = dtype(np.inf)
+            imin = dtype(-np.inf)
+        tile = jnp.zeros((PALLAS_MAX_GROUPS, 128), dtype)
         for g in range(num_groups):
             sel = live & (gid == g)
             row: List = []
@@ -101,8 +109,9 @@ def _kernel_factory(num_groups: int, num_channels: int, reduce_kinds):
     return kernel
 
 
-def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds):
-    """(blocks, PALLAS_MAX_GROUPS, 128) int32 per-block reductions."""
+def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds,
+                     dtype=jnp.int32):
+    """(blocks, PALLAS_MAX_GROUPS, 128) per-block reductions in `dtype`."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -120,7 +129,9 @@ def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds):
     col_spec = pl.BlockSpec(
         (128, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
-    kernel = _kernel_factory(num_groups, len(channels), tuple(reduce_kinds))
+    kernel = _kernel_factory(
+        num_groups, len(channels), tuple(reduce_kinds), dtype
+    )
     return pl.pallas_call(
         kernel,
         grid=(blocks,),
@@ -132,14 +143,14 @@ def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds):
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (blocks, PALLAS_MAX_GROUPS, 128), jnp.int32
+            (blocks, PALLAS_MAX_GROUPS, 128), dtype
         ),
         interpret=interpret,
     )(
         count.reshape(1).astype(jnp.int32),
         view(gid.astype(jnp.int32)),
         view(live.astype(jnp.int32)),
-        *[view(c.astype(jnp.int32)) for c in channels],
+        *[view(c.astype(dtype)) for c in channels],
     )
 
 
@@ -188,10 +199,15 @@ def maybe_grouped_aggregate(
             ins.append(None)
             continue
         v = evaluate(a.input, page)
-        if v.data.ndim != 1 or not (
-            jnp.issubdtype(v.data.dtype, jnp.integer)
-            or isinstance(v.type, T.BooleanType)
-        ):
+        if v.data.ndim != 1:
+            return None
+        integral = jnp.issubdtype(v.data.dtype, jnp.integer) or isinstance(
+            v.type, T.BooleanType
+        )
+        # float64 rides the hi/lo-split f32 channel path, sum/avg only
+        # (min/max would need 64-bit compares the kernel does not have)
+        floating = jnp.issubdtype(v.data.dtype, jnp.floating)
+        if not integral and not (floating and a.func in ("sum", "avg")):
             return None
         ins.append(v)
 
@@ -213,11 +229,17 @@ def maybe_grouped_aggregate(
     channels: List = []
     plan: List[Tuple[int, str]] = []
     kinds: List[str] = []
+    fchannels: List = []  # float32 hi/lo channels (their own kernel/tile)
+    fplan: List[Tuple[int, str]] = []
 
     def add_channel(arr, tag, kind="add"):
         channels.append(arr)
         plan.append(tag)
         kinds.append(kind)
+
+    def add_fchannel(arr, tag):
+        fchannels.append(arr)
+        fplan.append(tag)
 
     ones = jnp.ones(page.capacity, jnp.int32)
     for ai, (a, v) in enumerate(zip(aggs, ins)):
@@ -225,6 +247,20 @@ def maybe_grouped_aggregate(
         cmask = contrib.astype(jnp.int32)
         if a.func in ("count", "count_star", "avg"):
             add_channel(ones * cmask, (ai, "count", 0))
+        if a.func in ("sum", "avg") and jnp.issubdtype(
+            v.data.dtype, jnp.floating
+        ):
+            # hi/lo split: hi = f32(x), lo = f32(x - hi) represents the
+            # f64 value to ~48 mantissa bits; block partials sum in f32,
+            # blocks combine in f64 outside (documented tolerance — the
+            # XLA f64 path is the exact-comparison oracle in tests)
+            xf = v.data.astype(jnp.float64)
+            hi = xf.astype(jnp.float32)
+            lo = (xf - hi.astype(jnp.float64)).astype(jnp.float32)
+            fm = cmask.astype(jnp.float32)
+            add_fchannel(hi * fm, (ai, "fsum", 0))
+            add_fchannel(lo * fm, (ai, "fsum", 1))
+            continue
         if a.func in ("sum", "avg"):
             x = v.data.astype(jnp.int64)
             add_channel(
@@ -242,12 +278,21 @@ def maybe_grouped_aggregate(
             add_channel(
                 x, (ai, a.func, 0), kind=a.func
             )  # masking happens in-kernel via `sel`
-    if len(channels) > MAX_CHANNELS:
+    if len(channels) > MAX_CHANNELS or len(fchannels) > MAX_CHANNELS:
         return None
 
     partials = _pallas_partials(
         gid, live, channels, page.count, G, kinds
     )
+    fs = None
+    if fchannels:
+        fpartials = _pallas_partials(
+            gid, live, fchannels, page.count, G,
+            ["add"] * len(fchannels), dtype=jnp.float32,
+        )
+        fs = jnp.sum(fpartials.astype(jnp.float64), axis=0)[
+            :G, : len(fchannels)
+        ]
     s = jnp.sum(partials.astype(jnp.int64), axis=0)[:G, : len(channels)]
     mins = jnp.min(
         jnp.where(
@@ -265,6 +310,13 @@ def maybe_grouped_aggregate(
     by_agg: dict = {}
     for k, tag in enumerate(plan):
         by_agg.setdefault(tag[0], {})[(tag[1], tag[2])] = k
+    by_agg_f: dict = {}
+    for k, tag in enumerate(fplan):
+        by_agg_f.setdefault(tag[0], {})[(tag[1], tag[2])] = k
+
+    def fsum_of(ai):
+        chs = by_agg_f[ai]
+        return fs[:, chs[("fsum", 0)]] + fs[:, chs[("fsum", 1)]]
 
     counts_live = None
     out_blocks: List[Block] = []
@@ -315,6 +367,20 @@ def maybe_grouped_aggregate(
             out_blocks.append(
                 Block(s[:, by_agg[ai][("count", 0)]], T.BIGINT, None)
             )
+        elif a.func == "sum" and ai in by_agg_f:
+            out_blocks.append(
+                Block(
+                    fsum_of(ai).astype(a.output_type.storage_dtype),
+                    a.output_type,
+                    has,
+                )
+            )
+        elif a.func == "avg" and ai in by_agg_f:
+            cnt = s[:, by_agg[ai][("count", 0)]]
+            data = avg_from_sum_count(
+                fsum_of(ai), cnt, a.output_type, a.input.type
+            )
+            out_blocks.append(Block(data, a.output_type, cnt > 0))
         elif a.func == "sum":
             total = sum_of(ai)
             if isinstance(a.output_type, T.DecimalType) and a.output_type.is_long:

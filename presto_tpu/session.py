@@ -85,7 +85,7 @@ class Session:
         memory_budget=None,
         access_control=None,
         user: str = "user",
-        pallas_groupby: bool = False,
+        pallas_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
     ):
         self.access_control = access_control
         self.user = user
@@ -109,7 +109,7 @@ class Session:
         self.memory_budget = memory_budget
         self.pallas_groupby = pallas_groupby
         local = getattr(self.executor, "local", self.executor)
-        if hasattr(local, "pallas_groupby"):
+        if pallas_groupby is not None and hasattr(local, "pallas_groupby"):
             local.pallas_groupby = pallas_groupby
         # statement-layer state (shared BY REFERENCE with derived
         # property-override sessions, see with_properties)
@@ -380,13 +380,16 @@ class Session:
                 raise ValueError(f"table {name!r} already exists")
             if name in self.views and not ast.or_replace:
                 raise ValueError(f"view {name!r} already exists")
-            # validate now: the view text must parse AND plan
+            # validate now: the view text must parse AND plan — against
+            # the NEW binding (name excluded), so OR REPLACE cannot store
+            # a self-reference that only fails at first use
             from .sql.parser import parse as _parse
 
             vast = _parse(ast.query_sql)
             if not isinstance(vast, t.Query):
                 raise ValueError("CREATE VIEW requires a SELECT query")
-            Planner(self.catalog, views=self.views).plan_query(
+            probe = {k: v for k, v in self.views.items() if k != name}
+            Planner(self.catalog, views=probe).plan_query(
                 vast, outer=None, ctes={}
             )
             self.views[name] = ast.query_sql
@@ -469,7 +472,14 @@ class Session:
 
                 enforce(self.access_control, user, bound, views=self.views)
             if isinstance(bound, t.Query):
-                page, titles, _scope = self._run_query_ast(bound)
+                # SET SESSION overrides apply to prepared executions the
+                # same as to direct queries
+                target = (
+                    self.with_properties(dict(self._session_overrides))
+                    if self._session_overrides
+                    else self
+                )
+                page, titles, _scope = target._run_query_ast(bound)
                 return QueryResult(page, titles)
             return self._execute_statement(bound, user)
         if isinstance(ast, t.DescribeInput):
@@ -502,6 +512,12 @@ class Session:
                 return QueryResult(
                     Page(pg.blocks, pg.names, 0), ("Column", "Type")
                 )
+            # column names/types are metadata: same privilege as reading
+            # (SHOW COLUMNS enforces this; DESCRIBE OUTPUT must too)
+            if self.access_control is not None:
+                from .security import enforce
+
+                enforce(self.access_control, user, past, views=self.views)
             planner = Planner(self.catalog, views=self.views)
             rp = planner.plan_query(past, outer=None, ctes={})
             pg = Page.from_dict(
@@ -632,6 +648,11 @@ class Session:
 
         cat = self._writable()
         name = ast.name.lower()
+        if name in self.views:
+            # the planner resolves views first, so a same-named table
+            # would be permanently shadowed — reject the collision both
+            # ways (CREATE VIEW already checks tables)
+            raise ValueError(f"view {name!r} already exists")
         if name in cat.table_names():
             if ast.if_not_exists:
                 return self._row_count_result(0)

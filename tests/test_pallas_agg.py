@@ -28,3 +28,57 @@ def test_pallas_q1_partial_batch_boundary():
     want = q1_local(page).to_pylist()
     got = q1_local_pallas(page).to_pylist()
     assert got == want
+
+
+# -- generalized pallas_groupby: float64 sum/avg + count_if + auto-default
+
+
+def test_pallas_groupby_float_and_countif():
+    """float64 sum/avg ride the hi/lo f32 channel path (tolerance is
+    ~f32 ulps of sum(|x|) — the documented contract); count_if and
+    integer sums stay bit-exact."""
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    rng = np.random.default_rng(5)
+    n = 40000
+    pool = ("A", "N", "R")
+    flag = np.array([pool[i] for i in rng.integers(0, 3, n)])
+    d = rng.random(n) * 1e6 - 5e5
+    v = rng.integers(-1000, 1000, n)
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"f": list(flag), "d": d, "v": v})}
+    )
+    sql = (
+        "select f, sum(d) sd, avg(d) ad, count_if(v > 0) ci, sum(v) sv "
+        "from t group by f order by f"
+    )
+    ref = Session(cat, pallas_groupby=False).query(sql).rows()
+    pal = Session(cat, pallas_groupby=True).query(sql).rows()
+    assert len(ref) == 3
+    for r, p in zip(ref, pal):
+        mag = np.abs(d[flag == r[0]]).sum()
+        assert (r[0], r[3], r[4]) == (p[0], p[3], p[4])
+        assert abs(r[1] - p[1]) < mag * 1e-6
+        assert abs(r[2] - p[2]) < mag * 1e-6
+
+
+def test_pallas_groupby_auto_default_off_on_cpu():
+    """pallas_groupby=None resolves to the backend default at first
+    aggregation: False on CPU (interpret would crawl), True on TPU."""
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"v": np.array([1, 2], dtype=np.int64)})}
+    )
+    s = Session(cat)
+    assert s.executor.pallas_groupby is None  # unresolved until used
+    s.query("select count(*) c from t group by v")
+    assert s.executor.pallas_groupby is False  # CPU backend in tests
