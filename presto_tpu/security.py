@@ -127,7 +127,10 @@ class RuleBasedAccessControl(AccessControl):
         ]
         ladder = [NONE, SELECT, WRITE, ALL]
         cur = self._privilege(user, table)
-        new = ladder[min(ladder.index(cur), max(ladder.index(priv) - 1, 0))]
+        # revoking ALL or SELECT leaves nothing (write implies read here,
+        # so removing read removes everything); revoking WRITE leaves read
+        floor = NONE if priv in (ALL, SELECT) else SELECT
+        new = ladder[min(ladder.index(cur), ladder.index(floor))]
         self.rules.insert(0, AccessRule(new, user=eu, table=et))
 
 
@@ -217,6 +220,23 @@ def _names_to_check(name: str) -> List[str]:
     return [name] if bare == name else [name, bare]
 
 
+# view SQL text -> underlying table list; enforce() runs per query, so
+# the (pure) parse+collect of each referenced view is computed once
+_VIEW_TABLES_CACHE: dict = {}
+
+
+def _view_tables(view_sql: str) -> List[str]:
+    tables = _VIEW_TABLES_CACHE.get(view_sql)
+    if tables is None:
+        from .sql.parser import parse as _parse
+
+        tables = [x.lower() for x in collect_tables(_parse(view_sql))]
+        if len(_VIEW_TABLES_CACHE) > 4096:  # bound server memory
+            _VIEW_TABLES_CACHE.clear()
+        _VIEW_TABLES_CACHE[view_sql] = tables
+    return tables
+
+
 def enforce(access_control: AccessControl, user: str, ast,
             views=None) -> None:
     """Run the checks a statement requires (reference: StatementAnalyzer
@@ -237,12 +257,7 @@ def enforce(access_control: AccessControl, user: str, ast,
             bare = table.split(".")[-1]
             if views and bare in views and bare not in seen:
                 seen.add(bare)
-                from .sql.parser import parse as _parse
-
-                check_select_closure(
-                    [x.lower() for x in collect_tables(_parse(views[bare]))],
-                    seen,
-                )
+                check_select_closure(_view_tables(views[bare]), seen)
 
     check_select_closure([x.lower() for x in collect_tables(ast)])
     if isinstance(ast, t.ShowColumns):
@@ -271,11 +286,7 @@ def enforce(access_control: AccessControl, user: str, ast,
         # rights over everything it selects from (INVOKER model)
         for n in _names_to_check(ast.name.lower()):
             access_control.check_can_write_table(user, n)
-        from .sql.parser import parse as _parse
-
-        check_select_closure(
-            [x.lower() for x in collect_tables(_parse(ast.query_sql))]
-        )
+        check_select_closure(_view_tables(ast.query_sql))
     elif isinstance(ast, t.DropView):
         for n in _names_to_check(ast.name.lower()):
             access_control.check_can_write_table(user, n)

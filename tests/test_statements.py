@@ -268,3 +268,49 @@ def test_session_override_sees_transaction_writes():
 def test_describe_input_no_parameters(session):
     q(session, "prepare q0 from select 1 from t")
     assert q(session, "describe input q0") == []
+
+
+def test_describe_output_enforces_access_control():
+    ac = RuleBasedAccessControl(
+        [
+            {"privileges": "none", "user": "bob", "table": "secret"},
+            {"privileges": "all"},
+        ]
+    )
+    s = Session(_two_table_cat(), access_control=ac, user="admin")
+    s.query("prepare p from select * from secret")
+    assert s.query("describe output p").rows() == [("s", "bigint")]
+    with pytest.raises(AccessDeniedError):
+        s.query("describe output p", user="bob")
+
+
+def test_revoke_all_leaves_nothing():
+    ac = RuleBasedAccessControl([{"privileges": "all"}])
+    s = Session(_two_table_cat(), access_control=ac, user="admin")
+    s.query("revoke all on t from alice")
+    with pytest.raises(AccessDeniedError):
+        s.query("insert into t values (9)", user="alice")
+    with pytest.raises(AccessDeniedError):
+        s.query("select * from t", user="alice")
+
+
+def test_create_table_rejects_view_name(session):
+    q(session, "create view vv as select 1 x from t")
+    with pytest.raises(ValueError):
+        q(session, "create table vv (x bigint)")
+    with pytest.raises(ValueError):
+        q(session, "create table vv as select 1 from t")
+
+
+def test_or_replace_view_cannot_self_reference(session):
+    q(session, "create view v as select v from t")
+    with pytest.raises(Exception):
+        q(session, "create or replace view v as select * from v")
+    # the old definition must survive the failed replace
+    assert len(q(session, "select * from v")) == 3
+
+
+def test_execute_respects_session_overrides(session):
+    q(session, "set session batch_rows = 2048")
+    q(session, "prepare qq from select count(*) from t")
+    assert q(session, "execute qq") == [(3,)]
