@@ -381,10 +381,17 @@ class StreamingExecutor:
         est = self.catalog.row_count(node.table)
         B = self.batch_rows
         scan = getattr(self.catalog, "scan", None)
-        if scan is None or (est <= B // 2 and not predicate):
-            src = self.catalog.page(node.table)
-            yield self._rename_scan(node, src)
+        if scan is None:
+            yield self._rename_scan(node, self.catalog.page(node.table))
             return
+        if est <= B // 2 and not predicate:
+            try:
+                src = self.catalog.page(node.table)
+            except MemoryError:
+                pass  # chunked catalogs refuse to materialize; stream below
+            else:
+                yield self._rename_scan(node, src)
+                return
         cols = [col for _, col, _ in node.columns]
         exact = getattr(self.catalog, "exact_row_count", None)
         total = exact(node.table) if exact is not None else None
