@@ -3,11 +3,11 @@
 Reference protocol: presto-benchto-benchmarks tpch.yaml runs sf300-sf3000
 macro suites against Hive; this engine's ramp (BASELINE.md) is SF1 -> SF10
 (joins + group-by through the full SQL path under a device budget) ->
-SF100 (Q1/Q6 over BATCHED scans from a chunk-generated source that never
-holds the table in host RAM).
+SF100 (the q1/q6/q3/q5/q17/q18 north stars over BATCHED scans from a
+chunk-generated source that never holds any table in host RAM).
 
     python -m presto_tpu.benchmark.scale --sf 10
-    python -m presto_tpu.benchmark.scale --sf100            # Q1/Q6 streaming
+    python -m presto_tpu.benchmark.scale --sf100   # north stars, streamed
 """
 
 from __future__ import annotations
@@ -100,14 +100,16 @@ _STARTDATE, _ENDDATE = 8035, 10591  # 1992-01-01 .. 1998-12-31 (days)
 
 
 class ChunkedTpchCatalog:
-    """lineitem/orders/customer catalog generating rows ON DEMAND in
-    chunked batches — the SF100 scan source. Every column is a pure
-    function of the row index (benchgen's splitmix64 counter streams), so
+    """Seven-table TPC-H catalog generating rows ON DEMAND in chunked
+    batches — the SF100 scan source. Every column is a pure function of
+    the row index (benchgen's splitmix64 counter streams; customer/
+    supplier/part delegate to benchgen's generators outright), so
     lineitem and orders agree on per-order attributes WITHOUT shared
     state, host RAM holds at most ~2 chunks, and re-scans are
     deterministic (reference: the connector split contract — splits are
-    independently regeneratable). Three tables make SF100 Q3
-    (customer x orders x lineitem join + group + topN) streamable."""
+    independently regeneratable). lineitem/orders/customer stream the Q3
+    join; part/supplier/nation/region complete the Q5/Q17/Q18 north-star
+    shapes."""
 
     name = "tpch_chunked"
     CHUNK_ORDERS = 1 << 21  # ~2M orders -> ~8.4M lineitem rows per chunk
@@ -160,7 +162,9 @@ class ChunkedTpchCatalog:
     from .benchgen import _BRAND_POOL as _BRANDS
     from .benchgen import _CONTAINER_POOL as _CONTAINERS
 
-    _REGION_NAMES = ("AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST")
+    from ..connectors.tpch import REGIONS as _REGION_LIST
+
+    _REGION_NAMES = tuple(sorted(_REGION_LIST))
     _DICTS = {
         "l_returnflag": ("A", "N", "R"),
         "l_linestatus": ("F", "O"),
@@ -173,9 +177,14 @@ class ChunkedTpchCatalog:
     def __init__(self, sf: float):
         self.sf = sf
         self.n_orders = int(1_500_000 * sf)
-        self.n_cust = max(int(150_000 * sf), 2)
-        self.n_part = max(int(200_000 * sf), 2)
-        self.n_supp = max(int(10_000 * sf), 2)
+        from . import benchgen
+
+        sizes = benchgen._sizes(sf)
+        # dimension sizes come from benchgen so the delegated generators
+        # and the foreign-key bounds (streams 11/3/12) can never disagree
+        self.n_cust = sizes["customer"]
+        self.n_part = sizes["part"]
+        self.n_supp = sizes["supplier"]
         # nation dictionary sorted by name; region of each sorted nation
         from ..connectors.tpch import NATIONS
 
@@ -306,28 +315,20 @@ class ChunkedTpchCatalog:
                 "o_orderdate": self._orderdate(i).astype(np.int32),
                 "o_shippriority": np.zeros(len(i), np.int64),
             }
-        if table == "customer":
-            return {
-                "c_custkey": i + 1,
-                "c_nationkey": self._u(21, i, 0, 25),
-                "c_mktsegment": self._u(14, i, 0, 5).astype(np.int32),
-                "c_acctbal": self._u(16, i, -99999, 1_000_000),
-            }
-        if table == "part":
-            return {
-                "p_partkey": i + 1,
-                "p_brand": (
-                    self._u(42, i, 0, 5) * 5 + self._u(43, i, 0, 5)
-                ).astype(np.int32),
-                "p_container": self._u(
-                    44, i, 0, len(self._CONTAINERS)
-                ).astype(np.int32),
-            }
-        if table == "supplier":
-            return {
-                "s_suppkey": i + 1,
-                "s_nationkey": self._u(31, i, 0, 25),
-            }
+        if table in ("customer", "part", "supplier"):
+            # single source of truth: benchgen's generators produce these
+            # columns (same streams, same pools) for any index range
+            from . import benchgen
+
+            cols = benchgen._GENERATORS[table](
+                np, self.sf, tuple(self._schema_for(table)),
+                idx=i.astype(np.uint64),
+            )
+            out = {}
+            for nm, arr in cols.items():
+                pool = benchgen.SCHEMAS[table][nm][1]
+                out[nm] = arr.astype(np.int32) if pool is not None else arr
+            return out
         if table == "nation":
             return {
                 "n_nationkey": i,

@@ -1,0 +1,555 @@
+"""Shard-organized native storage engine — the raptor analog.
+
+Re-designed equivalent of presto-raptor (20,941 LoC: RaptorMetadata +
+storage/StorageManager + storage/organization/ShardCompactor /
+ShardOrganizer + a MySQL shard-metadata DB): the proof that the
+connector SPI carries a FULL storage engine, not just file readers.
+
+Design here:
+  * a table = a set of immutable parquet SHARD files under one directory
+    (reference OrcStorageManager writes ORC shards; parquet is this
+    engine's primary columnar format and shares its arrow bridge)
+  * shard metadata lives in SQLite (`metadata.db`): table schemas, shard
+    row counts, and per-column min/max statistics captured at WRITE time
+    (reference ShardStats/ColumnStats persisted to the shards table) —
+    scans prune whole shards against predicate hints without opening
+    files, and the pruned/read counts surface in EXPLAIN ANALYZE via the
+    `last_scan_files_*` counters (same contract as the hive connector)
+  * INSERT appends a new shard — never rewrites existing data
+  * `organize()` merges runs of small shards into compaction-target-sized
+    ones (reference ShardCompactor.compact + ShardOrganizer background
+    jobs; `start_organizer()` runs it on a daemon thread)
+  * DROP deletes metadata transactionally, then garbage-collects files
+"""
+
+from __future__ import annotations
+
+import datetime as pydt
+import json
+import os
+import sqlite3
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import types as T
+from ..page import Page
+from .parquet import arrow_table_to_page, build_sorted_dictionary, page_to_arrow
+from .spi import Predicate, WritableConnector, WriteError
+
+# compaction target: merge small shards until ~this many rows
+DEFAULT_COMPACT_ROWS = 1 << 20
+
+
+def _stat_value(typ: T.Type, v):
+    """A python min/max value -> (kind, TEXT) for the metadata DB."""
+    if v is None:
+        return None, None
+    if isinstance(typ, T.VarcharType):
+        return "str", str(v)
+    if isinstance(typ, T.DateType):
+        if isinstance(v, (int, np.integer)):
+            v = pydt.date(1970, 1, 1) + pydt.timedelta(days=int(v))
+        return "date", v.isoformat()
+    return "num", repr(float(v))
+
+
+def _decode_stat(kind: str, txt: str):
+    if kind == "str":
+        return txt
+    if kind == "date":
+        return pydt.date.fromisoformat(txt)
+    return float(txt)
+
+
+def _coerce_hint(value):
+    """Predicate-hint python value -> the comparison domain of the stored
+    stats (dates stay dates, strings stay strings, numbers -> float)."""
+    if isinstance(value, (pydt.date, str)):
+        return value
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+class ShardStoreCatalog(WritableConnector):
+    """Local shard storage engine implementing the full Catalog + write
+    SPI (usable anywhere the memory/hive catalogs are)."""
+
+    name = "shardstore"
+
+    def __init__(self, directory: str,
+                 compact_rows: int = DEFAULT_COMPACT_ROWS):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.compact_rows = compact_rows
+        self.db = sqlite3.connect(
+            os.path.join(directory, "metadata.db"), check_same_thread=False
+        )
+        self._db_lock = threading.Lock()
+        self.db.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS tables (
+                name TEXT PRIMARY KEY, schema_json TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS shards (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                table_name TEXT NOT NULL, path TEXT NOT NULL,
+                rows INTEGER NOT NULL,
+                seq REAL NOT NULL);
+            CREATE TABLE IF NOT EXISTS shard_stats (
+                shard_id INTEGER NOT NULL, column_name TEXT NOT NULL,
+                kind TEXT, min_v TEXT, max_v TEXT,
+                PRIMARY KEY (shard_id, column_name));
+            CREATE INDEX IF NOT EXISTS idx_shards_table
+                ON shards(table_name);
+            """
+        )
+        self.last_scan_files_read = 0
+        self.last_scan_files_skipped = 0
+        self._dict_cache: Dict = {}  # (table, column, version) -> dict
+        self._organizer: Optional[threading.Thread] = None
+        self._organizer_stop = threading.Event()
+        self.organize_events: List[dict] = []
+
+    # -- metadata ----------------------------------------------------------
+
+    def table_names(self) -> List[str]:
+        with self._db_lock:
+            rows = self.db.execute("SELECT name FROM tables").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        with self._db_lock:
+            row = self.db.execute(
+                "SELECT schema_json FROM tables WHERE name = ?", (table,)
+            ).fetchone()
+        if row is None:
+            raise KeyError(f"table {table!r} does not exist")
+        return {
+            c: T.parse_type(tn) for c, tn in json.loads(row[0]).items()
+        }
+
+    def row_count(self, table: str) -> int:
+        with self._db_lock:
+            row = self.db.execute(
+                "SELECT COALESCE(SUM(rows), 0) FROM shards "
+                "WHERE table_name = ?",
+                (table,),
+            ).fetchone()
+        return int(row[0])
+
+    def exact_row_count(self, table: str) -> int:
+        return self.row_count(table)
+
+    def unique_columns(self, table: str):
+        return []
+
+    def shard_count(self, table: str) -> int:
+        with self._db_lock:
+            return int(
+                self.db.execute(
+                    "SELECT COUNT(*) FROM shards WHERE table_name = ?",
+                    (table,),
+                ).fetchone()[0]
+            )
+
+    def _shards(self, table: str):
+        """Shards in GLOBAL ROW ORDER. Ordering is by `seq`, not id: a
+        compacted shard inherits the seq of the first shard it merged, so
+        row offsets stay stable across organize() — a streaming query
+        paginating by offset sees the same rows before and after a
+        concurrent compaction."""
+        with self._db_lock:
+            return self.db.execute(
+                "SELECT id, path, rows FROM shards WHERE table_name = ? "
+                "ORDER BY seq",
+                (table,),
+            ).fetchall()
+
+    def _version(self, table: str) -> int:
+        """Monotone shard-set version for cache invalidation."""
+        with self._db_lock:
+            row = self.db.execute(
+                "SELECT COALESCE(MAX(id), 0), COUNT(*) FROM shards "
+                "WHERE table_name = ?",
+                (table,),
+            ).fetchone()
+        return int(row[0]) * 1_000_003 + int(row[1])
+
+    # -- writes ------------------------------------------------------------
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        with self._db_lock:
+            if self.db.execute(
+                "SELECT 1 FROM tables WHERE name = ?", (table,)
+            ).fetchone():
+                raise WriteError(f"table {table!r} already exists")
+            self.db.execute(
+                "INSERT INTO tables VALUES (?, ?)",
+                (table, json.dumps({c: str(t) for c, t in schema.items()})),
+            )
+            self.db.commit()
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        self.create_table(
+            table, {c: b.type for c, b in zip(page.names, page.blocks)}
+        )
+        if int(page.count):
+            self.append(table, page)
+
+    def _page_stats(self, page: Page):
+        """Per-column (kind, min, max) captured at write time."""
+        n = int(page.count)
+        out = {}
+        for name, b in zip(page.names, page.blocks):
+            data = np.asarray(b.data[:n])
+            valid = None if b.valid is None else np.asarray(b.valid[:n])
+            if valid is not None:
+                data = data[valid]
+            if data.size == 0 or data.ndim != 1:
+                out[name] = (None, None, None)
+                continue
+            if isinstance(b.type, T.VarcharType):
+                d = b.dictionary or ()
+                codes = data[(data >= 0) & (data < len(d))]
+                if codes.size == 0 or not d:
+                    out[name] = (None, None, None)
+                    continue
+                out[name] = ("str", d[int(codes.min())], d[int(codes.max())])
+            elif isinstance(b.type, T.DateType):
+                epoch = pydt.date(1970, 1, 1)
+                out[name] = (
+                    "date",
+                    (epoch + pydt.timedelta(days=int(data.min()))).isoformat(),
+                    (epoch + pydt.timedelta(days=int(data.max()))).isoformat(),
+                )
+            elif isinstance(b.type, T.DecimalType) and not b.type.is_long:
+                sc = 10.0 ** b.type.scale
+                out[name] = (
+                    "num", repr(float(data.min()) / sc),
+                    repr(float(data.max()) / sc),
+                )
+            elif np.issubdtype(data.dtype, np.number):
+                out[name] = (
+                    "num", repr(float(data.min())), repr(float(data.max()))
+                )
+            else:
+                out[name] = (None, None, None)
+        return out
+
+    def _write_file(self, table: str, arrow_table) -> str:
+        import pyarrow.parquet as pq
+
+        path = os.path.join(
+            self.directory, f"{table}.{uuid.uuid4().hex}.parquet"
+        )
+        pq.write_table(arrow_table, path)
+        return path
+
+    def _insert_shard_meta(self, table, path, rows, stats, seq=None,
+                           drop_ids=(), drop_table_shards=False) -> None:
+        """ONE metadata transaction: optionally drop old shards, insert
+        the new one. seq defaults to the new id (append at the end)."""
+        with self._db_lock:
+            if drop_table_shards:
+                self.db.execute(
+                    "DELETE FROM shard_stats WHERE shard_id IN "
+                    "(SELECT id FROM shards WHERE table_name = ?)",
+                    (table,),
+                )
+                self.db.execute(
+                    "DELETE FROM shards WHERE table_name = ?", (table,)
+                )
+            if drop_ids:
+                qmarks = ",".join("?" * len(drop_ids))
+                self.db.execute(
+                    f"DELETE FROM shard_stats WHERE shard_id IN ({qmarks})",
+                    tuple(drop_ids),
+                )
+                self.db.execute(
+                    f"DELETE FROM shards WHERE id IN ({qmarks})",
+                    tuple(drop_ids),
+                )
+            cur = self.db.execute(
+                "INSERT INTO shards (table_name, path, rows, seq) "
+                "VALUES (?,?,?,0)",
+                (table, path, rows),
+            )
+            sid = cur.lastrowid
+            self.db.execute(
+                "UPDATE shards SET seq = ? WHERE id = ?",
+                (float(seq) if seq is not None else float(sid), sid),
+            )
+            for col, (kind, mn, mx) in stats.items():
+                self.db.execute(
+                    "INSERT INTO shard_stats VALUES (?,?,?,?,?)",
+                    (sid, col, kind, mn, mx),
+                )
+            self.db.commit()
+
+    def _write_shard(self, table: str, arrow_table, stats) -> None:
+        path = self._write_file(table, arrow_table)
+        self._insert_shard_meta(table, path, arrow_table.num_rows, stats)
+
+    def append(self, table: str, page: Page) -> None:
+        self.schema(table)  # existence check
+        if int(page.count) == 0:
+            return
+        self._write_shard(table, page_to_arrow(page), self._page_stats(page))
+
+    def replace(self, table: str, page: Page) -> None:
+        """Write-new-then-swap in ONE metadata transaction — a crash (or
+        concurrent reader) never observes the table without its data."""
+        old = self._shards(table)
+        arrow = page_to_arrow(page)
+        if arrow.num_rows:
+            path = self._write_file(table, arrow)
+            self._insert_shard_meta(
+                table, path, arrow.num_rows, self._page_stats(page),
+                drop_table_shards=True,
+            )
+        else:
+            with self._db_lock:
+                self.db.execute(
+                    "DELETE FROM shard_stats WHERE shard_id IN "
+                    "(SELECT id FROM shards WHERE table_name = ?)",
+                    (table,),
+                )
+                self.db.execute(
+                    "DELETE FROM shards WHERE table_name = ?", (table,)
+                )
+                self.db.commit()
+        self._gc([p for _id, p, _r in old])
+
+    def drop_table(self, table: str) -> None:
+        old = self._shards(table)
+        with self._db_lock:
+            self.db.execute(
+                "DELETE FROM shard_stats WHERE shard_id IN "
+                "(SELECT id FROM shards WHERE table_name = ?)",
+                (table,),
+            )
+            self.db.execute(
+                "DELETE FROM shards WHERE table_name = ?", (table,)
+            )
+            self.db.execute("DELETE FROM tables WHERE name = ?", (table,))
+            self.db.commit()
+        self._gc([p for _id, p, _r in old])
+
+    @staticmethod
+    def _gc(paths) -> None:
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    # -- reads -------------------------------------------------------------
+
+    def _read_shard(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+
+    def _dictionary(self, table: str, column: str):
+        key = (table, column, self._version(table))
+        got = self._dict_cache.get(key)
+        if got is None:
+            import pyarrow as pa
+
+            cols = [
+                self._read_shard(p).column(column)
+                for _id, p, _r in self._shards(table)
+            ]
+            if cols:
+                merged = pa.chunked_array(
+                    [c for col in cols for c in col.chunks]
+                )
+                got = build_sorted_dictionary(merged)
+            else:
+                got = ((), np.array([], dtype=object))
+            if len(self._dict_cache) > 256:
+                self._dict_cache.clear()
+            self._dict_cache[key] = got
+        return got
+
+    def _refuted(self, sid: int, predicate: Predicate) -> bool:
+        """True when the shard's stored min/max refute ANY conjunct
+        (reference ShardPredicate.create against the shards table)."""
+        with self._db_lock:
+            rows = self.db.execute(
+                "SELECT column_name, kind, min_v, max_v FROM shard_stats "
+                "WHERE shard_id = ?",
+                (sid,),
+            ).fetchall()
+        stats = {
+            c: (_decode_stat(k, mn), _decode_stat(k, mx))
+            for c, k, mn, mx in rows
+            if k is not None and mn is not None
+        }
+        for col, op, value in predicate:
+            st = stats.get(col)
+            if st is None:
+                continue
+            v = _coerce_hint(value)
+            if v is None:
+                continue
+            mn, mx = st
+            try:
+                if op == "eq" and (v < mn or v > mx):
+                    return True
+                if op == "lt" and mn >= v:
+                    return True
+                if op == "le" and mn > v:
+                    return True
+                if op == "gt" and mx <= v:
+                    return True
+                if op == "ge" and mx < v:
+                    return True
+            except TypeError:
+                continue  # incomparable: keep the shard
+        return False
+
+    def page(self, table: str) -> Page:
+        return self.scan(table, 0, self.row_count(table))
+
+    def scan(self, table: str, start: int, stop: int, pad_to=None,
+             columns=None, predicate=None) -> Page:
+        import pyarrow as pa
+
+        schema = self.schema(table)
+        names = list(columns) if columns is not None else list(schema)
+        stop = min(stop, self.row_count(table))
+        kept, skipped = [], 0
+        offset = 0
+        for sid, path, rows in self._shards(table):
+            s0, s1 = offset, offset + rows
+            offset = s1
+            if s1 <= start or s0 >= stop:
+                continue
+            if predicate and self._refuted(sid, predicate):
+                skipped += 1
+                continue
+            kept.append((path, max(start - s0, 0), min(stop, s1) - s0))
+        self.last_scan_files_read = len(kept)
+        self.last_scan_files_skipped = skipped
+        try:
+            pieces = [
+                self._read_shard(p).select(names).slice(lo, hi - lo)
+                for p, lo, hi in kept
+            ]
+        except FileNotFoundError:
+            # a concurrent organize() GC'd a file between listing and
+            # read; seq-stable offsets make a retry against fresh
+            # metadata return the identical rows
+            return self.scan(
+                table, start, stop, pad_to=pad_to, columns=columns,
+                predicate=predicate,
+            )
+        if pieces:
+            tb = pa.concat_tables(pieces)
+        else:
+            from .parquet import _type_to_arrow
+
+            tb = pa.table(
+                {n: pa.array([], type=_type_to_arrow(schema[n]))
+                 for n in names}
+            )
+        return arrow_table_to_page(
+            tb, names, tb.num_rows, pad_to,
+            lambda name: self._dictionary(table, name),
+        )
+
+    # -- organization (reference storage/organization/ShardCompactor) -----
+
+    def organize(self, table: Optional[str] = None) -> dict:
+        """Merge CONTIGUOUS runs of small shards into compaction-target-
+        sized shards (reference ShardCompactor.compact). The merged shard
+        inherits the run's first `seq`, and only seq-adjacent shards
+        merge, so the table's global row order — and therefore any
+        streaming query's offset pagination — is unchanged by
+        compaction. Swap is one metadata transaction; old files are GC'd
+        after (a reader mid-swap retries against fresh metadata).
+        Returns {table: shards_merged}."""
+        import pyarrow as pa
+
+        report = {}
+        tables = [table] if table else self.table_names()
+        for t in tables:
+            with self._db_lock:
+                shards = self.db.execute(
+                    "SELECT id, path, rows, seq FROM shards "
+                    "WHERE table_name = ? ORDER BY seq",
+                    (t,),
+                ).fetchall()
+            merged = 0
+            run: List = []
+            acc = 0
+
+            def flush(run, _t=t):
+                if len(run) < 2:
+                    return 0
+                tb = pa.concat_tables(
+                    [self._read_shard(p) for _i, p, _r, _q in run]
+                )
+                page = arrow_table_to_page(
+                    tb, tb.column_names, tb.num_rows, None,
+                    lambda name: self._dictionary(_t, name),
+                )
+                path = self._write_file(_t, tb)
+                self._insert_shard_meta(
+                    _t, path, tb.num_rows, self._page_stats(page),
+                    seq=run[0][3],
+                    drop_ids=[i for i, _p, _r, _q in run],
+                )
+                self._gc([p for _i, p, _r, _q in run])
+                return len(run)
+
+            for sid, path, rows, seq in shards:
+                if rows < self.compact_rows and acc + rows <= max(
+                    self.compact_rows, rows
+                ):
+                    run.append((sid, path, rows, seq))
+                    acc += rows
+                    if acc >= self.compact_rows:
+                        merged += flush(run)
+                        run, acc = [], 0
+                else:
+                    # a large shard (or target reached) ends the
+                    # contiguous run — never merge across it
+                    merged += flush(run)
+                    run, acc = [], 0
+                    if rows < self.compact_rows:
+                        run.append((sid, path, rows, seq))
+                        acc = rows
+            merged += flush(run)
+            if merged:
+                report[t] = merged
+                self.organize_events.append({"table": t, "merged": merged})
+        return report
+
+    def start_organizer(self, interval_s: float = 30.0) -> None:
+        """Background compaction loop (reference ShardOrganizer's
+        periodic organization jobs)."""
+        if self._organizer is not None:
+            return
+        self._organizer_stop.clear()
+
+        def loop():
+            while not self._organizer_stop.wait(interval_s):
+                try:
+                    self.organize()
+                except Exception:  # noqa: BLE001 - keep the daemon alive
+                    pass
+
+        self._organizer = threading.Thread(target=loop, daemon=True)
+        self._organizer.start()
+
+    def stop_organizer(self) -> None:
+        if self._organizer is not None:
+            self._organizer_stop.set()
+            self._organizer.join(timeout=5)
+            self._organizer = None
