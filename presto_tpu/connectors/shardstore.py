@@ -43,19 +43,6 @@ from .spi import Predicate, WritableConnector, WriteError
 DEFAULT_COMPACT_ROWS = 1 << 20
 
 
-def _stat_value(typ: T.Type, v):
-    """A python min/max value -> (kind, TEXT) for the metadata DB."""
-    if v is None:
-        return None, None
-    if isinstance(typ, T.VarcharType):
-        return "str", str(v)
-    if isinstance(typ, T.DateType):
-        if isinstance(v, (int, np.integer)):
-            v = pydt.date(1970, 1, 1) + pydt.timedelta(days=int(v))
-        return "date", v.isoformat()
-    return "num", repr(float(v))
-
-
 def _decode_stat(kind: str, txt: str):
     if kind == "str":
         return txt
@@ -417,7 +404,7 @@ class ShardStoreCatalog(WritableConnector):
         return self.scan(table, 0, self.row_count(table))
 
     def scan(self, table: str, start: int, stop: int, pad_to=None,
-             columns=None, predicate=None) -> Page:
+             columns=None, predicate=None, _retries: int = 2) -> Page:
         import pyarrow as pa
 
         schema = self.schema(table)
@@ -444,10 +431,13 @@ class ShardStoreCatalog(WritableConnector):
         except FileNotFoundError:
             # a concurrent organize() GC'd a file between listing and
             # read; seq-stable offsets make a retry against fresh
-            # metadata return the identical rows
+            # metadata return the identical rows. Bounded: a PERMANENTLY
+            # missing file (external deletion) must surface, not recurse
+            if _retries <= 0:
+                raise
             return self.scan(
                 table, start, stop, pad_to=pad_to, columns=columns,
-                predicate=predicate,
+                predicate=predicate, _retries=_retries - 1,
             )
         if pieces:
             tb = pa.concat_tables(pieces)
@@ -464,6 +454,34 @@ class ShardStoreCatalog(WritableConnector):
         )
 
     # -- organization (reference storage/organization/ShardCompactor) -----
+
+    def _merged_stats(self, shard_ids) -> dict:
+        """Combine the stored stats of `shard_ids`: min of mins, max of
+        maxes per column (ignoring shards with no stats for a column)."""
+        qmarks = ",".join("?" * len(shard_ids))
+        with self._db_lock:
+            rows = self.db.execute(
+                f"SELECT column_name, kind, min_v, max_v FROM shard_stats "
+                f"WHERE shard_id IN ({qmarks})",
+                tuple(shard_ids),
+            ).fetchall()
+        out: Dict = {}
+        for col, kind, mn, mx in rows:
+            if kind is None or mn is None:
+                out.setdefault(col, (None, None, None))
+                continue
+            cur = out.get(col)
+            if cur is None or cur[0] is None:
+                out[col] = (kind, mn, mx)
+                continue
+            cmn = min(_decode_stat(kind, cur[1]), _decode_stat(kind, mn))
+            cmx = max(_decode_stat(kind, cur[2]), _decode_stat(kind, mx))
+            enc = (
+                (lambda v: v.isoformat()) if kind == "date"
+                else (str if kind == "str" else (lambda v: repr(float(v))))
+            )
+            out[col] = (kind, enc(cmn), enc(cmx))
+        return out
 
     def organize(self, table: Optional[str] = None) -> dict:
         """Merge CONTIGUOUS runs of small shards into compaction-target-
@@ -495,13 +513,14 @@ class ShardStoreCatalog(WritableConnector):
                 tb = pa.concat_tables(
                     [self._read_shard(p) for _i, p, _r, _q in run]
                 )
-                page = arrow_table_to_page(
-                    tb, tb.column_names, tb.num_rows, None,
-                    lambda name: self._dictionary(_t, name),
-                )
+                # the merged shard's stats are the combine of the stored
+                # per-shard stats — no dictionary rebuild, no device
+                # round-trip (reference ShardCompactor merges ColumnStats
+                # the same way)
+                stats = self._merged_stats([i for i, _p, _r, _q in run])
                 path = self._write_file(_t, tb)
                 self._insert_shard_meta(
-                    _t, path, tb.num_rows, self._page_stats(page),
+                    _t, path, tb.num_rows, stats,
                     seq=run[0][3],
                     drop_ids=[i for i, _p, _r, _q in run],
                 )

@@ -41,6 +41,9 @@ LAMBDA_FORMS = {
     "any_match",
     "all_match",
     "none_match",
+    "map_filter",
+    "transform_values",
+    "transform_keys",
 }
 
 SPECIAL_FORMS = {
@@ -410,6 +413,8 @@ def _eval_lambda_form(expr: Call, page: Page) -> Val:
         return _eval_zip_with(expr, page)
     if name == "reduce":
         return _eval_reduce(expr, page)
+    if name in ("map_filter", "transform_values", "transform_keys"):
+        return _eval_map_lambda(expr, page)
     arr = evaluate(expr.args[0], page)
     lam: Lambda = expr.args[1]
     if arr.data.ndim != 2:
@@ -463,6 +468,62 @@ def _eval_lambda_form(expr: Call, page: Page) -> Val:
     else:  # none_match
         agg = ~jnp.any(truthy & inb, axis=1)
     return Val(agg, arr.valid, T.BOOLEAN)
+
+
+def _eval_map_lambda(expr: Call, page: Page) -> Val:
+    """map_filter / transform_values / transform_keys: the lambda body
+    evaluates over flattened (key, value) element pairs (reference
+    MapFilterFunction + MapTransform*Function)."""
+    name = expr.name
+    out_type = expr.type
+    m = evaluate(expr.args[0], page)
+    lam: Lambda = expr.args[1]
+    if m.keys is None or m.data.ndim != 2:
+        raise TypeError(f"{name} expects a map value")
+    keys = m.keys
+    cap, width = m.data.shape[0], m.data.shape[1]
+    kelems = _elements_val(keys, lam.param_types[0])
+    velems = _elements_val(m, lam.param_types[1])
+    flat = _flat_page_for(
+        page, width, [(lam.params[0], kelems), (lam.params[1], velems)]
+    )
+    body = evaluate(lam.body, flat)
+    inb = _in_bounds(m)
+
+    if name == "map_filter":
+        keep = (body.data & body.valid_mask()).reshape(cap, width) & inb
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        vdata = jnp.take_along_axis(m.data, order, axis=1)
+        kdata = jnp.take_along_axis(keys.data, order, axis=1)
+        ev = m.elem_valid
+        if ev is not None:
+            ev = jnp.take_along_axis(ev, order, axis=1)
+        lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+        new_keys = Val(
+            kdata, None, keys.type, keys.dict_id, lengths=lengths
+        )
+        return Val(
+            vdata, m.valid, out_type, m.dict_id, lengths=lengths,
+            elem_valid=ev, keys=new_keys,
+        )
+    bdata = body.data.reshape(cap, width)
+    bvalid = (
+        None if body.valid is None else body.valid.reshape(cap, width)
+    )
+    if name == "transform_values":
+        return Val(
+            bdata, m.valid, out_type, body.dict_id, lengths=m.lengths,
+            elem_valid=bvalid if bvalid is not None else m.elem_valid,
+            keys=keys,
+        )
+    # transform_keys: values unchanged; keys replaced by the body
+    new_keys = Val(
+        bdata, None, out_type.key, body.dict_id, lengths=m.lengths
+    )
+    return Val(
+        m.data, m.valid, out_type, m.dict_id, lengths=m.lengths,
+        elem_valid=m.elem_valid, keys=new_keys,
+    )
 
 
 def _eval_zip_with(expr: Call, page: Page) -> Val:

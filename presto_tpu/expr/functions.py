@@ -1901,6 +1901,10 @@ def _split(a: Val, delim: Val, *rest, out_type: T.Type) -> Val:
 
 @register("cardinality", _bigint_infer)
 def _cardinality(a: Val, out_type: T.Type) -> Val:
+    if getattr(a.type, "sketch", None) == "hll":
+        from ..ops.aggregate import hll_estimate
+
+        return Val(hll_estimate(a.data), a.valid, T.BIGINT)
     if a.lengths is None:
         raise TypeError("cardinality requires an array value")
     return Val(a.lengths.astype(jnp.int64), a.valid, T.BIGINT)

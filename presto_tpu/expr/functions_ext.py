@@ -1299,3 +1299,278 @@ def _regress(features: Val, model: Val, out_type: T.Type) -> Val:
         mlens = jnp.broadcast_to(mlens, (n,))
     out = mlreg.predict(fdata, features.lengths, mdata, mlens)
     return Val(out, and_valid(features.valid, model.valid), T.DOUBLE)
+
+
+# ---------------------------------------------------------------------------
+# round-5 registry tail (reference metadata/FunctionRegistry.java:360)
+# ---------------------------------------------------------------------------
+
+
+_f1("asinh", jnp.arcsinh)
+_f1("acosh", jnp.arccosh)
+_f1("atanh", jnp.arctanh)
+_f1("cot", lambda x: jnp.cos(x) / jnp.sin(x))
+
+
+@register("to_ieee754_64", _varchar_infer)
+def _to_ieee754_64(a: Val, out_type: T.Type) -> Val:
+    """double -> IEEE754 big-endian 8 bytes as hex (binary rides the
+    string layer; literal-only like to_big_endian_64)."""
+    import struct
+
+    v = _require_literal(
+        a, "to_ieee754_64 value (column inputs unsupported: unbounded "
+           "output dictionary)"
+    )
+    s = struct.pack(">d", float(v)).hex().upper()
+    return Val(
+        jnp.zeros(a.data.shape, jnp.int32), a.valid, T.VARCHAR,
+        intern_dictionary((s,)), literal=s,
+    )
+
+
+@register("to_ieee754_32", _varchar_infer)
+def _to_ieee754_32(a: Val, out_type: T.Type) -> Val:
+    import struct
+
+    v = _require_literal(
+        a, "to_ieee754_32 value (column inputs unsupported: unbounded "
+           "output dictionary)"
+    )
+    s = struct.pack(">f", float(v)).hex().upper()
+    return Val(
+        jnp.zeros(a.data.shape, jnp.int32), a.valid, T.VARCHAR,
+        intern_dictionary((s,)), literal=s,
+    )
+
+
+def _hex_dict_to_float(a: Val, fmt: str, width: int):
+    """Decode each dictionary entry's hex bytes -> float, gather by code
+    (column inputs fine: the dictionary is bounded)."""
+    import struct
+
+    d = a.dictionary
+    if d is None:
+        raise TypeError("from_ieee754 expects a varbinary/varchar value")
+    vals = []
+    for s in d:
+        try:
+            vals.append(struct.unpack(fmt, bytes.fromhex(s))[0])
+        except (ValueError, struct.error):
+            vals.append(float("nan"))
+    table = jnp.asarray(np.array(vals, np.float64))
+    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
+    return Val(table[codes], a.valid, T.DOUBLE)
+
+
+@register("from_ieee754_64", _double_infer)
+def _from_ieee754_64(a: Val, out_type: T.Type) -> Val:
+    return _hex_dict_to_float(a, ">d", 8)
+
+
+@register("from_ieee754_32", _double_infer)
+def _from_ieee754_32(a: Val, out_type: T.Type) -> Val:
+    return _hex_dict_to_float(a, ">f", 4)
+
+
+@register("current_timezone", _varchar_infer)
+def _current_timezone(a: Val = None, out_type: T.Type = None) -> Val:
+    # the engine runs in UTC (types.py timestamp semantics)
+    return Val(
+        jnp.zeros((1,), jnp.int32), None, T.VARCHAR,
+        intern_dictionary(("UTC",)), literal="UTC",
+    )
+
+
+@register("value_at_quantile", _double_infer)
+def _value_at_quantile(sk: Val, q: Val, out_type: T.Type) -> Val:
+    """Read a quantile off a qdigest-analog sketch (ops/qsketch.py
+    ARRAY(BIGINT) rows from qdigest_agg)."""
+    from ..ops import qsketch as qs
+
+    if sk.data.ndim != 2:
+        raise TypeError("value_at_quantile expects a qdigest sketch value")
+    frac = float(_require_literal(q, "value_at_quantile fraction"))
+    vals = qs.percentile_value(sk.data, frac)
+    valid = and_valid(sk.valid, jnp.sum(sk.data, axis=1) > 0)
+    return Val(vals.astype(jnp.float64), valid, T.DOUBLE)
+
+
+@register("quantile_at_value", _double_infer)
+def _quantile_at_value(sk: Val, v: Val, out_type: T.Type) -> Val:
+    """Inverse read: the rank (0..1) of `v` in the sketch's distribution."""
+    from ..ops import qsketch as qs
+
+    if sk.data.ndim != 2:
+        raise TypeError("quantile_at_value expects a qdigest sketch value")
+    x = v.data.astype(jnp.float64)
+    bucket = qs.bucket_of(x)
+    total = jnp.sum(sk.data, axis=1)
+    # counts in buckets strictly below the value's bucket + half its own
+    lane = jnp.arange(sk.data.shape[1])[None, :]
+    below = jnp.sum(
+        jnp.where(lane < bucket[:, None], sk.data, 0), axis=1
+    )
+    own = jnp.take_along_axis(sk.data, bucket[:, None], axis=1)[:, 0]
+    rank = (below + 0.5 * own) / jnp.maximum(total, 1)
+    valid = and_valid(and_valid(sk.valid, v.valid), total > 0)
+    return Val(rank, valid, T.DOUBLE)
+
+
+@register("cosine_similarity", _double_infer)
+def _cosine_similarity(a: Val, b: Val, out_type: T.Type) -> Val:
+    """cosine_similarity(map(varchar,double), map(varchar,double)) —
+    sparse vectors keyed by string (reference
+    operator/scalar/CosineSimilarityFunction)."""
+    if a.keys is None or b.keys is None:
+        raise TypeError("cosine_similarity expects two map values")
+    ka, kb = a.keys, b.keys
+    la = _elem_live(a)
+    lb = _elem_live(b)
+    va = jnp.where(la, a.data.astype(jnp.float64), 0.0)
+    vb = jnp.where(lb, b.data.astype(jnp.float64), 0.0)
+    kad, kbd = ka.data, kb.data
+    if ka.dict_id is not None and ka.dict_id != kb.dict_id:
+        # dictionary-coded keys from different dictionaries: remap both
+        # onto the merged sorted dictionary before comparing codes
+        from ..page import dictionary_by_id
+
+        da = dictionary_by_id(ka.dict_id)
+        db = dictionary_by_id(kb.dict_id)
+        merged = {s: i for i, s in enumerate(sorted(set(da) | set(db)))}
+        map_a = jnp.asarray(
+            np.array([merged[s] for s in da], np.int32)
+        )
+        map_b = jnp.asarray(
+            np.array([merged[s] for s in db], np.int32)
+        )
+        kad = map_a[jnp.clip(kad, 0, len(da) - 1)]
+        kbd = map_b[jnp.clip(kbd, 0, len(db) - 1)]
+    # dot over matching keys: compare every key pair (maps are small)
+    keq = kad[:, :, None] == kbd[:, None, :]
+    keq = keq & la[:, :, None] & lb[:, None, :]
+    dot = jnp.sum(keq * va[:, :, None] * vb[:, None, :], axis=(1, 2))
+    na = jnp.sqrt(jnp.sum(va * va, axis=1))
+    nb = jnp.sqrt(jnp.sum(vb * vb, axis=1))
+    denom = na * nb
+    out = jnp.where(denom > 0, dot / jnp.maximum(denom, 1e-300), jnp.nan)
+    return Val(out, and_valid(a.valid, b.valid), T.DOUBLE)
+
+
+@register("from_iso8601_timestamp", lambda ts: T.TIMESTAMP)
+def _from_iso8601_timestamp(a: Val, out_type: T.Type) -> Val:
+    """ISO8601 string -> timestamp (micros); dictionary transform."""
+    import datetime as pydt
+
+    d = a.dictionary
+    if d is None:
+        raise TypeError("from_iso8601_timestamp expects a varchar value")
+    vals = np.zeros(len(d), np.int64)
+    oks = np.zeros(len(d), np.bool_)
+    for i, s in enumerate(d):
+        try:
+            dt = pydt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+            if dt.tzinfo is not None:
+                dt = dt.astimezone(pydt.timezone.utc).replace(tzinfo=None)
+            epoch = pydt.datetime(1970, 1, 1)
+            vals[i] = int((dt - epoch).total_seconds() * 1_000_000)
+            oks[i] = True
+        except ValueError:
+            pass
+    vt, ot = jnp.asarray(vals), jnp.asarray(oks)
+    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
+    return Val(vt[codes], and_valid(a.valid, ot[codes]), T.TIMESTAMP)
+
+
+def _spooky(bits: int):
+    def impl(a: Val, out_type: T.Type) -> Val:
+        """Spooky-hash stand-in over utf8 bytes via the same host-side
+        dictionary transform as md5/xxhash (the reference's exact
+        SpookyHashV2 constants are not replicated; the contract — a
+        stable 32/64-bit hash of the bytes — is)."""
+        d = a.dictionary
+        if d is None:
+            raise TypeError("spooky_hash expects a varchar value")
+        vals = np.zeros(len(d), np.int64)
+        for i, s in enumerate(d):
+            h = hashlib.blake2b(s.encode(), digest_size=8).digest()
+            v = int.from_bytes(h, "big", signed=False)
+            if bits == 32:
+                v &= 0xFFFFFFFF
+            else:
+                v &= 0x7FFFFFFFFFFFFFFF
+            vals[i] = v
+        vt = jnp.asarray(vals)
+        codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
+        return Val(vt[codes], a.valid, T.BIGINT)
+
+    return impl
+
+
+register("spooky_hash_v2_32", _bigint_infer)(_spooky(32))
+register("spooky_hash_v2_64", _bigint_infer)(_spooky(64))
+
+
+@register("inverse_beta_cdf", _double_infer)
+def _inverse_beta_cdf(a: Val, b: Val, p: Val, out_type: T.Type) -> Val:
+    """Inverse of beta_cdf via fixed-iteration bisection (64 steps ->
+    ~2^-64 interval; XLA unrolls the loop, no data-dependent control
+    flow)."""
+    cdf = FUNCTIONS["beta_cdf"].impl
+    av, bv = a, b
+    target = _as_float(p)
+    lo = jnp.zeros_like(target)
+    hi = jnp.ones_like(target)
+    for _ in range(64):
+        mid = 0.5 * (lo + hi)
+        c = cdf(av, bv, Val(mid, None, T.DOUBLE), out_type=T.DOUBLE).data
+        go_hi = c < target
+        lo = jnp.where(go_hi, mid, lo)
+        hi = jnp.where(go_hi, hi, mid)
+    out = 0.5 * (lo + hi)
+    valid = and_valid(a.valid, b.valid, p.valid)
+    return Val(out, valid, T.DOUBLE)
+
+
+@register("split_to_map", lambda ts: T.MapType(T.VARCHAR, T.VARCHAR))
+def _split_to_map(a: Val, entry_d: Val, kv_d: Val, out_type: T.Type) -> Val:
+    """split_to_map('a=1,b=2', ',', '=') — per-dictionary-entry parse,
+    padded to the widest entry count (reference SplitToMapFunction)."""
+    ed = _require_literal(entry_d, "split_to_map entry delimiter")
+    kd = _require_literal(kv_d, "split_to_map key/value delimiter")
+    d = a.dictionary
+    if d is None:
+        raise TypeError("split_to_map expects a varchar value")
+    parsed = []
+    for s in d:
+        pairs = []
+        for part in s.split(ed):
+            if not part:
+                continue
+            k, _, v = part.partition(kd)
+            pairs.append((k, v))
+        parsed.append(pairs)
+    width = max((len(p) for p in parsed), default=0) or 1
+    keypool = tuple(sorted({k for ps in parsed for k, _v in ps})) or ("",)
+    valpool = tuple(sorted({v for ps in parsed for _k, v in ps})) or ("",)
+    kidx = {s: i for i, s in enumerate(keypool)}
+    vidx = {s: i for i, s in enumerate(valpool)}
+    kmat = np.zeros((len(d), width), np.int32)
+    vmat = np.zeros((len(d), width), np.int32)
+    lens = np.zeros(len(d), np.int32)
+    for i, ps in enumerate(parsed):
+        lens[i] = len(ps)
+        for j, (k, v) in enumerate(ps):
+            kmat[i, j] = kidx[k]
+            vmat[i, j] = vidx[v]
+    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
+    klens = jnp.asarray(lens)[codes]
+    keys = Val(
+        jnp.asarray(kmat)[codes], None, T.VARCHAR,
+        intern_dictionary(keypool), lengths=klens,
+    )
+    return Val(
+        jnp.asarray(vmat)[codes], a.valid,
+        T.MapType(T.VARCHAR, T.VARCHAR), intern_dictionary(valpool),
+        lengths=klens, keys=keys,
+    )

@@ -48,6 +48,7 @@ SUPPORTED = (
     "qsketch", "qsketch_merge",
     "linreg", "linreg_acc", "linreg_merge",
     "cmoments", "cmoments_merge",
+    "map_union", "multimap_agg", "num_hist",
 )
 
 
@@ -696,6 +697,56 @@ def grouped_aggregate_sorted(
                         spec, v_sorted, bk_sorted, live_s, gid_s,
                         max_groups, max_elems,
                     )
+                elif spec.func == "map_union":
+                    # rebuild the map Val (keys are lost by the plain
+                    # data[order] copy above)
+                    m_sorted = Val(
+                        v.data[order],
+                        None if v.valid is None else v.valid[order],
+                        v.type, v.dict_id,
+                        lengths=None if v.lengths is None
+                        else v.lengths[order],
+                        elem_valid=None if v.elem_valid is None
+                        else v.elem_valid[order],
+                        keys=Val(
+                            v.keys.data[order], None, v.keys.type,
+                            v.keys.dict_id,
+                        ),
+                    )
+                    blk, need = collect_map_union(
+                        spec, m_sorted, live_s, gid_s, max_groups,
+                        max_elems,
+                    )
+                elif spec.func == "multimap_agg":
+                    bk_sorted = Val(
+                        bk.data[order],
+                        None if bk.valid is None else bk.valid[order],
+                        bk.type,
+                        bk.dict_id,
+                    )
+                    blk, need = collect_multimap_agg(
+                        spec, v_sorted, bk_sorted, live_s, gid_s,
+                        max_groups, max_elems,
+                    )
+                elif spec.func == "num_hist":
+                    contributes = live_s if v.valid is None else (
+                        live_s & v.valid[order]
+                    )
+                    blk = numeric_histogram_agg(
+                        spec, v_sorted, contributes, gid_s, max_groups + 1
+                    )
+                    blk = Block(
+                        blk.data[:max_groups], blk.type, None,
+                        lengths=blk.lengths[:max_groups],
+                        elem_valid=blk.elem_valid[:max_groups],
+                        key_block=Block(
+                            blk.key_block.data[:max_groups],
+                            blk.key_block.type, None,
+                            lengths=blk.key_block.lengths[:max_groups],
+                            elem_valid=blk.key_block.elem_valid[:max_groups],
+                        ),
+                    )
+                    need = jnp.int32(0)
                 else:  # histogram
                     blk, need = collect_map_agg(
                         spec, v_sorted, None, live_s, gid_s,
@@ -720,7 +771,7 @@ def grouped_aggregate_sorted(
                 blocks.append(Block(hll_estimate(regs), T.BIGINT, None))
             else:
                 blocks.append(
-                    Block(regs, T.ArrayType(T.TINYINT), None)
+                    Block(regs, spec.output_type, None)
                 )
             names.append(spec.name)
             continue
@@ -730,7 +781,7 @@ def grouped_aggregate_sorted(
             regs = hll_merge_registers(
                 data_s, contributes, gid_s, max_groups + 1
             )[:max_groups]
-            blocks.append(Block(regs, T.ArrayType(T.TINYINT), None))
+            blocks.append(Block(regs, spec.output_type, None))
             names.append(spec.name)
             continue
         if spec.func in ("qsketch", "qsketch_merge"):
@@ -997,6 +1048,20 @@ def decompose_partial(aggs: Sequence[AggSpec]):
                 AggSpec("qsketch_merge", ColumnRef(s_name, sk_t), s_name, sk_t)
             )
             post.append(QSketchPost(a.name, s_name, frac, a.output_type))
+        elif a.func in ("hll_registers", "hll_merge"):
+            # bare sketch aggregates (approx_set / merge): partials merge
+            # by register-max
+            partial.append(a)
+            final.append(
+                AggSpec("hll_merge", ColumnRef(a.name, a.output_type),
+                        a.name, a.output_type)
+            )
+        elif a.func in ("qsketch", "qsketch_merge"):
+            partial.append(a)
+            final.append(
+                AggSpec("qsketch_merge", ColumnRef(a.name, a.output_type),
+                        a.name, a.output_type)
+            )
         elif a.func == "cmoments":
             # mergeable central-moment accumulators (ops/moments.py):
             # partial rows re-center on the merged mean at final time
@@ -1137,9 +1202,55 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
                 blk, _need = collect_map_agg(
                     spec, v_s, bk2, live0[order0], gid_s0, 1, page.capacity
                 )
+            elif spec.func == "map_union":
+                m_s = Val(
+                    v.data[order0],
+                    None if v.valid is None else v.valid[order0],
+                    v.type, v.dict_id,
+                    lengths=None if v.lengths is None
+                    else v.lengths[order0],
+                    elem_valid=None if v.elem_valid is None
+                    else v.elem_valid[order0],
+                    keys=Val(
+                        v.keys.data[order0], None, v.keys.type,
+                        v.keys.dict_id,
+                    ),
+                )
+                blk, _need = collect_map_union(
+                    spec, m_s, live0[order0], gid_s0, 1, page.capacity
+                )
+            elif spec.func == "multimap_agg":
+                bk3 = _eval_by_keys(page, [spec])[0]
+                bk3 = Val(
+                    bk3.data[order0],
+                    None if bk3.valid is None else bk3.valid[order0],
+                    bk3.type,
+                    bk3.dict_id,
+                )
+                blk, _need = collect_multimap_agg(
+                    spec, v_s, bk3, live0[order0], gid_s0, 1,
+                    page.capacity,
+                )
+            elif spec.func == "num_hist":
+                contributes0 = live0[order0] if v.valid is None else (
+                    live0[order0] & v_s.valid_mask()
+                )
+                blk = numeric_histogram_agg(
+                    spec, v_s, contributes0, gid_s0, 2
+                )
+                blk = Block(
+                    blk.data[:1], blk.type, None,
+                    lengths=blk.lengths[:1],
+                    elem_valid=blk.elem_valid[:1],
+                    key_block=Block(
+                        blk.key_block.data[:1], blk.key_block.type, None,
+                        lengths=blk.key_block.lengths[:1],
+                        elem_valid=blk.key_block.elem_valid[:1],
+                    ),
+                )
             elif spec.func == "hll_merge":
                 regs = hll_merge_registers(v_s.data, live0[order0], gid_s0, 2)[:1]
-                blk = Block(regs, T.ArrayType(T.TINYINT), None)
+                blk = Block(regs, spec.output_type, None)
             elif spec.func in ("qsketch", "qsketch_merge"):
                 from . import qsketch as qs
 
@@ -1216,7 +1327,7 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
                 if spec.func == "approx_distinct":
                     blk = Block(hll_estimate(regs), T.BIGINT, None)
                 else:
-                    blk = Block(regs, T.ArrayType(T.TINYINT), None)
+                    blk = Block(regs, spec.output_type, None)
             blocks.append(blk)
             names.append(spec.name)
             continue
@@ -1240,7 +1351,137 @@ def global_aggregate(page: Page, aggs: Sequence[AggSpec], pre_mask=None) -> Page
 # ApproximateCountDistinctAggregations + airlift HyperLogLog)
 # ---------------------------------------------------------------------------
 
-COLLECTION_AGGS = ("array_agg", "map_agg", "histogram")
+COLLECTION_AGGS = (
+    "array_agg", "map_agg", "histogram",
+    "map_union", "multimap_agg", "num_hist",
+)
+
+
+def collect_map_union(spec, mv, live_s, gid_s, max_groups: int,
+                      max_elems: int):
+    """map_union over sorted rows: explode each row's map entries into
+    (key, value) pseudo-rows and run the map_agg pair machinery — the
+    merged map keeps the first value seen per key (reference
+    MapUnionAggregation keeps an arbitrary one)."""
+    cap, width = mv.data.shape[0], mv.data.shape[1]
+    keys = mv.keys
+    lens = (
+        mv.lengths if mv.lengths is not None
+        else jnp.full(cap, width, jnp.int32)
+    )
+    inb = jnp.arange(width)[None, :] < lens[:, None]
+    live_x = (jnp.repeat(live_s, width) & inb.reshape(-1))
+    if mv.valid is not None:
+        live_x = live_x & jnp.repeat(mv.valid, width)
+    gid_x = jnp.repeat(gid_s, width)
+    kv = Val(keys.data.reshape(-1), None, mv.type.key, keys.dict_id)
+    ev = None
+    if mv.elem_valid is not None:
+        ev = mv.elem_valid.reshape(-1)
+    vv = Val(mv.data.reshape(-1), ev, mv.type.value, mv.dict_id)
+    return collect_map_agg(
+        AggSpec("map_agg", None, spec.name, spec.output_type),
+        kv, vv, live_x, gid_x, max_groups, max_elems,
+    )
+
+
+def collect_multimap_agg(spec, kv, vv, live_s, gid_s, max_groups: int,
+                         max_elems: int):
+    """multimap_agg(k, v): map k -> ARRAY of every v seen with k
+    (reference MultimapAggregationFunction). Values ride a 3-D
+    (group, key, occurrence) block; occurrences of a (group, key) pair
+    are contiguous in the pair-sorted row order."""
+    cap = gid_s.shape[0]
+    contributes = live_s if kv.valid is None else (live_s & kv.valid)
+    key_norm = hash_rows([kv])
+    perm, pair_gid, first_pos, pair_count = _pair_runs(
+        gid_s, key_norm, contributes, max_groups
+    )
+    grange = jnp.arange(max_groups, dtype=jnp.int32)
+    pstart = jnp.searchsorted(pair_gid, grange, side="left").astype(jnp.int32)
+    pend = jnp.searchsorted(pair_gid, grange, side="right").astype(jnp.int32)
+    pcounts = pend - pstart
+    j = jnp.arange(max_elems, dtype=jnp.int32)
+    ppos = jnp.clip(pstart[:, None] + j[None, :], 0, cap - 1)
+    inb = j[None, :] < jnp.minimum(pcounts[:, None], max_elems)
+    first_row = perm[first_pos]
+    keys_mat = kv.data[first_row][ppos]
+    kblk = Block(
+        keys_mat, T.ArrayType(kv.type), None, kv.dict_id,
+        lengths=jnp.minimum(pcounts, max_elems), elem_valid=inb,
+    )
+    e = jnp.arange(max_elems, dtype=jnp.int32)
+    vsorted = vv.data[perm]
+    vpos = jnp.clip(
+        first_pos[ppos][:, :, None] + e[None, None, :], 0, cap - 1
+    )
+    vcnt = pair_count[ppos]
+    data3 = vsorted[vpos]
+    ev3 = inb[:, :, None] & (
+        e[None, None, :] < jnp.minimum(vcnt, max_elems)[:, :, None]
+    )
+    if vv.valid is not None:
+        ev3 = ev3 & vv.valid[perm][vpos]
+    blk = Block(
+        data3, spec.output_type, None, vv.dict_id,
+        lengths=jnp.minimum(pcounts, max_elems), elem_valid=ev3,
+        key_block=kblk,
+    )
+    need = jnp.maximum(jnp.max(pcounts), jnp.max(vcnt))
+    return blk, need
+
+
+def numeric_histogram_agg(spec, v, contributes, gid, num_groups: int):
+    """numeric_histogram(b, x): equal-width histogram over each group's
+    [min, max] range, computed in one two-pass aggregate — bucket key =
+    mean of its members, value = member count. The reference's
+    NumericHistogramAggregation adapts bucket boundaries while
+    streaming; the fixed-shape equivalent is the equi-width split of the
+    exact per-group range (same bucket COUNT contract, deterministic
+    boundaries)."""
+    from ..expr.ir import Literal
+
+    b = spec.input2
+    buckets = int(b.value if isinstance(b, Literal) else b)
+    x = v.data.astype(jnp.float64)
+    if isinstance(v.type, T.DecimalType) and not v.type.is_long:
+        x = x / (10 ** v.type.scale)
+    big = jnp.float64(jnp.inf)
+    mn = jax.ops.segment_min(
+        jnp.where(contributes, x, big), gid, num_segments=num_groups
+    )
+    mx = jax.ops.segment_max(
+        jnp.where(contributes, x, -big), gid, num_segments=num_groups
+    )
+    w = jnp.maximum((mx - mn) / buckets, 1e-300)
+    bi = jnp.clip(
+        jnp.floor((x - mn[gid]) / w[gid]).astype(jnp.int32), 0, buckets - 1
+    )
+    flat = gid * buckets + bi
+    total = num_groups * buckets
+    cnt = jax.ops.segment_sum(
+        contributes.astype(jnp.float64), flat, num_segments=total
+    ).reshape(num_groups, buckets)
+    sx = jax.ops.segment_sum(
+        jnp.where(contributes, x, 0.0), flat, num_segments=total
+    ).reshape(num_groups, buckets)
+    centers = sx / jnp.maximum(cnt, 1.0)
+    # compact non-empty buckets to the front (empty buckets are absent
+    # from the result map, like the reference)
+    occupied = cnt > 0
+    order = jnp.argsort(~occupied, axis=1, stable=True)
+    centers = jnp.take_along_axis(centers, order, axis=1)
+    weights = jnp.take_along_axis(cnt, order, axis=1)
+    lens = jnp.sum(occupied, axis=1).astype(jnp.int32)
+    inb = jnp.arange(buckets)[None, :] < lens[:, None]
+    kblk = Block(
+        centers, T.ArrayType(T.DOUBLE), None, None,
+        lengths=lens, elem_valid=inb,
+    )
+    return Block(
+        weights, T.MapType(T.DOUBLE, T.DOUBLE), None, None,
+        lengths=lens, elem_valid=inb, key_block=kblk,
+    )
 
 HLL_P = 10  # 2^10 = 1024 registers; standard error 1.04/sqrt(m) ~ 3.25%
 HLL_M = 1 << HLL_P

@@ -324,6 +324,24 @@ def _collection_pylist(b: Block, data, valid, n: int) -> list:
         kdata = np.asarray(kb.data[:n])
         kt, vt = b.type.key, b.type.value
         col = []
+        if data.ndim == 3:
+            # array-valued map (multimap_agg): values per key ride the
+            # third axis, liveness in the 3-D elem_valid
+            et = vt.element
+            for i in range(n):
+                if valid is not None and not valid[i]:
+                    col.append(None)
+                    continue
+                row = {}
+                for j in range(int(lens[i])):
+                    k = kt.to_python(kdata[i, j], kb.dictionary)
+                    row[k] = [
+                        et.to_python(data[i, j, e], b.dictionary)
+                        for e in range(data.shape[2])
+                        if ev is None or ev[i, j, e]
+                    ]
+                col.append(row)
+            return col
         for i in range(n):
             if valid is not None and not valid[i]:
                 col.append(None)

@@ -41,6 +41,8 @@ AGG_FUNCS = {
     "min_by", "max_by", "approx_percentile",
     "array_agg", "map_agg", "histogram",
     "learn_linear_regression", "learn_regressor",
+    "map_union", "multimap_agg", "numeric_histogram",
+    "qdigest_agg", "approx_set", "merge",
 }
 
 # aggregates planned by rewriting onto the core set (reference: many of
@@ -48,13 +50,14 @@ AGG_FUNCS = {
 LAMBDA_FUNCS = {
     "transform", "filter", "reduce", "zip_with",
     "any_match", "all_match", "none_match",
+    "map_filter", "transform_values", "transform_keys",
 }
 
 REWRITE_AGG_FUNCS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "count_if", "bool_and", "bool_or", "every", "arbitrary",
     "geometric_mean", "covar_samp", "covar_pop", "corr",
-    "skewness", "kurtosis",
+    "skewness", "kurtosis", "regr_slope", "regr_intercept",
 }
 
 _BINOP_FN = {
@@ -1139,6 +1142,90 @@ class Planner:
                     "linreg", feats, self.channel(fname),
                     T.ArrayType(T.DOUBLE), input2=label,
                 )
+            elif fname == "map_union":
+                if len(call.args) != 1:
+                    raise PlanningError("map_union takes 1 argument")
+                m = sctx.translate(call.args[0])
+                if not isinstance(m.type, T.MapType):
+                    raise PlanningError("map_union expects a map argument")
+                if filt is not None:
+                    m = ir.Call(
+                        "if", (filt, m, ir.Literal(None, m.type)), m.type
+                    )
+                spec = AggSpec(
+                    "map_union", m, self.channel(fname), m.type
+                )
+            elif fname == "multimap_agg":
+                if len(call.args) != 2:
+                    raise PlanningError("multimap_agg takes 2 arguments")
+                k = sctx.translate(call.args[0])
+                v = sctx.translate(call.args[1])
+                if filt is not None:
+                    k = ir.Call(
+                        "if", (filt, k, ir.Literal(None, k.type)), k.type
+                    )
+                spec = AggSpec(
+                    "multimap_agg", k, self.channel(fname),
+                    T.MapType(k.type, T.ArrayType(v.type)), input2=v,
+                )
+            elif fname == "numeric_histogram":
+                if len(call.args) != 2:
+                    raise PlanningError(
+                        "numeric_histogram takes (buckets, value)"
+                    )
+                b = sctx.translate(call.args[0])
+                e = sctx.translate(call.args[1])
+                if not isinstance(b, ir.Literal):
+                    raise PlanningError(
+                        "numeric_histogram bucket count must be a literal"
+                    )
+                if filt is not None:
+                    e = ir.Call(
+                        "if", (filt, e, ir.Literal(None, e.type)), e.type
+                    )
+                spec = AggSpec(
+                    "num_hist", e, self.channel(fname),
+                    T.MapType(T.DOUBLE, T.DOUBLE),
+                    input2=ir.Literal(int(b.value), T.BIGINT),
+                )
+            elif fname == "qdigest_agg":
+                e = sctx.translate(call.args[0])
+                if filt is not None:
+                    e = ir.Call(
+                        "if", (filt, e, ir.Literal(None, e.type)), e.type
+                    )
+                spec = AggSpec(
+                    "qsketch", e, self.channel(fname),
+                    T.ArrayType(T.BIGINT),
+                )
+            elif fname == "approx_set":
+                e = sctx.translate(call.args[0])
+                if filt is not None:
+                    e = ir.Call(
+                        "if", (filt, e, ir.Literal(None, e.type)), e.type
+                    )
+                spec = AggSpec(
+                    "hll_registers", e, self.channel(fname),
+                    T.ArrayType(T.TINYINT, sketch="hll"),
+                )
+            elif fname == "merge":
+                # merge(approx_set sketch) or merge(qdigest sketch):
+                # dispatch on the sketch's element type
+                e = sctx.translate(call.args[0])
+                if not isinstance(e.type, T.ArrayType):
+                    raise PlanningError("merge expects a sketch value")
+                if filt is not None:
+                    e = ir.Call(
+                        "if", (filt, e, ir.Literal(None, e.type)), e.type
+                    )
+                if isinstance(e.type.element, T.TinyintType):
+                    spec = AggSpec(
+                        "hll_merge", e, self.channel(fname), e.type
+                    )
+                else:
+                    spec = AggSpec(
+                        "qsketch_merge", e, self.channel(fname), e.type
+                    )
             elif fname == "map_agg":
                 if len(call.args) != 2:
                     raise PlanningError("map_agg takes 2 arguments")
@@ -1290,7 +1377,11 @@ class Planner:
             xd = masked(ir.cast(sctx.translate(call.args[0]), D))
             a = emit("avg", c("ln", xd), "geomean")
             return c("exp", a)
-        if fname in ("covar_samp", "covar_pop", "corr"):
+        if fname in ("covar_samp", "covar_pop", "corr", "regr_slope",
+                     "regr_intercept"):
+            # regr_* (reference RealRegrSlopeAggregation family): both
+            # args are (y, x) — slope = covar_pop(y,x)/var_pop(x),
+            # intercept = avg(y) - slope * avg(x)
             x0 = ir.cast(sctx.translate(call.args[0]), D)
             y0 = ir.cast(sctx.translate(call.args[1]), D)
             both = c(
@@ -1313,6 +1404,21 @@ class Planner:
                 return null_if_under(
                     n, 2, c("divide", cov_num, c("subtract", nd, dlit(1.0)))
                 )
+            if fname in ("regr_slope", "regr_intercept"):
+                # args are (y, x): x carries arg0=y, y carries arg1=x here
+                sxx2 = emit("sum", c("multiply", y, y), "sxx")
+                var_x = c(
+                    "subtract", sxx2, c("divide", c("multiply", sy, sy), nd)
+                )
+                slope = c("divide", cov_num, var_x)
+                cond = c("ne", var_x, dlit(0.0), typ=T.BOOLEAN)
+                slope = ir.Call("if", (cond, slope, ir.Literal(None, D)), D)
+                if fname == "regr_slope":
+                    return null_if_under(n, 1, slope)
+                mean_y = c("divide", sx, nd)
+                mean_x = c("divide", sy, nd)
+                out = c("subtract", mean_y, c("multiply", slope, mean_x))
+                return null_if_under(n, 1, out)
             sxx = emit("sum", c("multiply", x, x), "sxx")
             syy = emit("sum", c("multiply", y, y), "syy")
             vx = c(
@@ -2444,6 +2550,24 @@ class SelectContext:
             else:
                 out = T.BOOLEAN
             return ir.Call(name, (arr, lam), out)
+        if name in ("map_filter", "transform_values", "transform_keys"):
+            # map higher-order functions (reference MapFilterFunction,
+            # MapTransformValuesFunction, MapTransformKeysFunction)
+            if len(ast.args) != 2 or not isinstance(ast.args[1], t.LambdaExpr):
+                raise PlanningError(f"{name}(map, (k, v) -> ...) expected")
+            m = self._tr(ast.args[0])
+            if not isinstance(m.type, T.MapType):
+                raise PlanningError(f"{name} expects a map argument")
+            lam = self._translate_lambda(
+                ast.args[1], (m.type.key, m.type.value)
+            )
+            if name == "map_filter":
+                out = m.type
+            elif name == "transform_values":
+                out = T.MapType(m.type.key, lam.body.type)
+            else:
+                out = T.MapType(lam.body.type, m.type.value)
+            return ir.Call(name, (m, lam), out)
         if name == "zip_with":
             if len(ast.args) != 3 or not isinstance(ast.args[2], t.LambdaExpr):
                 raise PlanningError("zip_with(array, array, lambda) expected")

@@ -286,6 +286,11 @@ class ArrayType(Type):
     table columns are not supported."""
 
     element: Type = None  # type: ignore[assignment]
+    # sketch marker: "hll" tags approx_set's register arrays so
+    # cardinality() reads the HLL estimate instead of the lane count
+    # (the reference has a distinct HYPERLOGLOG type; here the sketch
+    # rides ARRAY(TINYINT) with this annotation)
+    sketch: Optional[str] = None
     name: ClassVar[str] = "array"
 
     @property

@@ -1,0 +1,225 @@
+"""Round-5 registry tail: map lambdas, mergeable-sketch surface
+(qdigest_agg/approx_set/merge), map_union/multimap_agg,
+numeric_histogram, regr_slope/intercept, ieee754 + misc scalars
+(reference metadata/FunctionRegistry.java:360)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.page import Page
+from presto_tpu.parallel.mesh import default_mesh
+from presto_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    rng = np.random.default_rng(3)
+    n = 200
+    return Session(
+        MemoryCatalog(
+            {
+                "t": Page.from_dict(
+                    {
+                        "g": rng.integers(0, 3, n).astype(np.int64),
+                        "x": rng.random(n) * 10,
+                        "k": [f"k{i % 4}" for i in range(n)],
+                        "v": np.arange(n, dtype=np.int64),
+                        "s": ["a=1,b=22"] * n,
+                    }
+                )
+            }
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def _fix_y(session):
+    return session
+
+
+def one(session, expr):
+    return session.query(f"select {expr} q from t limit 1").rows()[0][0]
+
+
+# build y = 3x + 2 + noise as a second fixture-friendly table
+@pytest.fixture(scope="module")
+def regr_session():
+    rng = np.random.default_rng(3)
+    n = 200
+    x = rng.random(n) * 10
+    y = 3.0 * x + 2.0 + rng.random(n)
+    return Session(
+        MemoryCatalog({"t": Page.from_dict({"x": x, "y": y})})
+    ), x, y
+
+
+def test_regr_slope_intercept(regr_session):
+    s, x, y = regr_session
+    slope, icept = s.query(
+        "select regr_slope(y, x), regr_intercept(y, x) from t"
+    ).rows()[0]
+    ref_slope, ref_icept = np.polyfit(x, y, 1)
+    assert slope == pytest.approx(ref_slope, rel=1e-9)
+    assert icept == pytest.approx(ref_icept, rel=1e-9)
+
+
+def test_multimap_agg(session):
+    (m,) = session.query(
+        "select multimap_agg(k, v) from t where v < 10"
+    ).rows()[0]
+    assert m["k0"] == [0, 4, 8]
+    assert m["k3"] == [3, 7]
+
+
+def test_map_union(session):
+    (m,) = session.query(
+        "select map_union(map(array['a', k], array[v, v * 2])) "
+        "from t where v < 6"
+    ).rows()[0]
+    assert m["a"] == 0  # first value per key wins
+    assert m["k1"] == 2
+
+
+def test_numeric_histogram(session):
+    (h,) = session.query(
+        "select numeric_histogram(4, x) from t"
+    ).rows()[0]
+    assert len(h) == 4
+    assert sum(h.values()) == 200  # weights are member counts
+    keys = sorted(h)
+    assert all(0 <= k <= 10 for k in keys)
+
+
+def test_qdigest_roundtrip(session):
+    (med,) = session.query(
+        "select value_at_quantile(qdigest_agg(v), 0.5) from t"
+    ).rows()[0]
+    assert med == pytest.approx(100, rel=0.05)
+    (rank,) = session.query(
+        "select quantile_at_value(qdigest_agg(v), 100) from t"
+    ).rows()[0]
+    assert rank == pytest.approx(0.5, abs=0.05)
+
+
+def test_approx_set_merge_cardinality(session):
+    (c,) = session.query(
+        "select cardinality(approx_set(v % 137)) from t"
+    ).rows()[0]
+    assert c == pytest.approx(137, rel=0.05)
+    (c2,) = session.query(
+        "select cardinality(merge(sk)) from "
+        "(select approx_set(v % 137) sk from t group by g) u"
+    ).rows()[0]
+    assert c2 == pytest.approx(137, rel=0.05)
+
+
+def test_sketches_distributed(session):
+    cat = session.catalog
+    ds = Session(cat, mesh=default_mesh(8))
+    (c,) = ds.query(
+        "select cardinality(approx_set(v % 137)) from t"
+    ).rows()[0]
+    assert c == pytest.approx(137, rel=0.05)
+    (med,) = ds.query(
+        "select value_at_quantile(qdigest_agg(v), 0.5) from t"
+    ).rows()[0]
+    assert med == pytest.approx(100, rel=0.06)
+
+
+# -- map lambdas -----------------------------------------------------------
+
+
+def test_map_filter(session):
+    assert one(
+        session,
+        "map_filter(map(array['a','b','c'], array[1,2,3]), "
+        "(k, v) -> v >= 2)",
+    ) == {"b": 2, "c": 3}
+
+
+def test_transform_values_and_keys(session):
+    assert one(
+        session,
+        "transform_values(map(array['a','b'], array[1,2]), "
+        "(k, v) -> v * 10)",
+    ) == {"a": 10, "b": 20}
+    assert one(
+        session,
+        "transform_keys(map(array[1,2], array['x','y']), "
+        "(k, v) -> k + 100)",
+    ) == {101: "x", 102: "y"}
+
+
+# -- scalars ---------------------------------------------------------------
+
+
+def test_hyperbolic_tail(session):
+    assert one(session, "asinh(1.0)") == pytest.approx(math.asinh(1))
+    assert one(session, "acosh(2.0)") == pytest.approx(math.acosh(2))
+    assert one(session, "atanh(0.5)") == pytest.approx(math.atanh(0.5))
+    assert one(session, "cot(1.0)") == pytest.approx(
+        math.cos(1) / math.sin(1)
+    )
+
+
+def test_ieee754_roundtrip(session):
+    assert one(session, "to_ieee754_64(1.0)") == "3FF0000000000000"
+    assert one(
+        session, "from_ieee754_64(to_ieee754_64(3.14))"
+    ) == pytest.approx(3.14)
+    assert one(
+        session, "from_ieee754_32(to_ieee754_32(1.5))"
+    ) == pytest.approx(1.5)
+
+
+def test_split_to_map(session):
+    assert one(session, "split_to_map(s, ',', '=')") == {
+        "a": "1",
+        "b": "22",
+    }
+
+
+def test_from_iso8601_timestamp(session):
+    import datetime
+
+    v = one(session, "from_iso8601_timestamp('2020-05-01T10:00:00Z')")
+    want = datetime.datetime(2020, 5, 1, 10)
+    got = v if isinstance(v, datetime.datetime) else (
+        datetime.datetime(1970, 1, 1)
+        + datetime.timedelta(microseconds=int(v))
+    )
+    assert got == want
+
+
+def test_spooky_hashes_stable(session):
+    a = one(session, "spooky_hash_v2_64(s)")
+    b = one(session, "spooky_hash_v2_64(s)")
+    assert a == b and a > 0
+    assert 0 <= one(session, "spooky_hash_v2_32(s)") < 2**32
+
+
+def test_inverse_beta_cdf(session):
+    assert one(
+        session, "inverse_beta_cdf(2.0, 5.0, beta_cdf(2.0, 5.0, 0.3))"
+    ) == pytest.approx(0.3, abs=1e-9)
+
+
+def test_cosine_similarity_maps(session):
+    assert one(
+        session,
+        "cosine_similarity(map(array['a','b'], array[cast(1 as double),"
+        " cast(2 as double)]), map(array['a','b'], array[cast(1 as"
+        " double), cast(2 as double)]))",
+    ) == pytest.approx(1.0)
+    assert one(
+        session,
+        "cosine_similarity(map(array['a'], array[cast(1 as double)]),"
+        " map(array['b'], array[cast(1 as double)]))",
+    ) == pytest.approx(0.0)
+
+
+def test_current_timezone(session):
+    assert one(session, "current_timezone()") == "UTC"
