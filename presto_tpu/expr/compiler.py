@@ -511,10 +511,12 @@ def _eval_map_lambda(expr: Call, page: Page) -> Val:
         None if body.valid is None else body.valid.reshape(cap, width)
     )
     if name == "transform_values":
+        # the body's OWN validity is the only per-entry nullability: a
+        # lambda ignoring v yields non-null even for null input values
+        # (its valid mask already folds in elem_valid when it reads v)
         return Val(
             bdata, m.valid, out_type, body.dict_id, lengths=m.lengths,
-            elem_valid=bvalid if bvalid is not None else m.elem_valid,
-            keys=keys,
+            elem_valid=bvalid, keys=keys,
         )
     # transform_keys: values unchanged; keys replaced by the body
     new_keys = Val(

@@ -1344,23 +1344,42 @@ def _to_ieee754_32(a: Val, out_type: T.Type) -> Val:
     )
 
 
+def _dict_table_gather(a: Val, build, np_dtype, out_t: T.Type, what: str):
+    """Per-dictionary-entry scalar decode -> device gather by code: the
+    numeric-output sibling of functions.py's _dict_transform. `build`
+    maps one dictionary string to a python scalar (raising ValueError for
+    malformed entries, which become NULL rows)."""
+    d = a.dictionary
+    if d is None:
+        raise TypeError(f"{what} expects a varchar value")
+    vals = np.zeros(len(d), np_dtype)
+    oks = np.zeros(len(d), np.bool_)
+    for i, s in enumerate(d):
+        try:
+            vals[i] = build(s)
+            oks[i] = True
+        except (ValueError, OverflowError):
+            pass
+    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
+    return Val(
+        jnp.asarray(vals)[codes],
+        and_valid(a.valid, jnp.asarray(oks)[codes]),
+        out_t,
+    )
+
+
 def _hex_dict_to_float(a: Val, fmt: str, width: int):
     """Decode each dictionary entry's hex bytes -> float, gather by code
     (column inputs fine: the dictionary is bounded)."""
     import struct
 
-    d = a.dictionary
-    if d is None:
-        raise TypeError("from_ieee754 expects a varbinary/varchar value")
-    vals = []
-    for s in d:
+    def build(s):
         try:
-            vals.append(struct.unpack(fmt, bytes.fromhex(s))[0])
-        except (ValueError, struct.error):
-            vals.append(float("nan"))
-    table = jnp.asarray(np.array(vals, np.float64))
-    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
-    return Val(table[codes], a.valid, T.DOUBLE)
+            return struct.unpack(fmt, bytes.fromhex(s))[0]
+        except struct.error as e:
+            raise ValueError(str(e))
+
+    return _dict_table_gather(a, build, np.float64, T.DOUBLE, "from_ieee754")
 
 
 @register("from_ieee754_64", _double_infer)
@@ -1462,24 +1481,17 @@ def _from_iso8601_timestamp(a: Val, out_type: T.Type) -> Val:
     """ISO8601 string -> timestamp (micros); dictionary transform."""
     import datetime as pydt
 
-    d = a.dictionary
-    if d is None:
-        raise TypeError("from_iso8601_timestamp expects a varchar value")
-    vals = np.zeros(len(d), np.int64)
-    oks = np.zeros(len(d), np.bool_)
-    for i, s in enumerate(d):
-        try:
-            dt = pydt.datetime.fromisoformat(s.replace("Z", "+00:00"))
-            if dt.tzinfo is not None:
-                dt = dt.astimezone(pydt.timezone.utc).replace(tzinfo=None)
-            epoch = pydt.datetime(1970, 1, 1)
-            vals[i] = int((dt - epoch).total_seconds() * 1_000_000)
-            oks[i] = True
-        except ValueError:
-            pass
-    vt, ot = jnp.asarray(vals), jnp.asarray(oks)
-    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
-    return Val(vt[codes], and_valid(a.valid, ot[codes]), T.TIMESTAMP)
+    def build(s):
+        dt = pydt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+        if dt.tzinfo is not None:
+            dt = dt.astimezone(pydt.timezone.utc).replace(tzinfo=None)
+        return int(
+            (dt - pydt.datetime(1970, 1, 1)).total_seconds() * 1_000_000
+        )
+
+    return _dict_table_gather(
+        a, build, np.int64, T.TIMESTAMP, "from_iso8601_timestamp"
+    )
 
 
 def _spooky(bits: int):
@@ -1488,21 +1500,15 @@ def _spooky(bits: int):
         dictionary transform as md5/xxhash (the reference's exact
         SpookyHashV2 constants are not replicated; the contract — a
         stable 32/64-bit hash of the bytes — is)."""
-        d = a.dictionary
-        if d is None:
-            raise TypeError("spooky_hash expects a varchar value")
-        vals = np.zeros(len(d), np.int64)
-        for i, s in enumerate(d):
+
+        def build(s):
             h = hashlib.blake2b(s.encode(), digest_size=8).digest()
             v = int.from_bytes(h, "big", signed=False)
-            if bits == 32:
-                v &= 0xFFFFFFFF
-            else:
-                v &= 0x7FFFFFFFFFFFFFFF
-            vals[i] = v
-        vt = jnp.asarray(vals)
-        codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
-        return Val(vt[codes], a.valid, T.BIGINT)
+            return v & (0xFFFFFFFF if bits == 32 else 0x7FFFFFFFFFFFFFFF)
+
+        return _dict_table_gather(
+            a, build, np.int64, T.BIGINT, "spooky_hash"
+        )
 
     return impl
 
@@ -1574,3 +1580,221 @@ def _split_to_map(a: Val, entry_d: Val, kv_d: Val, out_type: T.Type) -> Val:
         T.MapType(T.VARCHAR, T.VARCHAR), intern_dictionary(valpool),
         lengths=klens, keys=keys,
     )
+
+
+# ---------------------------------------------------------------------------
+# geometry engine (round 5): polygons/linestrings as padded vertex lanes
+# (reference presto-geospatial GeoFunctions.java — the Esri-backed
+# surface re-implemented on ops/geometry.py's vectorized kernels; a
+# geometry VALUE is an ARRAY(DOUBLE) of interleaved [x0,y0,x1,y1,...]
+# with lengths = 2 * vertex count, so st_point values compose directly)
+# ---------------------------------------------------------------------------
+
+
+def _geom_verts(g: Val, what: str):
+    """Interleaved lanes -> ((n, V, 2) vertices, (n,) counts)."""
+    if g.lengths is None or g.data.ndim != 2:
+        raise TypeError(f"{what} requires a geometry value")
+    d = g.data.astype(jnp.float64)
+    if d.shape[1] % 2:
+        d = d[:, :-1]
+    v = d.reshape(d.shape[0], -1, 2)
+    return v, (g.lengths // 2).astype(jnp.int32)
+
+
+def _wkt_parse_val(a: Val, what: str) -> Val:
+    from ..ops import geometry as geo
+
+    d = a.dictionary
+    if d is None:
+        raise TypeError(f"{what} expects a varchar WKT value")
+    geoms, oks = [], np.zeros(len(d), np.bool_)
+    for i, s in enumerate(d):
+        try:
+            _kind, v = geo.parse_wkt(s)
+            geoms.append(v)
+            oks[i] = True
+        except ValueError:
+            geoms.append(np.zeros((1, 2), np.float64))
+    verts, nv = geo.pack_vertices(geoms)
+    flat = verts.reshape(len(d), -1)  # interleaved lanes
+    codes = jnp.clip(a.data.astype(jnp.int32), 0, max(len(d) - 1, 0))
+    data = jnp.asarray(flat)[codes]
+    lens = (jnp.asarray(nv) * 2)[codes]
+    valid = and_valid(a.valid, jnp.asarray(oks)[codes])
+    return Val(data, valid, T.ArrayType(T.DOUBLE), lengths=lens)
+
+
+@register("st_geometryfromtext", lambda ts: T.ArrayType(T.DOUBLE))
+def _st_geometryfromtext(a: Val, out_type: T.Type) -> Val:
+    return _wkt_parse_val(a, "st_geometryfromtext")
+
+
+@register("st_polygon", lambda ts: T.ArrayType(T.DOUBLE))
+def _st_polygon(a: Val, out_type: T.Type) -> Val:
+    return _wkt_parse_val(a, "st_polygon")
+
+
+@register("st_linefromtext", lambda ts: T.ArrayType(T.DOUBLE))
+def _st_linefromtext(a: Val, out_type: T.Type) -> Val:
+    return _wkt_parse_val(a, "st_linefromtext")
+
+
+def _broadcast_geoms(a: Val, b: Val, what: str):
+    va, na = _geom_verts(a, what)
+    vb, nb = _geom_verts(b, what)
+    n = max(va.shape[0], vb.shape[0])
+    if va.shape[0] == 1 and n > 1:
+        va = jnp.broadcast_to(va, (n,) + va.shape[1:])
+        na = jnp.broadcast_to(na, (n,))
+    if vb.shape[0] == 1 and n > 1:
+        vb = jnp.broadcast_to(vb, (n,) + vb.shape[1:])
+        nb = jnp.broadcast_to(nb, (n,))
+    return va, na, vb, nb
+
+
+@register("st_contains", _bool_infer)
+def _st_contains(g: Val, p: Val, out_type: T.Type) -> Val:
+    """st_contains(geometry, geometry): every vertex of the right operand
+    inside the left ring (exact for points; the all-vertices test for
+    polygons matches the no-hole subset)."""
+    from ..ops import geometry as geo
+
+    va, na, vb, nb = _broadcast_geoms(g, p, "st_contains")
+    V = vb.shape[1]
+    inside = geo.point_in_polygon(
+        vb[..., 0].reshape(-1),
+        vb[..., 1].reshape(-1),
+        jnp.repeat(va, V, axis=0),
+        jnp.repeat(na, V),
+    ).reshape(vb.shape[0], V)
+    lanes = jnp.arange(V)[None, :] < nb[:, None]
+    out = jnp.all(inside | ~lanes, axis=1) & (nb > 0)
+    return Val(out, and_valid(g.valid, p.valid), T.BOOLEAN)
+
+
+@register("st_within", _bool_infer)
+def _st_within(p: Val, g: Val, out_type: T.Type) -> Val:
+    return _st_contains(g, p, out_type=T.BOOLEAN)
+
+
+@register("st_intersects", _bool_infer)
+def _st_intersects(a: Val, b: Val, out_type: T.Type) -> Val:
+    from ..ops import geometry as geo
+
+    va, na, vb, nb = _broadcast_geoms(a, b, "st_intersects")
+    out = geo.polygons_intersect(va, na, vb, nb)
+    return Val(out, and_valid(a.valid, b.valid), T.BOOLEAN)
+
+
+@register("st_disjoint", _bool_infer)
+def _st_disjoint(a: Val, b: Val, out_type: T.Type) -> Val:
+    v = _st_intersects(a, b, out_type=T.BOOLEAN)
+    return Val(~v.data, v.valid, T.BOOLEAN)
+
+
+@register("st_area", _double_infer)
+def _st_area(g: Val, out_type: T.Type) -> Val:
+    from ..ops import geometry as geo
+
+    v, nv = _geom_verts(g, "st_area")
+    return Val(geo.polygon_area(v, nv), g.valid, T.DOUBLE)
+
+
+@register("st_centroid", lambda ts: T.ArrayType(T.DOUBLE))
+def _st_centroid(g: Val, out_type: T.Type) -> Val:
+    from ..ops import geometry as geo
+
+    v, nv = _geom_verts(g, "st_centroid")
+    cx, cy = geo.polygon_centroid(v, nv)
+    data = jnp.stack([cx, cy], axis=1)
+    return Val(
+        data, g.valid, T.ArrayType(T.DOUBLE),
+        lengths=jnp.full(data.shape[0], 2, jnp.int32),
+    )
+
+
+@register("st_length", _double_infer)
+def _st_length(g: Val, out_type: T.Type) -> Val:
+    from ..ops import geometry as geo
+
+    v, nv = _geom_verts(g, "st_length")
+    return Val(geo.line_length(v, nv), g.valid, T.DOUBLE)
+
+
+@register("st_perimeter", _double_infer)
+def _st_perimeter(g: Val, out_type: T.Type) -> Val:
+    from ..ops import geometry as geo
+
+    v, nv = _geom_verts(g, "st_perimeter")
+    return Val(geo.ring_perimeter(v, nv), g.valid, T.DOUBLE)
+
+
+def _geom_reduce(g: Val, what: str, axis_sel: int, fn):
+    v, nv = _geom_verts(g, what)
+    lanes = jnp.arange(v.shape[1])[None, :] < nv[:, None]
+    coord = v[..., axis_sel]
+    big = jnp.float64(jnp.inf)
+    if fn == "min":
+        out = jnp.min(jnp.where(lanes, coord, big), axis=1)
+    else:
+        out = jnp.max(jnp.where(lanes, coord, -big), axis=1)
+    return Val(out, and_valid(g.valid, nv > 0), T.DOUBLE)
+
+
+@register("st_xmin", _double_infer)
+def _st_xmin(g: Val, out_type: T.Type) -> Val:
+    return _geom_reduce(g, "st_xmin", 0, "min")
+
+
+@register("st_xmax", _double_infer)
+def _st_xmax(g: Val, out_type: T.Type) -> Val:
+    return _geom_reduce(g, "st_xmax", 0, "max")
+
+
+@register("st_ymin", _double_infer)
+def _st_ymin(g: Val, out_type: T.Type) -> Val:
+    return _geom_reduce(g, "st_ymin", 1, "min")
+
+
+@register("st_ymax", _double_infer)
+def _st_ymax(g: Val, out_type: T.Type) -> Val:
+    return _geom_reduce(g, "st_ymax", 1, "max")
+
+
+@register("st_envelope", lambda ts: T.ArrayType(T.DOUBLE))
+def _st_envelope(g: Val, out_type: T.Type) -> Val:
+    """Bounding-box polygon (closed 5-vertex ring)."""
+    x0 = _geom_reduce(g, "st_envelope", 0, "min").data
+    x1 = _geom_reduce(g, "st_envelope", 0, "max").data
+    y0 = _geom_reduce(g, "st_envelope", 1, "min").data
+    y1 = _geom_reduce(g, "st_envelope", 1, "max").data
+    data = jnp.stack(
+        [x0, y0, x1, y0, x1, y1, x0, y1, x0, y0], axis=1
+    )
+    return Val(
+        data, g.valid, T.ArrayType(T.DOUBLE),
+        lengths=jnp.full(data.shape[0], 10, jnp.int32),
+    )
+
+
+@register("st_isclosed", _bool_infer)
+def _st_isclosed(g: Val, out_type: T.Type) -> Val:
+    v, nv = _geom_verts(g, "st_isclosed")
+    last = jnp.take_along_axis(
+        v, jnp.maximum(nv - 1, 0)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    closed = jnp.all(v[:, 0] == last, axis=1) & (nv >= 3)
+    return Val(closed, g.valid, T.BOOLEAN)
+
+
+@register("st_isempty", _bool_infer)
+def _st_isempty(g: Val, out_type: T.Type) -> Val:
+    _v, nv = _geom_verts(g, "st_isempty")
+    return Val(nv == 0, g.valid, T.BOOLEAN)
+
+
+@register("st_numpoints", _bigint_infer)
+def _st_numpoints(g: Val, out_type: T.Type) -> Val:
+    _v, nv = _geom_verts(g, "st_numpoints")
+    return Val(nv.astype(jnp.int64), g.valid, T.BIGINT)

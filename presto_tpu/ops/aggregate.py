@@ -1427,7 +1427,11 @@ def collect_multimap_agg(spec, kv, vv, live_s, gid_s, max_groups: int,
         lengths=jnp.minimum(pcounts, max_elems), elem_valid=ev3,
         key_block=kblk,
     )
-    need = jnp.maximum(jnp.max(pcounts), jnp.max(vcnt))
+    # mask vcnt to live windows: clipped gathers past the pair count
+    # read garbage rows whose counts must not inflate the retry target
+    need = jnp.maximum(
+        jnp.max(pcounts), jnp.max(jnp.where(inb, vcnt, 0))
+    )
     return blk, need
 
 

@@ -2562,6 +2562,10 @@ class SelectContext:
                 ast.args[1], (m.type.key, m.type.value)
             )
             if name == "map_filter":
+                if not isinstance(lam.body.type, T.BooleanType):
+                    raise PlanningError(
+                        "map_filter lambda must return boolean"
+                    )
                 out = m.type
             elif name == "transform_values":
                 out = T.MapType(m.type.key, lam.body.type)

@@ -35,11 +35,6 @@ def session():
     )
 
 
-@pytest.fixture(scope="module")
-def _fix_y(session):
-    return session
-
-
 def one(session, expr):
     return session.query(f"select {expr} q from t limit 1").rows()[0][0]
 
@@ -223,3 +218,45 @@ def test_cosine_similarity_maps(session):
 
 def test_current_timezone(session):
     assert one(session, "current_timezone()") == "UTC"
+
+
+def test_multimap_need_not_inflated_by_padding():
+    """Regression: clipped gathers past the pair count must not inflate
+    the adaptive retry target (it would grow max_elems to page capacity
+    and allocate a quadratic 3-D block)."""
+    import jax.numpy as jnp
+
+    from presto_tpu import types as T
+    from presto_tpu.expr.functions import Val, intern_dictionary
+    from presto_tpu.ops.aggregate import AggSpec, collect_multimap_agg
+
+    cap = 1024
+    live = jnp.zeros(cap, bool).at[:6].set(True)
+    gid = jnp.zeros(cap, jnp.int32)
+    did = intern_dictionary(("a", "b", "c"))
+    kv = Val(
+        jnp.zeros(cap, jnp.int32).at[:6].set(
+            jnp.asarray([0, 0, 1, 1, 2, 2], jnp.int32)
+        ),
+        None, T.VARCHAR, did,
+    )
+    vv = Val(jnp.arange(cap, dtype=jnp.int64), None, T.BIGINT)
+    spec = AggSpec(
+        "multimap_agg", None, "m",
+        T.MapType(T.VARCHAR, T.ArrayType(T.BIGINT)),
+    )
+    _blk, need = collect_multimap_agg(spec, kv, vv, live, gid, 2, 8)
+    assert int(need) <= 3
+
+
+def test_transform_values_constant_lambda_over_null(session):
+    assert one(
+        session,
+        "transform_values(map(array['a','b'], "
+        "array[1, cast(null as bigint)]), (k, v) -> 9)",
+    ) == {"a": 9, "b": 9}
+
+
+def test_map_filter_requires_boolean_lambda(session):
+    with pytest.raises(Exception):
+        one(session, "map_filter(map(array['a'], array[1]), (k, v) -> v)")
