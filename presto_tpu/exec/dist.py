@@ -82,7 +82,7 @@ class DistributedExecutor:
 replicated subtrees delegate to the single-node Executor."""
 
     def __init__(self, catalog, mesh, axis: str = WORKER_AXIS,
-                 collector=None):
+                 collector=None, exchange_budget: Optional[int] = None):
         self.catalog = catalog
         self.mesh = mesh
         self.axis = axis
@@ -90,11 +90,25 @@ replicated subtrees delegate to the single-node Executor."""
         self.local = Executor(catalog, collector=collector)
         self._steps: Dict = {}
         self.collector = collector
+        # per-shard byte budget for exchanged join intermediates: when an
+        # exchange+join would materialize more than this, the hash space
+        # is split into buckets processed one at a time (SURVEY §7
+        # chunked ICI exchange; reference OutputBufferMemoryManager's
+        # backpressure role). None = materialize whole intermediates.
+        self.exchange_budget = exchange_budget
+        self.exchange_events: List[dict] = []
 
     # -- public --
 
     def run(self, root: N.PlanNode) -> Page:
-        out = self._run(root)
+        # per-query subtree memo: a node instance executes at most once
+        # (the grouped-join probe may walk children the fallback path
+        # revisits; without the memo that would double-execute stages)
+        self._node_memo: Dict[int, object] = {}
+        try:
+            out = self._run(root)
+        finally:
+            self._node_memo = {}
         if isinstance(out, SPage):  # fragmenter gathers, but be safe
             out = self.to_single(out)
         return out
@@ -242,6 +256,15 @@ replicated subtrees delegate to the single-node Executor."""
     # -- dispatch --
 
     def _run(self, node: N.PlanNode):
+        memo = getattr(self, "_node_memo", None)
+        if memo is not None and id(node) in memo:
+            return memo[id(node)]
+        out = self._run_timed(node)
+        if memo is not None:
+            memo[id(node)] = out
+        return out
+
+    def _run_timed(self, node: N.PlanNode):
         if self.collector is None:
             return self._run_inner(node)
         import time
@@ -417,7 +440,147 @@ replicated subtrees delegate to the single-node Executor."""
 
     # -- joins --
 
+    @staticmethod
+    def _row_bytes(sp: "SPage") -> int:
+        return sum(
+            int(jnp.dtype(lf.dtype).itemsize)
+            * (int(lf.shape[-1]) if lf.ndim > 2 else 1)
+            for lf in sp.leaves
+        )
+
+    def _maybe_grouped_join(self, node: N.Join):
+        """Grouped-execution exchange join (chunked ICI exchange): when
+        repartitioning both sides would materialize more than
+        exchange_budget bytes per shard, split the hash space into B
+        buckets and run filter -> all_to_all -> build -> join ONE BUCKET
+        at a time inside a single SPMD step each — the exchanged
+        intermediate never exceeds ~1/B of the materializing path, and
+        jax's async dispatch overlaps bucket b's compute with b+1's
+        enqueue (the double-buffering the reference gets from paged
+        OutputBuffers + ExchangeClient prefetch)."""
+        if self.exchange_budget is None or node.unique_build:
+            return None
+        if node.kind not in ("inner", "left"):
+            return None
+        if not (
+            isinstance(node.left, Exchange)
+            and node.left.kind == "repartition"
+            and isinstance(node.right, Exchange)
+            and node.right.kind == "repartition"
+        ):
+            return None
+        left = self._run(node.left.child)
+        right = self._run(node.right.child)
+        if not isinstance(left, SPage) or not isinstance(right, SPage):
+            return None
+        lcap, rcap = left.shard_capacity, right.shard_capacity
+        est = self.n * (
+            lcap * self._row_bytes(left) + rcap * self._row_bytes(right)
+        )
+        B = 1
+        while B < 64 and est // B > self.exchange_budget:
+            B *= 2
+        if B == 1:
+            return None  # fits the budget: the normal path materializes
+        right_names = tuple(nm for nm, _ in node.right.fields)
+        axis, n = self.axis, self.n
+        # per-bucket capacities start at cap/B (hash buckets are balanced
+        # in expectation); skew retries with doubled capacity on drops
+        bl = max(round_capacity(-(-lcap // B)), 64)
+        br = max(round_capacity(-(-rcap // B)), 64)
+        out_cap = max(round_capacity(-(-lcap // B)), 64)
+        parts: List[SPage] = []
+        peak = 0
+        from ..expr.compiler import evaluate as _ev
+        from ..ops.hashing import hash_rows
+
+        def bucket_filter(p: Page, keys, b):
+            vals = [_ev(k, p) for k in keys]
+            h = hash_rows(vals)
+            live = jnp.arange(p.capacity) < p.count
+            keep = live & (((h // n) % B) == b)
+            return compact(p, keep)
+
+        import numpy as _np
+
+        b = 0
+        while b < B:
+            cbl, cbr, cout = bl, br, out_cap
+
+            def step(l: Page, r: Page, bpage: Page, _cbl=cbl, _cbr=cbr,
+                     _cout=cout) -> Page:
+                # the bucket id arrives as a TRACED replicated scalar, so
+                # ONE compiled step (keyed on capacities) serves every
+                # bucket instead of B recompiles
+                _b = bpage.blocks[0].data[0]
+                lb = bucket_filter(l, node.left.keys, _b)
+                rb = bucket_filter(r, node.right.keys, _b)
+                lx, ldrop = exchange_by_hash(
+                    lb, node.left.keys, axis, n, _cbl
+                )
+                rx, rdrop = exchange_by_hash(
+                    rb, node.right.keys, axis, n, _cbr
+                )
+                out, overflow = join_expand(
+                    lx,
+                    build(rx, node.right_keys),
+                    node.left_keys,
+                    lx.names,
+                    [(nm, nm) for nm in right_names],
+                    out_capacity=_cout,
+                    kind=node.kind,
+                )
+                return out, ldrop + rdrop, overflow
+
+            bpage = Page.from_dict({"b": _np.asarray([b], _np.int32)})
+            out, (dropped, overflow) = self._apply(
+                (node, "gx", B, cbl, cbr, cout), step, [left, right],
+                rep_pages=[bpage], n_extra=2,
+            )
+            if int(jnp.max(dropped)) > 0:
+                bl, br = bl * 2, br * 2
+                continue  # retry the same bucket with bigger exchange caps
+            ov = int(jnp.max(overflow))
+            if ov > 0:
+                out_cap = round_capacity(out_cap + ov)
+                continue
+            peak = max(
+                peak, n * (bl * self._row_bytes(left)
+                           + br * self._row_bytes(right))
+            )
+            parts.append(self._shrink_sp(out))
+            b += 1
+        self.exchange_events.append(
+            {"buckets": B, "per_shard_bytes": peak, "estimate": est}
+        )
+        if len(parts) == 1:
+            out = parts[0]
+        else:
+            from ..ops.union import concat_pages
+
+            out, _ = self._apply(
+                (node, "gx-concat", B, tuple(p.shard_capacity for p in parts)),
+                lambda *pages: concat_pages(pages),
+                parts,
+            )
+            out = self._shrink_sp(out)
+        if node.residual is not None:
+            if node.kind != "inner":
+                raise ExecutionError(
+                    "residual on outer join not yet supported"
+                )
+            out, _ = self._apply(
+                (node, "gx-resid"),
+                lambda p: filter_page(p, node.residual),
+                [out],
+            )
+            out = self._shrink_sp(out)
+        return out
+
     def _d_join(self, node: N.Join):
+        grouped = self._maybe_grouped_join(node)
+        if grouped is not None:
+            return grouped
         left = self._run(node.left)
         right = self._run(node.right)
         if not isinstance(left, SPage):

@@ -1656,20 +1656,13 @@ def _broadcast_geoms(a: Val, b: Val, what: str):
 @register("st_contains", _bool_infer)
 def _st_contains(g: Val, p: Val, out_type: T.Type) -> Val:
     """st_contains(geometry, geometry): every vertex of the right operand
-    inside the left ring (exact for points; the all-vertices test for
-    polygons matches the no-hole subset)."""
+    inside the left ring AND no proper edge crossing — exact for points
+    and for hole-free polygons including concave containers (boundary
+    contact allowed, matching the reference's closure semantics)."""
     from ..ops import geometry as geo
 
     va, na, vb, nb = _broadcast_geoms(g, p, "st_contains")
-    V = vb.shape[1]
-    inside = geo.point_in_polygon(
-        vb[..., 0].reshape(-1),
-        vb[..., 1].reshape(-1),
-        jnp.repeat(va, V, axis=0),
-        jnp.repeat(na, V),
-    ).reshape(vb.shape[0], V)
-    lanes = jnp.arange(V)[None, :] < nb[:, None]
-    out = jnp.all(inside | ~lanes, axis=1) & (nb > 0)
+    out = geo.contains_all_vertices(va, na, vb, nb)
     return Val(out, and_valid(g.valid, p.valid), T.BOOLEAN)
 
 

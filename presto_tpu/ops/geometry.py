@@ -67,19 +67,31 @@ def pack_vertices(geoms: List[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _edges(verts: jnp.ndarray, nv: jnp.ndarray):
-    """Edge endpoints (closing edge included; degenerate when the ring is
-    explicitly closed, which is harmless for every kernel here).
+    """Consecutive-vertex edge endpoints. The last live vertex points at
+    ITSELF (degenerate edge), so open paths (linestrings) get no phantom
+    closing edge; rings are explicitly closed by parse_wkt (first vertex
+    repeated last), so their closing edge is a real lane.
     verts (..., V, 2), nv (...,) -> (a, b, live) with shapes
     (..., V, 2) / (..., V, 2) / (..., V)."""
     V = verts.shape[-2]
     idx = jnp.arange(V)
     nxt = jnp.where(
-        idx[None, :] + 1 < nv[..., None], idx[None, :] + 1, 0
+        idx[None, :] + 1 < nv[..., None], idx[None, :] + 1, idx[None, :]
     )
     a = verts
     b = jnp.take_along_axis(verts, nxt[..., None], axis=-2)
     live = idx[None, :] < nv[..., None]
     return a, b, live
+
+
+def is_closed_ring(verts: jnp.ndarray, nv: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise: first vertex equals the last live vertex and >= 4 lanes
+    (triangle + repeat) — the precondition for parity containment."""
+    last = jnp.take_along_axis(
+        verts, jnp.maximum(nv - 1, 0)[..., None, None].astype(jnp.int32),
+        axis=-2,
+    )[..., 0, :]
+    return jnp.all(verts[..., 0, :] == last, axis=-1) & (nv >= 4)
 
 
 def point_in_polygon(
@@ -154,6 +166,48 @@ def segments_intersect(
     return proper | touch
 
 
+def segments_cross_properly(a1, a2, b1, b2) -> jnp.ndarray:
+    """Strict interior crossing only (no touching) — the disqualifier for
+    polygon containment."""
+
+    def orient(p, q, r):
+        return (q[..., 0] - p[..., 0]) * (r[..., 1] - p[..., 1]) - (
+            q[..., 1] - p[..., 1]
+        ) * (r[..., 0] - p[..., 0])
+
+    d1 = orient(b1, b2, a1)
+    d2 = orient(b1, b2, a2)
+    d3 = orient(a1, a2, b1)
+    d4 = orient(a1, a2, b2)
+    # STRICT opposite signs on both: an endpoint ON the other segment
+    # (orientation 0) is touching, not crossing
+    return (d1 * d2 < 0) & (d3 * d4 < 0)
+
+
+def contains_all_vertices(
+    va: jnp.ndarray, na: jnp.ndarray, vb: jnp.ndarray, nb: jnp.ndarray
+) -> jnp.ndarray:
+    """Row-wise: ring A contains geometry B — every B vertex inside A and
+    no PROPER edge crossing (catches concave containers whose pocket the
+    all-vertices test alone would miss; boundary contact allowed)."""
+    n, V = vb.shape[0], vb.shape[1]
+    inside = point_in_polygon(
+        vb[..., 0].reshape(-1),
+        vb[..., 1].reshape(-1),
+        jnp.repeat(va, V, axis=0),
+        jnp.repeat(na, V),
+    ).reshape(n, V)
+    lanes = jnp.arange(V)[None, :] < nb[:, None]
+    all_in = jnp.all(inside | ~lanes, axis=1) & (nb > 0)
+    a1, a2, la = _edges(va, na)
+    b1, b2, lb = _edges(vb, nb)
+    cross = segments_cross_properly(
+        a1[:, :, None, :], a2[:, :, None, :],
+        b1[:, None, :, :], b2[:, None, :, :],
+    ) & la[:, :, None] & lb[:, None, :]
+    return all_in & ~jnp.any(cross, axis=(1, 2))
+
+
 def polygons_intersect(
     va: jnp.ndarray, na: jnp.ndarray, vb: jnp.ndarray, nb: jnp.ndarray
 ) -> jnp.ndarray:
@@ -167,8 +221,14 @@ def polygons_intersect(
     )
     hit = hit & la[:, :, None] & lb[:, None, :]
     edge_any = jnp.any(hit, axis=(1, 2))
-    a_in_b = point_in_polygon(va[:, 0, 0], va[:, 0, 1], vb, nb)
-    b_in_a = point_in_polygon(vb[:, 0, 0], vb[:, 0, 1], va, na)
+    # parity containment only applies to CLOSED rings — an open path is
+    # not a region (round-5 review: phantom containment for linestrings)
+    a_in_b = point_in_polygon(
+        va[:, 0, 0], va[:, 0, 1], vb, nb
+    ) & is_closed_ring(vb, nb)
+    b_in_a = point_in_polygon(
+        vb[:, 0, 0], vb[:, 0, 1], va, na
+    ) & is_closed_ring(va, na)
     return edge_any | a_in_b | b_in_a
 
 
@@ -240,8 +300,11 @@ def grid_spatial_join(
     if len(px) == 0 or not polys:
         return []
     verts, nv = pack_vertices(polys)
-    xs = np.concatenate([px, verts[..., 0].reshape(-1)])
-    ys = np.concatenate([py, verts[..., 1].reshape(-1)])
+    # bounds from the UNPADDED vertices: zero padding must not drag the
+    # grid to the origin (it collapses far-from-origin data to one cell)
+    allv = np.concatenate([g.reshape(-1, 2) for g in polys])
+    xs = np.concatenate([px, allv[:, 0]])
+    ys = np.concatenate([py, allv[:, 1]])
     x0, x1 = float(xs.min()), float(xs.max())
     y0, y1 = float(ys.min()), float(ys.max())
     wx = max(x1 - x0, 1e-12) / grid

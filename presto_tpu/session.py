@@ -86,6 +86,7 @@ class Session:
         access_control=None,
         user: str = "user",
         pallas_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
+        exchange_budget=None,  # per-shard bytes for exchanged joins
     ):
         self.access_control = access_control
         self.user = user
@@ -95,7 +96,9 @@ class Session:
         if mesh is not None:
             from .exec.dist import DistributedExecutor
 
-            self.executor = DistributedExecutor(catalog, mesh)
+            self.executor = DistributedExecutor(
+                catalog, mesh, exchange_budget=exchange_budget
+            )
         elif streaming:
             from .exec.stream import StreamingExecutor
 

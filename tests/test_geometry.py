@@ -204,3 +204,62 @@ def test_spatial_join_sql_nested_loop(session):
 def test_envelope_contains_geometry(session):
     poly = "st_polygon('POLYGON((1 1, 3 0, 5 4, 2 5, 1 1))')"
     assert one(session, f"st_contains(st_envelope({poly}), {poly})") is True
+
+
+def test_linestrings_disjoint_no_phantom_closing_edge(session):
+    # round-5 review: the fabricated closing edge made open paths
+    # intersect things they don't touch
+    assert one(
+        session,
+        "st_intersects(st_linefromtext('LINESTRING(0 0, 4 0, 4 4)'), "
+        "st_linefromtext('LINESTRING(0.2 1, 1 0.2)'))",
+    ) is False
+    assert one(
+        session,
+        "st_disjoint(st_linefromtext('LINESTRING(0 0, 4 0, 4 4)'), "
+        "st_linefromtext('LINESTRING(0.2 1, 1 0.2)'))",
+    ) is True
+
+
+def test_concave_container_not_fooled(session):
+    # all four vertices of the square are inside the U-shape, but the
+    # square spans the pocket — containment must be False
+    u = ("st_polygon('POLYGON((0 0, 6 0, 6 6, 4 6, 4 2, 2 2, 2 6, 0 6,"
+         " 0 0))')")
+    sq = "st_polygon('POLYGON((0.5 3, 5.5 3, 5.5 5, 0.5 5, 0.5 3))')"
+    assert one(session, f"st_contains({u}, {sq})") is False
+    # a genuinely-contained square in the left arm still passes
+    sq2 = "st_polygon('POLYGON((0.5 3, 1.5 3, 1.5 5, 0.5 5, 0.5 3))')"
+    assert one(session, f"st_contains({u}, {sq2})") is True
+
+
+def test_grid_join_far_from_origin():
+    # round-5 review: zero padding must not drag the grid bbox to the
+    # origin (collapsing far-away data into one cell)
+    rng = np.random.default_rng(3)
+    px = rng.uniform(1000, 1010, 100)
+    py = rng.uniform(1000, 1010, 100)
+    tri = np.array(
+        [(1002, 1002), (1008, 1002), (1005, 1008), (1002, 1002)],
+        np.float64,
+    )
+    sq = np.array(
+        [(1001, 1001), (1004, 1001), (1004, 1004), (1001, 1004),
+         (1001, 1001)],
+        np.float64,
+    )
+    got = geo.grid_spatial_join(px, py, [tri, sq], grid=8)
+    verts, nv = geo.pack_vertices([tri, sq])
+    want = []
+    for gi in range(2):
+        hit = np.asarray(
+            geo.point_in_polygon(
+                jnp.asarray(px), jnp.asarray(py),
+                jnp.asarray(
+                    np.broadcast_to(verts[gi], (100,) + verts[gi].shape)
+                ),
+                jnp.asarray(np.full(100, nv[gi])),
+            )
+        )
+        want.extend((int(i), gi) for i in np.nonzero(hit)[0])
+    assert got == sorted(want) and len(got) > 0
