@@ -1288,16 +1288,26 @@ def _regress(features: Val, model: Val, out_type: T.Type) -> Val:
     model is the ARRAY(DOUBLE) produced by learn_linear_regression."""
     from ..ops import mlreg
 
-    if features.lengths is None or model.lengths is None:
+    if features.data.ndim != 2 or model.data.ndim != 2:
         raise TypeError("regress takes (features array, model array)")
+
+    def _lens(v):
+        # a fixed-width array rebuilt from a join/exchange may carry no
+        # per-row lengths: every lane is live (same contract as
+        # element_at)
+        if v.lengths is not None:
+            return v.lengths
+        return jnp.full(v.data.shape[0], v.data.shape[1], jnp.int32)
+
     fdata = mlreg.logical_values(features.data, features.type)
     mdata = mlreg.logical_values(model.data, model.type)
-    mlens = model.lengths
+    flens = _lens(features)
+    mlens = _lens(model)
     n = fdata.shape[0]
     if mdata.shape[0] == 1 and n > 1:
         mdata = jnp.broadcast_to(mdata, (n, mdata.shape[1]))
         mlens = jnp.broadcast_to(mlens, (n,))
-    out = mlreg.predict(fdata, features.lengths, mdata, mlens)
+    out = mlreg.predict(fdata, flens, mdata, mlens)
     return Val(out, and_valid(features.valid, model.valid), T.DOUBLE)
 
 
@@ -1791,3 +1801,17 @@ def _st_isempty(g: Val, out_type: T.Type) -> Val:
 def _st_numpoints(g: Val, out_type: T.Type) -> Val:
     _v, nv = _geom_verts(g, "st_numpoints")
     return Val(nv.astype(jnp.int64), g.valid, T.BIGINT)
+
+
+@register("classify", _bigint_infer)
+def _classify(features: Val, model: Val, out_type: T.Type) -> Val:
+    """classify(features, model): predicted INTEGER class label
+    (reference presto-ml MLFunctions.classify over libsvm SVC). The
+    TPU-first classifier is the ridge model learn_classifier trains
+    (ops/mlreg.py normal equations) read out at the nearest integer
+    label — exact for {0,1} / {-1,1} and ordinal label sets, the
+    documented subset (libsvm's kernelized multiclass is out of scope)."""
+    v = _regress(features, model, out_type=T.DOUBLE)
+    return Val(
+        jnp.round(v.data).astype(jnp.int64), v.valid, T.BIGINT
+    )

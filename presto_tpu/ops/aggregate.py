@@ -291,16 +291,20 @@ def _reduce_percentile(
     from .sort import asc_normalized_scalar_key
 
     data = value.data
-    if data.ndim == 2:
-        raise NotImplementedError("approx_percentile over long decimals")
     vc = contributes if value.valid is None else (contributes & value.valid)
-    norm = asc_normalized_scalar_key(data, True)
-    if jnp.issubdtype(norm.dtype, jnp.floating):
-        vc = vc & ~jnp.isnan(norm)
-    # stable three-pass composite sort: by value, then contributing rows
-    # first, then by group id — no sentinel values, so genuine extremes
-    # (inf / INT64_MAX) can never collide with excluded rows
-    order = jnp.argsort(norm, stable=True)
+    if data.ndim == 2:
+        # long-decimal lanes: lexicographic (hi, lo) via two stable
+        # passes (canonical lo is non-negative, ops/decimal128.py)
+        order = jnp.argsort(data[:, 1], stable=True)
+        order = order[jnp.argsort(data[order, 0], stable=True)]
+    else:
+        norm = asc_normalized_scalar_key(data, True)
+        if jnp.issubdtype(norm.dtype, jnp.floating):
+            vc = vc & ~jnp.isnan(norm)
+        # stable three-pass composite sort: by value, then contributing
+        # rows first, then by group id — no sentinel values, so genuine
+        # extremes (inf / INT64_MAX) can never collide with excluded rows
+        order = jnp.argsort(norm, stable=True)
     order = order[jnp.argsort((~vc)[order], stable=True)]
     order = order[jnp.argsort(gid[order], stable=True)]
     n = data.shape[0]
@@ -1039,7 +1043,17 @@ def decompose_partial(aggs: Sequence[AggSpec]):
             # distributed approx_percentile goes through the MERGEABLE
             # log-histogram sketch (ops/qsketch.py) instead of exact
             # per-node selection — the qdigest role (reference
-            # ApproximateLongPercentileAggregations + QuantileDigest)
+            # ApproximateLongPercentileAggregations + QuantileDigest).
+            # Long-decimal lanes have no scalar sketch key: gather-path
+            # fallback (KeyError contract, same as collection aggs)
+            if (
+                a.input is not None
+                and isinstance(a.input.type, T.DecimalType)
+                and a.input.type.is_long
+            ):
+                raise KeyError(
+                    "cannot decompose percentile over long decimals"
+                )
             sk_t = T.ArrayType(T.BIGINT)
             s_name = f"{a.name}$qsk"
             frac = float(a.input2.value)

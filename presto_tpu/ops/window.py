@@ -425,6 +425,45 @@ def _log2_floor(n):
     return r
 
 
+def _range_minmax_pair(xh, xl, lo, hi, kind: str):
+    """Lexicographic (hi, lo) min/max over inclusive [lo, hi] — the
+    long-decimal twin of _range_minmax: the sparse table carries BOTH
+    int64 lanes and selects pairs lexicographically (canonical
+    decimal128 order, ops/decimal128.py)."""
+    cap = xh.shape[0]
+    big = jnp.iinfo(jnp.int64).max
+    ident_h = big if kind == "min" else -big - 1
+    ident_l = big if kind == "min" else -big - 1
+
+    def pick(ah, al, bh, bl):
+        if kind == "min":
+            take_a = (ah < bh) | ((ah == bh) & (al <= bl))
+        else:
+            take_a = (ah > bh) | ((ah == bh) & (al >= bl))
+        return jnp.where(take_a, ah, bh), jnp.where(take_a, al, bl)
+
+    lev_h, lev_l = [xh], [xl]
+    j = 0
+    while (1 << (j + 1)) <= cap:
+        ph, pl = lev_h[-1], lev_l[-1]
+        shift = 1 << j
+        sh = jnp.concatenate([ph[shift:], jnp.full((shift,), ident_h, ph.dtype)])
+        sl = jnp.concatenate([pl[shift:], jnp.full((shift,), ident_l, pl.dtype)])
+        nh, nl = pick(ph, pl, sh, sl)
+        lev_h.append(nh)
+        lev_l.append(nl)
+        j += 1
+    Mh = jnp.stack(lev_h).reshape(-1)
+    Ml = jnp.stack(lev_l).reshape(-1)
+    length = jnp.maximum(hi - lo + 1, 1)
+    lv = _log2_floor(length)
+    span = (jnp.int32(1) << lv).astype(jnp.int32)
+    i1 = jnp.clip(lv * cap + lo, 0, Mh.shape[0] - 1)
+    i2 = jnp.clip(lv * cap + hi - span + 1, 0, Mh.shape[0] - 1)
+    oh, ol = pick(Mh[i1], Ml[i1], Mh[i2], Ml[i2])
+    return oh, ol
+
+
 def _range_minmax(x, lo, hi, kind: str, ident):
     """min/max over inclusive [lo, hi] via a sparse table (log-doubling):
     O(n log n) build, O(1) per query — the static-shape answer to
@@ -487,7 +526,14 @@ def _frame_agg(f: WindowFunc, v, data_in, contrib, lo, hi, cap):
         return _avg(s, cnt, f, v), cnt > 0
     # min/max
     if data_in.ndim == 2:
-        raise NotImplementedError("framed min/max over long decimal")
+        big = jnp.iinfo(jnp.int64).max
+        ih = big if f.func == "min" else -big - 1
+        xh = jnp.where(contrib, data_in[:, 0], ih)
+        xl = jnp.where(contrib, data_in[:, 1], ih)
+        oh, ol = _range_minmax_pair(
+            xh, xl, jnp.minimum(lo, cap - 1), hi_c, f.func
+        )
+        return jnp.stack([oh, ol], axis=1), cnt > 0
     ident = (
         _min_identity(data_in.dtype)
         if f.func == "min"

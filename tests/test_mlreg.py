@@ -168,3 +168,30 @@ def test_regress_honors_model_length():
         "select regress(array[x], array[5.0]) from p limit 1"
     ).rows()
     assert float(short[0][0]) == 5.0  # intercept-only model
+
+
+def test_learn_classifier_classify():
+    """presto-ml classifier surface (MLFunctions.classify): ridge-to-
+    integer-labels, exact for {0,1} ordinal labels."""
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    rng = np.random.default_rng(2)
+    n = 400
+    x1 = rng.random(n) * 4 - 2
+    x2 = rng.random(n) * 4 - 2
+    label = (x1 + 2 * x2 > 0.3).astype(np.int64)
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"x1": x1, "x2": x2, "y": label})}
+    )
+    s = Session(cat)
+    correct, total = s.query(
+        "with m as (select learn_classifier(y, array[x1, x2]) model "
+        "from t) "
+        "select count_if(classify(array[x1, x2], model) = y) c, "
+        "count(*) n from t, m"
+    ).rows()[0]
+    assert total == n and correct / total > 0.93
