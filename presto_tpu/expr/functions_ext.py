@@ -1288,7 +1288,11 @@ def _regress(features: Val, model: Val, out_type: T.Type) -> Val:
     model is the ARRAY(DOUBLE) produced by learn_linear_regression."""
     from ..ops import mlreg
 
-    if features.data.ndim != 2 or model.data.ndim != 2:
+    if not isinstance(features.type, T.ArrayType) or not isinstance(
+        model.type, T.ArrayType
+    ):
+        # an ndim check alone would silently accept long-decimal columns
+        # (two storage lanes) and fit garbage
         raise TypeError("regress takes (features array, model array)")
 
     def _lens(v):
@@ -1805,13 +1809,13 @@ def _st_numpoints(g: Val, out_type: T.Type) -> Val:
 
 @register("classify", _bigint_infer)
 def _classify(features: Val, model: Val, out_type: T.Type) -> Val:
-    """classify(features, model): predicted INTEGER class label
+    """classify(features, model): predicted BINARY class label in {0, 1}
     (reference presto-ml MLFunctions.classify over libsvm SVC). The
     TPU-first classifier is the ridge model learn_classifier trains
-    (ops/mlreg.py normal equations) read out at the nearest integer
-    label — exact for {0,1} / {-1,1} and ordinal label sets, the
-    documented subset (libsvm's kernelized multiclass is out of scope)."""
+    (ops/mlreg.py normal equations), thresholded at 0.5 — so the output
+    is always a trained label, never an out-of-range rounding artifact
+    (kernelized multiclass is out of scope; train on 0/1 labels)."""
     v = _regress(features, model, out_type=T.DOUBLE)
     return Val(
-        jnp.round(v.data).astype(jnp.int64), v.valid, T.BIGINT
+        (v.data >= 0.5).astype(jnp.int64), v.valid, T.BIGINT
     )

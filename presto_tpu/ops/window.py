@@ -425,69 +425,65 @@ def _log2_floor(n):
     return r
 
 
-def _range_minmax_pair(xh, xl, lo, hi, kind: str):
-    """Lexicographic (hi, lo) min/max over inclusive [lo, hi] — the
-    long-decimal twin of _range_minmax: the sparse table carries BOTH
-    int64 lanes and selects pairs lexicographically (canonical
-    decimal128 order, ops/decimal128.py)."""
-    cap = xh.shape[0]
-    big = jnp.iinfo(jnp.int64).max
-    ident_h = big if kind == "min" else -big - 1
-    ident_l = big if kind == "min" else -big - 1
+def _sparse_table_query(lanes, lo, hi, pick, idents):
+    """min/max over inclusive [lo, hi] via a sparse table (log-doubling)
+    over one or more parallel LANES: O(n log n) build, O(1) per query.
+    `pick(a_lanes, b_lanes) -> selected lanes` is the (possibly
+    lexicographic) comparator; `idents` pads the shifted tails."""
+    cap = lanes[0].shape[0]
+    levels = [tuple(lanes)]
+    j = 0
+    while (1 << (j + 1)) <= cap:
+        prev = levels[-1]
+        shift = 1 << j
+        shifted = tuple(
+            jnp.concatenate([p[shift:], jnp.full((shift,), idn, p.dtype)])
+            for p, idn in zip(prev, idents)
+        )
+        levels.append(pick(prev, shifted))
+        j += 1
+    flats = tuple(
+        jnp.stack([lv[k] for lv in levels]).reshape(-1)
+        for k in range(len(lanes))
+    )
+    length = jnp.maximum(hi - lo + 1, 1)
+    lv = _log2_floor(length)
+    span = (jnp.int32(1) << lv).astype(jnp.int32)
+    i1 = jnp.clip(lv * cap + lo, 0, flats[0].shape[0] - 1)
+    i2 = jnp.clip(lv * cap + hi - span + 1, 0, flats[0].shape[0] - 1)
+    return pick(
+        tuple(f[i1] for f in flats), tuple(f[i2] for f in flats)
+    )
 
-    def pick(ah, al, bh, bl):
+
+def _range_minmax_pair(xh, xl, lo, hi, kind: str):
+    """Lexicographic (hi, lo) min/max — the long-decimal twin of
+    _range_minmax on the shared sparse table (canonical decimal128
+    order, ops/decimal128.py)."""
+    big = jnp.iinfo(jnp.int64).max
+    ident = big if kind == "min" else -big - 1
+
+    def pick(a, b):
+        ah, al = a
+        bh, bl = b
         if kind == "min":
             take_a = (ah < bh) | ((ah == bh) & (al <= bl))
         else:
             take_a = (ah > bh) | ((ah == bh) & (al >= bl))
         return jnp.where(take_a, ah, bh), jnp.where(take_a, al, bl)
 
-    lev_h, lev_l = [xh], [xl]
-    j = 0
-    while (1 << (j + 1)) <= cap:
-        ph, pl = lev_h[-1], lev_l[-1]
-        shift = 1 << j
-        sh = jnp.concatenate([ph[shift:], jnp.full((shift,), ident_h, ph.dtype)])
-        sl = jnp.concatenate([pl[shift:], jnp.full((shift,), ident_l, pl.dtype)])
-        nh, nl = pick(ph, pl, sh, sl)
-        lev_h.append(nh)
-        lev_l.append(nl)
-        j += 1
-    Mh = jnp.stack(lev_h).reshape(-1)
-    Ml = jnp.stack(lev_l).reshape(-1)
-    length = jnp.maximum(hi - lo + 1, 1)
-    lv = _log2_floor(length)
-    span = (jnp.int32(1) << lv).astype(jnp.int32)
-    i1 = jnp.clip(lv * cap + lo, 0, Mh.shape[0] - 1)
-    i2 = jnp.clip(lv * cap + hi - span + 1, 0, Mh.shape[0] - 1)
-    oh, ol = pick(Mh[i1], Ml[i1], Mh[i2], Ml[i2])
-    return oh, ol
+    return _sparse_table_query((xh, xl), lo, hi, pick, (ident, ident))
 
 
 def _range_minmax(x, lo, hi, kind: str, ident):
-    """min/max over inclusive [lo, hi] via a sparse table (log-doubling):
-    O(n log n) build, O(1) per query — the static-shape answer to
-    arbitrary per-row frames."""
-    cap = x.shape[0]
+    """Scalar min/max over inclusive [lo, hi] on the shared sparse
+    table."""
     op = jnp.minimum if kind == "min" else jnp.maximum
-    levels = [x]
-    j = 0
-    while (1 << (j + 1)) <= cap:
-        prev = levels[-1]
-        shift = 1 << j
-        shifted = jnp.concatenate(
-            [prev[shift:], jnp.full((shift,), ident, prev.dtype)]
-        )
-        levels.append(op(prev, shifted))
-        j += 1
-    M = jnp.stack(levels)  # (L, cap): M[j, i] covers [i, i + 2^j - 1]
-    length = jnp.maximum(hi - lo + 1, 1)
-    lv = _log2_floor(length)
-    span = (jnp.int32(1) << lv).astype(jnp.int32)
-    flat = M.reshape(-1)
-    i1 = jnp.clip(lv * cap + lo, 0, flat.shape[0] - 1)
-    i2 = jnp.clip(lv * cap + hi - span + 1, 0, flat.shape[0] - 1)
-    return op(flat[i1], flat[i2])
+
+    def pick(a, b):
+        return (op(a[0], b[0]),)
+
+    return _sparse_table_query((x,), lo, hi, pick, (ident,))[0]
 
 
 def _frame_agg(f: WindowFunc, v, data_in, contrib, lo, hi, cap):

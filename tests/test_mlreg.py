@@ -195,3 +195,26 @@ def test_learn_classifier_classify():
         "count(*) n from t, m"
     ).rows()[0]
     assert total == n and correct / total > 0.93
+
+
+def test_classify_labels_always_in_trained_set():
+    """Review regression: extreme feature values must not round past the
+    trained {0,1} labels."""
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    rng = np.random.default_rng(2)
+    x = np.concatenate([rng.random(200) * 6, [50.0]])
+    y = (x > 3).astype(np.int64)
+    s = Session(MemoryCatalog({"t": Page.from_dict({"x": x, "y": y})}))
+    labels = {
+        r[0]
+        for r in s.query(
+            "with m as (select learn_classifier(y, array[x]) model "
+            "from t) select classify(array[x], model) from t, m"
+        ).rows()
+    }
+    assert labels <= {0, 1}
