@@ -115,6 +115,247 @@ def read_jsonl(path: str) -> Tuple[List[str], List[List]]:
     return names, cols
 
 
+
+
+# ---------------------------------------------------------------------------
+# Avro object-container files (reference presto-record-decoder
+# AvroRowDecoder / avro-tools): from-scratch binary codec — zigzag
+# varints, [null, T] unions, null/deflate block codecs — no avro library
+# in the image, same from-scratch policy as native/lz4.cpp
+# ---------------------------------------------------------------------------
+
+import struct as _st  # noqa: E402 - avro/raw binary codecs below
+
+_AVRO_MAGIC = b"Obj\x01"
+
+
+def _zz_encode(n: int) -> bytes:
+    u = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class _AvroReader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def long(self) -> int:
+        u = shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            u |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (u >> 1) ^ -(u & 1)
+
+    def raw(self, n: int) -> bytes:
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def string(self) -> str:
+        return self.raw(self.long()).decode()
+
+    def map(self) -> dict:
+        out = {}
+        while True:
+            n = self.long()
+            if n == 0:
+                return out
+            if n < 0:
+                self.long()  # block byte size (unused)
+                n = -n
+            for _ in range(n):
+                k = self.string()
+                out[k] = self.raw(self.long())
+
+
+def _avro_read_value(r: "_AvroReader", typ):
+    if isinstance(typ, list):  # union: [null, T] nullable convention
+        idx = r.long()
+        branch = typ[idx]
+        if branch == "null":
+            return None
+        return _avro_read_value(r, branch)
+    if isinstance(typ, dict):
+        typ = typ.get("type", typ)
+        return _avro_read_value(r, typ)
+    if typ == "null":
+        return None
+    if typ == "boolean":
+        return r.raw(1) != b"\x00"
+    if typ in ("int", "long"):
+        return r.long()
+    if typ == "float":
+        return _st.unpack("<f", r.raw(4))[0]
+    if typ == "double":
+        return _st.unpack("<d", r.raw(8))[0]
+    if typ == "bytes":
+        # binary rides the string layer as hex (engine-wide policy)
+        return r.raw(r.long()).hex()
+    if typ == "string":
+        return r.string()
+    raise ValueError(f"unsupported avro type {typ!r}")
+
+
+def read_avro(path: str) -> Tuple[List[str], List[List]]:
+    """Avro OCF -> (names, columns). Primitive record fields + nullable
+    unions; null/deflate codecs."""
+    import zlib as _zlib
+
+    with open(path, "rb") as f:
+        buf = f.read()
+    if buf[:4] != _AVRO_MAGIC:
+        raise ValueError(f"{path}: not an avro object container file")
+    r = _AvroReader(buf)
+    r.pos = 4
+    meta = r.map()
+    sync = r.raw(16)
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    if schema.get("type") != "record":
+        raise ValueError("avro schema must be a record")
+    fields = schema["fields"]
+    names = [f["name"] for f in fields]
+    cols: List[List] = [[] for _ in names]
+    while r.pos < len(buf):
+        count = r.long()
+        size = r.long()
+        block = r.raw(size)
+        if r.raw(16) != sync:
+            raise ValueError(f"{path}: bad avro sync marker")
+        if codec == "deflate":
+            block = _zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        br = _AvroReader(block)
+        for _ in range(count):
+            for i, fd in enumerate(fields):
+                cols[i].append(_avro_read_value(br, fd["type"]))
+    return names, cols
+
+
+def write_avro(path: str, names: Sequence[str], cols: Sequence[List],
+               codec: str = "deflate") -> None:
+    """Columns -> Avro OCF (the writer twin; nullable primitive fields,
+    types inferred from python values)."""
+    import zlib as _zlib
+
+    def typ_of(values):
+        for v in values:
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                return "boolean"
+            if isinstance(v, int):
+                return "long"
+            if isinstance(v, float):
+                return "double"
+            if isinstance(v, bytes):
+                return "bytes"
+            return "string"
+        return "string"
+
+    types = [typ_of(c) for c in cols]
+    schema = {
+        "type": "record",
+        "name": "row",
+        "fields": [
+            {"name": n, "type": ["null", t]}
+            for n, t in zip(names, types)
+        ],
+    }
+
+    def enc_value(v, t) -> bytes:
+        if v is None:
+            return _zz_encode(0)
+        out = _zz_encode(1)
+        if t == "boolean":
+            return out + (b"\x01" if v else b"\x00")
+        if t == "long":
+            return out + _zz_encode(int(v))
+        if t == "double":
+            return out + _st.pack("<d", float(v))
+        if t == "bytes":
+            return out + _zz_encode(len(v)) + v
+        b = str(v).encode()
+        return out + _zz_encode(len(b)) + b
+
+    n_rows = len(cols[0]) if cols else 0
+    body = b"".join(
+        enc_value(cols[i][row], types[i])
+        for row in range(n_rows)
+        for i in range(len(names))
+    )
+    if codec == "deflate":
+        comp = _zlib.compressobj(wbits=-15)
+        body = comp.compress(body) + comp.flush()
+    sync = b"\x07" * 16
+    meta_entries = {
+        b"avro.schema": json.dumps(schema).encode(),
+        b"avro.codec": codec.encode(),
+    }
+    out = bytearray(_AVRO_MAGIC)
+    out += _zz_encode(len(meta_entries))
+    for k, v in meta_entries.items():
+        out += _zz_encode(len(k)) + k + _zz_encode(len(v)) + v
+    out += _zz_encode(0)
+    out += sync
+    if n_rows:
+        out += _zz_encode(n_rows) + _zz_encode(len(body)) + body + sync
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+def read_raw(path: str, fields: Sequence[dict]) -> Tuple[List[str], List[List]]:
+    """Fixed-width binary records (reference presto-record-decoder
+    RawRowDecoder): `fields` = [{name, type, start, end}] byte slices per
+    record; big-endian ints/doubles, space-padded varchar. The field
+    spec lives in a sidecar `<table>.rawschema` JSON."""
+    import struct as _st
+
+    rec_size = max(int(f["end"]) for f in fields)
+    names = [f["name"] for f in fields]
+    cols: List[List] = [[] for _ in fields]
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if len(data) % rec_size:
+        raise ValueError(
+            f"{path}: size {len(data)} is not a multiple of the "
+            f"record size {rec_size}"
+        )
+    for off in range(0, len(data), rec_size):
+        rec = data[off:off + rec_size]
+        for i, fd in enumerate(fields):
+            chunk = rec[int(fd["start"]):int(fd["end"])]
+            t = fd["type"].lower()
+            if t in ("bigint", "long"):
+                cols[i].append(_st.unpack(">q", chunk)[0])
+            elif t in ("integer", "int"):
+                cols[i].append(_st.unpack(">i", chunk)[0])
+            elif t == "smallint":
+                cols[i].append(_st.unpack(">h", chunk)[0])
+            elif t == "tinyint":
+                cols[i].append(_st.unpack(">b", chunk)[0])
+            elif t == "double":
+                cols[i].append(_st.unpack(">d", chunk)[0])
+            elif t == "boolean":
+                cols[i].append(chunk[0] != 0)
+            else:  # varchar: space-padded bytes
+                cols[i].append(chunk.decode().rstrip(" \x00"))
+    return names, cols
+
+
 class LocalFileCatalog(Connector):
     """tables: file stem -> path; schemas inferred at first load and
     overridable via `schemas={'table': {'col': Type}}`."""
@@ -127,7 +368,7 @@ class LocalFileCatalog(Connector):
         self._paths: Dict[str, str] = {}
         for fname in sorted(os.listdir(directory)):
             stem, ext = os.path.splitext(fname)
-            if ext.lower() in (".csv", ".tsv", ".jsonl"):
+            if ext.lower() in (".csv", ".tsv", ".jsonl", ".avro", ".raw"):
                 key = stem.lower()
                 if key in self._paths:
                     raise ValueError(
@@ -145,8 +386,14 @@ class LocalFileCatalog(Connector):
         if pg is not None:
             return pg
         path = self._paths[table]
-        if path.endswith(".jsonl"):
+        low = path.lower()  # registration is case-insensitive; match it
+        if low.endswith(".jsonl"):
             names, cols = read_jsonl(path)
+        elif low.endswith(".avro"):
+            names, cols = read_avro(path)
+        elif low.endswith(".raw"):
+            with open(path[:-4] + ".rawschema") as f:
+                names, cols = read_raw(path, json.load(f))
         else:
             names, cols = read_csv(path)
         override = self.schemas_override.get(table, {})

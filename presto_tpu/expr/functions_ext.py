@@ -1307,6 +1307,12 @@ def _regress(features: Val, model: Val, out_type: T.Type) -> Val:
     mdata = mlreg.logical_values(model.data, model.type)
     flens = _lens(features)
     mlens = _lens(model)
+    if mdata.shape[1] == mlreg.MODEL_WIDTH:
+        # learned model: the two trailing lanes are label bounds, not
+        # weights (ops/mlreg.py MODEL layout; a hand-written literal of
+        # exactly MODEL_WIDTH lanes is indistinguishable — documented)
+        mdata = mdata[:, : mlreg.MODEL_WIDTH - 2]
+        mlens = jnp.minimum(mlens, mlreg.MODEL_WIDTH - 2)
     n = fdata.shape[0]
     if mdata.shape[0] == 1 and n > 1:
         mdata = jnp.broadcast_to(mdata, (n, mdata.shape[1]))
@@ -1809,13 +1815,26 @@ def _st_numpoints(g: Val, out_type: T.Type) -> Val:
 
 @register("classify", _bigint_infer)
 def _classify(features: Val, model: Val, out_type: T.Type) -> Val:
-    """classify(features, model): predicted BINARY class label in {0, 1}
+    """classify(features, model): predicted INTEGER class label
     (reference presto-ml MLFunctions.classify over libsvm SVC). The
-    TPU-first classifier is the ridge model learn_classifier trains
-    (ops/mlreg.py normal equations), thresholded at 0.5 — so the output
-    is always a trained label, never an out-of-range rounding artifact
-    (kernelized multiclass is out of scope; train on 0/1 labels)."""
+    TPU-first classifier rounds the ridge score and CLAMPS it to the
+    label range recorded in the model at training time (ops/mlreg.py
+    MODEL layout), so the output is always within the trained label
+    set's bounds — exact for {0,1}, {-1,1} and ordinal integer labels
+    (kernelized multiclass is out of scope)."""
+    from ..ops import mlreg
+
     v = _regress(features, model, out_type=T.DOUBLE)
+    md = mlreg.logical_values(model.data, model.type)
+    if md.shape[1] == mlreg.MODEL_WIDTH:
+        lmin, lmax = md[:, -2], md[:, -1]
+        n = v.data.shape[0]
+        if lmin.shape[0] == 1 and n > 1:
+            lmin = jnp.broadcast_to(lmin, (n,))
+            lmax = jnp.broadcast_to(lmax, (n,))
+        score = jnp.clip(v.data, lmin, lmax)
+    else:
+        score = v.data
     return Val(
-        (v.data >= 0.5).astype(jnp.int64), v.valid, T.BIGINT
+        jnp.round(score).astype(jnp.int64), v.valid, T.BIGINT
     )

@@ -2,8 +2,11 @@
 
 Re-designed equivalent of presto-ml (2,946 LoC: learn_regressor /
 learn_classifier aggregates + regress/classify scalars over libsvm
-models). TPU-first reduction: the MODEL is an ARRAY(DOUBLE) of weights
-(features..., intercept LAST) — no opaque binary blobs — and LEARNING is
+models). TPU-first reduction: the MODEL is an ARRAY(DOUBLE)
+[w_0..w_{K_MAX-1}, intercept, label_min, label_max] — no opaque binary
+blobs; the trailing LABEL BOUNDS let classify() clamp to the trained
+label range (user-written literal models keep the intercept-last
+contract and carry no bounds) — and LEARNING is
 the normal-equations accumulation, which is exactly a segment-sum:
 
     acc(group) = [ n | X^T y | vec(X^T X) ]   with X = [features, 1]
@@ -25,7 +28,12 @@ import jax.numpy as jnp
 
 K_MAX = 15  # max feature lanes; canonical accumulator layout
 _M = K_MAX + 1  # + intercept
-ACC_WIDTH = 1 + _M + _M * _M
+_SUM_WIDTH = 1 + _M + _M * _M  # additively-merged lanes
+# + 2 trailing LABEL-BOUND lanes (min, max) merged by min/max — they let
+# classify() clamp predictions to the trained label range (round-5
+# review: a threshold picked without bounds emitted impossible labels)
+ACC_WIDTH = _SUM_WIDTH + 2
+MODEL_WIDTH = _M + 2  # [w..., intercept, label_min, label_max]
 _RIDGE = 1e-9
 
 
@@ -68,7 +76,17 @@ def group_accumulate(
     flat = jnp.concatenate(
         [w[:, None], xty, xtx.reshape(n, _M * _M)], axis=1
     )
-    return jax.ops.segment_sum(flat, gid, num_segments=num_groups)
+    sums = jax.ops.segment_sum(flat, gid, num_segments=num_groups)
+    big = jnp.float64(jnp.inf)
+    lmin = jax.ops.segment_min(
+        jnp.where(contributes, label, big), gid, num_segments=num_groups
+    )
+    lmax = jax.ops.segment_max(
+        jnp.where(contributes, label, -big), gid, num_segments=num_groups
+    )
+    return jnp.concatenate(
+        [sums, lmin[:, None], lmax[:, None]], axis=1
+    )
 
 
 def merge_accumulators(
@@ -76,22 +94,44 @@ def merge_accumulators(
     num_groups: int,
 ) -> jnp.ndarray:
     rows = jnp.where(
-        contributes[:, None], accs[:, :ACC_WIDTH], 0.0
+        contributes[:, None], accs[:, :_SUM_WIDTH], 0.0
     )
-    return jax.ops.segment_sum(rows, gid, num_segments=num_groups)
+    sums = jax.ops.segment_sum(rows, gid, num_segments=num_groups)
+    big = jnp.float64(jnp.inf)
+    has_bounds = accs.shape[1] >= ACC_WIDTH
+    if has_bounds:
+        lmin_in, lmax_in = accs[:, _SUM_WIDTH], accs[:, _SUM_WIDTH + 1]
+    else:  # legacy partials without bound lanes
+        lmin_in = jnp.zeros(accs.shape[0])
+        lmax_in = jnp.zeros(accs.shape[0])
+    lmin = jax.ops.segment_min(
+        jnp.where(contributes, lmin_in, big), gid, num_segments=num_groups
+    )
+    lmax = jax.ops.segment_max(
+        jnp.where(contributes, lmax_in, -big), gid, num_segments=num_groups
+    )
+    return jnp.concatenate(
+        [sums, lmin[:, None], lmax[:, None]], axis=1
+    )
 
 
 def solve_weights(accs: jnp.ndarray):
-    """(G, ACC_WIDTH) accumulators -> ((G, _M) weights, (G,) has-rows).
+    """(G, ACC_WIDTH) accumulators -> ((G, MODEL_WIDTH) models,
+    (G,) has-rows).
 
-    Weight layout: [w_0 .. w_{K_MAX-1}, intercept]."""
+    Model layout: [w_0 .. w_{K_MAX-1}, intercept, label_min, label_max]
+    — regress/classify recognize the trailing bound lanes by width."""
     g = accs.shape[0]
     counts = accs[:, 0]
     xty = accs[:, 1 : 1 + _M]
-    xtx = accs[:, 1 + _M :].reshape(g, _M, _M)
+    xtx = accs[:, 1 + _M : _SUM_WIDTH].reshape(g, _M, _M)
     xtx = xtx + _RIDGE * jnp.eye(_M, dtype=xtx.dtype)[None]
     w = jnp.linalg.solve(xtx, xty[..., None])[..., 0]
-    return w, counts > 0
+    if accs.shape[1] >= ACC_WIDTH:
+        bounds = accs[:, _SUM_WIDTH:ACC_WIDTH]
+    else:
+        bounds = jnp.zeros((g, 2))
+    return jnp.concatenate([w, bounds], axis=1), counts > 0
 
 
 def predict(

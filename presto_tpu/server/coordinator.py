@@ -263,6 +263,12 @@ class CoordinatorServer:
                         content_type="text/html; charset=utf-8",
                     )
                     return
+                if parts == ["timeline"]:
+                    self._send(
+                        200, outer._render_timeline().encode(),
+                        content_type="text/html; charset=utf-8",
+                    )
+                    return
                 if parts == ["v1", "resourceGroupState"]:
                     self._send(
                         200,
@@ -383,7 +389,14 @@ state {"SHUTTING_DOWN" if self.shutting_down else "ACTIVE"}</p>
             if info.error
             else ""
         )
-        return f"""<!doctype html><html><head><meta charset="utf-8">
+        # LIVE view (reference webapp query.html auto-updates): running
+        # queries re-render every 2s until terminal
+        live = (
+            "" if info.done
+            else '<meta http-equiv="refresh" content="2">'
+        )
+        stages = self._render_stages(info)
+        return f"""<!doctype html><html><head><meta charset="utf-8">{live}
 <title>{query_id}</title><style>
 body{{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}}
 pre{{background:#fff;border:1px solid #ddd;padding:1em;overflow:auto;
@@ -398,7 +411,90 @@ font-size:13px}} .err{{background:#fde8e8}}
 </table>
 <h2>SQL</h2><pre>{html.escape(info.sql)}</pre>
 <h2>Plan</h2><pre>{plan}</pre>
+{stages}
 {err}</body></html>"""
+
+    def _render_stages(self, info) -> str:
+        """Stage breakdown (reference webapp stage.html): the FRAGMENTED
+        plan with one section per stage when the session is distributed;
+        single-stage note otherwise."""
+        import html
+
+        sess = self.manager.session
+        if getattr(sess, "mesh", None) is None:
+            return (
+                "<h2>Stages</h2><p>single stage (one-process session — "
+                "pass a mesh for fragmented execution)</p>"
+            )
+        # render once per query and cache on the QueryInfo: the live page
+        # refreshes every 2s and must not re-plan each time (and the plan
+        # at SUBMIT time is the one that executed)
+        cached = getattr(info, "stages_html", None)
+        if cached is None:
+            try:
+                node = sess.plan(info.sql)
+                from ..plan import nodes as N
+
+                txt = html.escape(N.plan_tree_str(node))
+            except Exception as e:  # noqa: BLE001 - advisory view
+                txt = html.escape(f"(stage render failed: {e})")
+            cached = f"<h2>Stages (fragmented)</h2><pre>{txt}</pre>"
+            try:
+                info.stages_html = cached
+            except AttributeError:
+                pass  # frozen dataclass: render per view
+        return cached
+
+    def _render_timeline(self) -> str:
+        """Query lifecycle timeline (reference webapp timeline.html): an
+        SVG gantt of the most recent queries — queued span (created ->
+        started) and execution span (started -> finished/now), refreshed
+        live every 2s."""
+        import html
+
+        infos = sorted(
+            self.manager.list_queries(),
+            key=lambda q: q.created_at,
+        )[-30:]
+        now = time.time()
+        if infos:
+            t0 = min(q.created_at for q in infos)
+            t1 = max((q.finished_at or now) for q in infos)
+        else:
+            t0, t1 = now - 1, now
+        span = max(t1 - t0, 1e-3)
+        W, ROW = 900, 22
+        bars = []
+        for i, q in enumerate(infos):
+            y = i * ROW
+            qs = (q.created_at - t0) / span * W
+            xs = ((q.started_at or q.created_at) - t0) / span * W
+            xe = ((q.finished_at or now) - t0) / span * W
+            color = {
+                "FINISHED": "#2e7d32", "FAILED": "#c62828",
+                "RUNNING": "#1565c0",
+            }.get(q.state, "#999")
+            label = html.escape(q.sql.replace("\n", " ")[:60])
+            bars.append(
+                f'<rect x="{qs:.1f}" y="{y + 4}" '
+                f'width="{max(xs - qs, 1):.1f}" height="12" fill="#ccc"/>'
+                f'<rect x="{xs:.1f}" y="{y + 4}" '
+                f'width="{max(xe - xs, 1):.1f}" height="12" '
+                f'fill="{color}"><title>{label}</title></rect>'
+                f'<text x="{min(xe + 4, W - 150):.1f}" y="{y + 14}" '
+                f'font-size="10">'
+                f'<a href="/query/{q.query_id}">{q.query_id}</a></text>'
+            )
+        h = max(len(infos) * ROW + 10, 40)
+        return f"""<!doctype html><html><head><meta charset="utf-8">
+<meta http-equiv="refresh" content="2"><title>timeline</title>
+<style>body{{font-family:system-ui,sans-serif;margin:2em}}</style>
+</head><body><p><a href="/">&larr; queries</a></p>
+<h1>Query timeline</h1>
+<p>grey = queued, colored = executing (green finished / red failed /
+blue running)</p>
+<svg width="{W + 160}" height="{h}">{''.join(bars)}</svg>
+</body></html>"""
 
     # -- protocol payloads --
 

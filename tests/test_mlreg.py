@@ -48,13 +48,16 @@ def test_learn_and_regress_global(sess):
         "select learn_linear_regression(y, array[x1, x2]) m from obs"
     ).rows()
     w = _weights(rows[0][0])
-    from presto_tpu.ops.mlreg import K_MAX
+    from presto_tpu.ops.mlreg import MODEL_WIDTH
 
-    assert len(w) == K_MAX + 1
+    # [w..., intercept, label_min, label_max] (round-5 MODEL layout)
+    assert len(w) == MODEL_WIDTH
     # the global fit mixes two intercept groups: residual sd ~5 makes the
     # coefficient standard error ~0.11 at n=2000
+    from presto_tpu.ops.mlreg import K_MAX
+
     assert abs(w[0] - 3) < 0.4 and abs(w[1] + 2) < 0.4
-    assert abs(w[-1] - 10) < 0.5  # mean intercept of the two groups
+    assert abs(w[K_MAX] - 10) < 0.5  # mean intercept of the two groups
     assert all(abs(v) < 1e-6 for v in w[2:K_MAX])  # unused lanes ~0
     # regress against literal weights
     pred = sess.query(
@@ -72,8 +75,10 @@ def test_learn_grouped(sess):
     assert len(rows) == 2
     w0 = _weights(rows[0][1])
     w1 = _weights(rows[1][1])
-    assert abs(w0[-1] - 5) < 0.05
-    assert abs(w1[-1] - 15) < 0.05
+    from presto_tpu.ops.mlreg import K_MAX
+
+    assert abs(w0[K_MAX] - 5) < 0.05
+    assert abs(w1[K_MAX] - 15) < 0.05
     for w in (w0, w1):
         assert abs(w[0] - 3) < 0.05 and abs(w[1] + 2) < 0.05
 
@@ -137,7 +142,9 @@ def test_decimal_inputs_descale():
         "select learn_linear_regression(y, array[x]) from d"
     ).rows()
     w = [float(v) for v in rows[0][0]]
-    assert abs(w[0] - 4.0) < 1e-6 and abs(w[-1] - 2.0) < 1e-6
+    from presto_tpu.ops.mlreg import K_MAX
+
+    assert abs(w[0] - 4.0) < 1e-6 and abs(w[K_MAX] - 2.0) < 1e-6
 
 
 def test_empty_group_yields_null_model(sess):

@@ -258,3 +258,40 @@ def test_query_detail_page():
             urllib.request.urlopen(f"{srv.uri}/query/nope", timeout=10)
     finally:
         srv.stop()
+
+
+def test_web_ui_timeline_and_stages():
+    """Live web UI views (reference webapp timeline.html / stage.html):
+    the timeline gantt lists recent queries; the detail page carries a
+    stage section and auto-refreshes while running."""
+    import json
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.session import Session
+
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"v": np.arange(10, dtype=np.int64)})}
+    )
+    srv = CoordinatorServer(Session(cat)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(
+            f"{base}/v1/statement", data=b"select sum(v) from t",
+            method="POST",
+        )
+        qid = json.loads(urllib.request.urlopen(req).read())["id"]
+        time.sleep(0.3)
+        tl = urllib.request.urlopen(f"{base}/timeline").read().decode()
+        assert "Query timeline" in tl and qid in tl
+        qd = urllib.request.urlopen(
+            f"{base}/query/{qid}"
+        ).read().decode()
+        assert "Stages" in qd
+    finally:
+        srv.stop()
