@@ -25,7 +25,9 @@ fewer kernels launched, fewer channels resident in HBM:
   RemoveFalseFilter       Filter(false/null) -> Limit 0
   RemoveTrueFilter        Filter(true) -> child
   DistinctOverDistinct    Distinct(Distinct) -> Distinct
-  InferTransitiveEquality a=b AND a=lit  adds  b=lit inside a Filter
+  InferTransitiveEquality,
+PushLimitThroughUnion, PushLimitThroughOuterJoin, PushTopNThroughProject,
+DistinctOverAggregate a=b AND a=lit  adds  b=lit inside a Filter
                           (feeds the scan-pushdown that already exists)
 """
 
@@ -298,6 +300,68 @@ def _infer_transitive_equality(node: N.Filter, caps) -> Optional[N.PlanNode]:
     return N.Filter(node.child, _conjoin(parts + new))
 
 
+def _push_limit_through_union(node: N.Limit, caps) -> Optional[N.PlanNode]:
+    """limit n (union all ...) => limit n (union all (limit n)...) —
+    reference PushLimitThroughUnion; each branch stops producing early."""
+    u: N.Union = node.child
+    if u.distinct:
+        return None
+    if all(
+        isinstance(i, (N.Limit, N.TopN)) and i.count <= node.count
+        for i in u.inputs
+    ):
+        return None  # already pushed (fixpoint)
+    return N.Limit(
+        N.Union(
+            tuple(N.Limit(i, node.count) for i in u.inputs), False
+        ),
+        node.count,
+    )
+
+
+def _push_limit_through_outer_join(node: N.Limit, caps) -> Optional[N.PlanNode]:
+    """limit n (left join ...) => limit n (left join (limit n probe) ...)
+    — reference PushLimitThroughOuterJoin: every probe row survives a
+    LEFT join at least once, so n probe rows suffice."""
+    j: N.Join = node.child
+    if j.kind != "left" or j.residual is not None:
+        return None
+    if isinstance(j.left, (N.Limit, N.TopN)) and j.left.count <= node.count:
+        return None
+    return N.Limit(
+        dataclasses.replace(j, left=N.Limit(j.left, node.count)),
+        node.count,
+    )
+
+
+def _push_topn_through_project(node: N.TopN, caps) -> Optional[N.PlanNode]:
+    """topN over a renaming projection reorders BEFORE projecting —
+    reference PushTopNThroughProject (sort keys must map to plain column
+    refs; computed keys stay put)."""
+    proj: N.Project = node.child
+    env = {n: e for n, e in zip(proj.names, proj.exprs)}
+    new_keys = []
+    for k in node.keys:
+        e = k.expr
+        if not isinstance(e, ir.ColumnRef):
+            return None
+        src = env.get(e.name)
+        if not isinstance(src, ir.ColumnRef):
+            return None
+        new_keys.append(dataclasses.replace(k, expr=src))
+    return N.Project(
+        N.TopN(proj.child, tuple(new_keys), node.count),
+        proj.exprs,
+        proj.names,
+    )
+
+
+def _distinct_over_aggregate(node: N.Distinct, caps) -> Optional[N.PlanNode]:
+    """Aggregation output rows are unique per key set (and a global
+    aggregate is one row) — reference RemoveRedundantDistinct."""
+    return node.child
+
+
 def default_rules() -> List[Rule]:
     P = pattern
     return [
@@ -345,6 +409,26 @@ def default_rules() -> List[Rule]:
             "InferTransitiveEquality",
             P(N.Filter),
             _infer_transitive_equality,
+        ),
+        Rule(
+            "PushLimitThroughUnion",
+            P(N.Limit).child(P(N.Union)),
+            _push_limit_through_union,
+        ),
+        Rule(
+            "PushLimitThroughOuterJoin",
+            P(N.Limit).child(P(N.Join)),
+            _push_limit_through_outer_join,
+        ),
+        Rule(
+            "PushTopNThroughProject",
+            P(N.TopN).child(P(N.Project)),
+            _push_topn_through_project,
+        ),
+        Rule(
+            "DistinctOverAggregate",
+            P(N.Distinct).child(P(N.Aggregate)),
+            _distinct_over_aggregate,
         ),
     ]
 

@@ -165,3 +165,69 @@ def test_sql_results_unchanged_by_rules():
 def test_every_rule_has_a_name_and_fires_somewhere():
     names = {r.name for r in default_rules()}
     assert len(names) == len(default_rules())
+
+
+def test_push_limit_through_union():
+    u = N.Union((scan("a"), scan("a")), False)
+    out = rewrite(N.Limit(u, 5))
+    assert_plan(
+        out,
+        (N.Limit, lambda n: n.count == 5,
+         (N.Union,
+          (N.Limit, lambda n: n.count == 5, (N.TableScan,)),
+          (N.Limit, lambda n: n.count == 5, (N.TableScan,)))),
+    )
+    # UNION DISTINCT must NOT push (branch limits change the result)
+    ud = N.Union((scan("a"), scan("a")), True)
+    out2 = rewrite(N.Limit(ud, 5))
+    assert_plan(out2, (N.Limit, (N.Union, (N.TableScan,), (N.TableScan,))))
+
+
+def test_push_limit_through_outer_join():
+    j = N.Join(
+        "left", scan("a"), scan("b"), (A,), (B,), unique_build=False
+    )
+    out = rewrite(N.Limit(j, 4))
+    assert_plan(
+        out,
+        (N.Limit,
+         (N.Join,
+          (N.Limit, lambda n: n.count == 4, (N.TableScan,)),
+          (N.TableScan,))),
+    )
+    # inner joins can drop probe rows: no push
+    ji = N.Join(
+        "inner", scan("a"), scan("b"), (A,), (B,), unique_build=False
+    )
+    out2 = rewrite(N.Limit(ji, 4))
+    assert_plan(
+        out2, (N.Limit, (N.Join, (N.TableScan,), (N.TableScan,)))
+    )
+
+
+def test_push_topn_through_project():
+    proj = N.Project(scan("a", "b"), (A, B), ("x", "y"))
+    plan = N.TopN(proj, (SortKey(col("x", T.BIGINT)),), 3)
+    out = rewrite(plan)
+    assert_plan(
+        out,
+        (N.Project, (N.TopN, lambda n: n.count == 3, (N.TableScan,))),
+    )
+    # computed sort key stays put
+    proj2 = N.Project(
+        scan("a"), (ir.Call("add", (A, lit(1)), T.BIGINT),), ("p",)
+    )
+    plan2 = N.TopN(proj2, (SortKey(col("p", T.BIGINT)),), 3)
+    out2 = rewrite(plan2)
+    assert_plan(out2, (N.TopN, (N.Project, (N.TableScan,))))
+
+
+def test_distinct_over_aggregate_removed():
+    from presto_tpu.ops.aggregate import AggSpec
+
+    agg = N.Aggregate(
+        scan("a"), (A,), ("a",),
+        (AggSpec("count_star", None, "c", T.BIGINT),),
+    )
+    out = rewrite(N.Distinct(agg))
+    assert_plan(out, (N.Aggregate, (N.TableScan,)))
