@@ -197,7 +197,13 @@ def test_percentile_rejects_varchar(sess):
         sess.query("select approx_percentile(name, 0.5) from t")
 
 
-def test_percentile_rejects_long_decimal_at_plan_time(sess):
+def test_percentile_long_decimal_supported(sess):
+    # round 5: long decimals select exactly via the lexicographic
+    # two-lane sort (previously rejected at plan time)
     sess.query("create table ld (v decimal(30,2))")
-    with pytest.raises(Exception, match="not supported"):
-        sess.query("select approx_percentile(v, 0.5) from ld")
+    sess.query("insert into ld values (1.50), (12345678901234567.25), "
+               "(3.75)")
+    from decimal import Decimal
+
+    got = sess.query("select approx_percentile(v, 0.5) from ld").rows()
+    assert got == [(Decimal("3.75"),)]
