@@ -1,0 +1,105 @@
+"""Blackhole connector — the write-sink / benchmarking catalog.
+
+Re-designed equivalent of presto-blackhole (BlackHoleMetadata +
+BlackHolePageSinkProvider): INSERT/CTAS accept and DISCARD rows at full
+speed (the standard sink for write-path benchmarking), reads return
+empty pages, and tables are metadata-only. Optionally a table can be
+configured to SYNTHESIZE rows on scan (the reference's split/page/row
+properties collapsed to one `rows` knob) so read benchmarks need no
+storage either: columns are zeros/empty strings generated on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import types as T
+from ..page import Block, Page, intern_dictionary
+from .spi import WritableConnector, WriteError
+
+
+class BlackHoleCatalog(WritableConnector):
+    name = "blackhole"
+
+    def __init__(self, synthetic_rows: Optional[Dict[str, int]] = None):
+        self._schemas: Dict[str, Dict[str, T.Type]] = {}
+        self.rows_written: Dict[str, int] = {}
+        # table -> row count to synthesize on scan (0 = plain sink)
+        self.synthetic_rows = dict(synthetic_rows or {})
+
+    # -- metadata --
+
+    def table_names(self) -> List[str]:
+        return sorted(self._schemas)
+
+    def schema(self, table: str) -> Dict[str, T.Type]:
+        try:
+            return dict(self._schemas[table])
+        except KeyError:
+            raise KeyError(f"table {table!r} does not exist")
+
+    def row_count(self, table: str) -> int:
+        return self.synthetic_rows.get(table, 0)
+
+    def exact_row_count(self, table: str) -> int:
+        return self.row_count(table)
+
+    def unique_columns(self, table: str):
+        return []
+
+    # -- reads: empty (or synthesized zeros) --
+
+    def page(self, table: str) -> Page:
+        schema = self.schema(table)
+        n = self.synthetic_rows.get(table, 0)
+        blocks = {}
+        for c, t in schema.items():
+            if isinstance(t, T.VarcharType):
+                did = intern_dictionary(("",))
+                blocks[c] = Block(
+                    np.zeros(max(n, 1), np.int32), t, None, did
+                )
+            else:
+                blocks[c] = Block(
+                    np.zeros(
+                        (max(n, 1), 2) if (
+                            isinstance(t, T.DecimalType) and t.is_long
+                        ) else max(n, 1),
+                        t.storage_dtype,
+                    ),
+                    t,
+                    None,
+                )
+        pg = Page.from_dict(blocks)
+        return Page(pg.blocks, pg.names, n)
+
+    # -- writes: discard --
+
+    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+        if table in self._schemas:
+            raise WriteError(f"table {table!r} already exists")
+        self._schemas[table] = dict(schema)
+        self.rows_written[table] = 0
+
+    def create_table_from_page(self, table: str, page: Page) -> None:
+        self.create_table(
+            table, {c: b.type for c, b in zip(page.names, page.blocks)}
+        )
+        self.append(table, page)
+
+    def append(self, table: str, page: Page) -> None:
+        self.schema(table)
+        self.rows_written[table] = (
+            self.rows_written.get(table, 0) + int(page.count)
+        )
+
+    def replace(self, table: str, page: Page) -> None:
+        self.schema(table)
+        self.rows_written[table] = int(page.count)
+
+    def drop_table(self, table: str) -> None:
+        self._schemas.pop(table, None)
+        self.rows_written.pop(table, None)
+        self.synthetic_rows.pop(table, None)

@@ -264,6 +264,10 @@ def enforce(access_control: AccessControl, user: str, ast,
         # metadata reveals schema: same privilege as reading the table
         for n in _names_to_check(ast.table.lower()):
             access_control.check_can_select_from_table(user, n)
+    if isinstance(ast, t.ShowCreateTable):
+        # same metadata surface as SHOW COLUMNS
+        for n in _names_to_check(ast.name.lower()):
+            access_control.check_can_select_from_table(user, n)
     if isinstance(ast, (t.CreateTable, t.DropTable)):
         for n in _names_to_check(ast.name.lower()):
             access_control.check_can_write_table(user, n)

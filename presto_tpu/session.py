@@ -221,7 +221,8 @@ class Session:
              t.DropSchema, t.ShowSchemas, t.Prepare, t.ExecutePrepared,
              t.Deallocate, t.DescribeInput, t.DescribeOutput, t.SetSession,
              t.ResetSession, t.ShowSession, t.RenameTable, t.RenameColumn,
-             t.AddColumn, t.DropColumn, t.Grant, t.Revoke),
+             t.AddColumn, t.DropColumn, t.Grant, t.Revoke,
+             t.ShowFunctions, t.ShowCatalogs, t.ShowCreateTable),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
@@ -438,6 +439,49 @@ class Session:
                 raise ValueError(f"schema {name!r} is not empty: {held}")
             self.schemas.discard(name)
             return self._row_count_result(0)
+        if isinstance(ast, t.ShowFunctions):
+            # reference ShowQueriesRewrite SHOW FUNCTIONS over the
+            # registry; kind mirrors FunctionKind
+            from .sql.planner import AGG_FUNCS, LAMBDA_FUNCS, REWRITE_AGG_FUNCS
+            from .expr.functions import FUNCTIONS
+            from .ops.window import AGGREGATE, OFFSET, RANKING, VALUE
+
+            # one row per name; precedence aggregate > scalar > lambda >
+            # window (sum/avg/min/max/count exist both as aggregates and
+            # window reducers — Presto lists them once, as aggregates)
+            kind_of = {}
+            for n in RANKING | OFFSET | VALUE | AGGREGATE:
+                kind_of[n] = "window"
+            for n in LAMBDA_FUNCS:
+                kind_of[n] = "lambda"
+            for n in FUNCTIONS:
+                kind_of[n] = "scalar"
+            for n in AGG_FUNCS | REWRITE_AGG_FUNCS:
+                kind_of[n] = "aggregate"
+            rows = sorted(kind_of.items())
+            pg = Page.from_dict(
+                {
+                    "Function": [r[0] for r in rows],
+                    "Kind": [r[1] for r in rows],
+                }
+            )
+            return QueryResult(pg, ("Function", "Kind"))
+        if isinstance(ast, t.ShowCatalogs):
+            pg = Page.from_dict(
+                {"Catalog": [str(getattr(self.catalog, "name", "default"))]}
+            )
+            return QueryResult(pg, ("Catalog",))
+        if isinstance(ast, t.ShowCreateTable):
+            name = ast.name.lower()
+            if name in self.views:
+                raise ValueError(
+                    f"{name!r} is a view; use SHOW CREATE VIEW"
+                )
+            schema = self._table_schema(self.catalog, name)
+            cols = ",\n   ".join(f"{c} {ty}" for c, ty in schema.items())
+            txt = f"CREATE TABLE {name} (\n   {cols}\n)"
+            pg = Page.from_dict({"Create Table": [txt]})
+            return QueryResult(pg, ("Create Table",))
         if isinstance(ast, t.ShowSchemas):
             names = sorted(self.schemas)
             pg = Page.from_dict({"Schema": names})

@@ -173,12 +173,25 @@ class Parser:
             if self.accept_word("session"):
                 self.finish()
                 return t.ShowSession()
+            if self.accept_word("functions"):
+                self.finish()
+                return t.ShowFunctions()
+            if self.accept_word("catalogs"):
+                self.finish()
+                return t.ShowCatalogs()
             if self.accept_kw("create"):
-                self.expect_word("view")
+                if self.accept_word("view"):
+                    name = self.ident()
+                    self.finish()
+                    return t.ShowCreateView(name)
+                self.expect_kw("table")
                 name = self.ident()
                 self.finish()
-                return t.ShowCreateView(name)
-            self.error("expected TABLES, COLUMNS, SCHEMAS, SESSION or CREATE VIEW")
+                return t.ShowCreateTable(name)
+            self.error(
+                "expected TABLES, COLUMNS, SCHEMAS, SESSION, FUNCTIONS, "
+                "CATALOGS or CREATE TABLE/VIEW"
+            )
         if self.accept_kw("begin") or (
             self.accept_kw("start") and self.expect_kw("transaction") is None
         ):

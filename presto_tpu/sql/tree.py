@@ -540,3 +540,18 @@ def count_parameters(node) -> int:
         for v in node:
             n = max(n, count_parameters(v))
     return n
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowFunctions(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCatalogs(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCreateTable(Node):
+    name: str

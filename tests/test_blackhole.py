@@ -1,0 +1,45 @@
+"""Blackhole connector (reference presto-blackhole): discard-sink writes,
+empty/synthetic reads — the write-path benchmarking catalog."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.blackhole import BlackHoleCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+def test_writes_discarded_reads_empty():
+    cat = BlackHoleCatalog()
+    s = Session(cat)
+    s.query("create table sink (k bigint, s varchar)")
+    s.query("insert into sink values (1, 'a'), (2, 'b')")
+    s.query("insert into sink values (3, 'c')")
+    assert cat.rows_written["sink"] == 3
+    assert s.query("select count(*) from sink").rows() == [(0,)]
+    assert s.query("select * from sink").rows() == []
+    s.query("drop table sink")
+    assert "sink" not in cat.table_names()
+
+
+def test_ctas_into_blackhole():
+    cat = BlackHoleCatalog()
+    s = Session(cat)
+    s.query("create table src (v bigint)")
+    cat.synthetic_rows["src"] = 100
+    s.query("create table sink as select v * 2 vv from src")
+    assert cat.rows_written["sink"] == 100
+    assert s.query("select count(*) from sink").rows() == [(0,)]
+
+
+def test_synthetic_rows_scan():
+    from presto_tpu import types as T
+
+    cat = BlackHoleCatalog(synthetic_rows={"gen": 1000})
+    cat.create_table("gen", {"v": T.BIGINT, "s": T.VARCHAR})
+    s = Session(cat)
+    assert s.query("select count(*) from gen").rows() == [(1000,)]
+    st = Session(cat, streaming=True, batch_rows=256)
+    assert st.query("select count(*), sum(v) from gen").rows() == [
+        (1000, 0)
+    ]

@@ -314,3 +314,31 @@ def test_execute_respects_session_overrides(session):
     q(session, "set session batch_rows = 2048")
     q(session, "prepare qq from select count(*) from t")
     assert q(session, "execute qq") == [(3,)]
+
+
+def test_show_functions_catalogs_create_table(session):
+    fns = q(session, "show functions")
+    names = {r[0] for r in fns}
+    assert len(fns) > 300
+    assert {"abs", "approx_percentile", "transform", "row_number"} <= names
+    kinds = dict(fns)
+    assert kinds["approx_percentile"] == "aggregate"
+    assert kinds["transform"] == "lambda"
+    assert q(session, "show catalogs") == [("memory",)]
+    (txt,) = q(session, "show create table t")[0]
+    assert txt.startswith("CREATE TABLE t") and "g bigint" in txt
+
+
+def test_show_create_table_enforced_and_views_redirect():
+    ac = RuleBasedAccessControl(
+        [
+            {"privileges": "none", "user": "bob", "table": "secret"},
+            {"privileges": "all"},
+        ]
+    )
+    s = Session(_two_table_cat(), access_control=ac, user="admin")
+    with pytest.raises(AccessDeniedError):
+        s.query("show create table secret", user="bob")
+    s.query("create view vv as select * from t")
+    with pytest.raises(ValueError, match="is a view"):
+        s.query("show create table vv")
