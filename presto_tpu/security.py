@@ -268,6 +268,10 @@ def enforce(access_control: AccessControl, user: str, ast,
         # same metadata surface as SHOW COLUMNS
         for n in _names_to_check(ast.name.lower()):
             access_control.check_can_select_from_table(user, n)
+    if isinstance(ast, t.ShowStats):
+        # statistics leak DATA values (min/max/NDV): read privilege
+        for n in _names_to_check(ast.name.lower()):
+            access_control.check_can_select_from_table(user, n)
     if isinstance(ast, (t.CreateTable, t.DropTable)):
         for n in _names_to_check(ast.name.lower()):
             access_control.check_can_write_table(user, n)

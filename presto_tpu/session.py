@@ -17,6 +17,22 @@ from .sql.parser import parse
 from .sql.planner import Planner
 
 
+def _opt_f64(values):
+    """Optional-float column: (data, valid-aware) numpy for Page.from_dict."""
+    import numpy as np
+
+    from .page import Block
+    from . import types as T
+
+    data = np.array(
+        [0.0 if v is None else float(v) for v in values], np.float64
+    )
+    valid = np.array([v is not None for v in values], bool)
+    return Block.from_numpy(
+        data, T.DOUBLE, valid=None if valid.all() else valid
+    )
+
+
 class QueryResult:
     def __init__(self, page, titles):
         self.page = page
@@ -222,7 +238,8 @@ class Session:
              t.Deallocate, t.DescribeInput, t.DescribeOutput, t.SetSession,
              t.ResetSession, t.ShowSession, t.RenameTable, t.RenameColumn,
              t.AddColumn, t.DropColumn, t.Grant, t.Revoke,
-             t.ShowFunctions, t.ShowCatalogs, t.ShowCreateTable),
+             t.ShowFunctions, t.ShowCatalogs, t.ShowCreateTable,
+             t.ShowStats),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
@@ -482,6 +499,58 @@ class Session:
             txt = f"CREATE TABLE {name} (\n   {cols}\n)"
             pg = Page.from_dict({"Create Table": [txt]})
             return QueryResult(pg, ("Create Table",))
+        if isinstance(ast, t.ShowStats):
+            # reference ShowStatsRewrite: per-column CBO statistics —
+            # NDV, null fraction, logical min/max + a summary row with
+            # the table row count
+            name = ast.name.lower()
+            schema = self._table_schema(self.catalog, name)
+            stats_fn = getattr(self.catalog, "column_stats", None)
+            rows_total = None
+            erc = getattr(self.catalog, "exact_row_count", None)
+            if erc is not None:
+                try:
+                    rows_total = float(erc(name))
+                except Exception:  # noqa: BLE001 - summary is advisory
+                    rows_total = None
+            cols, ndvs, nfs, lows, highs = [], [], [], [], []
+            for c in schema:
+                st = None
+                if stats_fn is not None:
+                    try:
+                        st = stats_fn(name, c)
+                    except Exception:  # noqa: BLE001
+                        st = None
+                cols.append(c)
+                ndvs.append(None if st is None else st.ndv)
+                nfs.append(None if st is None else st.null_fraction)
+                lows.append(None if st is None or st.min is None
+                            else str(st.min))
+                highs.append(None if st is None or st.max is None
+                             else str(st.max))
+            # summary row (column_name NULL, row_count set) — the
+            # reference's layout
+            cols.append(None)
+            ndvs.append(None)
+            nfs.append(None)
+            lows.append(None)
+            highs.append(None)
+            rc = [None] * (len(cols) - 1) + [rows_total]
+            pg = Page.from_dict(
+                {
+                    "column_name": cols,
+                    "distinct_values_count": _opt_f64(ndvs),
+                    "nulls_fraction": _opt_f64(nfs),
+                    "row_count": _opt_f64(rc),
+                    "low_value": lows,
+                    "high_value": highs,
+                }
+            )
+            return QueryResult(
+                pg,
+                ("column_name", "distinct_values_count", "nulls_fraction",
+                 "row_count", "low_value", "high_value"),
+            )
         if isinstance(ast, t.ShowSchemas):
             names = sorted(self.schemas)
             pg = Page.from_dict({"Schema": names})

@@ -179,6 +179,11 @@ class Parser:
             if self.accept_word("catalogs"):
                 self.finish()
                 return t.ShowCatalogs()
+            if self.accept_word("stats"):
+                self.expect_kw("for")
+                name = self.ident()
+                self.finish()
+                return t.ShowStats(name)
             if self.accept_kw("create"):
                 if self.accept_word("view"):
                     name = self.ident()
@@ -190,7 +195,7 @@ class Parser:
                 return t.ShowCreateTable(name)
             self.error(
                 "expected TABLES, COLUMNS, SCHEMAS, SESSION, FUNCTIONS, "
-                "CATALOGS or CREATE TABLE/VIEW"
+                "CATALOGS, STATS FOR or CREATE TABLE/VIEW"
             )
         if self.accept_kw("begin") or (
             self.accept_kw("start") and self.expect_kw("transaction") is None

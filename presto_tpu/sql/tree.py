@@ -555,3 +555,8 @@ class ShowCatalogs(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowCreateTable(Node):
     name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowStats(Node):
+    name: str

@@ -342,3 +342,31 @@ def test_show_create_table_enforced_and_views_redirect():
     s.query("create view vv as select * from t")
     with pytest.raises(ValueError, match="is a view"):
         s.query("show create table vv")
+
+
+def test_show_stats_for_table():
+    """SHOW STATS FOR (reference ShowStatsRewrite): per-column NDV/null
+    fraction/min/max + the summary row carrying the table row count."""
+    from presto_tpu.connectors.tpch import TpchCatalog
+
+    s = Session(TpchCatalog(sf=0.01))
+    rows = s.query("show stats for nation").rows()
+    by_col = {r[0]: r for r in rows}
+    assert by_col["n_nationkey"][1] == 25.0  # NDV
+    assert by_col["n_nationkey"][4] == "0.0"  # low_value
+    assert by_col["n_nationkey"][5] == "24.0"  # high_value
+    summary = by_col[None]
+    assert summary[3] == 25.0  # row_count
+
+
+def test_show_stats_enforces_read_privilege():
+    ac = RuleBasedAccessControl(
+        [
+            {"privileges": "none", "user": "bob", "table": "secret"},
+            {"privileges": "all"},
+        ]
+    )
+    s = Session(_two_table_cat(), access_control=ac, user="admin")
+    assert len(s.query("show stats for secret").rows()) >= 2
+    with pytest.raises(AccessDeniedError):
+        s.query("show stats for secret", user="bob")
