@@ -14,9 +14,13 @@ int64/two-lane arithmetic — so decimal(38) sums stay exact at any scale
 factor.
 
 Layout: each (n,) column is viewed as (n/128, 128); the grid walks row
-blocks of (128, 128) = 16384 rows; the kernel emits an (8, 128) partial
-tile per block: row g = group id (6 live groups, padded to 8), columns =
-limb channels (14 live, padded to 128 lanes).
+blocks of (128, 128) = 16384 rows; the kernel emits a (128, 128) partial
+tile per block: row g*16+k holds the PER-LANE partial sums of limb
+channel k masked to group g (6 live groups x 14 live channels, padded to
+128 rows). Only sublane (axis 0) reductions happen in-kernel — Mosaic
+lowers `jnp.sum(axis=0)` natively, and cross-lane reduction is exactly
+what the VPU is worst at; the final 128-lane fold runs in XLA int64
+outside the kernel (combine()).
 
 DEPLOYMENT: Mosaic kernels DO execute through the axon tunnel (round-4
 verification — TPU_STATUS.md §1; the round-3 "trivial pallas_call hangs"
@@ -88,21 +92,22 @@ def _kernel(cut_ref, cnt_ref, qty_ref, price_ref, disc_ref, tax_ref,
     )
 
     zero = jnp.int32(0)
-    tile = jnp.zeros((8, 128), jnp.int32)
+    # sublane-only reductions: each (group, channel) pair fills row g*16+k
+    # with per-lane sums (int32 is safe: 128 rows x <2^16 limbs < 2^23).
+    # The generic lax.reduce primitive has no Mosaic lowering; jnp.sum
+    # with an explicit int32 dtype lowers to the supported reduce_sum.
+    rows_out = []
     for g in range(_G):
         sel = live & (gid == g)
-        # keep everything int32: under x64, bare sums/literals promote to
-        # int64, which Pallas-on-TPU cannot reduce
-        # lax.reduce with an int32 init avoids jnp.sum's int64 accumulator
-        row = [
-            jax.lax.reduce(
-                jnp.where(sel, ch, zero), zero, jax.lax.add, (0, 1)
+        for ch in channels:
+            rows_out.append(
+                jnp.sum(jnp.where(sel, ch, zero), axis=0, dtype=jnp.int32)
             )
-            for ch in channels
-        ]
-        row_v = jnp.stack(row + [zero] * (128 - len(row)))
-        tile = tile.at[g, :].set(row_v)
-    out_ref[:] = tile[None]
+        rows_out.extend([jnp.zeros((128,), jnp.int32)] * (16 - len(channels)))
+    rows_out.extend(
+        [jnp.zeros((128,), jnp.int32)] * (128 - _G * 16)
+    )
+    out_ref[:] = jnp.stack(rows_out)[None]
 
 
 def q1_partial_sums(qty, price, disc, tax, rf, ls, ship, count, cutoff):
@@ -132,9 +137,9 @@ def q1_partial_sums(qty, price, disc, tax, rf, ls, ship, count, cutoff):
         ]
         + [col_spec] * 7,
         out_specs=pl.BlockSpec(
-            (1, 8, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            (1, 128, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((blocks, 8, 128), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((blocks, 128, 128), jnp.int32),
         interpret=interpret,
     )(
         cutoff.reshape(1),
@@ -150,14 +155,18 @@ def q1_partial_sums(qty, price, disc, tax, rf, ls, ship, count, cutoff):
 
 
 def combine(partials):
-    """(blocks, 8, 128) int32 limb partials -> per-group int64/lane sums.
+    """(blocks, 128, 128) int32 limb partials -> per-group int64 sums.
 
-    Returns dict of (6,)-shaped arrays: count, sum_qty, sum_price,
-    sum_disc (int64) and disc_price/charge as (6, 2) two-lane values
-    (ops/decimal128 layout) — exact at any row count."""
+    Row g*16+k of each block tile holds channel k of group g as 128
+    per-lane partials; fold blocks + lanes in int64 here (outside the
+    kernel), then decode limb channels. Returns dict of (6,)-shaped
+    arrays: count, sum_qty, sum_price, sum_disc (int64) and
+    disc_price/charge as (6, 2) two-lane values (ops/decimal128
+    layout) — exact at any row count."""
     from . import decimal128 as d128
 
-    s = jnp.sum(partials.astype(jnp.int64), axis=0)[: _G, : _CH]  # (6, 14)
+    folded = jnp.sum(partials.astype(jnp.int64), axis=(0, 2))  # (128,)
+    s = folded.reshape(8, 16)[: _G, : _CH]  # (6, 14)
     ch = [s[:, k] for k in range(_CH)]
 
     def lanes(lo16, mid, hi32):

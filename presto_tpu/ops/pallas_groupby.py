@@ -46,12 +46,26 @@ MAX_CHANNELS = 128  # one output lane per channel
 _SUM_BOUND = 1 << 45  # |sum input| bound keeping block limb sums in int32
 
 
+def _rows_pad(num_groups: int, num_channels: int) -> int:
+    """Output tile rows: one row per (group, channel), padded to the
+    int32 sublane multiple (8)."""
+    return -(-(num_groups * num_channels) // 8) * 8
+
+
 def _kernel_factory(num_groups: int, num_channels: int, reduce_kinds,
                     dtype=jnp.int32):
     """Build the grid kernel for a (G, channels) plan. reduce_kinds[k] in
     {'add', 'min', 'max'} selects the per-channel block reduction.
     dtype is the tile/channel element type: int32 for the exact limb
-    path, float32 for the hi/lo-split float64 path."""
+    path, float32 for the hi/lo-split float64 path.
+
+    Only SUBLANE (axis 0) reductions happen in-kernel — the generic
+    lax.reduce primitive has no Mosaic lowering, and cross-lane scalar
+    reduction is what the VPU is worst at. Row g*num_channels+k of the
+    output tile holds channel k of group g as 128 per-lane partials; the
+    lane fold happens outside the kernel in XLA int64/f64."""
+
+    rpad = _rows_pad(num_groups, num_channels)
 
     def kernel(cnt_ref, *refs):
         from jax.experimental import pallas as pl
@@ -74,44 +88,37 @@ def _kernel_factory(num_groups: int, num_channels: int, reduce_kinds,
             zero = dtype(0)
             imax = dtype(np.inf)
             imin = dtype(-np.inf)
-        tile = jnp.zeros((PALLAS_MAX_GROUPS, 128), dtype)
+        rows_out: List = []
         for g in range(num_groups):
             sel = live & (gid == g)
-            row: List = []
             for k, ref in enumerate(chan_refs):
                 ch = ref[:]
                 kind = reduce_kinds[k]
                 if kind == "add":
-                    row.append(
-                        jax.lax.reduce(
-                            jnp.where(sel, ch, zero), zero, jax.lax.add,
-                            (0, 1),
-                        )
+                    rows_out.append(
+                        jnp.sum(jnp.where(sel, ch, zero), axis=0,
+                                dtype=dtype)
                     )
                 elif kind == "min":
-                    row.append(
-                        jax.lax.reduce(
-                            jnp.where(sel, ch, imax), imax, jax.lax.min,
-                            (0, 1),
-                        )
+                    rows_out.append(
+                        jnp.min(jnp.where(sel, ch, imax), axis=0)
                     )
                 else:
-                    row.append(
-                        jax.lax.reduce(
-                            jnp.where(sel, ch, imin), imin, jax.lax.max,
-                            (0, 1),
-                        )
+                    rows_out.append(
+                        jnp.max(jnp.where(sel, ch, imin), axis=0)
                     )
-            row_v = jnp.stack(row + [zero] * (128 - len(row)))
-            tile = tile.at[g, :].set(row_v)
-        out_ref[:] = tile[None]
+        rows_out.extend(
+            [jnp.full((128,), zero, dtype)] * (rpad - len(rows_out))
+        )
+        out_ref[:] = jnp.stack(rows_out)[None]
 
     return kernel
 
 
 def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds,
                      dtype=jnp.int32):
-    """(blocks, PALLAS_MAX_GROUPS, 128) per-block reductions in `dtype`."""
+    """(blocks, rows_pad, 128) per-block per-lane partials in `dtype`;
+    row g*len(channels)+k = channel k of group g (see _kernel_factory)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -132,18 +139,19 @@ def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds,
     kernel = _kernel_factory(
         num_groups, len(channels), tuple(reduce_kinds), dtype
     )
+    rpad = _rows_pad(num_groups, len(channels))
     return pl.pallas_call(
         kernel,
         grid=(blocks,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
         + [col_spec] * (2 + len(channels)),
         out_specs=pl.BlockSpec(
-            (1, PALLAS_MAX_GROUPS, 128),
+            (1, rpad, 128),
             lambda i: (i, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (blocks, PALLAS_MAX_GROUPS, 128), dtype
+            (blocks, rpad, 128), dtype
         ),
         interpret=interpret,
     )(
@@ -280,31 +288,42 @@ def maybe_grouped_aggregate(
             )  # masking happens in-kernel via `sel`
     if len(channels) > MAX_CHANNELS or len(fchannels) > MAX_CHANNELS:
         return None
+    # bound the per-block output tile (rows x 128 lanes) to 512KB VMEM
+    if max(
+        _rows_pad(G, len(channels)), _rows_pad(G, len(fchannels))
+    ) > 1024:
+        return None
 
-    partials = _pallas_partials(
-        gid, live, channels, page.count, G, kinds
-    )
+    CH = len(channels)
+    if CH:
+        partials = _pallas_partials(
+            gid, live, channels, page.count, G, kinds
+        )
+        pv = (
+            partials[:, : G * CH, :]
+            .reshape(-1, G, CH, 128)
+            .astype(jnp.int64)
+        )
+        s = jnp.sum(pv, axis=(0, 3))  # (G, CH)
+        # min/max channels combine across blocks AND lanes by min/max
+        # (their in-kernel fill values imax/imin survive empty groups)
+        pmin = jnp.min(pv, axis=(0, 3))
+        pmax = jnp.max(pv, axis=(0, 3))
+    else:
+        s = pmin = pmax = jnp.zeros((G, 0), jnp.int64)
     fs = None
     if fchannels:
+        CHF = len(fchannels)
         fpartials = _pallas_partials(
             gid, live, fchannels, page.count, G,
-            ["add"] * len(fchannels), dtype=jnp.float32,
+            ["add"] * CHF, dtype=jnp.float32,
         )
-        fs = jnp.sum(fpartials.astype(jnp.float64), axis=0)[
-            :G, : len(fchannels)
-        ]
-    s = jnp.sum(partials.astype(jnp.int64), axis=0)[:G, : len(channels)]
-    mins = jnp.min(
-        jnp.where(
-            partials.astype(jnp.int64) == 0, np.iinfo(np.int64).max,
-            partials.astype(jnp.int64),
-        ),
-        axis=0,
-    )[:G, : len(channels)]
-    # min/max channels combine across blocks by min/max, not sum
-    pmin = jnp.min(partials.astype(jnp.int64), axis=0)[:G, : len(channels)]
-    pmax = jnp.max(partials.astype(jnp.int64), axis=0)[:G, : len(channels)]
-    del mins
+        fs = jnp.sum(
+            fpartials[:, : G * CHF, :]
+            .reshape(-1, G, CHF, 128)
+            .astype(jnp.float64),
+            axis=(0, 3),
+        )
 
     # per-agg recomposition
     by_agg: dict = {}

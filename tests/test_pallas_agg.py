@@ -66,6 +66,35 @@ def test_pallas_groupby_float_and_countif():
         assert abs(r[2] - p[2]) < mag * 1e-6
 
 
+def test_pallas_groupby_min_max_and_empty_group():
+    """min/max channels combine across blocks AND lanes by min/max (the
+    imax/imin in-kernel fill values must survive the per-lane partial
+    layout); a key value absent from the data exercises empty-group
+    compaction."""
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Page
+    from presto_tpu.session import Session
+
+    rng = np.random.default_rng(11)
+    n = 50000  # spans multiple 16384-row kernel blocks
+    pool = ("A", "N", "R", "Z")  # "Z" never drawn -> empty group
+    flag = np.array([pool[i] for i in rng.integers(0, 3, n)])
+    v = rng.integers(-(10**9), 10**9, n)
+    cat = MemoryCatalog(
+        {"t": Page.from_dict({"f": list(flag), "v": v})}
+    )
+    sql = (
+        "select f, min(v) mn, max(v) mx, sum(v) sv, count(*) c "
+        "from t group by f order by f"
+    )
+    ref = Session(cat, pallas_groupby=False).query(sql).rows()
+    pal = Session(cat, pallas_groupby=True).query(sql).rows()
+    assert len(ref) == 3
+    assert pal == ref
+
+
 def test_pallas_groupby_auto_default_off_on_cpu():
     """pallas_groupby=None resolves to the backend default at first
     aggregation: False on CPU (interpret would crawl), True on TPU."""
