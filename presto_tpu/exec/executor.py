@@ -185,10 +185,17 @@ class Executor:
         if self.pallas_groupby:
             from ..ops.pallas_groupby import maybe_grouped_aggregate
 
-            out = maybe_grouped_aggregate(
-                page, node.group_exprs, node.group_names, node.aggs,
-                node.mask,
-            )
+            try:
+                out = maybe_grouped_aggregate(
+                    page, node.group_exprs, node.group_names, node.aggs,
+                    node.mask,
+                )
+            except Exception:
+                # a Mosaic lowering/compile failure must degrade to the
+                # XLA composition, not fail the query (round-5 bench: the
+                # default-on kernel took down the whole SQL stage)
+                self.pallas_groupby = False
+                out = None
             if out is not None:
                 return self._shrink(out)
         # groups <= live rows; guess low and retry with the true group count

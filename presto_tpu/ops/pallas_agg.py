@@ -128,30 +128,35 @@ def q1_partial_sums(qty, price, disc, tax, rf, ls, ship, count, cutoff):
     col_spec = pl.BlockSpec(
         (128, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
-    return pl.pallas_call(
-        _kernel,
-        grid=(blocks,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ]
-        + [col_spec] * 7,
-        out_specs=pl.BlockSpec(
-            (1, 128, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((blocks, 128, 128), jnp.int32),
-        interpret=interpret,
-    )(
-        cutoff.reshape(1),
-        count.reshape(1),
-        view(qty),
-        view(price),
-        view(disc),
-        view(tax),
-        view(rf),
-        view(ls),
-        view(ship),
-    )
+    # trace with x64 OFF: under the repo's global x64 mode the BlockSpec
+    # index maps trace to i64 functions, which Mosaic fails to legalize
+    # ("func.return (i64)") — every value in this kernel is explicit
+    # int32, so 32-bit tracing is semantics-preserving
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _kernel,
+            grid=(blocks,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ]
+            + [col_spec] * 7,
+            out_specs=pl.BlockSpec(
+                (1, 128, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((blocks, 128, 128), jnp.int32),
+            interpret=interpret,
+        )(
+            cutoff.reshape(1),
+            count.reshape(1),
+            view(qty),
+            view(price),
+            view(disc),
+            view(tax),
+            view(rf),
+            view(ls),
+            view(ship),
+        )
 
 
 def combine(partials):

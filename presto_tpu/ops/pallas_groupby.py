@@ -140,26 +140,31 @@ def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds,
         num_groups, len(channels), tuple(reduce_kinds), dtype
     )
     rpad = _rows_pad(num_groups, len(channels))
-    return pl.pallas_call(
-        kernel,
-        grid=(blocks,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
-        + [col_spec] * (2 + len(channels)),
-        out_specs=pl.BlockSpec(
-            (1, rpad, 128),
-            lambda i: (i, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct(
-            (blocks, rpad, 128), dtype
-        ),
-        interpret=interpret,
-    )(
+    ins = (
         count.reshape(1).astype(jnp.int32),
         view(gid.astype(jnp.int32)),
         view(live.astype(jnp.int32)),
         *[view(c.astype(dtype)) for c in channels],
     )
+    # trace with x64 OFF: under global x64 the BlockSpec index maps trace
+    # to i64 functions, which Mosaic fails to legalize ("func.return
+    # (i64)"); the kernel is explicit int32/float32 throughout
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            kernel,
+            grid=(blocks,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [col_spec] * (2 + len(channels)),
+            out_specs=pl.BlockSpec(
+                (1, rpad, 128),
+                lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (blocks, rpad, 128), dtype
+            ),
+            interpret=interpret,
+        )(*ins)
 
 
 def _eligible_keys(page: Page, group_exprs) -> Optional[Tuple[list, list]]:
