@@ -48,6 +48,11 @@ def main(argv=None):
         "--catalog", default="tpch",
         help="tpch | tpcds | memory | a directory of csv/tsv/jsonl files",
     )
+    ap.add_argument(
+        "--catalog-dir",
+        help="directory of <name>.properties catalog files (server-style "
+        "bootstrap; tables reachable bare or as <name>.<table>)",
+    )
     ap.add_argument("--server", help="coordinator URI (remote REST mode)")
     ap.add_argument("--serve", action="store_true",
                     help="start a coordinator server instead of a REPL")
@@ -61,6 +66,10 @@ def main(argv=None):
     def build_catalog():
         # only the --serve and local-REPL paths need one; remote mode
         # must not validate a path that exists only on the coordinator
+        if args.catalog_dir:
+            from .server.catalog_store import load_catalog_store
+
+            return load_catalog_store(args.catalog_dir)
         if args.catalog == "tpch":
             from .connectors.tpch import TpchCatalog
 

@@ -1,0 +1,235 @@
+"""Static catalog store + connector-factory registry — the server
+bootstrap's catalog loading.
+
+Re-designed equivalent of the reference's PluginManager +
+StaticCatalogStore (presto-main/.../metadata/StaticCatalogStore.java:45
+loadCatalogs; server/PluginManager.java): every `<name>.properties` file
+in a catalog directory declares one catalog; `connector.name` selects the
+connector factory and the remaining keys are that connector's config.
+Third-party "plugins" register factories at import time via
+`register_connector` (the ConnectorFactory SPI analog — no classloader
+isolation: one process, one interpreter).
+
+Loaded catalogs mount under their file-stem name: a table is reachable
+bare (first catalog wins, MultiCatalog flat federation) or qualified as
+`catalog.table` / `catalog.default.table`, matching the reference's
+MetadataManager qualified-name resolution.
+
+Example::
+
+    etc/catalog/tpch.properties:
+        connector.name=tpch
+        tpch.scale-factor=0.1
+    etc/catalog/files.properties:
+        connector.name=localfile
+        localfile.data-dir=/data/csvs
+
+    cat = load_catalog_store("etc/catalog")
+    Session(cat).query("select count(*) from tpch.lineitem")
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Callable, Dict, List
+
+from ..connectors.jdbc import MultiCatalog
+from ..connectors.spi import Connector
+
+Factory = Callable[[Dict[str, str]], Connector]
+_FACTORIES: Dict[str, Factory] = {}
+
+
+def register_connector(name: str, factory: Factory) -> None:
+    """ConnectorFactory registration (Plugin.getConnectorFactories analog)."""
+    _FACTORIES[name] = factory
+
+
+def connector_names() -> List[str]:
+    return sorted(_FACTORIES)
+
+
+def _f_tpch(props):
+    sf = float(props.get("tpch.scale-factor", 1.0))
+    if props.get("tpch.device-generated", "").lower() in ("true", "1"):
+        from ..connectors.tpch_device import DeviceTpchCatalog
+
+        return DeviceTpchCatalog(sf=sf)
+    from ..connectors.tpch import TpchCatalog
+
+    return TpchCatalog(sf=sf)
+
+
+def _f_tpcds(props):
+    from ..connectors.tpcds import TpcdsCatalog
+
+    return TpcdsCatalog(sf=float(props.get("tpcds.scale-factor", 1.0)))
+
+
+def _f_memory(props):
+    from ..connectors.memory import MemoryCatalog
+
+    return MemoryCatalog({})
+
+
+def _f_localfile(props):
+    from ..connectors.localfile import LocalFileCatalog
+
+    return LocalFileCatalog(props["localfile.data-dir"])
+
+
+def _f_hive(props):
+    from ..connectors.hive import HiveCatalog
+
+    return HiveCatalog(props["hive.warehouse-dir"])
+
+
+def _f_sqlite(props):
+    from ..connectors.jdbc import SqliteCatalog
+
+    url = props.get("connection-url", ":memory:")
+    if url.startswith("jdbc:sqlite:"):  # accept the reference's URL shape
+        url = url[len("jdbc:sqlite:"):]
+    return SqliteCatalog(url)
+
+
+def _f_blackhole(props):
+    from ..connectors.blackhole import BlackHoleCatalog
+
+    return BlackHoleCatalog()
+
+
+def _f_shardstore(props):
+    from ..connectors.shardstore import ShardStoreCatalog
+
+    return ShardStoreCatalog(props["shardstore.data-dir"])
+
+
+for _n, _f in (
+    ("tpch", _f_tpch),
+    ("tpcds", _f_tpcds),
+    ("memory", _f_memory),
+    ("localfile", _f_localfile),
+    ("hive", _f_hive),
+    ("sqlite", _f_sqlite),
+    ("blackhole", _f_blackhole),
+    ("shardstore", _f_shardstore),
+):
+    register_connector(_n, _f)
+
+
+def parse_properties(path: str) -> Dict[str, str]:
+    """Minimal java-properties subset: key=value lines, # / ! comments,
+    trailing whitespace stripped (what catalog files actually use)."""
+    props: Dict[str, str] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line[0] in "#!":
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}: malformed line {raw!r}")
+            k, _, v = line.partition("=")
+            props[k.strip()] = v.strip()
+    return props
+
+
+class CatalogStore(MultiCatalog):
+    """Named federation: tables resolve bare (first catalog wins) or as
+    `catalog.table` (registered as dotted names, which the planner's
+    qualified-name resolution already accepts)."""
+
+    name = "catalogs"
+
+    def __init__(self, catalogs: Dict[str, Connector]):
+        super().__init__(list(catalogs.values()))
+        self.catalogs = dict(catalogs)
+
+    def _owner_and_table(self, table: str):
+        if "." in table:
+            cat, _, rest = table.partition(".")
+            m = self.catalogs.get(cat)
+            if m is not None:
+                if rest.startswith("default."):
+                    rest = rest[len("default."):]
+                if rest in m.table_names():
+                    return m, rest
+        for m in self.members:
+            if table in m.table_names():
+                return m, table
+        raise KeyError(f"unknown table {table!r}")
+
+    # -- Connector surface, routed through qualified resolution --
+    def table_names(self) -> List[str]:
+        out: List[str] = []
+        for cname, m in self.catalogs.items():
+            for t in m.table_names():
+                out.append(f"{cname}.{t}")
+                if t not in out:
+                    out.append(t)
+        return out
+
+    def _owner(self, table: str):  # MultiCatalog hook
+        return self._owner_and_table(table)[0]
+
+    def schema(self, table: str):
+        m, t = self._owner_and_table(table)
+        return m.schema(t)
+
+    def row_count(self, table: str) -> int:
+        m, t = self._owner_and_table(table)
+        return m.row_count(t)
+
+    def exact_row_count(self, table: str) -> int:
+        m, t = self._owner_and_table(table)
+        return m.exact_row_count(t)
+
+    def unique_columns(self, table: str):
+        m, t = self._owner_and_table(table)
+        return m.unique_columns(t)
+
+    def column_stats(self, table: str, column: str):
+        m, t = self._owner_and_table(table)
+        return m.column_stats(t, column)
+
+    def page(self, table: str):
+        m, t = self._owner_and_table(table)
+        return m.page(t)
+
+    def scan(self, table: str, start: int, stop: int, pad_to=None,
+             columns=None, predicate=None):
+        m, t = self._owner_and_table(table)
+        return m.scan(t, start, stop, pad_to=pad_to, columns=columns,
+                      predicate=predicate)
+
+    def supports_index(self, table: str, column: str) -> bool:
+        m, t = self._owner_and_table(table)
+        fn = getattr(m, "supports_index", None)
+        return bool(fn and fn(t, column))
+
+    def index_lookup(self, table: str, column: str, keys, columns):
+        m, t = self._owner_and_table(table)
+        return m.index_lookup(t, column, keys, columns)
+
+
+def load_catalog_store(directory: str) -> CatalogStore:
+    """Boot every `<name>.properties` in `directory` (StaticCatalogStore
+    .loadCatalogs analog: file stem = catalog name)."""
+    catalogs: Dict[str, Connector] = {}
+    for path in sorted(glob.glob(os.path.join(directory, "*.properties"))):
+        cname = os.path.splitext(os.path.basename(path))[0]
+        props = parse_properties(path)
+        conn_name = props.get("connector.name")
+        if not conn_name:
+            raise ValueError(f"{path}: missing connector.name")
+        factory = _FACTORIES.get(conn_name)
+        if factory is None:
+            raise ValueError(
+                f"{path}: unknown connector {conn_name!r} "
+                f"(registered: {', '.join(connector_names())})"
+            )
+        catalogs[cname] = factory(props)
+    if not catalogs:
+        raise ValueError(f"no *.properties catalogs in {directory!r}")
+    return CatalogStore(catalogs)

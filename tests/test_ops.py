@@ -274,3 +274,45 @@ def test_kernels_are_jittable():
     p = Page.from_dict({"x": np.array([1, 2, 3, 4, 5], np.int64)}, pad_to=8)
     out = pipeline(p)
     assert out.to_pylist() == [(12,)]
+
+
+def test_join_bucket_directory_stress():
+    """The bucket-start directory (O(1) probe ranges) vs a brute-force
+    oracle: many probes, duplicate build keys, dead build rows beyond
+    count, and a composite key — bucket candidates that differ in hash
+    or sit in the dead tail must never match."""
+    rng = np.random.default_rng(7)
+    nb, npr = 5000, 20000
+    bk = rng.integers(0, 3000, nb)  # duplicates guaranteed
+    bw = rng.integers(0, 1 << 40, nb)
+    build_page = Page.from_dict(
+        {"k": bk.astype(np.int64), "w": bw.astype(np.int64)},
+        pad_to=8192,  # dead tail after nb rows
+    )
+    pk = rng.integers(0, 4000, npr)  # some keys miss entirely
+    probe = Page.from_dict({"k": pk.astype(np.int64)}, pad_to=1 << 15)
+    bs = build(build_page, [col("k", T.BIGINT)])
+    assert bs.bucket_start is not None and bs.bucket_bits > 0
+
+    out = join_n1(probe, bs, [col("k", T.BIGINT)], [], [], kind="semi")
+    got = sorted(r[0] for r in out.to_pylist())
+    want = sorted(int(k) for k in pk if k in set(bk.tolist()))
+    assert got == want
+
+    # 1:N expansion counts through bucket (superset) candidate ranges
+    sub = Page.from_dict({"k": pk[:50].astype(np.int64)}, pad_to=64)
+    out, overflow = join_expand(
+        sub, bs, [col("k", T.BIGINT)], ["k"], [("w", "w")],
+        out_capacity=4096, kind="inner",
+    )
+    assert int(overflow) == 0
+    got = sorted(out.to_pylist())
+    import collections
+
+    bw_by_k = collections.defaultdict(list)
+    for k, w in zip(bk.tolist(), bw.tolist()):
+        bw_by_k[k].append(w)
+    want = sorted(
+        (int(k), w) for k in pk[:50].tolist() for w in bw_by_k.get(k, [])
+    )
+    assert got == want
