@@ -272,6 +272,46 @@ def bench_agg_sorted(sf: float) -> Bench:
     )
 
 
+def bench_agg_matmul(sf: float) -> Bench:
+    """Same shape as agg_sorted_suppkey through the MXU one-hot-matmul
+    strategy (ops/matmul_agg.py) — the A/B that shows what moving a
+    group-by from the sort network to the systolic array buys."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.aggregate import AggSpec
+    from ..ops.matmul_agg import maybe_matmul_grouped_aggregate
+    from .handcoded import DEC12_2, _table_page
+
+    page = _table_page(
+        "lineitem", sf, ("l_suppkey", "l_quantity", "l_extendedprice")
+    )
+    qty = col("l_quantity", DEC12_2)
+    aggs = (
+        AggSpec("sum", qty, "s", AggSpec.infer_output_type("sum", DEC12_2)),
+        AggSpec("count_star", None, "c", T.BIGINT),
+    )
+    gexprs = (col("l_suppkey", T.BIGINT),)
+    probe = maybe_matmul_grouped_aggregate(
+        page, gexprs, ("l_suppkey",), aggs, None
+    )
+    if probe is None:  # NDV beyond the dense budget at this sf
+        raise RuntimeError(f"ineligible at sf={sf} (NDV > dense budget)")
+
+    def step(acc, p):
+        out = maybe_matmul_grouped_aggregate(
+            _chained_page(p, acc), gexprs, ("l_suppkey",), aggs, None
+        )
+        return _consume(out)
+
+    return Bench(
+        "agg_matmul_suppkey",
+        int(page.count),
+        step,
+        (page,),
+        note=f"groups={int(probe.count)} (MXU one-hot matmul)",
+    )
+
+
 def _orders_keys_page(sf: float):
     from .handcoded import _table_page
 
@@ -424,6 +464,7 @@ DEVICE_BENCHES = {
     "agg_direct_q1": bench_agg_direct,
     "agg_pallas_q1": bench_agg_pallas,
     "agg_sorted_suppkey": bench_agg_sorted,
+    "agg_matmul_suppkey": bench_agg_matmul,
     "join_build": bench_join_build,
     "join_probe_n1": bench_join_probe,
     "sort_2key": bench_sort,

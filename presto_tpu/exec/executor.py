@@ -40,7 +40,8 @@ class ExecutionError(RuntimeError):
 
 class Executor:
     def __init__(self, catalog, shrink: bool = True, jit: bool = True,
-                 collector=None, pallas_groupby=None):
+                 collector=None, pallas_groupby=None,
+                 matmul_groupby=None):
         self.catalog = catalog
         self.shrink = shrink
         self.jit = jit
@@ -53,6 +54,10 @@ class Executor:
         # either way (resolved lazily so importing the executor never
         # initializes a backend).
         self.pallas_groupby = pallas_groupby
+        # route eligible dense-key aggregations (G <= 4096) through the
+        # one-hot-matmul MXU path (ops/matmul_agg.py) before falling back
+        # to the sort strategy; same auto semantics as pallas_groupby
+        self.matmul_groupby = matmul_groupby
         # (plan node, static params) -> jitted kernel; the analog of the
         # reference caching compiled PageProcessors per plan
         # (LocalExecutionPlanner compiles once, Drivers reuse)
@@ -195,6 +200,23 @@ class Executor:
                 # XLA composition, not fail the query (round-5 bench: the
                 # default-on kernel took down the whole SQL stage)
                 self.pallas_groupby = False
+                out = None
+            if out is not None:
+                return self._shrink(out)
+        if self.matmul_groupby is None:
+            import jax
+
+            self.matmul_groupby = jax.default_backend() == "tpu"
+        if self.matmul_groupby:
+            from ..ops.matmul_agg import maybe_matmul_grouped_aggregate
+
+            try:
+                out = maybe_matmul_grouped_aggregate(
+                    page, node.group_exprs, node.group_names, node.aggs,
+                    node.mask,
+                )
+            except Exception:
+                self.matmul_groupby = False
                 out = None
             if out is not None:
                 return self._shrink(out)

@@ -362,6 +362,111 @@ def _distinct_over_aggregate(node: N.Distinct, caps) -> Optional[N.PlanNode]:
     return node.child
 
 
+_NONDETERMINISTIC = {"random", "rand", "uuid", "shuffle"}
+
+
+def _deterministic(e: ir.RowExpression) -> bool:
+    if isinstance(e, ir.Call):
+        if e.name in _NONDETERMINISTIC:
+            return False
+        return all(_deterministic(a) for a in e.args)
+    if isinstance(e, ir.Lambda):
+        return _deterministic(e.body)
+    return True
+
+
+def _push_filter_through_join(node: N.Filter, caps) -> Optional[N.PlanNode]:
+    """Single-side conjuncts move below the join (reference:
+    PredicatePushDown.java join pushdown). Inner joins push to both
+    sides; LEFT joins only to the probe side — filtering the
+    null-extended side below the join would turn dropped rows into
+    null-extended ones."""
+    j = node.child
+    if not isinstance(j, N.Join) or j.kind not in ("inner", "left"):
+        return None
+    lnames = {n for n, _ in j.left.fields}
+    rnames = {n for n, _ in j.right.fields}
+    stay: List[ir.RowExpression] = []
+    lpush: List[ir.RowExpression] = []
+    rpush: List[ir.RowExpression] = []
+    for c in split_conjuncts(node.predicate):
+        refs: set = set()
+        _refs(c, refs)
+        if refs and refs <= lnames and _deterministic(c):
+            lpush.append(c)
+        elif (
+            refs and refs <= rnames and j.kind == "inner"
+            and _deterministic(c)
+        ):
+            rpush.append(c)
+        else:
+            stay.append(c)
+    if not lpush and not rpush:
+        return None
+    left = N.Filter(j.left, _conjoin(lpush)) if lpush else j.left
+    right = N.Filter(j.right, _conjoin(rpush)) if rpush else j.right
+    out: N.PlanNode = dataclasses.replace(j, left=left, right=right)
+    return N.Filter(out, _conjoin(stay)) if stay else out
+
+
+def _push_filter_through_union(node: N.Filter, caps) -> Optional[N.PlanNode]:
+    """Filter distributes over UNION [ALL] inputs (reference:
+    PushDownDereferencesThroughUnion's simpler cousin —
+    the engine's union inputs already share the first input's channel
+    names, so the predicate applies verbatim to each input)."""
+    u = node.child
+    if not isinstance(u, N.Union) or not _deterministic(node.predicate):
+        return None
+    return dataclasses.replace(
+        u,
+        inputs=tuple(N.Filter(i, node.predicate) for i in u.inputs),
+    )
+
+
+def _push_filter_through_aggregate(node: N.Filter, caps) -> Optional[N.PlanNode]:
+    """HAVING conjuncts that reference only group keys filter ROWS below
+    the aggregation instead of groups above it (reference:
+    PushPredicateThroughAggregation semantics inside PredicatePushDown):
+    a group exists iff some row has its key, so key-only predicates
+    commute with grouping."""
+    a = node.child
+    if not isinstance(a, N.Aggregate) or not a.group_exprs:
+        return None
+    env = {n: e for n, e in zip(a.group_names, a.group_exprs)}
+    gnames = set(a.group_names)
+    push: List[ir.RowExpression] = []
+    stay: List[ir.RowExpression] = []
+    for c in split_conjuncts(node.predicate):
+        refs: set = set()
+        _refs(c, refs)
+        if refs and refs <= gnames and _deterministic(c):
+            push.append(_substitute(c, env))
+        else:
+            stay.append(c)
+    if not push:
+        return None
+    out: N.PlanNode = dataclasses.replace(
+        a, child=N.Filter(a.child, _conjoin(push))
+    )
+    return N.Filter(out, _conjoin(stay)) if stay else out
+
+
+_ORDER_SENSITIVE_AGGS = {"array_agg", "map_agg", "multimap_agg", "histogram"}
+
+
+def _remove_redundant_sort(node: N.PlanNode, caps) -> Optional[N.PlanNode]:
+    """A Sort feeding an order-insensitive consumer is dead work
+    (reference: RemoveRedundantSort / PruneOrderByInAggregation)."""
+    child = node.child
+    if not isinstance(child, N.Sort):
+        return None
+    if isinstance(node, N.Aggregate) and any(
+        a.func in _ORDER_SENSITIVE_AGGS for a in node.aggs
+    ):
+        return None
+    return dataclasses.replace(node, child=child.child)
+
+
 def default_rules() -> List[Rule]:
     P = pattern
     return [
@@ -429,6 +534,26 @@ def default_rules() -> List[Rule]:
             "DistinctOverAggregate",
             P(N.Distinct).child(P(N.Aggregate)),
             _distinct_over_aggregate,
+        ),
+        Rule(
+            "PushFilterThroughJoin",
+            P(N.Filter).child(P(N.Join)),
+            _push_filter_through_join,
+        ),
+        Rule(
+            "PushFilterThroughUnion",
+            P(N.Filter).child(P(N.Union)),
+            _push_filter_through_union,
+        ),
+        Rule(
+            "PushFilterThroughAggregate",
+            P(N.Filter).child(P(N.Aggregate)),
+            _push_filter_through_aggregate,
+        ),
+        Rule(
+            "RemoveRedundantSort",
+            P(N.Aggregate, N.Distinct).child(P(N.Sort)),
+            _remove_redundant_sort,
         ),
     ]
 

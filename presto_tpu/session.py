@@ -63,6 +63,7 @@ SESSION_PROPERTIES = {
     "memory_budget": int,         # device-memory budget (bytes)
     "query_priority": int,        # resource-group query_priority policy
     "pallas_groupby": _parse_bool,  # small-G aggregation via the Pallas kernel
+    "matmul_groupby": _parse_bool,  # dense-key aggregation via MXU matmuls
 }
 
 
@@ -102,6 +103,7 @@ class Session:
         access_control=None,
         user: str = "user",
         pallas_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
+        matmul_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
         exchange_budget=None,  # per-shard bytes for exchanged joins
     ):
         self.access_control = access_control
@@ -127,9 +129,12 @@ class Session:
         self.batch_rows = batch_rows
         self.memory_budget = memory_budget
         self.pallas_groupby = pallas_groupby
+        self.matmul_groupby = matmul_groupby
         local = getattr(self.executor, "local", self.executor)
         if pallas_groupby is not None and hasattr(local, "pallas_groupby"):
             local.pallas_groupby = pallas_groupby
+        if matmul_groupby is not None and hasattr(local, "matmul_groupby"):
+            local.matmul_groupby = matmul_groupby
         # statement-layer state (shared BY REFERENCE with derived
         # property-override sessions, see with_properties)
         self.views: dict = {}  # name -> view query SQL
@@ -181,6 +186,9 @@ class Session:
                 user=self.user,
                 pallas_groupby=engine.get(
                     "pallas_groupby", self.pallas_groupby
+                ),
+                matmul_groupby=engine.get(
+                    "matmul_groupby", self.matmul_groupby
                 ),
             )
             # statement-layer state is session-wide, not per-override

@@ -607,6 +607,14 @@ class Planner:
         if len(parts) == 1:
             raise PlanningError(f"unknown table {name!r}")
         cat_name = str(getattr(self.catalog, "name", "")).lower()
+        # a catalog store mounts members as dotted `<catalog>.<table>`
+        # names; collapse the implicit `default` schema against those
+        if (
+            len(parts) == 3
+            and parts[1] == "default"
+            and f"{parts[0]}.{parts[2]}" in known
+        ):
+            return f"{parts[0]}.{parts[2]}"
         if len(parts) == 3 and parts[0] != cat_name:
             raise PlanningError(
                 f"unknown catalog {parts[0]!r} (session catalog is "

@@ -231,3 +231,102 @@ def test_distinct_over_aggregate_removed():
     )
     out = rewrite(N.Distinct(agg))
     assert_plan(out, (N.Aggregate, (N.TableScan,)))
+
+
+def scan2(name, *cols_):
+    return N.TableScan(
+        name, name, tuple((c, c, T.BIGINT) for c in cols_)
+    )
+
+
+def test_push_filter_through_join_inner():
+    j = N.Join("inner", scan2("l", "a"), scan2("r", "b"), (A,), (B,))
+    f = N.Filter(
+        j,
+        ir.and_(
+            ir.Call("gt", (A, lit(1)), T.BOOLEAN),
+            ir.Call("lt", (B, lit(9)), T.BOOLEAN),
+        ),
+    )
+    out = rewrite(f)
+    # both single-side conjuncts move below the join
+    assert_plan(
+        out,
+        (N.Join, (N.Filter, (N.TableScan,)), (N.Filter, (N.TableScan,))),
+    )
+
+
+def test_push_filter_through_left_join_probe_side_only():
+    j = N.Join("left", scan2("l", "a"), scan2("r", "b"), (A,), (B,))
+    f = N.Filter(
+        j,
+        ir.and_(
+            ir.Call("gt", (A, lit(1)), T.BOOLEAN),
+            ir.Call("lt", (B, lit(9)), T.BOOLEAN),
+        ),
+    )
+    out = rewrite(f)
+    # the right-side (null-extended) conjunct must STAY above the join
+    assert_plan(
+        out,
+        (N.Filter, (N.Join, (N.Filter, (N.TableScan,)), (N.TableScan,))),
+    )
+
+
+def test_push_filter_through_union():
+    u = N.Union((scan("a"), scan("a")))
+    f = N.Filter(u, ir.Call("gt", (A, lit(3)), T.BOOLEAN))
+    out = rewrite(f)
+    assert_plan(
+        out, (N.Union, (N.Filter, (N.TableScan,)), (N.Filter, (N.TableScan,)))
+    )
+
+
+def test_push_filter_through_aggregate_group_keys():
+    from presto_tpu.ops.aggregate import AggSpec
+
+    a = N.Aggregate(
+        scan("a", "b"),
+        (A,),
+        ("g",),
+        (AggSpec("sum", B, "s", T.BIGINT),),
+    )
+    # g > 2 references only the group key -> rows filter below the agg;
+    # s > 5 is a real HAVING on an aggregate -> stays above
+    f = N.Filter(
+        a,
+        ir.and_(
+            ir.Call("gt", (col("g", T.BIGINT), lit(2)), T.BOOLEAN),
+            ir.Call("gt", (col("s", T.BIGINT), lit(5)), T.BOOLEAN),
+        ),
+    )
+    out = rewrite(f)
+    assert_plan(
+        out, (N.Filter, (N.Aggregate, (N.Filter, (N.TableScan,))))
+    )
+    # pushed conjunct now references the child column `a`
+    refs = set()
+    from presto_tpu.plan.rules import _refs
+
+    _refs(out.child.child.predicate, refs)
+    assert refs == {"a"}
+
+
+def test_remove_redundant_sort_under_aggregate_and_distinct():
+    from presto_tpu.ops.aggregate import AggSpec
+
+    srt = N.Sort(scan("a", "b"), (SortKey(A),))
+    agg = N.Aggregate(srt, (A,), ("g",), (AggSpec("sum", B, "s", T.BIGINT),))
+    assert_plan(rewrite(agg), (N.Aggregate, (N.TableScan,)))
+    assert_plan(
+        rewrite(N.Distinct(N.Sort(scan("a"), (SortKey(A),)))),
+        (N.Distinct, (N.TableScan,)),
+    )
+    # order-sensitive aggregate keeps its sort
+    agg2 = N.Aggregate(
+        N.Sort(scan("a", "b"), (SortKey(A),)),
+        (A,),
+        ("g",),
+        (AggSpec("array_agg", B, "s", T.ArrayType(T.BIGINT)),),
+    )
+    assert_plan(rewrite(agg2), (N.Aggregate, (N.Sort, (N.TableScan,))))
