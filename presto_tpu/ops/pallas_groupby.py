@@ -224,19 +224,27 @@ def maybe_grouped_aggregate(
             return None
         ins.append(v)
 
-    # dense mixed-radix group id + overall liveness
+    # dense mixed-radix group id. NULL keys form their OWN group (SQL
+    # GROUP BY semantics — dropping them was a silent wrong-result on
+    # the default-on TPU path): each nullable key gets one extra slot.
     from .aggregate import _masked_live
 
     live = _masked_live(page, pre_mask)
     gid = jnp.zeros(page.capacity, jnp.int32)
+    eff_domains: List[int] = []
     for v, d in zip(keys, domains):
-        code = v.data.astype(jnp.int32)
-        gid = gid * d + jnp.clip(code, 0, d - 1)
+        code = jnp.clip(v.data.astype(jnp.int32), 0, d - 1)
+        eff = d
         if v.valid is not None:
-            live = live & v.valid
+            code = jnp.where(v.valid, code, d)  # null slot = last
+            eff = d + 1
+        gid = gid * eff + code
+        eff_domains.append(eff)
     G = 1
-    for d in domains:
+    for d in eff_domains:
         G *= d
+    if G > PALLAS_MAX_GROUPS:
+        return None
 
     # channel plan: (agg index, role, limb index, reduce kind)
     channels: List = []
@@ -345,16 +353,22 @@ def maybe_grouped_aggregate(
     counts_live = None
     out_blocks: List[Block] = []
     out_names: List[str] = []
-    # group key columns from the dense gid (mixed radix decode)
+    # group key columns from the dense gid (mixed radix decode over the
+    # EFFECTIVE domains; a nullable key's last slot decodes to NULL)
     grange = jnp.arange(G, dtype=jnp.int32)
     rem = grange
     key_codes = []
-    for d in reversed(domains):
+    for d in reversed(eff_domains):
         key_codes.append(rem % d)
         rem = rem // d
     key_codes = list(reversed(key_codes))
-    for v, nm, code in zip(keys, group_names, key_codes):
-        out_blocks.append(Block(code, v.type, None, v.dict_id))
+    for v, nm, code, d, eff in zip(
+        keys, group_names, key_codes, domains, eff_domains
+    ):
+        valid = (code < d) if eff != d else None
+        out_blocks.append(
+            Block(jnp.clip(code, 0, d - 1), v.type, valid, v.dict_id)
+        )
         out_names.append(nm)
 
     # rows-per-group (for empty-group compaction): any count channel, else

@@ -95,6 +95,35 @@ def test_pallas_groupby_min_max_and_empty_group():
     assert pal == ref
 
 
+def test_pallas_groupby_null_key_group():
+    """A NULL group key forms its own group (SQL GROUP BY) — the kernel
+    path must not silently drop those rows (round-5 regression: `live`
+    used to AND away null keys)."""
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.page import Block, Page
+    from presto_tpu.session import Session
+
+    fb = Block.from_numpy(
+        np.array([0, 1, 0, 1, 0], np.int32), T.VARCHAR,
+        valid=np.array([True, True, False, True, True]),
+        dictionary=("A", "B"),
+    )
+    vb = Block.from_numpy(np.array([1, 2, 4, 8, 16], np.int64), T.BIGINT)
+    cat = MemoryCatalog({"t": Page.from_blocks([fb, vb], ["f", "v"])})
+    sql = "select f, sum(v) s, count(*) c from t group by f"
+    ref = sorted(
+        Session(cat, pallas_groupby=False).query(sql).rows(), key=str
+    )
+    pal = sorted(
+        Session(cat, pallas_groupby=True).query(sql).rows(), key=str
+    )
+    assert ref == pal
+    assert (None, 4, 1) in pal
+
+
 def test_pallas_groupby_auto_default_off_on_cpu():
     """pallas_groupby=None resolves to the backend default at first
     aggregation: False on CPU (interpret would crawl), True on TPU."""
