@@ -1,0 +1,42 @@
+#!/bin/bash
+# Runs AFTER tools_tpu_watch.sh succeeds (fresh TPU_BENCH.json): the
+# round-5 on-chip follow-up queue, strictly serial so no two processes
+# ever share the tunnel:
+#   1. join-stage profile (bucket directory vs searchsorted A/B)
+#   2. micro suite at SF1 (incl. agg_matmul vs agg_sorted and pallas A/B)
+#   3. hand Q1/Q6 at SF10 (scale evidence, still device-generated)
+# Everything appends JSON lines to TPU_FOLLOWUP.jsonl (committed later).
+cd /root/repo || exit 1
+LOG=/tmp/tpu_followup.log
+OUT=TPU_FOLLOWUP.jsonl
+echo "$(date -u +%FT%TZ) followup start" >> $LOG
+
+run() {  # run <tag> <timeout_s> <cmd...>
+  tag=$1; to=$2; shift 2
+  echo "$(date -u +%FT%TZ) [$tag] start" >> $LOG
+  res=$(timeout "$to" "$@" 2>>$LOG | grep -E '^\{' | tail -1)
+  if [ -n "$res" ]; then
+    echo "{\"stage\": \"$tag\", \"at\": \"$(date -u +%FT%TZ)\", \"result\": $res}" >> $OUT
+    echo "$(date -u +%FT%TZ) [$tag] ok" >> $LOG
+  else
+    echo "{\"stage\": \"$tag\", \"at\": \"$(date -u +%FT%TZ)\", \"result\": null}" >> $OUT
+    echo "$(date -u +%FT%TZ) [$tag] no result" >> $LOG
+  fi
+  # tunnel liveness gate between stages; abort the queue if wedged
+  timeout 120 python -c "import jax; jax.devices(); import jax.numpy as j; j.ones(8).block_until_ready()" >/dev/null 2>&1 || {
+    echo "$(date -u +%FT%TZ) tunnel dead after [$tag] - stopping" >> $LOG
+    exit 1
+  }
+}
+
+run join_profile 1800 python -m presto_tpu.benchmark.profile_join --sf 0.1
+# micro prints indented JSON: capture via --out, record the path
+echo "$(date -u +%FT%TZ) [micro_sf1] start" >> $LOG
+timeout 3600 python -m presto_tpu.benchmark.micro --sf 1 --runs 3 \
+  --out TPU_MICRO_SF1.json >> $LOG 2>&1 \
+  && echo "{\"stage\": \"micro_sf1\", \"at\": \"$(date -u +%FT%TZ)\", \"result\": \"TPU_MICRO_SF1.json\"}" >> $OUT
+timeout 120 python -c "import jax; jax.devices(); import jax.numpy as j; j.ones(8).block_until_ready()" >/dev/null 2>&1 || exit 1
+# SF10 scale run writes its own artifact, never clobbering the SF1 one
+BENCH_SF=10 BENCH_MICRO=0 BENCH_ARTIFACT=TPU_BENCH_SF10.json \
+  run bench_sf10 3600 python bench.py
+echo "$(date -u +%FT%TZ) followup done" >> $LOG
