@@ -291,15 +291,20 @@ def bench_agg_matmul(sf: float) -> Bench:
         AggSpec("count_star", None, "c", T.BIGINT),
     )
     gexprs = (col("l_suppkey", T.BIGINT),)
-    probe = maybe_matmul_grouped_aggregate(
-        page, gexprs, ("l_suppkey",), aggs, None
-    )
-    if probe is None:  # NDV beyond the dense budget at this sf
+    from ..ops.matmul_agg import plan_matmul_grouped_aggregate
+
+    # plan on the host (min/max sync), execute traced under jit
+    plan = plan_matmul_grouped_aggregate(page, gexprs, aggs, None)
+    if plan is None:  # NDV beyond the dense budget at this sf
         raise RuntimeError(f"ineligible at sf={sf} (NDV > dense budget)")
+    probe = maybe_matmul_grouped_aggregate(
+        page, gexprs, ("l_suppkey",), aggs, None, plan=plan
+    )
 
     def step(acc, p):
         out = maybe_matmul_grouped_aggregate(
-            _chained_page(p, acc), gexprs, ("l_suppkey",), aggs, None
+            _chained_page(p, acc), gexprs, ("l_suppkey",), aggs, None,
+            plan=plan,
         )
         return _consume(out)
 

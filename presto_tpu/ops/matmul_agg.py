@@ -109,27 +109,32 @@ def grouped_matmul_partials(gid, channels, G: int):
     return out
 
 
-def _eligible_keys(page: Page, group_exprs, live):
-    """Evaluate group keys and assign dense codes.
+def plan_matmul_grouped_aggregate(page: Page, group_exprs, aggs, pre_mask):
+    """HOST side of eligibility: decide dense domains/bases, syncing key
+    min/max where needed. Must run EAGERLY (outside jit) — the resulting
+    plan (all python ints) is static, so `_apply` below is traceable.
 
-    Returns (keys, codes, domains, bases) or None. `bases[i]` is the
-    value the code was rebased by for integer keys (None otherwise);
-    NULL adds one extra slot per nullable key (code == domain-1)."""
-    keys, codes, domains, bases = [], [], [], []
+    Plan = (domains, bases): `bases[i]` is the rebase value for integer
+    keys (None otherwise); NULL adds one extra slot per nullable key."""
+    if not group_exprs:
+        return None
+    if any(a.func not in _SUPPORTED for a in aggs):
+        return None
+    from .aggregate import _masked_live
+
+    live = _masked_live(page, pre_mask)
+    domains, bases = [], []
     for e in group_exprs:
         v = evaluate(e, page)
         base = None
         if isinstance(v.type, T.VarcharType) and v.dictionary is not None:
             d = max(len(v.dictionary), 1)
-            code = v.data.astype(jnp.int32)
         elif isinstance(v.type, T.BooleanType):
             d = 2
-            code = v.data.astype(jnp.int32)
         elif v.data.ndim == 1 and jnp.issubdtype(v.data.dtype, jnp.integer):
             ok = live if v.valid is None else (live & v.valid)
-            any_live = bool(jnp.any(ok))
-            if not any_live:
-                d, code = 1, jnp.zeros(page.capacity, jnp.int32)
+            if not bool(jnp.any(ok)):
+                d = 1
             else:
                 big = jnp.iinfo(jnp.int64)
                 data = v.data.astype(jnp.int64)
@@ -140,16 +145,12 @@ def _eligible_keys(page: Page, group_exprs, live):
                     return None
                 d = int(span)
                 base = mn
-                code = (data - mn).astype(jnp.int32)
         else:
             return None
         if v.valid is not None:  # NULL keys get their own group slot
-            code = jnp.where(v.valid, code, d)
             d += 1
         if d > MATMUL_MAX_GROUPS:
             return None
-        keys.append(v)
-        codes.append(jnp.clip(code, 0, d - 1))
         domains.append(d)
         bases.append(base)
     total = 1
@@ -157,25 +158,47 @@ def _eligible_keys(page: Page, group_exprs, live):
         total *= d
     if not 0 < total <= MATMUL_MAX_GROUPS:
         return None
-    return keys, codes, domains, bases
+    return tuple(domains), tuple(bases)
+
+
+def _key_codes(page: Page, group_exprs, plan):
+    """Traceable re-evaluation of keys -> dense codes under a static plan."""
+    domains, bases = plan
+    keys, codes = [], []
+    for e, d, base in zip(group_exprs, domains, bases):
+        v = evaluate(e, page)
+        d_data = d - (1 if v.valid is not None else 0)  # non-null slots
+        if base is not None:
+            code = (v.data.astype(jnp.int64) - base).astype(jnp.int32)
+        else:
+            code = v.data.astype(jnp.int32)
+        code = jnp.clip(code, 0, max(d_data - 1, 0))
+        if v.valid is not None:
+            code = jnp.where(v.valid, code, d - 1)  # null slot = last
+        keys.append(v)
+        codes.append(code)
+    return keys, codes
 
 
 def maybe_matmul_grouped_aggregate(
-    page: Page, group_exprs, group_names, aggs: Sequence[AggSpec], pre_mask
+    page: Page, group_exprs, group_names, aggs: Sequence[AggSpec], pre_mask,
+    plan=None,
 ) -> Optional[Page]:
     """Route an eligible aggregation through the MXU path; None when not
-    eligible (caller falls back to the sort strategy)."""
-    if not group_exprs:
-        return None
-    if any(a.func not in _SUPPORTED for a in aggs):
+    eligible (caller falls back to the sort strategy). Pass a
+    pre-computed `plan` (plan_matmul_grouped_aggregate) to make this
+    call fully traceable under jit."""
+    if plan is None:
+        plan = plan_matmul_grouped_aggregate(
+            page, group_exprs, aggs, pre_mask
+        )
+    if plan is None:
         return None
     from .aggregate import _masked_live
 
     live = _masked_live(page, pre_mask)
-    elig = _eligible_keys(page, group_exprs, live)
-    if elig is None:
-        return None
-    keys, codes, domains, bases = elig
+    keys, codes = _key_codes(page, group_exprs, plan)
+    domains, bases = plan
     ins = []
     for a in aggs:
         if a.input is None:
