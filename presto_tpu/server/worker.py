@@ -323,11 +323,17 @@ class WorkerServer:
 
     def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0,
                  memory_limit: Optional[int] = None,
-                 buffer_bound: Optional[int] = 32 << 20):
+                 buffer_bound: Optional[int] = 32 << 20,
+                 task_concurrency: int = 2):
+        from ..exec.taskqueue import MultilevelScheduler
+
         self.catalog = catalog
         self.tasks: Dict[str, TaskState] = {}
         self.pool = WorkerMemoryPool(memory_limit)
         self.buffer_bound = buffer_bound
+        # multilevel feedback gate over per-batch quanta (reference
+        # TaskExecutor + MultilevelSplitQueue)
+        self.scheduler = MultilevelScheduler(task_concurrency)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -512,8 +518,17 @@ class WorkerServer:
             # page-at-a-time into the bounded buffers: put() applies
             # backpressure when the consumer lags past the bound; pages
             # bigger than the bound split into row slices first
-            # (reference PageSplitterUtil)
-            for page in ex.stream(fragment):
+            # (reference PageSplitterUtil). Each batch passes through the
+            # multilevel scheduler gate (exec/taskqueue.py) so a fresh
+            # query's quanta preempt a long-running one BETWEEN batches;
+            # buffer emission stays outside the quantum — blocking on a
+            # slow consumer must not hold an execution slot.
+            stream_iter = iter(ex.stream(fragment))
+            while True:
+                with self.scheduler.quantum(state.query_id):
+                    page = next(stream_iter, None)
+                if page is None:
+                    break
                 for piece in _split_to_bound(page, bound):
                     if keys is not None:
                         parts = _hash_partition(piece, keys, nparts)
