@@ -20,6 +20,12 @@ from .spi import Connector
 
 QUERIES = "system.runtime.queries"
 NODES = "system.runtime.nodes"
+# jmx-analog runtime metrics (reference presto-jmx connector exposing
+# the JVM's Runtime/Memory/OperatingSystem MBeans as tables): the
+# process table is this interpreter's runtime MBean, the memory table
+# the device/host pool gauges a JVM would publish per memory pool
+JMX_PROCESS = "system.jmx.process"
+JMX_MEMORY = "system.jmx.memory"
 
 
 def _varchar(values: List[Optional[str]]) -> Block:
@@ -86,6 +92,88 @@ def _nodes_page(node_manager, self_uri: Optional[str]) -> Page:
     )
 
 
+def _process_page() -> Page:
+    import os
+    import resource
+    import threading
+    import time as _t
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    import jax
+
+    backend = jax.default_backend()
+    return Page.from_dict(
+        {
+            "pid": (np.array([os.getpid()], np.int64), T.BIGINT),
+            "rss_bytes": (
+                np.array([ru.ru_maxrss * 1024], np.int64), T.BIGINT,
+            ),
+            "user_time_s": (
+                np.array([ru.ru_utime], np.float64), T.DOUBLE,
+            ),
+            "system_time_s": (
+                np.array([ru.ru_stime], np.float64), T.DOUBLE,
+            ),
+            "threads": (
+                np.array([threading.active_count()], np.int64), T.BIGINT,
+            ),
+            "backend": _varchar([backend]),
+            "devices": (
+                np.array([len(jax.devices())], np.int64), T.BIGINT,
+            ),
+            "uptime_hint_s": (
+                np.array([_t.process_time()], np.float64), T.DOUBLE,
+            ),
+        }
+    )
+
+
+def _memory_page(memory_manager, node_manager) -> Page:
+    """One row per known memory pool: the coordinator's cluster view
+    (worker /v1/memory polls) or, standalone, this process's pool."""
+    rows = []
+    snap = None
+    if memory_manager is not None:
+        snap = getattr(memory_manager, "last_snapshot", None)
+    if snap:
+        for uri, info in snap.items():
+            rows.append(
+                (
+                    uri,
+                    int(info.get("reserved", 0)),
+                    int(info.get("limit", 0) or 0),
+                    int(info.get("blocked", 0)),
+                )
+            )
+    if not rows:
+        rows.append(("local", 0, 0, 0))
+    return Page.from_dict(
+        {
+            "pool": _varchar([r[0] for r in rows]),
+            "reserved_bytes": (
+                np.array([r[1] for r in rows], np.int64), T.BIGINT,
+            ),
+            "max_bytes": (
+                np.array([r[2] for r in rows], np.int64), T.BIGINT,
+            ),
+            "blocked": (
+                np.array([r[3] for r in rows], np.int64), T.BIGINT,
+            ),
+        }
+    )
+
+
+_JMX_PROCESS_SCHEMA: Dict[str, T.Type] = {
+    "pid": T.BIGINT, "rss_bytes": T.BIGINT, "user_time_s": T.DOUBLE,
+    "system_time_s": T.DOUBLE, "threads": T.BIGINT, "backend": T.VARCHAR,
+    "devices": T.BIGINT, "uptime_hint_s": T.DOUBLE,
+}
+_JMX_MEMORY_SCHEMA: Dict[str, T.Type] = {
+    "pool": T.VARCHAR, "reserved_bytes": T.BIGINT, "max_bytes": T.BIGINT,
+    "blocked": T.BIGINT,
+}
+
+
 _QUERIES_SCHEMA: Dict[str, T.Type] = {
     "query_id": T.VARCHAR, "state": T.VARCHAR, "user": T.VARCHAR,
     "source": T.VARCHAR, "query": T.VARCHAR, "elapsed_s": T.DOUBLE,
@@ -103,11 +191,12 @@ class SystemCatalog(Connector):
     session, whose catalog is this object)."""
 
     def __init__(self, wrapped, manager=None, node_manager=None,
-                 self_uri: Optional[str] = None):
+                 self_uri: Optional[str] = None, memory_manager=None):
         self.wrapped = wrapped
         self.manager = manager
         self.node_manager = node_manager
         self.self_uri = self_uri
+        self.memory_manager = memory_manager
 
     @property
     def name(self):
@@ -115,25 +204,31 @@ class SystemCatalog(Connector):
 
     # -- metadata --
 
+    _SYSTEM_TABLES = (QUERIES, NODES, JMX_PROCESS, JMX_MEMORY)
+
     def table_names(self) -> List[str]:
-        return list(self.wrapped.table_names()) + [QUERIES, NODES]
+        return list(self.wrapped.table_names()) + list(self._SYSTEM_TABLES)
 
     def schema(self, table: str):
         if table == QUERIES:
             return dict(_QUERIES_SCHEMA)
         if table == NODES:
             return dict(_NODES_SCHEMA)
+        if table == JMX_PROCESS:
+            return dict(_JMX_PROCESS_SCHEMA)
+        if table == JMX_MEMORY:
+            return dict(_JMX_MEMORY_SCHEMA)
         return self.wrapped.schema(table)
 
     def row_count(self, table: str) -> int:
         if table == QUERIES:
             return len(self.manager.list_queries()) if self.manager else 0
-        if table == NODES:
+        if table in (NODES, JMX_PROCESS, JMX_MEMORY):
             return 1
         return self.wrapped.row_count(table)
 
     def unique_columns(self, table: str):
-        if table in (QUERIES, NODES):
+        if table in self._SYSTEM_TABLES:
             return []
         return self.wrapped.unique_columns(table)
 
@@ -144,16 +239,20 @@ class SystemCatalog(Connector):
             return _queries_page(self.manager)
         if table == NODES:
             return _nodes_page(self.node_manager, self.self_uri)
+        if table == JMX_PROCESS:
+            return _process_page()
+        if table == JMX_MEMORY:
+            return _memory_page(self.memory_manager, self.node_manager)
         return self.wrapped.page(table)
 
     def exact_row_count(self, table: str) -> int:
-        if table in (QUERIES, NODES):
+        if table in self._SYSTEM_TABLES:
             return int(self.page(table).count)
         return self.wrapped.exact_row_count(table)
 
     def scan(self, table: str, start: int, stop: int, pad_to=None,
              columns=None, predicate=None) -> Page:
-        if table in (QUERIES, NODES):
+        if table in self._SYSTEM_TABLES:
             return Connector.scan(
                 self, table, start, stop, pad_to=pad_to, columns=columns
             )

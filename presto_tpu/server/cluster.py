@@ -351,6 +351,15 @@ class ClusterMemoryManager:
                     states.append((uri, json.loads(resp.read())))
             except Exception:  # noqa: BLE001 - failure detector's job
                 continue
+        # live gauge snapshot for system.jmx.memory
+        self.last_snapshot = {
+            uri: {
+                "reserved": int(st.get("reserved") or 0),
+                "limit": st.get("limit") or 0,
+                "blocked": len(st.get("blocked") or ()),
+            }
+            for uri, st in states
+        }
         blocked = any(st.get("blocked") for _, st in states)
         if not blocked:
             self._blocked_streak = 0

@@ -317,3 +317,40 @@ def test_qualified_table_names():
         sess.query("select a from memory.other.t")
     with pytest.raises(Exception, match="unknown table"):
         sess.query("select a from default.nope")
+
+
+def test_system_jmx_tables():
+    """jmx-analog runtime metrics (reference presto-jmx connector): the
+    process MBean row and memory pool gauges are queryable SQL tables."""
+    from presto_tpu.connectors.system import SystemCatalog
+
+    class FakeMemMgr:
+        last_snapshot = {
+            "http://w1": {"reserved": 1024, "limit": 4096, "blocked": 1},
+            "http://w2": {"reserved": 0, "limit": 4096, "blocked": 0},
+        }
+
+    syscat = SystemCatalog(MemoryCatalog({}), memory_manager=FakeMemMgr())
+    s = Session(syscat)
+    rows = s.query(
+        "select pid, rss_bytes, threads, backend, devices "
+        "from system.jmx.process"
+    ).rows()
+    assert len(rows) == 1
+    pid, rss, threads, backend, devices = rows[0]
+    assert pid > 0 and rss > 0 and threads >= 1 and devices >= 1
+    assert backend in ("cpu", "tpu")
+
+    mem = s.query(
+        "select pool, reserved_bytes, max_bytes, blocked "
+        "from system.jmx.memory order by pool"
+    ).rows()
+    assert mem == [
+        ("http://w1", 1024, 4096, 1),
+        ("http://w2", 0, 4096, 0),
+    ]
+    # joins/aggregations over jmx tables run through the normal engine
+    agg = s.query(
+        "select sum(reserved_bytes) from system.jmx.memory"
+    ).rows()
+    assert agg == [(1024,)]
