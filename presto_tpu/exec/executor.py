@@ -216,7 +216,9 @@ class Executor:
                     node.mask,
                 )
             except Exception:
-                self.matmul_groupby = False
+                # fall back for THIS aggregation only — the matmul path
+                # is plain XLA, so a failure is shape-specific, unlike a
+                # Mosaic compile failure (which disables pallas above)
                 out = None
             if out is not None:
                 return self._shrink(out)

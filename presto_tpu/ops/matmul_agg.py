@@ -236,7 +236,10 @@ def maybe_matmul_grouped_aggregate(
     if len(channels) > MAX_CHANNELS:
         return None
 
-    s = grouped_matmul_partials(gid, channels, G)
+    if channels:
+        s = grouped_matmul_partials(gid, channels, G)
+    else:  # pure GROUP BY / DISTINCT: occupancy only, no dot needed
+        s = jnp.zeros((G, 0), jnp.int64)
 
     def sum_of(base):
         return _recombine(s, base) - _recombine(s, base + N_LIMBS)

@@ -116,3 +116,15 @@ def test_matmul_groupby_session_property_end_to_end():
     s = Session(cat)
     s.query(sql)
     assert s.executor.matmul_groupby is False
+
+
+def test_matmul_agg_pure_group_by_no_aggs():
+    """GROUP BY with no aggregates (and DISTINCT): occupancy-only path,
+    no dot products — must run through the MXU strategy, not crash into
+    the executor fallback."""
+    page = Page.from_dict({"k": np.array([3, 1, 3, 2, 1], np.int64)})
+    out = maybe_matmul_grouped_aggregate(
+        page, (col("k", T.BIGINT),), ("k",), (), None
+    )
+    assert out is not None
+    assert sorted(r[0] for r in out.to_pylist()) == [1, 2, 3]
