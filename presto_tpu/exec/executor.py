@@ -175,6 +175,13 @@ class Executor:
         blocks = tuple(page.block(c) for c in node.channels)
         return Page(blocks, tuple(node.titles), page.count)
 
+    def _strategy_note(self, node, name: str) -> None:
+        """Record which aggregation strategy ran (EXPLAIN ANALYZE
+        surfaces it — the 4-strategy choice is the engine's hottest
+        decision and should be observable, not guessed)."""
+        if self.collector is not None:
+            self.collector.stats_for(node).detail = f"strategy={name}"
+
     # -- aggregation --
     def _exec_aggregate(self, node: N.Aggregate, page: Page) -> Page:
         if not node.group_exprs:
@@ -202,6 +209,7 @@ class Executor:
                 self.pallas_groupby = False
                 out = None
             if out is not None:
+                self._strategy_note(node, "pallas")
                 return self._shrink(out)
         if self.matmul_groupby is None:
             import jax
@@ -221,7 +229,9 @@ class Executor:
                 # Mosaic compile failure (which disables pallas above)
                 out = None
             if out is not None:
+                self._strategy_note(node, "mxu-matmul")
                 return self._shrink(out)
+        self._strategy_note(node, "hash-sort")
         # groups <= live rows; guess low and retry with the true group count
         # (returned regardless of the bound) on overflow — the adaptive-
         # capacity pattern used by all static-shape operators here

@@ -912,6 +912,13 @@ class Session:
             )
         else:
             ex = Executor(self.catalog, collector=collector)
+        # profile with the session's strategy overrides (pallas/matmul
+        # group-by), matching the executor the session actually runs
+        local = getattr(ex, "local", ex)
+        if self.pallas_groupby is not None and hasattr(local, "pallas_groupby"):
+            local.pallas_groupby = self.pallas_groupby
+        if self.matmul_groupby is not None and hasattr(local, "matmul_groupby"):
+            local.matmul_groupby = self.matmul_groupby
         ex.run(node)
         tree = N.plan_tree_str(node, collector=collector)
         total_ms = collector.total_wall_s() * 1e3
