@@ -337,6 +337,12 @@ def main():
             qp_s = _chained_device_time(jax, q1_local_pallas, page, "l_quantity", RUNS)
             details["q1_pallas_ms"] = round(qp_s * 1e3, 2)
             details["q1_pallas_rows_per_s"] = round(n_rows / qp_s)
+            # both paths compute exact Q1 end-to-end; the headline is the
+            # engine's best path (the reference's hand-coded benchmark
+            # likewise reports its fastest implementation)
+            if qp_s < q1_s:
+                rows_per_s = n_rows / qp_s
+                details["headline_path"] = "pallas_single_pass"
         except Exception as e:  # noqa: BLE001
             details["q1_pallas_error"] = repr(e)[:300]
         persist()
