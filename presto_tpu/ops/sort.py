@@ -53,17 +53,25 @@ def asc_normalized_scalar_key(data, ascending: bool):
 
 
 def _float_total_order(x):
-    """IEEE-754 total-order integer key for a float array (sign-magnitude
-    to two's-complement): preserves numeric order, gives NaNs a stable
-    place at the extremes instead of comparator-dependent behavior."""
+    """Total-order integer key for a float array matching jnp.argsort's
+    semantics exactly (the pre-fused-sort behavior): -0.0 ties +0.0 and
+    NaNs compare ABOVE +inf (so they land last in ascending order; the
+    caller re-forces them last after any descending flip)."""
     import jax
 
     wide = x.dtype == jnp.float64
     it = jnp.int64 if wide else jnp.int32
+    x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)  # -0.0 ties +0.0
     bits = jax.lax.bitcast_convert_type(x, it)
-    sign = bits >> (63 if wide else 31)  # arithmetic: -1 if negative
     top = it(-(1 << 63)) if wide else it(-(1 << 31))  # INT_MIN bit pattern
-    return bits ^ (sign | top)
+    # SIGNED-comparison total order (lax.sort compares keys as signed):
+    # positive floats keep their bit pattern (already ascending, >= 0);
+    # negative floats map to ~bits ^ top = -1 - magnitude (< 0, ascending
+    # with the float value). The unsigned-classic `bits ^ (sign | top)`
+    # would invert the two sign classes under signed comparison.
+    key = jnp.where(bits < 0, (~bits) ^ top, bits)
+    # pin ALL NaNs (either sign) above every real value
+    return jnp.where(jnp.isnan(x), it(jnp.iinfo(it).max), key)
 
 
 def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
@@ -98,8 +106,17 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
             ops.extend([hi, lo])
             continue
         if jnp.issubdtype(data.dtype, jnp.floating):
-            data = _float_total_order(data)
-        elif jnp.issubdtype(data.dtype, jnp.bool_):
+            raw = data
+            data = _float_total_order(raw)
+            if not k.ascending:
+                data = ~data
+            # jnp.argsort parity: NaNs sort LAST in both directions
+            data = jnp.where(
+                jnp.isnan(raw), jnp.iinfo(data.dtype).max, data
+            )
+            ops.append(data)
+            continue
+        if jnp.issubdtype(data.dtype, jnp.bool_):
             data = data.astype(jnp.int8)
         if not k.ascending:
             data = ~data.astype(data.dtype)

@@ -316,3 +316,28 @@ def test_join_bucket_directory_stress():
         (int(k), w) for k in pk[:50].tolist() for w in bw_by_k.get(k, [])
     )
     assert got == want
+
+
+def test_sort_float_signs_nans_negzero():
+    """Fused-sort float key regression (TPC-DS 47/57/89 round-5): keys
+    are compared SIGNED, so negatives must map below positives; NaNs
+    sort last in BOTH directions (jnp.argsort parity); -0.0 ties +0.0
+    (stable: original order preserved among the tie)."""
+    from presto_tpu.ops.sort import SortKey, sort_page
+
+    vals = np.array(
+        [21.2, -73.85, float("nan"), 0.0, -0.0, float("inf"),
+         -float("inf"), 1e-300, -1e-300], np.float64
+    )
+    tag = np.arange(len(vals), dtype=np.int64)
+    page = Page.from_dict({"v": vals, "t": tag})
+    asc = sort_page(page, (SortKey(col("v", T.DOUBLE)),)).to_pylist()
+    got = [r[1] for r in asc]
+    # -inf, -73.85, -1e-300, 0.0(idx3), -0.0(idx4), 1e-300, 21.2, inf, nan
+    assert got == [6, 1, 8, 3, 4, 7, 0, 5, 2]
+    desc = sort_page(
+        page, (SortKey(col("v", T.DOUBLE), ascending=False),)
+    ).to_pylist()
+    got_d = [r[1] for r in desc]
+    assert got_d[-1] == 2  # NaN still last under DESC
+    assert got_d[:3] == [5, 0, 7]  # inf, 21.2, 1e-300
