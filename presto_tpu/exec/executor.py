@@ -272,6 +272,28 @@ class Executor:
         return self._shrink(out)
 
     def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:
+        if self.matmul_groupby is None:
+            self.matmul_groupby = jax.default_backend() == "tpu"
+        if self.matmul_groupby:
+            # DISTINCT over dense keys = the MXU strategy's occupancy-only
+            # shape (no channels, no dot) — skips the full hash-sort
+            from ..expr.ir import ColumnRef
+            from ..ops.matmul_agg import maybe_matmul_grouped_aggregate
+
+            exprs = tuple(
+                ColumnRef(n, b.type)
+                for n, b in zip(page.names, page.blocks)
+            )
+            try:
+                out = maybe_matmul_grouped_aggregate(
+                    page, exprs, page.names, (), None
+                )
+            except Exception:
+                out = None
+            if out is not None:
+                self._strategy_note(node, "mxu-occupancy")
+                return self._shrink(out)
+        self._strategy_note(node, "hash-sort")
         fn = self._kernel(node, lambda: lambda p: distinct_page(p, p.capacity))
         return self._shrink(fn(page))
 

@@ -128,3 +128,18 @@ def test_matmul_agg_pure_group_by_no_aggs():
     )
     assert out is not None
     assert sorted(r[0] for r in out.to_pylist()) == [1, 2, 3]
+
+
+def test_distinct_routes_through_occupancy_path():
+    rng = np.random.default_rng(4)
+    k = rng.integers(0, 500, 3000)
+    j = rng.integers(0, 4, 3000)
+    cat = MemoryCatalog(
+        {"t": Page.from_dict(
+            {"k": k.astype(np.int64), "j": j.astype(np.int64)}
+        )}
+    )
+    sql = "select distinct k, j from t order by k, j"
+    ref = Session(cat, matmul_groupby=False).query(sql).rows()
+    got = Session(cat, matmul_groupby=True).query(sql).rows()
+    assert got == ref and len(ref) > 400
