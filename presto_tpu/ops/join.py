@@ -26,6 +26,7 @@ Right/full outer come with the planner's join-side swap in a later round.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -82,13 +83,19 @@ def build(page: Page, key_exprs) -> BuildSide:
     h = jnp.where(live, h, MAX_HASH)  # dead rows cluster at the end
     order = jnp.argsort(h)
     sh = h[order]
+    if os.environ.get("PRESTO_TPU_JOIN_PROBE", "directory") != "directory":
+        # chip-diagnosis escape hatch: probe via searchsorted only
+        return BuildSide(sh, order, page, tuple(keys), page.count)
     bits = _pick_bucket_bits(page.capacity)
     nb = 1 << bits
     bucket = (sh >> np.uint64(64 - bits)).astype(jnp.int32)
-    counts = jnp.zeros(nb, jnp.int32).at[bucket].add(1, mode="drop")
-    starts = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
-    )
+    # directory from the SORTED bucket ids via vectorized binary search —
+    # pure gather rounds. (A bincount/scatter-add builds the same counts
+    # but XLA:TPU lowers large scatters to a serial loop; at a 1.5M-row
+    # build side that serialization dominates the whole join.)
+    starts = jnp.searchsorted(
+        bucket, jnp.arange(nb + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
     return BuildSide(
         sh, order, page, tuple(keys), page.count, starts, bits
     )

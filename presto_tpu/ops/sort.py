@@ -10,6 +10,7 @@ truncation (lax.top_k only handles single keys)."""
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
@@ -123,6 +124,13 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
         ops.append(data)
     # dead rows last: most-significant operand
     ops.insert(0, (~page.live_mask()).astype(jnp.int8))
+    if os.environ.get("PRESTO_TPU_FUSED_SORT", "1") == "0":
+        # chip-diagnosis escape hatch: the pre-fused composition —
+        # iterated stable argsort, least-significant operand first
+        perm = jnp.arange(cap, dtype=jnp.int32)
+        for op in reversed(ops):
+            perm = perm[jnp.argsort(op[perm], stable=True)]
+        return perm
     idx = jnp.arange(cap, dtype=jnp.int32)
     out = jax.lax.sort(
         tuple(ops) + (idx,), num_keys=len(ops), is_stable=True

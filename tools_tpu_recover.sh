@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-5 staged chip-recovery chain. The 08:45 UTC chip session captured
+# the Q1 headline (422.5M rows/s, pallas single-pass) but the worker
+# CRASHED during Q3 SQL and a join micro then wedged the tunnel. The two
+# chip-unverified kernels on that path are the bucket-directory join
+# probe and the fused variadic sort, both now env-gateable. This chain
+# re-runs the lost stages in increasing-risk order, liveness-gated, so
+# one bad kernel can't take out the whole evidence run:
+#   1. join micro, SAFE gates (searchsorted probe)    -> baseline joins ok
+#   2. join micro, directory probe                    -> A/B the suspect
+#   3. sort micro, SAFE gate (iterated argsort)       -> baseline sorts ok
+#   4. sort micro, fused lax.sort                     -> A/B the suspect
+#   5. full micro suite SF0.1 (default gates)
+#   6. north-star SQL q3/q5/q18/q17 at SF1, one query per process
+#   7. SF10 bench (device-generated, hand Q1/Q6)
+# Completed stages are recorded in /tmp/tpu_stages_done; after a tunnel
+# wedge the outer loop goes back to polling and RESUMES at the first
+# unfinished stage, so overnight wedge/recovery cycles make progress.
+cd /root/repo || exit 1
+LOG=/tmp/tpu_recover.log
+OUT=TPU_FOLLOWUP.jsonl
+DONE=/tmp/tpu_stages_done
+touch "$DONE"
+echo "$(date -u +%FT%TZ) recover-watcher start" >> $LOG
+
+alive() {
+  timeout 120 python -c "import jax; jax.devices(); import jax.numpy as j; j.ones(8).block_until_ready()" >/dev/null 2>&1
+}
+
+run() {  # run <tag> <timeout_s> <cmd...>; skip if done; record; gate after
+  tag=$1; to=$2; shift 2
+  grep -qx "$tag" "$DONE" && return 0
+  echo "$(date -u +%FT%TZ) [$tag] start: $*" >> $LOG
+  res=$(timeout "$to" "$@" 2>>$LOG | grep -E '^\{' | tail -1)
+  if [ -n "$res" ]; then
+    echo "{\"stage\": \"$tag\", \"at\": \"$(date -u +%FT%TZ)\", \"result\": $res}" >> $OUT
+    echo "$(date -u +%FT%TZ) [$tag] ok" >> $LOG
+  else
+    echo "{\"stage\": \"$tag\", \"at\": \"$(date -u +%FT%TZ)\", \"result\": null}" >> $OUT
+    echo "$(date -u +%FT%TZ) [$tag] NO RESULT (timeout/crash)" >> $LOG
+  fi
+  # done either way: a crashed stage is evidence too, don't re-crash on resume
+  echo "$tag" >> "$DONE"
+  alive || { echo "$(date -u +%FT%TZ) tunnel dead after [$tag] - repoll" >> $LOG; return 1; }
+}
+
+M="python -m presto_tpu.benchmark.micro"
+NS="python -m presto_tpu.benchmark.northstar"
+
+chain() {
+  run join_safe    600 env PRESTO_TPU_JOIN_PROBE=searchsorted $M --sf 0.01 --only join_build join_probe_n1 || return 1
+  run join_dir     600 $M --sf 0.01 --only join_build join_probe_n1 || return 1
+  run sort_safe    600 env PRESTO_TPU_FUSED_SORT=0 $M --sf 0.01 --only sort_2key top_n_100 || return 1
+  run sort_fused   600 $M --sf 0.01 --only sort_2key top_n_100 || return 1
+  if ! grep -qx micro_sf01 "$DONE"; then
+    echo "$(date -u +%FT%TZ) [micro_sf01] start" >> $LOG
+    timeout 2400 $M --sf 0.1 --runs 3 --out TPU_MICRO.json >> $LOG 2>&1 \
+      && echo "{\"stage\": \"micro_sf01\", \"at\": \"$(date -u +%FT%TZ)\", \"result\": \"TPU_MICRO.json\"}" >> $OUT
+    echo micro_sf01 >> "$DONE"
+    alive || return 1
+  fi
+  run ns_q3_sf1    1800 $NS --sf 1 --runs 2 --queries q3 || return 1
+  run ns_q5_sf1    1800 $NS --sf 1 --runs 2 --queries q5 || return 1
+  run ns_q18_sf1   1800 $NS --sf 1 --runs 2 --queries q18 || return 1
+  run ns_q17_sf1   1800 $NS --sf 1 --runs 2 --queries q17 || return 1
+  BENCH_SF=10 BENCH_MICRO=0 BENCH_ARTIFACT=TPU_BENCH_SF10.json \
+    run bench_sf10 2400 python bench.py || return 1
+  return 0
+}
+
+while true; do
+  if (echo > /dev/tcp/127.0.0.1/8082) 2>/dev/null && alive; then
+    echo "$(date -u +%FT%TZ) TPU ALIVE - chain (re)starts" >> $LOG
+    if chain; then echo "$(date -u +%FT%TZ) chain COMPLETE" >> $LOG; exit 0; fi
+  fi
+  sleep 90
+done
