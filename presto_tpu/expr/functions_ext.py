@@ -1838,3 +1838,162 @@ def _classify(features: Val, model: Val, out_type: T.Type) -> Val:
     return Val(
         jnp.round(score).astype(jnp.int64), v.valid, T.BIGINT
     )
+
+
+# ---------------------------------------------------------------------------
+# Joda-pattern datetime formatting (reference DateTimeFunctions.java
+# format_datetime/parse_datetime — Joda syntax, vs date_format's MySQL)
+# ---------------------------------------------------------------------------
+
+
+def _joda_to_strptime(fmt: str) -> str:
+    """Joda pattern -> strptime. Repeat-counted letters; '' escapes."""
+    out = []
+    i, n = 0, len(fmt)
+    while i < n:
+        c = fmt[i]
+        if c == "'":  # quoted literal ('' = literal quote)
+            if i + 1 < n and fmt[i + 1] == "'":
+                out.append("'")
+                i += 2
+                continue
+            j = fmt.index("'", i + 1) if "'" in fmt[i + 1:] else n
+            out.append(fmt[i + 1:j].replace("%", "%%"))
+            i = j + 1
+            continue
+        if c.isalpha():
+            j = i
+            while j < n and fmt[j] == c:
+                j += 1
+            cnt = j - i
+            i = j
+            if c == "y" or c == "Y":
+                out.append("%Y" if cnt != 2 else "%y")
+            elif c == "M":
+                out.append("%m" if cnt <= 2 else ("%b" if cnt == 3 else "%B"))
+            elif c == "d":
+                out.append("%d")
+            elif c == "D":
+                out.append("%j")
+            elif c == "E":
+                out.append("%a" if cnt <= 3 else "%A")
+            elif c == "H":
+                out.append("%H")
+            elif c == "h":
+                out.append("%I")
+            elif c == "m":
+                out.append("%M")
+            elif c == "s":
+                out.append("%S")
+            elif c == "S":
+                out.append("%f")
+            elif c == "a":
+                out.append("%p")
+            else:
+                raise NotImplementedError(f"parse_datetime Joda letter {c!r}")
+        else:
+            out.append(c.replace("%", "%%"))
+            i += 1
+    return "".join(out)
+
+
+@register("parse_datetime", lambda ts: T.TIMESTAMP)
+def _parse_datetime(a: Val, fmt: Val, out_type: T.Type) -> Val:
+    import datetime as _dt
+
+    from .functions import _TS_US, _dict_table_nullable
+
+    f = _joda_to_strptime(_require_literal(fmt, "parse_datetime format"))
+    epoch = _dt.datetime(1970, 1, 1)
+
+    def parse(s: str):
+        try:
+            us = (_dt.datetime.strptime(s, f) - epoch).total_seconds()
+            return int(us * _TS_US), True
+        except ValueError:
+            return 0, False
+
+    return _dict_table_nullable(a, parse, np.int64, T.TIMESTAMP)
+
+
+@register("format_datetime", _varchar_infer)
+def _format_datetime(a: Val, fmt: Val, out_type: T.Type) -> Val:
+    """Joda-pattern formatting of date/timestamp values. Date-valued like
+    date_format: day strings come from a precomputed 1582..2500 day table
+    (functions.py _date_format_table machinery); time-of-day letters on
+    timestamps are rejected the same way date_format rejects %H/%i/%s."""
+    from .functions import (
+        _DATE_FMT_BASE,
+        _DATE_FMT_N,
+        _TS_US,
+        _date_format_table,
+        _mysql_format_date,  # noqa: F401  (documents the sibling model)
+    )
+
+    f = _require_literal(fmt, "format_datetime format")
+    strp = _joda_to_strptime(f)  # validates letters; %-free = literal
+    if isinstance(a.type, T.TimestampType):
+        if any(s in strp for s in ("%H", "%I", "%M", "%S", "%f", "%p")):
+            raise NotImplementedError(
+                "format_datetime with time-of-day letters on timestamp"
+            )
+        days = (a.data // (86400 * _TS_US)).astype(jnp.int64)
+    elif isinstance(a.type, T.DateType):
+        days = a.data.astype(jnp.int64)
+    else:
+        raise TypeError(f"format_datetime on {a.type}")
+    # reuse the cached day table keyed by the equivalent strftime string
+    import datetime as _dt
+
+    cache_key = ("joda", f)
+    from .functions import _DATE_FMT_CACHE
+
+    cached = _DATE_FMT_CACHE.get(cache_key)
+    if cached is None:
+        base = _dt.date(1582, 10, 15)
+        strings = [
+            (base + _dt.timedelta(days=i)).strftime(strp)
+            for i in range(_DATE_FMT_N)
+        ]
+        dictionary = tuple(sorted(set(strings)))
+        index = {s: i for i, s in enumerate(dictionary)}
+        cached = (dictionary, np.array([index[s] for s in strings], np.int32))
+        _DATE_FMT_CACHE[cache_key] = cached
+    dictionary, mapping = cached
+    off = days - _DATE_FMT_BASE
+    in_range = (off >= 0) & (off < _DATE_FMT_N)
+    codes = jnp.asarray(mapping)[
+        jnp.clip(off, 0, _DATE_FMT_N - 1).astype(jnp.int32)
+    ]
+    return Val(
+        codes,
+        and_valid(a.valid, in_range),
+        T.VARCHAR,
+        intern_dictionary(dictionary),
+    )
+
+
+@register("parse_presto_data_size", _double_infer)
+def _parse_presto_data_size(a: Val, out_type: T.Type) -> Val:
+    """'2.3MB' -> bytes. Reference returns DECIMAL(38,0)
+    (DataSizeFunctions.java); here DOUBLE — the unit ladder reaches ZB/YB
+    which overflow int64, and the engine's numeric tower treats DOUBLE as
+    the widest plain scalar."""
+    import re as _re
+
+    from .functions import _dict_table_nullable
+
+    units = {
+        "B": 1.0, "kB": 2.0**10, "MB": 2.0**20, "GB": 2.0**30,
+        "TB": 2.0**40, "PB": 2.0**50, "EB": 2.0**60, "ZB": 2.0**70,
+        "YB": 2.0**80,
+    }
+    pat = _re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([A-Za-z]+)\s*$")
+
+    def f(s: str):
+        m = pat.match(s)
+        if not m or m.group(2) not in units:
+            return 0.0, False
+        return float(m.group(1)) * units[m.group(2)], True
+
+    return _dict_table_nullable(a, f, np.float64, T.DOUBLE)

@@ -1969,9 +1969,9 @@ class FromPlanner:
 
     def assemble(self, where: Optional[t.Node]) -> Tuple[N.PlanNode, Scope]:
         if not self.pool:
-            if not self.unnests:
-                raise PlanningError("SELECT without FROM not yet supported")
-            # UNNEST of constants: expand over a one-row base
+            # FROM-less SELECT (reference: Query without QuerySpecification
+            # relation plans over a values row) and UNNEST of constants
+            # both expand over a one-row base
             leaf = N.SingleRow(self.p.channel("singlerow"))
             self.pool.append(
                 PoolItem(

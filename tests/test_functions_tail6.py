@@ -1,0 +1,84 @@
+"""Round-5 session-3 registry tail: Joda-pattern format_datetime /
+parse_datetime, parse_presto_data_size, and FROM-less SELECT
+(reference DateTimeFunctions.java, DataSizeFunctions.java; Query
+planning without a relation)."""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(MemoryCatalog({}))
+
+
+def one(session, expr):
+    return session.query(f"select {expr} q").rows()[0][0]
+
+
+def test_select_without_from(session):
+    assert session.query("select 1").rows() == [(1,)]
+    assert session.query("select 1 + 2 x, upper('ab') y").rows() == [
+        (3, "AB")
+    ]
+
+
+def test_select_without_from_subquery(session):
+    assert session.query(
+        "select count(*) from (select 1, 2) t"
+    ).rows() == [(1,)]
+
+
+def test_format_datetime_joda(session):
+    assert (
+        one(session, "format_datetime(date '2001-08-22', 'E, MMM d yyyy')")
+        == "Wed, Aug 22 2001"
+    )
+    assert (
+        one(session, "format_datetime(date '2001-08-22', 'yyyy-MM-dd')")
+        == "2001-08-22"
+    )
+    # quoted literal + two-digit year: Joda pattern yy'y'
+    assert (
+        one(session, "format_datetime(date '2001-08-22', 'yy''y''')")
+        == "01y"
+    )
+
+
+def test_format_datetime_timestamp_rejects_time_letters(session):
+    with pytest.raises(Exception):
+        one(
+            session,
+            "format_datetime(timestamp '2001-08-22 03:04:05', "
+            "'yyyy-MM-dd HH:mm')",
+        )
+
+
+def test_parse_datetime(session):
+    ts = one(
+        session,
+        "parse_datetime('2001-08-22 03:04:05', 'yyyy-MM-dd HH:mm:ss')",
+    )
+    # engine timestamps are epoch microseconds
+    assert ts == 998_449_445_000_000
+
+
+def test_parse_datetime_bad_input_null(session):
+    assert (
+        one(session, "parse_datetime('nope', 'yyyy-MM-dd')") is None
+    )
+
+
+def test_parse_presto_data_size(session):
+    assert one(session, "parse_presto_data_size('2.3MB')") == pytest.approx(
+        2.3 * 2**20
+    )
+    assert one(session, "parse_presto_data_size('17GB')") == pytest.approx(
+        17 * 2**30
+    )
+    assert one(session, "parse_presto_data_size('1YB')") == pytest.approx(
+        2.0**80
+    )
+    assert one(session, "parse_presto_data_size('x')") is None
