@@ -38,6 +38,7 @@ LAMBDA_FORMS = {
     "filter",
     "reduce",
     "zip_with",
+    "map_zip_with",
     "any_match",
     "all_match",
     "none_match",
@@ -411,6 +412,8 @@ def _eval_lambda_form(expr: Call, page: Page) -> Val:
     out_type = expr.type
     if name == "zip_with":
         return _eval_zip_with(expr, page)
+    if name == "map_zip_with":
+        return _eval_map_zip_with(expr, page)
     if name == "reduce":
         return _eval_reduce(expr, page)
     if name in ("map_filter", "transform_values", "transform_keys"):
@@ -468,6 +471,97 @@ def _eval_lambda_form(expr: Call, page: Page) -> Val:
     else:  # none_match
         agg = ~jnp.any(truthy & inb, axis=1)
     return Val(agg, arr.valid, T.BOOLEAN)
+
+
+def _eval_map_zip_with(expr: Call, page: Page) -> Val:
+    """map_zip_with(m1, m2, (k, v1, v2) -> ...) — reference
+    MapZipWithFunction: output keys are the UNION of the two key sets;
+    a side's value is NULL where its map lacks the key.
+
+    TPU shape: concat the two key lanes, one per-row sort clusters
+    duplicates, a shifted-compare marks first occurrences, and a stable
+    compaction left-packs the union; each side's value is then a masked
+    equality-join of the union keys against that side's (short) key lane
+    — O(W^2) per row on lanes that are all collection-width bounded."""
+    m1 = evaluate(expr.args[0], page)
+    m2 = evaluate(expr.args[1], page)
+    lam: Lambda = expr.args[2]
+    if m1.keys is None or m2.keys is None:
+        raise TypeError("map_zip_with expects two map values")
+    k1, k2 = m1.keys, m2.keys
+    kd1, kd2, kdict = k1.data, k2.data, k1.dict_id
+    # the keys companion is typed array(varchar) — gate on dict ids
+    if (k1.dict_id is not None or k2.dict_id is not None) and (
+        k1.dict_id != k2.dict_id
+    ):
+        from .functions import unify_dictionaries
+
+        kd1, kd2, kdict = unify_dictionaries(k1, k2)
+    if kd1.dtype != kd2.dtype:
+        wide = jnp.promote_types(kd1.dtype, kd2.dtype)
+        kd1, kd2 = kd1.astype(wide), kd2.astype(wide)
+    cap, w1 = m1.data.shape[0], m1.data.shape[1]
+    w2 = m2.data.shape[1]
+    W = w1 + w2
+    inb1, inb2 = _in_bounds(m1), _in_bounds(m2)
+    big = (
+        jnp.iinfo(kd1.dtype).max
+        if jnp.issubdtype(kd1.dtype, jnp.integer)
+        else jnp.asarray(jnp.inf, kd1.dtype)
+    )
+    allk = jnp.concatenate(
+        [jnp.where(inb1, kd1, big), jnp.where(inb2, kd2, big)], axis=1
+    )
+    inb = jnp.concatenate([inb1, inb2], axis=1)
+    order = jnp.argsort(allk, axis=1, stable=True)
+    sk = jnp.take_along_axis(allk, order, axis=1)
+    sinb = jnp.take_along_axis(inb, order, axis=1)
+    first = jnp.concatenate(
+        [jnp.ones((cap, 1), jnp.bool_), sk[:, 1:] != sk[:, :-1]], axis=1
+    )
+    uniq = sinb & first
+    pack = jnp.argsort(~uniq, axis=1, stable=True)
+    ukeys = jnp.take_along_axis(sk, pack, axis=1)
+    ulen = uniq.sum(axis=1).astype(jnp.int32)
+
+    def lookup(m: Val, kd, inbm):
+        eq = (ukeys[:, :, None] == kd[:, None, :]) & inbm[:, None, :]
+        found = jnp.any(eq, axis=2)
+        idx = jnp.argmax(eq, axis=2).astype(jnp.int32)
+        vdat = jnp.take_along_axis(m.data, idx, axis=1)
+        ev = found
+        if m.elem_valid is not None:
+            ev = ev & jnp.take_along_axis(m.elem_valid, idx, axis=1)
+        return vdat, ev
+
+    v1, ev1 = lookup(m1, kd1, inb1)
+    v2, ev2 = lookup(m2, kd2, inb2)
+    kelems = Val(ukeys.reshape(-1), None, lam.param_types[0], kdict)
+    v1e = Val(v1.reshape(-1), ev1.reshape(-1), lam.param_types[1], m1.dict_id)
+    v2e = Val(v2.reshape(-1), ev2.reshape(-1), lam.param_types[2], m2.dict_id)
+    flat = _flat_page_for(
+        page,
+        W,
+        [
+            (lam.params[0], kelems),
+            (lam.params[1], v1e),
+            (lam.params[2], v2e),
+        ],
+    )
+    body = evaluate(lam.body, flat)
+    bdata = body.data.reshape(cap, W)
+    bvalid = None if body.valid is None else body.valid.reshape(cap, W)
+    out_type = expr.type
+    new_keys = Val(ukeys, None, out_type.key, kdict, lengths=ulen)
+    return Val(
+        bdata,
+        and_valid(m1.valid, m2.valid),
+        out_type,
+        body.dict_id,
+        lengths=ulen,
+        elem_valid=bvalid,
+        keys=new_keys,
+    )
 
 
 def _eval_map_lambda(expr: Call, page: Page) -> Val:

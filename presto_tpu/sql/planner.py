@@ -48,7 +48,7 @@ AGG_FUNCS = {
 # aggregates planned by rewriting onto the core set (reference: many of
 # operator/aggregation/*'s 100+ functions decompose into sum/count states)
 LAMBDA_FUNCS = {
-    "transform", "filter", "reduce", "zip_with",
+    "transform", "filter", "reduce", "zip_with", "map_zip_with",
     "any_match", "all_match", "none_match",
     "map_filter", "transform_values", "transform_keys",
 }
@@ -2592,6 +2592,30 @@ class SelectContext:
             lam = self._translate_lambda(ast.args[2], (elem(a), elem(b)))
             return ir.Call(
                 "zip_with", (a, b, lam), T.ArrayType(lam.body.type)
+            )
+        if name == "map_zip_with":
+            # reference MapZipWithFunction: (K,V1), (K,V2), (K,V1,V2)->V3
+            if len(ast.args) != 3 or not isinstance(ast.args[2], t.LambdaExpr):
+                raise PlanningError(
+                    "map_zip_with(map, map, (k, v1, v2) -> ...) expected"
+                )
+            a = self._tr(ast.args[0])
+            b = self._tr(ast.args[1])
+            if not isinstance(a.type, T.MapType) or not isinstance(
+                b.type, T.MapType
+            ):
+                raise PlanningError("map_zip_with expects two map arguments")
+            if a.type.key != b.type.key:
+                raise PlanningError(
+                    "map_zip_with maps must share the key type"
+                )
+            lam = self._translate_lambda(
+                ast.args[2], (a.type.key, a.type.value, b.type.value)
+            )
+            return ir.Call(
+                "map_zip_with",
+                (a, b, lam),
+                T.MapType(a.type.key, lam.body.type),
             )
         if name == "reduce":
             if len(ast.args) != 4 or not all(

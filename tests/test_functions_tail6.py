@@ -82,3 +82,30 @@ def test_parse_presto_data_size(session):
         2.0**80
     )
     assert one(session, "parse_presto_data_size('x')") is None
+
+
+def test_map_zip_with_union_keys(session):
+    assert one(
+        session,
+        "map_zip_with(map(array['a','b'], array[1,2]), "
+        "map(array['b','c'], array[10,20]), "
+        "(k, v1, v2) -> coalesce(v1, 0) + coalesce(v2, 0))",
+    ) == {"a": 1, "b": 12, "c": 20}
+
+
+def test_map_zip_with_missing_side_null(session):
+    assert one(
+        session,
+        "map_zip_with(map(array[1,2], array['x','y']), "
+        "map(array[2], array['z']), "
+        "(k, v1, v2) -> concat(coalesce(v1, '-'), coalesce(v2, '-')))",
+    ) == {1: "x-", 2: "yz"}
+
+
+def test_map_zip_with_key_mismatch_rejected(session):
+    with pytest.raises(Exception):
+        one(
+            session,
+            "map_zip_with(map(array[1], array[1]), "
+            "map(array['a'], array[1]), (k, v1, v2) -> v1)",
+        )
