@@ -467,6 +467,122 @@ def _remove_redundant_sort(node: N.PlanNode, caps) -> Optional[N.PlanNode]:
     return dataclasses.replace(node, child=child.child)
 
 
+# functions whose value depends on evaluation context, never foldable
+# (reference: FunctionRegistry isDeterministic + CURRENT_* special forms)
+_NONDETERMINISTIC = {
+    "random", "rand", "now", "uuid", "shuffle",
+    "current_date", "current_timestamp", "current_time",
+    "current_timezone", "localtimestamp", "localtime",
+}
+
+
+def _foldable(e: ir.RowExpression) -> bool:
+    """No column refs, no lambdas, no nondeterministic calls anywhere."""
+    if isinstance(e, ir.ColumnRef):
+        return False
+    if isinstance(e, ir.Lambda):
+        return False
+    if isinstance(e, ir.Call):
+        if e.name in _NONDETERMINISTIC:
+            return False
+        return all(_foldable(a) for a in e.args)
+    return isinstance(e, ir.Literal)
+
+
+def _fold_expr(e: ir.RowExpression) -> Tuple[ir.RowExpression, bool]:
+    """Bottom-up constant folding (reference SimplifyExpressions /
+    ExpressionInterpreter): a ref-free deterministic subtree is evaluated
+    ONCE at plan time — on the host CPU backend so planning never touches
+    the accelerator — and replaced by a Literal. Arrays/maps and decimal
+    lanes stay unfolded (no scalar literal form)."""
+    if isinstance(e, ir.Call) and e.args:
+        if (
+            _foldable(e)
+            and not isinstance(e, ir.Literal)
+            and _scalar_literal_type(e.type)
+        ):
+            v = _eval_const(e)
+            if v is not _FOLD_FAIL:
+                return ir.Literal(v, e.type), True
+        changed = False
+        new_args = []
+        for a in e.args:
+            na, ch = _fold_expr(a)
+            new_args.append(na)
+            changed = changed or ch
+        if changed:
+            return (
+                dataclasses.replace(e, args=tuple(new_args)),
+                True,
+            )
+    return e, False
+
+
+_FOLD_FAIL = object()
+
+
+def _scalar_literal_type(t) -> bool:
+    from .. import types as T
+
+    return isinstance(
+        t,
+        (
+            T.BigintType, T.IntegerType, T.DoubleType, T.BooleanType,
+            T.VarcharType, T.DateType, T.TimestampType,
+        ),
+    )
+
+
+def _eval_const(e: ir.Call):
+    import jax
+    import numpy as np
+
+    from .. import types as T
+    from ..expr.compiler import evaluate
+    from ..page import Page
+
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        return _FOLD_FAIL
+    try:
+        with jax.default_device(cpu):
+            page = Page.from_dict({"__row__": np.zeros(1, np.int64)})
+            val = evaluate(e, page)
+            if val.data.ndim != 1:
+                return _FOLD_FAIL
+            if val.valid is not None and not bool(val.valid[0]):
+                return None
+            x = val.data[0].item()
+            if isinstance(e.type, T.VarcharType):
+                d = val.dictionary
+                if d is None:
+                    return _FOLD_FAIL
+                return d[int(x)]
+            if isinstance(e.type, T.BooleanType):
+                return bool(x)
+            return x
+    except Exception:  # noqa: BLE001 — unfoldable stays symbolic
+        return _FOLD_FAIL
+
+
+def _simplify_filter(node: N.Filter, caps) -> Optional[N.PlanNode]:
+    ne, changed = _fold_expr(node.predicate)
+    return dataclasses.replace(node, predicate=ne) if changed else None
+
+
+def _simplify_project(node: N.Project, caps) -> Optional[N.PlanNode]:
+    changed = False
+    out = []
+    for ex in node.exprs:
+        ne, c = _fold_expr(ex)
+        out.append(ne)
+        changed = changed or c
+    return (
+        dataclasses.replace(node, exprs=tuple(out)) if changed else None
+    )
+
+
 def default_rules() -> List[Rule]:
     P = pattern
     return [
@@ -555,6 +671,8 @@ def default_rules() -> List[Rule]:
             P(N.Aggregate, N.Distinct).child(P(N.Sort)),
             _remove_redundant_sort,
         ),
+        Rule("SimplifyFilterExpressions", P(N.Filter), _simplify_filter),
+        Rule("SimplifyProjectExpressions", P(N.Project), _simplify_project),
     ]
 
 

@@ -330,3 +330,44 @@ def test_remove_redundant_sort_under_aggregate_and_distinct():
         (AggSpec("array_agg", B, "s", T.ArrayType(T.BIGINT)),),
     )
     assert_plan(rewrite(agg2), (N.Aggregate, (N.Sort, (N.TableScan,))))
+
+
+def test_simplify_filter_constant_fold():
+    # a > (10 - 8)  ->  a > 2
+    pred = ir.Call(
+        "gt",
+        (A, ir.Call("subtract", (lit(10, T.BIGINT), lit(8, T.BIGINT)), T.BIGINT)),
+        T.BOOLEAN,
+    )
+    out = rewrite(N.Filter(scan("a"), pred))
+    assert isinstance(out, N.Filter)
+    folded = out.predicate.args[1]
+    assert isinstance(folded, ir.Literal) and folded.value == 2
+
+
+def test_simplify_project_constant_fold_varchar():
+    # upper('ab') folds to a varchar literal at plan time
+    e = ir.Call("upper", (lit("ab", T.VARCHAR),), T.VARCHAR)
+    out = rewrite(N.Project(scan("a"), (A, e), ("a", "u")))
+    assert isinstance(out, N.Project)
+    folded = out.exprs[1]
+    assert isinstance(folded, ir.Literal) and folded.value == "AB"
+
+
+def test_simplify_skips_nondeterministic():
+    e = ir.Call("random", (), T.DOUBLE)
+    plus = ir.Call("add", (e, lit(1.0, T.DOUBLE)), T.DOUBLE)
+    out = rewrite(N.Project(scan("a"), (plus,), ("r",)))
+    assert isinstance(out, N.Project)
+    assert isinstance(out.exprs[0], ir.Call)  # not folded
+
+
+def test_simplify_null_folds_to_null_literal():
+    e = ir.Call(
+        "add",
+        (lit(None, T.BIGINT), lit(1, T.BIGINT)),
+        T.BIGINT,
+    )
+    out = rewrite(N.Project(scan("a"), (e,), ("n",)))
+    folded = out.exprs[0]
+    assert isinstance(folded, ir.Literal) and folded.value is None
