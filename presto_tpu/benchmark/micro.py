@@ -464,6 +464,141 @@ def bench_hash_rows(sf: float) -> Bench:
     return Bench("hash_rows_2key", int(page.count), step, (b0.data, b1.data))
 
 
+def bench_semi_join(sf: float) -> Bench:
+    """Semi-join membership mask: lineitem.l_orderkey IN orders-subset
+    (ref: HashSemiJoinOperator / BenchmarkHashBuildAndJoinOperators'
+    semi variant; rows/s counts probe rows)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.join import build, semi_match_mask
+    from .handcoded import _table_page
+
+    probe = _table_page("lineitem", sf, ("l_orderkey",))
+    bs = build(_orders_keys_page(sf), (col("o_orderkey", T.BIGINT),))
+    pkeys = (col("l_orderkey", T.BIGINT),)
+
+    def step(acc, p):
+        return _consume(semi_match_mask(_chained_page(p, acc), bs, pkeys))
+
+    return Bench("semi_join_mark", int(probe.count), step, (probe,))
+
+
+def bench_distinct(sf: float) -> Bench:
+    """High-NDV DISTINCT over two key columns (ref: BenchmarkGroupByHash
+    distinct mode / MarkDistinctOperator)."""
+    from ..ops.sort import distinct_page
+    from .handcoded import _table_page
+
+    page = _table_page("lineitem", sf, ("l_suppkey", "l_partkey"))
+    cap = int(page.capacity)
+
+    def step(acc, p):
+        return _consume(distinct_page(_chained_page(p, acc), cap))
+
+    return Bench("distinct_2key", int(page.count), step, (page,))
+
+
+def bench_expr_case_chain(sf: float) -> Bench:
+    """Expression-heavy projection: CASE + math chain over doubles (ref:
+    BenchmarkPageProcessor / hand-written expression benchmarks)."""
+    from .. import types as T
+    from ..expr import ir
+    from ..expr.compiler import evaluate
+    from .handcoded import DEC4_2, DEC12_2, _table_page
+
+    page = _table_page("lineitem", sf, ("l_extendedprice", "l_discount"))
+    price = ir.cast(ir.col("l_extendedprice", DEC12_2), T.DOUBLE)
+    disc = ir.cast(ir.col("l_discount", DEC4_2), T.DOUBLE)
+    rev = ir.Call(
+        "multiply",
+        (
+            price,
+            ir.Call(
+                "subtract", (ir.Literal(1.0, T.DOUBLE), disc), T.DOUBLE
+            ),
+        ),
+        T.DOUBLE,
+    )
+    expr = ir.Call(
+        "if",
+        (
+            ir.Call(
+                "gt", (disc, ir.Literal(0.05, T.DOUBLE)), T.BOOLEAN
+            ),
+            ir.Call("sqrt", (rev,), T.DOUBLE),
+            ir.Call(
+                "ln",
+                (
+                    ir.Call(
+                        "add", (rev, ir.Literal(1.0, T.DOUBLE)), T.DOUBLE
+                    ),
+                ),
+                T.DOUBLE,
+            ),
+        ),
+        T.DOUBLE,
+    )
+
+    def step(acc, p):
+        return _consume(evaluate(expr, _chained_page(p, acc)))
+
+    return Bench("expr_case_chain", int(page.count), step, (page,))
+
+
+def bench_like_dictionary(sf: float) -> Bench:
+    """LIKE over a dictionary varchar column — evaluates once per DICT
+    entry then remaps codes (ref: BenchmarkLikeFunctions; the dictionary
+    design makes this O(dict) not O(rows), which is the point)."""
+    from .. import types as T
+    from ..expr import ir
+    from ..expr.compiler import evaluate
+    from .handcoded import _table_page
+
+    page = _table_page("part", sf, ("p_brand",))
+    expr = ir.Call(
+        "like",
+        (
+            ir.col("p_brand", T.VARCHAR),
+            ir.Literal("%#3%", T.VARCHAR),
+        ),
+        T.BOOLEAN,
+    )
+
+    def step(acc, p):
+        return _consume(evaluate(expr, _chained_page(p, acc)))
+
+    return Bench("like_dictionary", int(page.count), step, (page,))
+
+
+def bench_decimal_chain(sf: float) -> Bench:
+    """Decimal128 arithmetic chain: extendedprice * (1 - discount) in
+    exact decimal lanes (ref: BenchmarkDecimalOperators)."""
+    from ..expr import ir
+    from ..expr.compiler import evaluate
+    from .handcoded import DEC4_2, DEC12_2, _table_page
+    from .. import types as T
+
+    page = _table_page("lineitem", sf, ("l_extendedprice", "l_discount"))
+    one = ir.Literal("1.00", T.DecimalType(3, 2))
+    disc_price = ir.Call(
+        "multiply",
+        (
+            ir.col("l_extendedprice", DEC12_2),
+            ir.Call(
+                "subtract",
+                (one, ir.col("l_discount", DEC4_2)),
+                T.DecimalType(4, 2),
+            ),
+        ),
+        T.DecimalType(17, 4),
+    )
+
+    def step(acc, p):
+        return _consume(evaluate(disc_price, _chained_page(p, acc)))
+
+    return Bench("decimal_mul_chain", int(page.count), step, (page,))
+
+
 DEVICE_BENCHES = {
     "filter_compact": bench_filter_compact,
     "agg_direct_q1": bench_agg_direct,
@@ -472,10 +607,15 @@ DEVICE_BENCHES = {
     "agg_matmul_suppkey": bench_agg_matmul,
     "join_build": bench_join_build,
     "join_probe_n1": bench_join_probe,
+    "semi_join_mark": bench_semi_join,
+    "distinct_2key": bench_distinct,
     "sort_2key": bench_sort,
     "top_n_100": bench_top_n,
     "window_rank_runsum": bench_window,
     "hash_rows_2key": bench_hash_rows,
+    "expr_case_chain": bench_expr_case_chain,
+    "like_dictionary": bench_like_dictionary,
+    "decimal_mul_chain": bench_decimal_chain,
 }
 
 
