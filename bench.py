@@ -370,17 +370,34 @@ def main():
                 "group by l_orderkey, o_orderdate, o_shippriority "
                 "order by rev desc, o_orderdate limit 10"
             )
-            for name, sql in (("q1_sql_ms", None), ("q3_sql_ms", q3)):
-                if sql is None:
-                    from presto_tpu.benchmark.tpch_sql import QUERIES
+            from presto_tpu.benchmark.tpch_sql import QUERIES
 
-                    sql = QUERIES[1]
-                sess.query(sql).rows()  # warm (compile + caches)
-                t0 = time.perf_counter()
-                sess.query(sql).rows()
-                details[name] = round((time.perf_counter() - t0) * 1e3, 1)
-            details["sql_sf"] = sql_sf
-            persist()
+            q6 = (
+                "select sum(l_extendedprice * l_discount) as revenue "
+                "from lineitem where l_shipdate >= date '1994-01-01' "
+                "and l_shipdate < date '1995-01-01' "
+                "and l_discount between 0.05 and 0.07 and l_quantity < 24"
+            )
+            # per-query isolation + artifact persistence BEFORE each next
+            # query: the 08:45 chip session lost the whole stage when Q3
+            # crashed the TPU worker — now a crash costs only the queries
+            # after it, and everything measured so far is already on disk
+            for name, sql in (
+                ("q1_sql_ms", QUERIES[1]),
+                ("q6_sql_ms", q6),
+                ("q3_sql_ms", q3),
+            ):
+                try:
+                    sess.query(sql).rows()  # warm (compile + caches)
+                    t0 = time.perf_counter()
+                    sess.query(sql).rows()
+                    details[name] = round(
+                        (time.perf_counter() - t0) * 1e3, 1
+                    )
+                except Exception as e:  # noqa: BLE001
+                    details[f"{name}_error"] = repr(e)[:200]
+                details["sql_sf"] = sql_sf
+                persist()
         except Exception as e:  # noqa: BLE001
             details["sql_error"] = repr(e)[:200]
 
