@@ -247,7 +247,7 @@ class Session:
              t.ResetSession, t.ShowSession, t.RenameTable, t.RenameColumn,
              t.AddColumn, t.DropColumn, t.Grant, t.Revoke,
              t.ShowFunctions, t.ShowCatalogs, t.ShowCreateTable,
-             t.ShowStats),
+             t.ShowStats, t.Use, t.Analyze),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
@@ -265,8 +265,42 @@ class Session:
         if isinstance(ast, t.Explain):
             from .page import Page
 
+            etype = getattr(ast, "etype", "logical")
             if ast.analyze:
                 lines = self.explain_analyze_plan(node).split("\n")
+            elif etype == "validate":
+                # reference ExplainTask TYPE VALIDATE: analysis+planning
+                # succeeded if we got here
+                pg = Page.from_dict({"Valid": [True]})
+                return QueryResult(pg, ("Valid",))
+            elif etype == "io":
+                # reference IOPlanPrinter: the tables/columns the plan reads
+                scans = []
+
+                def walk(n):
+                    if isinstance(n, N.TableScan):
+                        cols = ", ".join(c for _, c, _ in n.columns)
+                        scans.append(f"{n.table} [{cols}]")
+                    for c in n.children:
+                        walk(c)
+
+                walk(node)
+                pg = Page.from_dict({"Table": scans or [None]})
+                if not scans:
+                    pg = Page(pg.blocks, pg.names, 0)
+                return QueryResult(pg, ("Table",))
+            elif etype == "distributed":
+                # reference PlanPrinter.textDistributedPlan over fragments
+                from .plan.fragment import fragment_plan
+
+                workers = (
+                    self.mesh.devices.size if self.mesh is not None else 2
+                )
+                froot = fragment_plan(
+                    node, self.catalog, self.broadcast_threshold,
+                    num_workers=workers,
+                )
+                lines = N.plan_tree_str(froot).split("\n")
             else:
                 lines = N.plan_tree_str(node).split("\n")
             pg = Page.from_dict({"Query Plan": lines})
@@ -326,16 +360,66 @@ class Session:
         pg = Page.from_dict({"rows": np.array([n], dtype=np.int64)})
         return QueryResult(pg, ("rows",))
 
+    @staticmethod
+    def _like_filter(names, pat):
+        """SQL LIKE pattern over a name list (SHOW ... LIKE 'x%')."""
+        if pat is None:
+            return names
+        import re
+
+        rx = re.compile(
+            "^" + re.escape(pat).replace("%", ".*").replace("_", ".") + "$",
+            re.IGNORECASE,
+        )
+        return [n for n in names if rx.match(n)]
+
     def _execute_statement(self, ast, user: Optional[str] = None) -> QueryResult:
         from .page import Page
 
         if user is None:
             user = self.user
 
+        if isinstance(ast, t.Use):
+            # reference UseTask: switch the session default catalog/schema.
+            # With a CatalogStore the used catalog becomes the FIRST
+            # bare-name resolver (per-session copy, no global mutation).
+            from .server.catalog_store import CatalogStore
+
+            cat_name, schema = ast.catalog, ast.schema
+            if cat_name is None and isinstance(self.catalog, CatalogStore) \
+                    and schema in self.catalog.catalogs:
+                cat_name, schema = schema, "default"
+            if cat_name is not None:
+                if not isinstance(self.catalog, CatalogStore) or \
+                        cat_name not in self.catalog.catalogs:
+                    raise ValueError(f"catalog {cat_name!r} does not exist")
+                ordered = {cat_name: self.catalog.catalogs[cat_name]}
+                ordered.update(self.catalog.catalogs)
+                self._swap_catalog(CatalogStore(ordered))
+            elif schema not in self.schemas:
+                raise ValueError(f"schema {schema!r} does not exist")
+            self.current_schema = schema
+            return self._row_count_result(0)
+
+        if isinstance(ast, t.Analyze):
+            # reference AnalyzeTask: collect and materialize table stats
+            # (here: force column-stat derivation through the CBO path and
+            # report the analyzed row count)
+            name = ast.table.lower()
+            schema = self._table_schema(self.catalog, name)
+            get = getattr(self.catalog, "column_stats", None)
+            if get is not None:
+                for c in schema:
+                    get(name, c)  # populates the connector's stats cache
+            return self._row_count_result(
+                int(self.catalog.row_count(name))
+            )
+
         if isinstance(ast, t.ShowTables):
             # views list alongside tables (reference ShowQueriesRewrite:
             # information_schema.tables carries both)
             names = sorted(set(self.catalog.table_names()) | set(self.views))
+            names = self._like_filter(names, ast.like)
             if self.access_control is not None:
                 # filter out tables the user cannot read (reference
                 # SystemAccessControl.filterTables)
@@ -483,7 +567,11 @@ class Session:
                 kind_of[n] = "scalar"
             for n in AGG_FUNCS | REWRITE_AGG_FUNCS:
                 kind_of[n] = "aggregate"
-            rows = sorted(kind_of.items())
+            rows = sorted(
+                (n, k)
+                for n, k in kind_of.items()
+                if n in set(self._like_filter(list(kind_of), ast.like))
+            )
             pg = Page.from_dict(
                 {
                     "Function": [r[0] for r in rows],

@@ -155,27 +155,53 @@ class Parser:
     def parse_statement(self) -> t.Node:
         if self.accept_kw("explain"):
             analyze = self.accept_kw("analyze")
+            etype = "logical"
+            if not analyze and self.accept("("):
+                # EXPLAIN (TYPE LOGICAL|DISTRIBUTED|VALIDATE|IO
+                #          [, FORMAT TEXT]) — reference SqlBase.g4 explain
+                while True:
+                    if self.accept_word("type"):
+                        etype = self.tok.text.lower()
+                        if etype not in (
+                            "logical", "distributed", "validate", "io"
+                        ):
+                            self.error(
+                                "expected LOGICAL, DISTRIBUTED, VALIDATE "
+                                "or IO"
+                            )
+                        self.i += 1
+                    elif self.accept_word("format"):
+                        if not self.accept_word("text"):
+                            self.error("only FORMAT TEXT is supported")
+                    else:
+                        self.error("expected TYPE or FORMAT")
+                    if not self.accept(","):
+                        break
+                self.expect(")")
             q = self.parse_query()
             self.finish()
-            return t.Explain(q, analyze)
+            return t.Explain(q, analyze, etype)
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
+                like = self._accept_like_pattern()
                 self.finish()
-                return t.ShowTables()
+                return t.ShowTables(like)
             if self.accept_kw("columns"):
                 self.expect_kw("from")
                 name = self.ident()
                 self.finish()
                 return t.ShowColumns(name)
             if self.accept_word("schemas"):
+                like = self._accept_like_pattern()
                 self.finish()
-                return t.ShowSchemas()
+                return t.ShowSchemas(like)
             if self.accept_word("session"):
                 self.finish()
                 return t.ShowSession()
             if self.accept_word("functions"):
+                like = self._accept_like_pattern()
                 self.finish()
-                return t.ShowFunctions()
+                return t.ShowFunctions(like)
             if self.accept_word("catalogs"):
                 self.finish()
                 return t.ShowCatalogs()
@@ -262,16 +288,32 @@ class Parser:
             name = self.ident()
             self.finish()
             return t.Deallocate(name)
-        if self.at_word("describe"):
+        if self.at_word("describe") or self.at_word("desc"):
             self.i += 1
             if self.accept_word("input"):
                 name = self.ident()
                 self.finish()
                 return t.DescribeInput(name)
-            self.expect_word("output")
+            if self.accept_word("output"):
+                name = self.ident()
+                self.finish()
+                return t.DescribeOutput(name)
+            # DESCRIBE <table> = SHOW COLUMNS (reference SqlParser maps
+            # describe to ShowColumns)
             name = self.ident()
             self.finish()
-            return t.DescribeOutput(name)
+            return t.ShowColumns(name)
+        if self.at_word("use"):
+            self.i += 1
+            a = self.ident()
+            b = self.ident() if self.accept(".") else None
+            self.finish()
+            return t.Use(a if b is not None else None, b if b is not None else a)
+        if self.at_word("analyze"):
+            self.i += 1
+            name = self.ident()
+            self.finish()
+            return t.Analyze(name)
         if self.at_word("set") and self.peek().text.lower() == "session":
             self.i += 2
             name = self.ident()
@@ -332,6 +374,17 @@ class Parser:
         q = self.parse_query()
         self.finish()
         return q
+
+    def _accept_like_pattern(self):
+        """Optional LIKE 'pattern' tail on SHOW statements (reference
+        SqlBase.g4 showTables/showSchemas/showFunctions)."""
+        if self.accept_kw("like") or self.accept_word("like"):
+            tk = self.tok
+            if tk.kind != "string":
+                self.error("expected a string pattern after LIKE")
+            self.i += 1
+            return tk.text
+        return None
 
     def _accept_if_exists(self) -> bool:
         # IF is contextual (not a keyword) so that if(c, a, b) stays callable
@@ -537,6 +590,11 @@ class Parser:
             return inner
         if self.at_kw("values"):
             return self.parse_values()
+        if self.at_kw("table"):
+            # TABLE t = SELECT * FROM t (SqlBase.g4 TABLE queryPrimary)
+            self.i += 1
+            name = self.ident()
+            return t.Select((t.Star(),), t.Table(name))
         return self.parse_select()
 
     def parse_values(self) -> t.Values:

@@ -272,11 +272,13 @@ class SetOperation(Node):
 class Explain(Node):
     query: Query
     analyze: bool = False
+    # reference grammar: EXPLAIN (TYPE LOGICAL|DISTRIBUTED|VALIDATE|IO)
+    etype: str = "logical"
 
 
 @dataclasses.dataclass(frozen=True)
 class ShowTables(Node):
-    pass
+    like: "str | None" = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -419,7 +421,7 @@ class DropSchema(Node):
 
 @dataclasses.dataclass(frozen=True)
 class ShowSchemas(Node):
-    pass
+    like: "str | None" = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -544,7 +546,7 @@ def count_parameters(node) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class ShowFunctions(Node):
-    pass
+    like: "str | None" = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -560,3 +562,18 @@ class ShowCreateTable(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowStats(Node):
     name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Use(Node):
+    """USE [catalog.]schema (reference UseTask.java)."""
+
+    catalog: "str | None"
+    schema: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Analyze(Node):
+    """ANALYZE table (reference AnalyzeTask: collect table statistics)."""
+
+    table: str

@@ -1,0 +1,91 @@
+"""Round-5 session-3 statement surface: DESCRIBE table / DESC, USE,
+TABLE shorthand, EXPLAIN (TYPE ...), ANALYZE, SHOW ... LIKE (reference
+SqlBase.g4 + execution/UseTask.java, ExplainTask, AnalyzeTask)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def session():
+    return Session(
+        MemoryCatalog(
+            {
+                "t": Page.from_dict({"x": np.arange(5, dtype=np.int64)}),
+                "u": Page.from_dict({"y": np.arange(3, dtype=np.int64)}),
+            }
+        )
+    )
+
+
+def test_describe_is_show_columns(session):
+    assert session.query("describe t").rows() == session.query(
+        "show columns from t"
+    ).rows()
+    assert session.query("desc t").rows()[0][0] == "x"
+
+
+def test_table_shorthand(session):
+    assert session.query("table t").rows() == session.query(
+        "select * from t"
+    ).rows()
+    # works as a set-op operand too
+    assert len(session.query("table t union all table t").rows()) == 10
+
+
+def test_explain_type_validate(session):
+    assert session.query(
+        "explain (type validate) select * from t"
+    ).rows() == [(True,)]
+    with pytest.raises(Exception):
+        session.query("explain (type validate) select nope from t")
+
+
+def test_explain_type_io_lists_scans(session):
+    rows = session.query(
+        "explain (type io) select x from t where x > 1"
+    ).rows()
+    assert rows == [("t [x]",)]
+
+
+def test_explain_type_distributed_shows_fragments(session):
+    txt = "\n".join(
+        r[0]
+        for r in session.query(
+            "explain (type distributed) select count(*) from t"
+        ).rows()
+    )
+    assert "Aggregate" in txt
+
+
+def test_analyze_returns_row_count(session):
+    assert session.query("analyze t").rows() == [(5,)]
+    with pytest.raises(Exception):
+        session.query("analyze missing")
+
+
+def test_show_like_patterns(session):
+    assert session.query("show tables like 't%'").rows() == [("t",)]
+    assert session.query("show tables like '%'").rows() == [("t",), ("u",)]
+    fns = session.query("show functions like 'array_s%'").rows()
+    assert ("array_sort", "scalar") in fns
+
+
+def test_use_schema_and_catalog():
+    from presto_tpu.server.catalog_store import CatalogStore
+
+    a = MemoryCatalog({"t": Page.from_dict({"x": np.arange(2, dtype=np.int64)})})
+    b = MemoryCatalog({"t": Page.from_dict({"x": np.arange(7, dtype=np.int64)})})
+    s = Session(CatalogStore({"first": a, "second": b}))
+    # bare name resolves to the first catalog
+    assert len(s.query("select * from t").rows()) == 2
+    s.query("use second")
+    assert len(s.query("select * from t").rows()) == 7
+    # qualified names still reach both
+    assert len(s.query("select * from first.t").rows()) == 2
+    with pytest.raises(Exception):
+        s.query("use nope.nothere")
