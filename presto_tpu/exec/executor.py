@@ -111,10 +111,23 @@ class Executor:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}")
         return method(node, *pages)
 
-    def _shrink(self, page: Page) -> Page:
-        """Slice page capacity down to the live row count's bucket."""
+    def _shrink(self, page: Page, node: "N.PlanNode" = None) -> Page:
+        """Slice page capacity down to the live row count's bucket.
+
+        Reading the count is a BLOCKING host sync — through the axon
+        relay each one is a full tunnel round trip, and they were the
+        dominant term in on-chip SQL wall time (TPU_STATUS §4b: ~5
+        syncs ~= 2.5 s for a 14 ms aggregation). So the sync is only
+        paid when shrinking can plausibly win: the page is big AND the
+        CBO expects the live count to be well under capacity."""
         if not self.shrink:
             return page
+        if page.capacity <= (1 << 14):
+            return page  # too small for shrinking to pay for a sync
+        if node is not None:
+            est = self._est_rows(node)
+            if est is not None and est >= 0.5 * page.capacity:
+                return page  # expected near-full: skip the sync
         n = int(page.count)
         cap = round_capacity(max(n, 1))
         if cap >= page.capacity:
@@ -122,6 +135,23 @@ class Executor:
         idx = slice(0, cap)
         blocks = [b.take_rows(idx) for b in page.blocks]
         return Page(tuple(blocks), page.names, page.count)
+
+    def _est_rows(self, node):
+        """CBO row estimate for a node's output (cached per plan node)."""
+        cache = getattr(self, "_est_cache", None)
+        if cache is None:
+            cache = self._est_cache = {}
+        key = id(node)
+        if key in cache:
+            return cache[key]
+        try:
+            from ..plan.stats import derive
+
+            est = float(derive(node, self.catalog).rows)
+        except Exception:  # noqa: BLE001 — estimation is best-effort
+            est = None
+        cache[key] = est
+        return est
 
     # -- physical nodes (fragmented plans executed single-node) --
     def _exec_exchange(self, node, page: Page) -> Page:
@@ -159,11 +189,11 @@ class Executor:
                 node.ordinality_channel,
             ),
         )
-        return self._shrink(fn(page))
+        return self._shrink(fn(page), node)
 
     def _exec_filter(self, node: N.Filter, page: Page) -> Page:
         fn = self._kernel(node, lambda: lambda p: filter_page(p, node.predicate))
-        return self._shrink(fn(page))
+        return self._shrink(fn(page), node)
 
     def _exec_project(self, node: N.Project, page: Page) -> Page:
         fn = self._kernel(
@@ -210,7 +240,7 @@ class Executor:
                 out = None
             if out is not None:
                 self._strategy_note(node, "pallas")
-                return self._shrink(out)
+                return self._shrink(out, node)
         if self.matmul_groupby is None:
             import jax
 
@@ -230,7 +260,7 @@ class Executor:
                 out = None
             if out is not None:
                 self._strategy_note(node, "mxu-matmul")
-                return self._shrink(out)
+                return self._shrink(out, node)
         self._strategy_note(node, "hash-sort")
         # groups <= live rows; guess low and retry with the true group count
         # (returned regardless of the bound) on overflow — the adaptive-
@@ -269,7 +299,7 @@ class Executor:
                     out.count,
                 )
             break
-        return self._shrink(out)
+        return self._shrink(out, node)
 
     def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:
         if self.matmul_groupby is None:
@@ -292,10 +322,10 @@ class Executor:
                 out = None
             if out is not None:
                 self._strategy_note(node, "mxu-occupancy")
-                return self._shrink(out)
+                return self._shrink(out, node)
         self._strategy_note(node, "hash-sort")
         fn = self._kernel(node, lambda: lambda p: distinct_page(p, p.capacity))
-        return self._shrink(fn(page))
+        return self._shrink(fn(page), node)
 
     # -- joins --
     def _exec_join(self, node: N.Join, left: Page, right: Page) -> Page:
@@ -323,7 +353,7 @@ class Executor:
                         "residual on outer join not yet supported"
                     )
                 out = filter_page(out, node.residual)
-            return self._shrink(out)
+            return self._shrink(out, node)
         # general 1:N expansion with adaptive capacity retry
         cap = round_capacity(max(int(left.count), 1))
         while True:
@@ -349,7 +379,7 @@ class Executor:
             if node.kind != "inner":
                 raise ExecutionError("residual on outer join not yet supported")
             out = filter_page(out, node.residual)
-        return self._shrink(out)
+        return self._shrink(out, node)
 
     def _exec_outer_join(self, node: N.Join, left: Page, right: Page) -> Page:
         """LEFT join with a residual ON filter, and FULL OUTER join.
@@ -394,7 +424,7 @@ class Executor:
             if node.residual is not None
             else expanded
         )
-        matched = self._shrink(matched)
+        matched = self._shrink(matched, node)
 
         def drop(page: Page, names) -> Page:
             keep = [
@@ -435,7 +465,7 @@ class Executor:
                     prepend=True,
                 )
             )
-        return self._shrink(concat_pages(parts))
+        return self._shrink(concat_pages(parts), node)
 
     @staticmethod
     def _attach_mark(probe: Page, mask, name: str) -> Page:
@@ -461,7 +491,7 @@ class Executor:
                 [],
                 kind="anti" if node.anti else "semi",
             )
-            return self._shrink(out)
+            return self._shrink(out, node)
         # residual EXISTS: expand probe x source on equi keys, filter the
         # residual, then keep probe rows whose row-id survived
         rid = self._row_id_channel(probe)
@@ -486,7 +516,7 @@ class Executor:
             cap = round_capacity(cap + int(overflow))
             self._retries += 1
         matched = filter_page(expanded, node.residual)
-        matched = self._shrink(matched)
+        matched = self._shrink(matched, node)
         rid_type = T.BIGINT
         bs2 = build(matched, (ir.ColumnRef(rid, rid_type),))
         if node.mark is not None:
@@ -509,7 +539,7 @@ class Executor:
             b for b, n in zip(out.blocks, out.names) if n != rid
         )
         names = tuple(n for n in out.names if n != rid)
-        return self._shrink(Page(blocks, names, out.count))
+        return self._shrink(Page(blocks, names, out.count), node)
 
     def _row_id_channel(self, page: Page) -> str:
         i = 0
@@ -580,10 +610,10 @@ class Executor:
         return fn(page)
 
     def _exec_limit(self, node: N.Limit, page: Page) -> Page:
-        return self._shrink(limit_page(page, node.count))
+        return self._shrink(limit_page(page, node.count), node)
 
     def _exec_union(self, node: N.Union, *pages: Page) -> Page:
         from ..ops.union import concat_pages
 
         # positional union: output schema/names follow the first branch
-        return self._shrink(concat_pages(pages, distinct=node.distinct))
+        return self._shrink(concat_pages(pages, distinct=node.distinct), node)
