@@ -77,3 +77,17 @@ def test_streaming_session_q6(catalog, oracle):
     expected = oracle.query(sql)
     types = [b.type for b in result.page.blocks]
     assert_same_results(result.rows(), expected, types, ordered=False)
+
+
+# the BASELINE.json north stars through the device catalog — exactly the
+# shapes benchmark/northstar.py times on chip (Q5 6-table join order,
+# Q17 correlated-subquery large build, Q18 HAVING semi-join big groups)
+@pytest.mark.parametrize("name", ["q3", "q5", "q17", "q18"])
+def test_northstar_oracle(session, oracle, name):
+    from presto_tpu.benchmark.northstar import QUERIES as NS
+
+    sql = NS[name]
+    result = session.query(sql)
+    expected = oracle.query(sql)
+    types = [b.type for b in result.page.blocks]
+    assert_same_results(result.rows(), expected, types, ordered=False)
