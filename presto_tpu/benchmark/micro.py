@@ -656,7 +656,7 @@ def run_serde_bench(sf: float, runs: int = RUNS) -> Dict:
         "deserialize_MBps": round(raw_bytes / t_des / 1e6, 1),
         "wire_bytes": len(wire),
         "raw_bytes": raw_bytes,
-        "note": "host codec",
+        "note": f"host codec {('zstd' if __import__('presto_tpu.server.serde', fromlist=['_zstd_c'])._zstd_c is not None else 'lz4')}",
     }
 
 

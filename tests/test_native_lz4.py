@@ -135,7 +135,26 @@ def test_serde_uses_lz4_and_roundtrips():
         }
     )
     wire = serialize_page(pg)
-    assert wire[4] == 2  # lz4 codec selected
+    # codec negotiation: zstd (3) preferred when the wheel is present,
+    # the native LZ4 (2) otherwise
+    from presto_tpu.server import serde as _s
+
+    assert wire[4] == (3 if _s._zstd_c is not None else 2)
+    back = deserialize_page(wire)
+    assert back.to_pylist() == pg.to_pylist()
+
+
+def test_serde_lz4_roundtrips_without_zstd(monkeypatch):
+    from presto_tpu.page import Page
+    from presto_tpu.server import serde as _s
+    from presto_tpu.server.serde import deserialize_page, serialize_page
+
+    monkeypatch.setattr(_s, "_zstd_c", None)
+    pg = Page.from_dict(
+        {"a": np.arange(5000, dtype=np.int64) % 17}
+    )
+    wire = serialize_page(pg)
+    assert wire[4] == 2  # native lz4 fallback
     back = deserialize_page(wire)
     assert back.to_pylist() == pg.to_pylist()
 
