@@ -41,7 +41,11 @@ from ..page import Block, Page
 from .aggregate import AggSpec, avg_from_sum_count
 
 BLK_ROWS = 16384  # 128 x 128 rows per grid step
-PALLAS_MAX_GROUPS = 32
+# G cap: the per-block output tile gate (rows_pad <= 1024 rows) is the
+# real bound — at G=64 a 16-channel plan exactly fills the 512KB tile.
+# Single-pass wins GROW with G vs the XLA fallback (one data read vs
+# G x A masked column reads), so eligible mid-size domains route here.
+PALLAS_MAX_GROUPS = 64
 MAX_CHANNELS = 128  # one output lane per channel
 _SUM_BOUND = 1 << 45  # |sum input| bound keeping block limb sums in int32
 

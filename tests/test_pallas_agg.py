@@ -140,3 +140,40 @@ def test_pallas_groupby_auto_default_off_on_cpu():
     assert s.executor.pallas_groupby is None  # unresolved until used
     s.query("select count(*) c from t group by v")
     assert s.executor.pallas_groupby is False  # CPU backend in tests
+
+
+def test_pallas_groupby_g63_matches_sort_strategy():
+    """Round-5 G-cap raise (32 -> 64): a 63-way dictionary group-by is
+    pallas-eligible and matches the hash-sort strategy exactly."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.expr.ir import col
+    from presto_tpu.ops.aggregate import AggSpec, grouped_aggregate_sorted
+    from presto_tpu.ops.pallas_groupby import maybe_grouped_aggregate
+    from presto_tpu.page import Block, Page, intern_dictionary
+
+    rng = np.random.default_rng(0)
+    n = 50000
+    codes = rng.integers(0, 63, n).astype(np.int32)
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    d = intern_dictionary(tuple(f"k{i:02d}" for i in range(63)))
+    pg = Page(
+        (
+            Block(jnp.asarray(codes), T.VARCHAR, None, d),
+            Block(jnp.asarray(vals), T.BIGINT),
+        ),
+        ("g", "v"),
+        jnp.asarray(n, jnp.int32),
+    )
+    aggs = (
+        AggSpec("sum", col("v", T.BIGINT), "s", T.BIGINT),
+        AggSpec("count_star", None, "c", T.BIGINT),
+    )
+    out = maybe_grouped_aggregate(pg, (col("g", T.VARCHAR),), ("g",), aggs, None)
+    assert out is not None
+    want = grouped_aggregate_sorted(
+        pg, (col("g", T.VARCHAR),), ("g",), aggs, 128
+    )
+    assert sorted(out.to_pylist()) == sorted(want.to_pylist())
