@@ -264,8 +264,14 @@ class Executor:
         self._strategy_note(node, "hash-sort")
         # groups <= live rows; guess low and retry with the true group count
         # (returned regardless of the bound) on overflow — the adaptive-
-        # capacity pattern used by all static-shape operators here
-        max_groups = round_capacity(min(max(int(page.count), 1), 1 << 16))
+        # capacity pattern used by all static-shape operators here. The
+        # initial guess comes from the CBO's NDV estimate (free) instead
+        # of a blocking count sync; page.capacity bounds it above.
+        est = self._est_rows(node)
+        guess = int(est) if est is not None else page.capacity
+        max_groups = round_capacity(
+            min(max(guess, 1), page.capacity, 1 << 16)
+        )
         max_elems = 128  # collection-aggregate width (adaptive, like mg)
         while True:
             mg, me = max_groups, max_elems
@@ -354,8 +360,13 @@ class Executor:
                     )
                 out = filter_page(out, node.residual)
             return self._shrink(out, node)
-        # general 1:N expansion with adaptive capacity retry
-        cap = round_capacity(max(int(left.count), 1))
+        # general 1:N expansion with adaptive capacity retry; initial
+        # guess = probe capacity vs CBO join-output estimate (no count
+        # sync — each one is a tunnel round trip on TPU)
+        est = self._est_rows(node)
+        cap = round_capacity(
+            max(left.capacity, int(est) if est is not None else 1, 1)
+        )
         while True:
             c = cap
             fn = self._kernel(
@@ -404,7 +415,10 @@ class Executor:
         bs = build(right2, node.right_keys)
         probe_out = list(left.names) + [rid_l]
         build_out = [(n, n) for n in right.names] + [(rid_r, rid_r)]
-        cap = round_capacity(max(int(left.count), 1))
+        est = self._est_rows(node)
+        cap = round_capacity(
+            max(left.capacity, int(est) if est is not None else 1, 1)
+        )
         while True:
             expanded, overflow = join_expand(
                 left2,
@@ -500,7 +514,7 @@ class Executor:
         needed = self._residual_channels(node.residual)
         probe_out = [rid] + [n for n in probe.names if n in needed]
         build_out = [(n, n) for n in source.names if n in needed]
-        cap = round_capacity(max(int(probe.count), 1))
+        cap = round_capacity(max(probe.capacity, 1))  # no count sync
         while True:
             expanded, overflow = join_expand(
                 probe2,
