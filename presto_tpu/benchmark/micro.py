@@ -804,7 +804,30 @@ def main(argv=None):
     ap.add_argument("--only", nargs="*", default=None)
     ap.add_argument("--out", default=None, help="write JSON here too")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument(
+        "--virtual-devices",
+        type=int,
+        default=0,
+        help="force an N-device virtual CPU mesh (exchange benches on a "
+        "single-chip box; implies --cpu)",
+    )
     args = ap.parse_args(argv)
+    if args.virtual_devices:
+        import os
+        import re
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={args.virtual_devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     if args.cpu:
         import os
 
