@@ -59,6 +59,7 @@ chain() {
     echo micro_sf01 >> "$DONE"
     alive || return 1
   fi
+  run ns_all_sf01  1200 $NS --sf 0.1 --runs 2 || return 1
   run ns_q3_sf1    1800 $NS --sf 1 --runs 2 --queries q3 || return 1
   run ns_q5_sf1    1800 $NS --sf 1 --runs 2 --queries q5 || return 1
   run ns_q18_sf1   1800 $NS --sf 1 --runs 2 --queries q18 || return 1
