@@ -324,10 +324,13 @@ class WorkerServer:
     def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0,
                  memory_limit: Optional[int] = None,
                  buffer_bound: Optional[int] = 32 << 20,
-                 task_concurrency: int = 2):
+                 task_concurrency: int = 2,
+                 fault_rate: float = 0.0):
         from ..exec.taskqueue import MultilevelScheduler
 
         self.catalog = catalog
+        # fault injection knob: probability a task fails at start
+        self.fault_rate = float(fault_rate)
         self.tasks: Dict[str, TaskState] = {}
         self.pool = WorkerMemoryPool(memory_limit)
         self.buffer_bound = buffer_bound
@@ -484,6 +487,18 @@ class WorkerServer:
         )
         state.buffers = buffers
         try:
+            if self.fault_rate > 0:
+                # fault injection (reference: test-only task failures,
+                # e.g. TestEventListener's failing connector; here a
+                # worker-level knob so cluster tests can exercise the
+                # failure-propagation path deterministically)
+                import random
+
+                if random.random() < self.fault_rate:
+                    raise RuntimeError(
+                        f"injected fault on worker {self.node_id} "
+                        f"(fault_rate={self.fault_rate})"
+                    )
             fragment = pickle.loads(base64.b64decode(spec["fragment"]))
             splits = {
                 t: tuple(rng) for t, rng in (spec.get("splits") or {}).items()

@@ -295,3 +295,34 @@ def test_web_ui_timeline_and_stages():
         assert "Stages" in qd
     finally:
         srv.stop()
+
+
+def test_fault_injection_fails_query_cleanly():
+    """Fault injection (SURVEY §5): a worker with fault_rate=1 fails every
+    task at start; the query must fail with the injected cause propagated
+    to the client, and the cluster must stay usable for the next query
+    once the faulty worker is excluded."""
+    good = WorkerServer(TpchCatalog(sf=0.002)).start()
+    bad = WorkerServer(TpchCatalog(sf=0.002), fault_rate=1.0).start()
+    nodes = NodeManager([good.uri, bad.uri], interval=3600,
+                        failure_threshold=1)
+    sess = HttpClusterSession(TpchCatalog(sf=0.002), nodes)
+    try:
+        with pytest.raises(Exception) as exc_info:
+            sess.query(
+                "select count(*) n, sum(o_totalprice) s from orders "
+                "group by o_shippriority"
+            ).rows()
+        assert "injected fault" in str(exc_info.value)
+        # exclude the faulty worker (the heartbeat prober does this for
+        # dead workers; injected faults leave /v1/status healthy, so the
+        # operator-level exclusion is explicit here)
+        nodes.workers[bad.uri]["state"] = "FAILED"
+        got = sess.query("select count(*) from orders").rows()
+        want = Session(TpchCatalog(sf=0.002)).query(
+            "select count(*) from orders"
+        ).rows()
+        assert got == want
+    finally:
+        good.stop()
+        bad.stop()
