@@ -247,7 +247,7 @@ class Session:
              t.ResetSession, t.ShowSession, t.RenameTable, t.RenameColumn,
              t.AddColumn, t.DropColumn, t.Grant, t.Revoke,
              t.ShowFunctions, t.ShowCatalogs, t.ShowCreateTable,
-             t.ShowStats, t.Use, t.Analyze),
+             t.ShowStats, t.Use, t.Analyze, t.ShowGrants),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
@@ -579,6 +579,29 @@ class Session:
                 }
             )
             return QueryResult(pg, ("Function", "Kind"))
+        if isinstance(ast, t.ShowGrants):
+            # surface the active rule set (reference: SHOW GRANTS reads
+            # information_schema.table_privileges); filtered to rules
+            # whose table pattern covers the named table
+            rules = getattr(self.access_control, "rules", []) or []
+            rows = [(r.user, r.table, r.privileges) for r in rules]
+            if ast.table is not None:
+                import re as _re
+
+                rows = [
+                    (u, tp, p) for (u, tp, p) in rows
+                    if _re.fullmatch(tp, ast.table.lower())
+                ]
+            pg = Page.from_dict(
+                {
+                    "Grantee": [r[0] for r in rows] or [None],
+                    "Table": [r[1] for r in rows] or [None],
+                    "Privilege": [r[2] for r in rows] or [None],
+                }
+            )
+            if not rows:
+                pg = Page(pg.blocks, pg.names, 0)
+            return QueryResult(pg, ("Grantee", "Table", "Privilege"))
         if isinstance(ast, t.ShowCatalogs):
             pg = Page.from_dict(
                 {"Catalog": [str(getattr(self.catalog, "name", "default"))]}

@@ -205,6 +205,13 @@ class Parser:
             if self.accept_word("catalogs"):
                 self.finish()
                 return t.ShowCatalogs()
+            if self.accept_word("grants"):
+                name = None
+                if self.accept_kw("on"):
+                    self.accept_kw("table")
+                    name = self.ident()
+                self.finish()
+                return t.ShowGrants(name)
             if self.accept_word("stats"):
                 self.expect_kw("for")
                 name = self.ident()

@@ -577,3 +577,11 @@ class Analyze(Node):
     """ANALYZE table (reference AnalyzeTask: collect table statistics)."""
 
     table: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowGrants(Node):
+    """SHOW GRANTS [ON [TABLE] t] (reference ShowQueriesRewrite over
+    information_schema.table_privileges)."""
+
+    table: "str | None" = None

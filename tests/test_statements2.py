@@ -89,3 +89,34 @@ def test_use_schema_and_catalog():
     assert len(s.query("select * from first.t").rows()) == 2
     with pytest.raises(Exception):
         s.query("use nope.nothere")
+
+
+def test_show_grants():
+    from presto_tpu.security import RuleBasedAccessControl
+
+    ac = RuleBasedAccessControl(
+        [
+            {"user": "admin", "privileges": "all"},
+            {"user": ".*", "table": "secret.*", "privileges": "none"},
+            {"user": ".*", "privileges": "select"},
+        ]
+    )
+    s = Session(
+        MemoryCatalog(
+            {"t": Page.from_dict({"x": np.arange(3, dtype=np.int64)})}
+        ),
+        access_control=ac,
+        user="admin",
+    )
+    assert s.query("show grants").rows() == [
+        ("admin", ".*", "all"),
+        (".*", "secret.*", "none"),
+        (".*", ".*", "select"),
+    ]
+    # table-filtered: rules whose pattern covers the table
+    assert s.query("show grants on table t").rows() == [
+        ("admin", ".*", "all"),
+        (".*", ".*", "select"),
+    ]
+    # no access control installed: empty result, not an error
+    assert Session(MemoryCatalog({})).query("show grants").rows() == []
