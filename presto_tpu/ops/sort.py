@@ -75,6 +75,17 @@ def _float_total_order(x):
     return jnp.where(jnp.isnan(x), it(jnp.iinfo(it).max), key)
 
 
+def _sort_operands(page: Page, keys: Sequence[SortKey]):
+    """The variadic lax.sort key operands for a page: (dead-flag,
+    [null-flag_i, key_i...]) — shared by the full sort and the block-wise
+    top-N selection so the two can never disagree on order."""
+    cap = page.capacity
+    ops = _key_operands(page, keys)
+    # dead rows last: most-significant operand
+    ops.insert(0, (~page.live_mask()).astype(jnp.int8))
+    return ops
+
+
 def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
     """Permutation that orders live rows by the sort keys; dead rows last.
 
@@ -86,6 +97,22 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
     import jax
 
     cap = page.capacity
+    ops = _sort_operands(page, keys)
+    if os.environ.get("PRESTO_TPU_FUSED_SORT", "1") == "0":
+        # chip-diagnosis escape hatch: the pre-fused composition —
+        # iterated stable argsort, least-significant operand first
+        perm = jnp.arange(cap, dtype=jnp.int32)
+        for op in reversed(ops):
+            perm = perm[jnp.argsort(op[perm], stable=True)]
+        return perm
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    out = jax.lax.sort(
+        tuple(ops) + (idx,), num_keys=len(ops), is_stable=True
+    )
+    return out[-1]
+
+
+def _key_operands(page: Page, keys: Sequence[SortKey]):
     ops = []
     for k in keys:
         v = evaluate(k.expr, page)
@@ -122,20 +149,7 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
         if not k.ascending:
             data = ~data.astype(data.dtype)
         ops.append(data)
-    # dead rows last: most-significant operand
-    ops.insert(0, (~page.live_mask()).astype(jnp.int8))
-    if os.environ.get("PRESTO_TPU_FUSED_SORT", "1") == "0":
-        # chip-diagnosis escape hatch: the pre-fused composition —
-        # iterated stable argsort, least-significant operand first
-        perm = jnp.arange(cap, dtype=jnp.int32)
-        for op in reversed(ops):
-            perm = perm[jnp.argsort(op[perm], stable=True)]
-        return perm
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    out = jax.lax.sort(
-        tuple(ops) + (idx,), num_keys=len(ops), is_stable=True
-    )
-    return out[-1]
+    return ops
 
 
 def apply_permutation(page: Page, perm: jnp.ndarray) -> Page:
@@ -150,10 +164,66 @@ def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
     return apply_permutation(page, sort_permutation(page, keys))
 
 
+_TOPN_BLK = 1 << 13  # selection block; also the fast path's N ceiling
+
+
 def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:
-    """ORDER BY + LIMIT n with static output capacity n (TopNOperator)."""
-    s = sort_page(page, keys)
+    """ORDER BY + LIMIT n with static output capacity n (TopNOperator).
+
+    TPU-first selection instead of the reference's bounded heap
+    (operator/TopNOperator.java GroupedTopNBuilder): for small n over a
+    big page, per-BLOCK variadic sorts keep each block's first n
+    candidates (any global top-n row is in its block's top-n), one small
+    sort over the B*n candidates picks the winners, and only THEN are
+    the payload columns gathered — n rows instead of the whole page.
+    The full sort + full-page gather only remains for big n. Ties break
+    by original row id in both paths (stable), so the two agree
+    exactly."""
+    import jax
+
     cap = min(n, page.capacity)
+    if (
+        n <= _TOPN_BLK // 4
+        and page.capacity >= 4 * _TOPN_BLK
+        and os.environ.get("PRESTO_TPU_BLOCK_TOPN", "1") != "0"
+    ):
+        ops = _sort_operands(page, keys)
+        idx = jnp.arange(page.capacity, dtype=jnp.int32)
+        blk = _TOPN_BLK
+        pad = (-page.capacity) % blk
+        if pad:
+            # padding rows carry dead-flag 2 > any real flag: sort last
+            ops = [
+                jnp.concatenate(
+                    [o, jnp.full((pad,), 2 if i == 0 else 0, o.dtype)]
+                )
+                for i, o in enumerate(ops)
+            ]
+            idx = jnp.concatenate(
+                [idx, jnp.zeros((pad,), jnp.int32)]
+            )
+        B = (page.capacity + pad) // blk
+        blocked = [o.reshape(B, blk) for o in ops] + [idx.reshape(B, blk)]
+        out = jax.lax.sort(
+            tuple(blocked),
+            dimension=1,
+            num_keys=len(ops),
+            is_stable=True,
+        )
+        cands = [o[:, :n].reshape(-1) for o in out]
+        final = jax.lax.sort(
+            tuple(cands),
+            num_keys=len(ops) + 1,  # idx as last key: exact stable ties
+            is_stable=True,
+        )
+        perm = final[-1][:cap]
+        blocks = []
+        for b in page.blocks:
+            nb = b.take_rows(perm)
+            blocks.append(nb)
+        count = jnp.minimum(page.count, cap).astype(jnp.int32)
+        return Page(tuple(blocks), page.names, count)
+    s = sort_page(page, keys)
     blocks = []
     for b in s.blocks:
         data = b.data[:cap]

@@ -345,3 +345,36 @@ def test_sort_float_signs_nans_negzero():
     got_d = [r[1] for r in desc]
     assert got_d[-1] == 2  # NaN still last under DESC
     assert got_d[:3] == [5, 0, 7]  # inf, 21.2, 1e-300
+
+
+def test_block_topn_matches_full_sort():
+    """Round-5 block-wise TopN selection: per-block candidate sorts +
+    final candidate sort + n-row gather must match the full stable sort
+    exactly — heavy ties, descending float key, nulls."""
+    import os
+
+    from presto_tpu.expr.ir import col
+    from presto_tpu.ops.sort import SortKey, top_n
+
+    rng = np.random.default_rng(1)
+    n = 1 << 17
+    b = rng.standard_normal(n)
+    bv = rng.random(n) > 0.01  # some NULLs
+    pg = Page.from_dict(
+        {
+            "a": rng.integers(0, 50, n).astype(np.int64),
+            "b": Block.from_numpy(b, T.DOUBLE, valid=bv),
+            "c": np.arange(n, dtype=np.int64),
+        }
+    )
+    keys = (
+        SortKey(col("a", T.BIGINT)),
+        SortKey(col("b", T.DOUBLE), ascending=False),
+    )
+    fast = top_n(pg, keys, 100)
+    os.environ["PRESTO_TPU_BLOCK_TOPN"] = "0"
+    try:
+        slow = top_n(pg, keys, 100)
+    finally:
+        os.environ.pop("PRESTO_TPU_BLOCK_TOPN")
+    assert fast.to_pylist() == slow.to_pylist()
