@@ -37,12 +37,32 @@ _MAGIC = b"PTP1"
 MAX_PAGE_BYTES = 1 << 30
 
 # zstd (codec 3) is optional: gate on import so the serde stays
-# dependency-free where the wheel is absent
+# dependency-free where the wheel is absent. (De)compressor objects are
+# NOT thread-safe — the exchange path serializes from producer threads
+# and deserializes from puller threads concurrently — so instances live
+# in thread-local storage. `_zstd_c` stays a truthy sentinel for the
+# codec-availability checks (tests monkeypatch it to None).
 try:
+    import threading as _threading
+
     import zstandard as _zstd
 
-    _zstd_c = _zstd.ZstdCompressor(level=1)
+    _zstd_c = _zstd.ZstdCompressor(level=1)  # availability sentinel
     _zstd_d = _zstd.ZstdDecompressor()
+    _zstd_tls = _threading.local()
+
+    def _zstd_compress(raw: bytes) -> bytes:
+        c = getattr(_zstd_tls, "c", None)
+        if c is None:
+            c = _zstd_tls.c = _zstd.ZstdCompressor(level=1)
+        return c.compress(raw)
+
+    def _zstd_decompress(data: bytes, max_output_size: int) -> bytes:
+        d = getattr(_zstd_tls, "d", None)
+        if d is None:
+            d = _zstd_tls.d = _zstd.ZstdDecompressor()
+        return d.decompress(data, max_output_size=max_output_size)
+
 except Exception:  # noqa: BLE001
     _zstd_c = _zstd_d = None
 
@@ -126,7 +146,7 @@ def serialize_page(
     # zlib > raw-if-incompressible. The codec byte keeps old readers'
     # frames decodable either way.
     if _zstd_c is not None:
-        packed = _zstd_c.compress(raw)
+        packed = _zstd_compress(raw)
         if len(packed) < len(raw):
             return _MAGIC + b"\x03" + packed
         return _MAGIC + b"\x00" + raw
@@ -179,9 +199,7 @@ def deserialize_page(
         if _zstd_d is None:
             raise ValueError("zstd page received but zstandard missing")
         # untrusted wire input: stream-bound the inflated size like zlib
-        raw = _zstd_d.decompress(
-            data[5:], max_output_size=MAX_PAGE_BYTES
-        )
+        raw = _zstd_decompress(data[5:], MAX_PAGE_BYTES)
     else:
         raise ValueError(f"unknown page codec {codec}")
     view = memoryview(raw)
