@@ -566,6 +566,25 @@ def _eval_const(e: ir.Call):
         return _FOLD_FAIL
 
 
+def _merge_adjacent_unions(node: N.Union, caps) -> Optional[N.PlanNode]:
+    """Union(Union(a,b),c) -> Union(a,b,c) (reference MergeAdjacentUnions
+    / pruning SetOperationNodeUtils): one concat kernel instead of a
+    chain. A DISTINCT child union cannot be inlined into an ALL parent
+    (it dedupes first); any child inlines into a DISTINCT parent."""
+    flat = []
+    changed = False
+    for c in node.inputs:
+        if isinstance(c, N.Union) and (node.distinct or not c.distinct):
+            # channel names already unified by the planner contract
+            flat.extend(c.inputs)
+            changed = True
+        else:
+            flat.append(c)
+    if not changed:
+        return None
+    return dataclasses.replace(node, inputs=tuple(flat))
+
+
 def _simplify_filter(node: N.Filter, caps) -> Optional[N.PlanNode]:
     ne, changed = _fold_expr(node.predicate)
     return dataclasses.replace(node, predicate=ne) if changed else None
@@ -673,6 +692,7 @@ def default_rules() -> List[Rule]:
         ),
         Rule("SimplifyFilterExpressions", P(N.Filter), _simplify_filter),
         Rule("SimplifyProjectExpressions", P(N.Project), _simplify_project),
+        Rule("MergeAdjacentUnions", P(N.Union), _merge_adjacent_unions),
     ]
 
 

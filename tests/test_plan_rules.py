@@ -371,3 +371,26 @@ def test_simplify_null_folds_to_null_literal():
     out = rewrite(N.Project(scan("a"), (e,), ("n",)))
     folded = out.exprs[0]
     assert isinstance(folded, ir.Literal) and folded.value is None
+
+
+def test_merge_adjacent_unions():
+    u = N.Union(
+        (N.Union((scan("a"), scan("a")), distinct=False), scan("a")),
+        distinct=False,
+    )
+    out = rewrite(u)
+    assert isinstance(out, N.Union) and len(out.inputs) == 3
+    # DISTINCT child must NOT inline into an ALL parent
+    u2 = N.Union(
+        (N.Union((scan("a"), scan("a")), distinct=True), scan("a")),
+        distinct=False,
+    )
+    out2 = rewrite(u2)
+    assert len(out2.inputs) == 2
+    # anything inlines into a DISTINCT parent
+    u3 = N.Union(
+        (N.Union((scan("a"), scan("a")), distinct=True), scan("a")),
+        distinct=True,
+    )
+    out3 = rewrite(u3)
+    assert len(out3.inputs) == 3 and out3.distinct
