@@ -41,6 +41,9 @@ run() {  # run <tag> <timeout_s> <cmd...>; skip if done; record; gate after
   fi
   # done either way: a crashed stage is evidence too, don't re-crash on resume
   echo "$tag" >> "$DONE"
+  # commit evidence immediately: a later wedge or round-end must not lose it
+  git add TPU_FOLLOWUP.jsonl TPU_BENCH.json TPU_MICRO.json TPU_BENCH_SF10.json 2>/dev/null
+  git -c user.email=bench@local -c user.name=bench commit -q -m "chip evidence: $tag" 2>/dev/null
   alive || { echo "$(date -u +%FT%TZ) tunnel dead after [$tag] - repoll" >> $LOG; return 1; }
 }
 
