@@ -40,6 +40,39 @@ def _fmt(v) -> str:
     return str(v)
 
 
+
+
+def split_statements(text: str):
+    """Split a multi-statement string on top-level semicolons (respects
+    single/double-quoted spans — the reference CLI's --execute accepts
+    'stmt; stmt; ...')."""
+    out, buf, q = [], [], None
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if q:
+            buf.append(c)
+            if c == q:
+                if i + 1 < n and text[i + 1] == q:  # escaped quote
+                    buf.append(text[i + 1])
+                    i += 1
+                else:
+                    q = None
+        elif c in ("'", '"'):
+            q = c
+            buf.append(c)
+        elif c == ";":
+            if "".join(buf).strip():
+                out.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    if "".join(buf).strip():
+        out.append("".join(buf).strip())
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="presto-tpu")
     ap.add_argument("query", nargs="?", help="SQL to run (REPL if omitted)")
@@ -133,7 +166,8 @@ def main(argv=None):
             print(f"({len(rows)} rows in {dt:.2f}s)")
 
         if args.query:
-            run_remote(args.query)
+            for stmt in split_statements(args.query):
+                run_remote(stmt)
             return
         print(f"presto-tpu CLI — remote {args.server}. End statements with ';'.")
         buf = []
@@ -181,7 +215,8 @@ def main(argv=None):
         print(f"({r.row_count()} rows in {dt:.2f}s)")
 
     if args.query:
-        run_one(args.query)
+        for stmt in split_statements(args.query):
+            run_one(stmt)
         return
 
     print(f"presto-tpu CLI — {banner_name()}. End statements with ';'.")

@@ -200,3 +200,20 @@ def test_benchmark_suites_definitions_and_run():
         assert q["p50_ms"] > 0 and q["rows"] > 0 and not q["error"]
     out2 = run("distributed_sort", sf=0.005, queries=["sort_1col"], runs=1)
     assert out2["queries"]["sort_1col"]["rows"] == 10
+
+
+def test_cli_split_statements():
+    from presto_tpu.cli import split_statements
+
+    assert split_statements("select 1; select 2;") == [
+        "select 1",
+        "select 2",
+    ]
+    # semicolons inside string literals are not separators
+    assert split_statements("select 'a;b'; select ';'") == [
+        "select 'a;b'",
+        "select ';'",
+    ]
+    assert split_statements("select 'it''s; fine'") == [
+        "select 'it''s; fine'"
+    ]
