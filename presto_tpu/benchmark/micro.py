@@ -670,7 +670,10 @@ def run_exchange_bench(sf: float, runs: int = RUNS) -> Optional[Dict]:
     if n_dev < 2:
         return None
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8 home
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
