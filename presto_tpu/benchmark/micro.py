@@ -714,13 +714,22 @@ def run_exchange_bench(sf: float, runs: int = RUNS) -> Optional[Dict]:
 
         return Block(data, t, None)
 
-    smapped = shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(P(), P(axis), P(axis)),
-        out_specs=P(),
-        check_rep=False,
-    )
+    try:
+        smapped = shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+            check_vma=False,  # jax >= 0.8 spelling
+        )
+    except TypeError:
+        smapped = shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+            check_rep=False,
+        )
 
     def step(acc, k, v):
         return smapped(acc, k, v)
