@@ -367,6 +367,16 @@ replicated subtrees delegate to the single-node Executor."""
             shrink=True,
         )
 
+    def _d_sample(self, node):
+        from ..ops.filter import sample_page
+
+        return self._unary(
+            node,
+            ("sample", node),
+            lambda p: sample_page(p, node.fraction, node.seed),
+            shrink=True,
+        )
+
     def _d_filter(self, node: N.Filter):
         return self._unary(
             node,

@@ -191,6 +191,15 @@ class Executor:
         )
         return self._shrink(fn(page), node)
 
+    def _exec_sample(self, node: N.Sample, page: Page) -> Page:
+        from ..ops.filter import sample_page
+
+        fn = self._kernel(
+            node,
+            lambda: lambda p: sample_page(p, node.fraction, node.seed),
+        )
+        return self._shrink(fn(page), node)
+
     def _exec_filter(self, node: N.Filter, page: Page) -> Page:
         fn = self._kernel(node, lambda: lambda p: filter_page(p, node.predicate))
         return self._shrink(fn(page), node)

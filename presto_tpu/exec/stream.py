@@ -336,7 +336,7 @@ class StreamingExecutor:
             hints = _pushdown_hints(node.predicate, node.child)
             for batch in self._stream_scan(node.child, predicate=hints):
                 yield self.local.exec_node(node, batch)
-        elif isinstance(node, (N.Filter, N.Project, N.Unnest)):
+        elif isinstance(node, (N.Filter, N.Project, N.Unnest, N.Sample)):
             # all row-local and stateless: apply per batch (Unnest expands
             # within the batch, keeping the device-memory budget honest)
             for batch in self.stream(node.child):

@@ -59,3 +59,23 @@ def filter_project_page(page: Page, predicate, exprs, names) -> Page:
     if v.valid is not None:
         keep = keep & v.valid
     return compact(projected, keep)
+
+
+def sample_page(page: Page, fraction: float, seed: int) -> Page:
+    """TABLESAMPLE BERNOULLI(p): keep each live row independently with
+    probability `fraction`, decided by a splitmix64 hash of (row
+    position, seed) — deterministic within one plan (the seed is drawn
+    at plan time), stateless across batches (reference SampleNode +
+    bernoulli_sample filter rewrite)."""
+    import numpy as np
+
+    idx = jnp.arange(page.capacity, dtype=jnp.uint64)
+    z = (idx + jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF)) * jnp.uint64(
+        0x9E3779B97F4A7C15
+    )
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> jnp.uint64(31))
+    u = (z >> jnp.uint64(11)).astype(jnp.float64) * (1.0 / (1 << 53))
+    keep = (u < fraction) & page.live_mask()
+    return compact(page, keep)

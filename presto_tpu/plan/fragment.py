@@ -166,6 +166,10 @@ class Fragmenter:
         child, dist = self._visit(node.child)
         return dataclasses.replace(node, child=child), dist
 
+    def _v_sample(self, node):
+        child, dist = self._visit(node.child)
+        return dataclasses.replace(node, child=child), dist
+
     def _v_filter(self, node):
         child, dist = self._visit(node.child)
         return N.Filter(child, node.predicate), dist

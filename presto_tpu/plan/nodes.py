@@ -57,6 +57,27 @@ class TableScan(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Sample(PlanNode):
+    """TABLESAMPLE BERNOULLI/SYSTEM(p) (reference SampleNode; both
+    sample types execute as row-level bernoulli here — SYSTEM's
+    split-level granularity has no analog when a scan is one device
+    array). Seeded at plan time so each query samples differently but
+    one query's plan is deterministic under kernel caching."""
+
+    child: PlanNode
+    fraction: float  # 0..1
+    seed: int
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Unnest(PlanNode):
     """Expand array expressions into rows: child columns replicate per
     element, arrays zip by position (reference UnnestNode +

@@ -39,6 +39,11 @@ def prune_columns(node: N.PlanNode, needed: Set[str]) -> N.PlanNode:
     if isinstance(node, N.SingleRow):
         return node
 
+    if isinstance(node, N.Sample):
+        return dataclasses.replace(
+            node, child=prune_columns(node.child, needed)
+        )
+
     if isinstance(node, N.Unnest):
         child_have = set(node.child.field_names())
         child_needed = needed & child_have

@@ -159,6 +159,12 @@ class StatsDeriver:
     def _d_singlerow(self, node) -> PlanStats:
         return PlanStats(1.0)
 
+    def _d_sample(self, node) -> PlanStats:
+        child = self.stats(node.children[0])
+        return dataclasses.replace(
+            child, rows=max(child.rows * node.fraction, 1.0)
+        )
+
     def _d_filter(self, node: N.Filter) -> PlanStats:
         return filter_stats(self.stats(node.child), node.predicate)
 

@@ -10,6 +10,7 @@ multiplicative < unary < primary).
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import List, Optional, Tuple
 
@@ -792,8 +793,37 @@ class Parser:
         name = self.ident()
         while self.accept("."):  # qualified: catalog.schema.table
             name += "." + self.ident()
+        rel = None
+        if self.accept_word("tablesample"):
+            # TABLESAMPLE binds before the alias in SqlBase.g4
+            # (sampledRelation: aliasedRelation TABLESAMPLE ...), but
+            # accepting it here first keeps `t TABLESAMPLE ...` and
+            # `t alias TABLESAMPLE ...` both parseable
+            rel = self._parse_tablesample(t.Table(name, None))
+            alias, _ = self._parse_alias(required=False)
+            if alias is not None:
+                rel = dataclasses.replace(
+                    rel, relation=t.Table(name, alias)
+                )
+            return rel
         alias, _ = self._parse_alias(required=False)
-        return t.Table(name, alias)
+        rel = t.Table(name, alias)
+        if self.accept_word("tablesample"):
+            rel = self._parse_tablesample(rel)
+        return rel
+
+    def _parse_tablesample(self, rel):
+        method = self.tok.text.lower()
+        if method not in ("bernoulli", "system"):
+            self.error("expected BERNOULLI or SYSTEM")
+        self.i += 1
+        self.expect("(")
+        pct_tok = self.tok
+        if pct_tok.kind != "number":
+            self.error("expected a sample percentage")
+        self.i += 1
+        self.expect(")")
+        return t.TableSample(rel, method, float(pct_tok.text))
 
     def _parse_alias(self, required: bool):
         alias = None

@@ -629,6 +629,17 @@ class Planner:
 
     # -- relations --
     def plan_relation(self, rel, outer, ctes) -> RelationPlan:
+        if isinstance(rel, t.TableSample):
+            import random as _random
+
+            inner = self.plan_relation(rel.relation, outer, ctes)
+            frac = max(0.0, min(rel.percentage / 100.0, 1.0))
+            # plan-time seed: each query samples a fresh subset while the
+            # compiled kernel stays deterministic (reference SampleNode)
+            node = N.Sample(
+                inner.node, frac, _random.getrandbits(62)
+            )
+            return RelationPlan(node, inner.scope)
         if isinstance(rel, t.Table):
             return self.plan_table(rel, ctes, outer)
         if isinstance(rel, t.SubqueryRelation):

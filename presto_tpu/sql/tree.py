@@ -200,6 +200,15 @@ class Table(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class TableSample(Node):
+    """relation TABLESAMPLE BERNOULLI|SYSTEM (percentage)."""
+
+    relation: Node
+    method: str  # bernoulli | system
+    percentage: float
+
+
+@dataclasses.dataclass(frozen=True)
 class SubqueryRelation(Node):
     query: "Query"
     alias: str

@@ -120,3 +120,38 @@ def test_show_grants():
     ]
     # no access control installed: empty result, not an error
     assert Session(MemoryCatalog({})).query("show grants").rows() == []
+
+
+def test_tablesample_bernoulli_and_system():
+    """TABLESAMPLE (reference SqlBase.g4 sampledRelation + SampleNode):
+    row-level bernoulli with a plan-time seed — fresh subset per query,
+    proportionate counts, aliases still bind."""
+    from presto_tpu.connectors.tpch import TpchCatalog
+
+    s = Session(TpchCatalog(sf=0.01))
+    n = s.query("select count(*) from lineitem").rows()[0][0]
+    a = s.query(
+        "select count(*) from lineitem tablesample bernoulli (50)"
+    ).rows()[0][0]
+    b = s.query(
+        "select count(*) from lineitem tablesample bernoulli (50)"
+    ).rows()[0][0]
+    assert 0.4 * n < a < 0.6 * n and 0.4 * n < b < 0.6 * n
+    assert a != b  # fresh seed per planned query
+    c = s.query(
+        "select count(*) from lineitem tablesample system (10)"
+    ).rows()[0][0]
+    assert 0.05 * n < c < 0.15 * n
+    # alias + join still work around the sample
+    r = s.query(
+        "select count(*) from lineitem tablesample bernoulli (20) l, "
+        "orders o where l.l_orderkey = o.o_orderkey"
+    ).rows()[0][0]
+    assert 0.1 * n < r < 0.3 * n
+    # 0 and 100 percent edges
+    assert s.query(
+        "select count(*) from lineitem tablesample bernoulli (0)"
+    ).rows() == [(0,)]
+    assert s.query(
+        "select count(*) from lineitem tablesample bernoulli (100)"
+    ).rows() == [(n,)]
