@@ -60,6 +60,7 @@ SPECIAL_FORMS = {
     "in",
     "between",
     "cast",
+    "try_cast",
 }
 
 
@@ -144,6 +145,9 @@ def evaluate(expr: RowExpression, page: Page, n: Optional[int] = None) -> Val:
     if name == "cast":
         v = evaluate(expr.args[0], page)
         return _cast_val(v, expr.type)
+    if name == "try_cast":
+        v = evaluate(expr.args[0], page)
+        return _cast_val(v, expr.type, null_on_failure=True)
 
     vals = [evaluate(a, page) for a in expr.args]
     return apply_function(name, vals, expr.type)
@@ -243,12 +247,19 @@ def _align_pair(a: Val, b: Val, out_type: T.Type):
     return ca, cb
 
 
-def _cast_val(v: Val, to: T.Type) -> Val:
+def _cast_val(v: Val, to: T.Type, null_on_failure: bool = False) -> Val:
     frm = v.type
     if frm == to:
         return v
     if isinstance(frm, T.UnknownType):
         return Val(jnp.zeros(v.data.shape, to.storage_dtype), jnp.zeros(v.data.shape, jnp.bool_), to)
+    if isinstance(frm, T.VarcharType) and not isinstance(
+        to, (T.VarcharType, T.DateType)
+    ):
+        # varchar -> numeric/boolean: parse once per DICTIONARY entry on
+        # host (the date-cast model below). CAST raises on any
+        # unparseable entry; TRY_CAST maps those entries to NULL.
+        return _cast_varchar_entries(v, to, null_on_failure)
     if isinstance(to, T.VarcharType):
         if isinstance(frm, T.VarcharType):
             return Val(v.data, v.valid, to, v.dict_id)
@@ -312,6 +323,76 @@ def _cast_val(v: Val, to: T.Type) -> Val:
         )
         return Val(table[v.data], v.valid, to)
     raise NotImplementedError(f"cast {frm} -> {to}")
+
+
+def _cast_varchar_entries(v: Val, to: T.Type, null_on_failure: bool) -> Val:
+    import decimal as _dec
+
+    d = v.dictionary or ()
+
+    def parse(s: str):
+        s2 = s.strip()
+        try:
+            if isinstance(to, T.BooleanType):
+                low = s2.lower()
+                if low in ("true", "t", "1"):
+                    return 1, True
+                if low in ("false", "f", "0"):
+                    return 0, True
+                return 0, False
+            if T.is_integral(to):
+                return int(s2), True
+            if T.is_floating(to):
+                return float(s2), True
+            if isinstance(to, T.DecimalType):
+                q = _dec.Decimal(s2).scaleb(to.scale).to_integral_value(
+                    rounding=_dec.ROUND_HALF_UP
+                )
+                x = int(q)
+                # two-int64-lane representation bound (ops/decimal128.py)
+                if to.is_long and abs(x) >= (1 << 95):
+                    return 0, False
+                if not to.is_long and abs(x) >= (1 << 63):
+                    return 0, False
+                return x, True
+        except (ValueError, _dec.InvalidOperation, ArithmeticError):
+            return 0, False
+        return 0, False
+
+    parsed = [parse(s) for s in d]
+    bad = [s for s, (_, ok) in zip(d, parsed) if not ok]
+    if bad and not null_on_failure:
+        raise ValueError(
+            f"Cannot cast {bad[0]!r} to {to.display()} (CAST; use "
+            "TRY_CAST for NULL-on-failure)"
+        )
+    if isinstance(to, T.DecimalType) and to.is_long:
+        # long decimals: build (hi, lo) 32-bit lanes from python ints
+        lanes = np.zeros((max(len(parsed), 1), 2), np.int64)
+        for i, (x, _ok) in enumerate(parsed):
+            lanes[i, 0] = x >> 32
+            lanes[i, 1] = x & 0xFFFFFFFF
+        table = jnp.asarray(lanes)
+        data = table[v.data]
+    else:
+        if isinstance(to, T.BooleanType):
+            npdt = np.bool_
+        elif T.is_floating(to):
+            npdt = np.float64 if isinstance(to, T.DoubleType) else np.float32
+        else:
+            npdt = np.int64
+        table = jnp.asarray(
+            np.array([x for x, _ in parsed] or [0], npdt).astype(
+                to.storage_dtype
+            )
+        )
+        data = table[v.data]
+    okt = jnp.asarray(np.array([ok for _, ok in parsed] or [True], bool))
+    ok = okt[v.data]
+    valid = ok if v.valid is None else (v.valid & ok)
+    if not bad:
+        valid = v.valid  # all entries parse: keep original nullability
+    return Val(data, valid, to)
 
 
 def _rescale_int(data, from_scale: int, to_scale: int):

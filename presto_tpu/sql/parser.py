@@ -918,6 +918,23 @@ class Parser:
             if op is None:
                 break
             self.i += 1
+            if self.at_kw("all") or (
+                self.tok.kind == "ident"
+                and self.tok.text.lower() in ("any", "some")
+            ):
+                quant = "all" if self.at_kw("all") else "any"
+                self.i += 1
+                self.expect("(")
+                if self.at_kw("select", "with"):
+                    q = self.parse_query()
+                elif self.at_kw("values"):
+                    v = self.parse_values()
+                    q = t.Query(v)
+                else:
+                    self.error("expected a subquery after ALL/ANY/SOME")
+                self.expect(")")
+                e = t.quantified_comparison(op, quant, e, q)
+                continue
             # quantified comparison / subquery comparand
             if self.tok.kind == "(" and self.peek().kind == "kw" and self.peek().text in ("select", "with"):
                 self.i += 1

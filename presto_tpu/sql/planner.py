@@ -2475,12 +2475,10 @@ class SelectContext:
             v = self._tr(ast.operand)
             to = T.parse_type(ast.type_name)
             if ast.try_cast and to != v.type:
-                # TRY_CAST returns NULL on conversion failure; translating it
-                # as a plain cast would silently drop that semantic.
-                raise PlanningError(
-                    f"TRY_CAST({v.type.display()} AS {to.display()}) "
-                    "not yet supported"
-                )
+                # NULL instead of error on conversion failure — its own
+                # special form so the kernel knows to map bad entries to
+                # NULL rather than raise (compiler._cast_varchar_entries)
+                return ir.Call("try_cast", (v,), to)
             return ir.cast(v, to)
         if isinstance(ast, t.Extract):
             v = self._tr(ast.operand)
@@ -2651,6 +2649,18 @@ class SelectContext:
 
     def _function(self, ast: t.FunctionCall) -> ir.RowExpression:
         name = ast.name
+        if name == "try":
+            # reference TryFunction: NULL instead of an error. Device
+            # kernels never raise data-dependent errors (XLA semantics:
+            # 1/0, overflow etc. produce values, not exceptions), so the
+            # only TRY-visible failures are cast failures — route
+            # try(cast(..)) onto try_cast; everything else passes through
+            if len(ast.args) != 1:
+                raise PlanningError("try() takes exactly one argument")
+            arg = ast.args[0]
+            if isinstance(arg, t.Cast):
+                arg = dataclasses.replace(arg, try_cast=True)
+            return self._tr(arg)
         if name in AGG_FUNCS or name in REWRITE_AGG_FUNCS:
             raise PlanningError(
                 f"aggregate {name} in invalid context (window functions later)"

@@ -131,6 +131,61 @@ class ScalarSubquery(Node):
     query: "Query"
 
 
+def quantified_comparison(op: str, quantifier: str, value: Node,
+                          query: "Query") -> Node:
+    """x op ALL|ANY|SOME (subquery) desugared at parse time (reference
+    quantifiedComparison + the TransformQuantifiedComparison rewrite):
+    = ANY is IN, <> ALL is NOT IN; ordering comparisons reduce onto
+    min/max/count aggregates of the subquery, with empty-set and NULL
+    semantics expressed as a searched CASE."""
+    if op == "=" and quantifier == "any":
+        return InSubquery(value, query, False)
+    if op == "<>" and quantifier == "all":
+        return InSubquery(value, query, True)
+    if op not in ("<", "<=", ">", ">="):
+        raise ValueError(f"quantified {op} {quantifier.upper()} unsupported")
+    rel = SubqueryRelation(query, "$qc", ("v",))
+    v = Identifier(("v",))
+
+    def agg(fn, star=False):
+        sel = Select(
+            (SelectItem(FunctionCall(fn, () if star else (v,), is_star=star)),),
+            rel,
+        )
+        return ScalarSubquery(Query(sel))
+
+    if quantifier == "all":
+        bound = agg("max" if op in (">", ">=") else "min")
+    else:
+        bound = agg("min" if op in (">", ">=") else "max")
+    cnt_all = agg("count", star=True)
+    cnt_val = agg("count")
+    zero = NumberLiteral("0")
+    cmp_bound = BinaryOp(op, value, bound)
+    has_null = BinaryOp("<>", cnt_all, cnt_val)
+    if quantifier == "all":
+        return Case(
+            None,
+            (
+                (BinaryOp("=", cnt_all, zero), BooleanLiteral(True)),
+                (IsNull(value, False), NullLiteral()),
+                (NotOp(cmp_bound), BooleanLiteral(False)),
+                (has_null, NullLiteral()),
+            ),
+            BooleanLiteral(True),
+        )
+    return Case(
+        None,
+        (
+            (BinaryOp("=", cnt_all, zero), BooleanLiteral(False)),
+            (IsNull(value, False), NullLiteral()),
+            (cmp_bound, BooleanLiteral(True)),
+            (has_null, NullLiteral()),
+        ),
+        BooleanLiteral(False),
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class Like(Node):
     value: Node

@@ -109,3 +109,86 @@ def test_map_zip_with_key_mismatch_rejected(session):
             "map_zip_with(map(array[1], array[1]), "
             "map(array['a'], array[1]), (k, v1, v2) -> v1)",
         )
+
+
+@pytest.fixture(scope="module")
+def vsession():
+    from presto_tpu.page import Page
+
+    return Session(
+        MemoryCatalog(
+            {"t": Page.from_dict({"v": ["12", " 34 ", "x", "5.7", None]})}
+        )
+    )
+
+
+def test_cast_varchar_to_numeric(vsession):
+    # round-5 session-3 fix: this used to return the DICTIONARY CODE (0)
+    assert vsession.query("select cast('12' as bigint)").rows() == [(12,)]
+    assert vsession.query("select cast('1.5' as double)").rows() == [(1.5,)]
+    assert vsession.query(
+        "select cast('3.25' as decimal(10,2))"
+    ).rows()[0][0] == pytest.approx(3.25)
+    # CAST raises on unparseable entries; TRY_CAST maps them to NULL
+    with pytest.raises(Exception):
+        vsession.query("select cast(v as bigint) from t").rows()
+    assert vsession.query(
+        "select try_cast(v as bigint) from t"
+    ).rows() == [(12,), (34,), (None,), (None,), (None,)]
+    assert vsession.query(
+        "select try_cast(v as double) from t"
+    ).rows() == [(12.0,), (34.0,), (None,), (5.7,), (None,)]
+
+
+def test_try_function(vsession):
+    assert vsession.query(
+        "select try(cast('abc' as bigint)) a, try(1 + 1) b"
+    ).rows() == [(None, 2)]
+
+
+def test_cast_varchar_boolean_and_long_decimal(vsession):
+    assert vsession.query(
+        "select cast('true' as boolean), try_cast('nope' as boolean)"
+    ).rows() == [(True, None)]
+    import decimal
+
+    assert vsession.query(
+        "select cast('12345678901234567890.5' as decimal(38,1))"
+    ).rows() == [(decimal.Decimal("12345678901234567890.5"),)]
+    # beyond the two-lane range: CAST errors, TRY_CAST nulls
+    with pytest.raises(Exception):
+        vsession.query(
+            "select cast('123456789012345678901234567890.5' "
+            "as decimal(38,1))"
+        ).rows()
+    assert vsession.query(
+        "select try_cast('123456789012345678901234567890.5' "
+        "as decimal(38,1))"
+    ).rows() == [(None,)]
+
+
+def test_quantified_comparisons(vsession):
+    q = vsession.query
+    assert q("select 3 > all (values (1),(2))").rows() == [(True,)]
+    assert q("select 2 > all (values (1),(2))").rows() == [(False,)]
+    assert q("select 1 > any (values (1),(2))").rows() == [(False,)]
+    assert q("select 2 > any (values (1),(2))").rows() == [(True,)]
+    # empty set: ALL -> true, ANY -> false
+    assert q("select 1 > all (select 5 where false)").rows() == [(True,)]
+    assert q("select 1 > any (select 5 where false)").rows() == [(False,)]
+    # NULLs poison undecided comparisons
+    assert q(
+        "select 1 > all (select cast(null as bigint))"
+    ).rows() == [(None,)]
+    assert q(
+        "select 5 > any (values (1), (cast(null as bigint)))"
+    ).rows() == [(True,)]
+    # = ANY is IN; <> ALL is NOT IN (WHERE context, like IN itself)
+    assert q(
+        "select count(*) from (select 2 x) s "
+        "where x = any (values (1),(2))"
+    ).rows() == [(1,)]
+    assert q(
+        "select count(*) from (select 3 x) s "
+        "where x <> all (values (1),(2))"
+    ).rows() == [(1,)]
