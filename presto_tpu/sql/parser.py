@@ -874,6 +874,35 @@ class Parser:
         while True:
             if self.accept_kw("is"):
                 negated = self.accept_kw("not")
+                if self.accept_kw("distinct"):
+                    # IS [NOT] DISTINCT FROM: null-safe comparison
+                    # (SqlBase.g4 predicate DISTINCT FROM) — desugared:
+                    # both-null -> not-distinct; one-null -> distinct;
+                    # else plain <>/=
+                    self.expect_kw("from")
+                    other = self.parse_additive()
+                    both_null = t.LogicalOp(
+                        "and", (t.IsNull(e, False), t.IsNull(other, False))
+                    )
+                    either_null = t.LogicalOp(
+                        "or", (t.IsNull(e, False), t.IsNull(other, False))
+                    )
+                    cmp_ = t.BinaryOp("<>" if not negated else "=", e, other)
+                    e = t.Case(
+                        None,
+                        (
+                            (
+                                both_null,
+                                t.BooleanLiteral(negated),
+                            ),
+                            (
+                                either_null,
+                                t.BooleanLiteral(not negated),
+                            ),
+                        ),
+                        cmp_,
+                    )
+                    continue
                 self.expect_kw("null")
                 e = t.IsNull(e, negated)
                 continue
@@ -1062,6 +1091,23 @@ class Parser:
             operand = self.parse_expr()
             self.expect(")")
             return t.Extract(field, operand)
+        if (
+            tok.kind == "ident"
+            and tok.text.lower() == "position"
+            and self.peek().kind == "("
+        ):
+            # position(sub IN str) (SqlBase.g4 POSITION) = strpos(str, sub);
+            # the plain position(str, sub) call form stays a normal call
+            save = self.i
+            self.i += 2
+            # additive level: the IN here is the POSITION keyword form,
+            # not the membership predicate
+            sub = self.parse_additive()
+            if self.accept_kw("in"):
+                hay = self.parse_additive()
+                self.expect(")")
+                return t.FunctionCall("strpos", (hay, sub))
+            self.i = save
         if self.at_kw("substring"):
             # substring(x FROM a [FOR b]) or substring(x, a, b)
             self.i += 1

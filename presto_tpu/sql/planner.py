@@ -2367,6 +2367,20 @@ class SelectContext:
             return ir.Literal(None, T.UNKNOWN)
         if isinstance(ast, t.DateLiteral):
             return ir.Literal(ast.value, T.DATE)
+        if isinstance(ast, t.TimestampLiteral):
+            import datetime as _dt
+
+            txt = ast.value.strip()
+            fmt = (
+                "%Y-%m-%d %H:%M:%S.%f" if "." in txt
+                else ("%Y-%m-%d %H:%M:%S" if ":" in txt else "%Y-%m-%d")
+            )
+            epoch = _dt.datetime(1970, 1, 1)
+            us = int(
+                (_dt.datetime.strptime(txt, fmt) - epoch).total_seconds()
+                * 1_000_000
+            )
+            return ir.Literal(us, T.TIMESTAMP)
         if isinstance(ast, t.IntervalLiteral):
             n = int(ast.value) * (-1 if ast.negative else 1)
             if ast.unit in ("year", "month"):
@@ -2482,7 +2496,12 @@ class SelectContext:
             return ir.cast(v, to)
         if isinstance(ast, t.Extract):
             v = self._tr(ast.operand)
-            if ast.field not in ("year", "month", "day", "quarter"):
+            fields = (
+                "year", "month", "day", "quarter", "hour", "minute",
+                "second", "week", "day_of_week", "dow", "day_of_year",
+                "doy", "year_of_week", "yow",
+            )
+            if ast.field not in fields:
                 raise PlanningError(f"extract({ast.field}) not supported")
             return ir.Call(ast.field, (v,), T.BIGINT)
         if isinstance(ast, t.ArrayLiteral):

@@ -192,3 +192,30 @@ def test_quantified_comparisons(vsession):
         "select count(*) from (select 3 x) s "
         "where x <> all (values (1),(2))"
     ).rows() == [(1,)]
+
+
+def test_is_distinct_from(session):
+    q = session.query
+    assert q("select 1 is distinct from 2").rows() == [(True,)]
+    assert q("select 1 is distinct from 1").rows() == [(False,)]
+    assert q("select null is distinct from 1").rows() == [(True,)]
+    assert q("select null is distinct from null").rows() == [(False,)]
+    assert q("select null is not distinct from null").rows() == [(True,)]
+
+
+def test_timestamp_literal_and_extract_time(session):
+    assert session.query(
+        "select extract(hour from timestamp '2001-01-01 03:04:05'), "
+        "extract(minute from timestamp '2001-01-01 03:04:05'), "
+        "extract(second from timestamp '2001-01-01 03:04:05')"
+    ).rows() == [(3, 4, 5)]
+    assert session.query(
+        "select extract(dow from date '2026-08-01')"
+    ).rows() == [(6,)]
+
+
+def test_position_in_syntax(session):
+    assert session.query("select position('b' in 'abc')").rows() == [(2,)]
+    assert session.query("select position('x' in 'abc')").rows() == [(0,)]
+    # plain call form unchanged
+    assert session.query("select position('abc', 'b')").rows() == [(2,)]
