@@ -1281,6 +1281,31 @@ class Planner:
                     fname, e, self.channel(fname), e.type, input2=k
                 )
             else:
+                if len(call.args) == 2 and call.distinct and fname == "count":
+                    # count(DISTINCT a, b): dedupe jointly over both
+                    # channels (the Distinct-rewrite projects input AND
+                    # input2), count tuples with no NULL component
+                    e = sctx.translate(call.args[0])
+                    e2 = sctx.translate(call.args[1])
+                    if filt is not None:
+                        e = ir.Call(
+                            "if", (filt, e, ir.Literal(None, e.type)), e.type
+                        )
+                    spec = AggSpec(
+                        "distinct_count", e, self.channel(fname), T.BIGINT,
+                        input2=e2,
+                    )
+                    aggs.append(spec)
+                    agg_map[orig_call] = (spec.name, spec.output_type)
+                    continue
+                if len(call.args) != 1:
+                    raise PlanningError(
+                        f"{fname} takes one argument"
+                        + (
+                            " (DISTINCT over more than 2 columns not "
+                            "supported)" if call.distinct else ""
+                        )
+                    )
                 (arg,) = call.args
                 e = sctx.translate(arg)
                 if filt is not None:
@@ -1472,7 +1497,7 @@ class Planner:
                 N.Aggregate(child, tuple(group_exprs), tuple(group_names), tuple(aggs)),
                 False,
             )
-        if len({a.input for a in distinct_specs}) > 1:
+        if len({(a.input, a.input2) for a in distinct_specs}) > 1:
             # the dedupe below is joint over all distinct arguments; with
             # different arguments it would overcount — refuse loudly
             raise PlanningError(
@@ -1487,20 +1512,54 @@ class Planner:
         proj_exprs = list(group_exprs)
         proj_names = list(group_names)
         inner_names = []
+        pair_names = {}
         for a in distinct_specs:
             ch = self.channel("darg")
             proj_exprs.append(a.input)
             proj_names.append(ch)
             inner_names.append(ch)
+            if a.input2 is not None:
+                # multi-column DISTINCT: the second channel joins the
+                # dedupe key (count(DISTINCT a, b) = distinct tuples)
+                ch2 = self.channel("darg")
+                proj_exprs.append(a.input2)
+                proj_names.append(ch2)
+                pair_names[ch] = (ch2, a.input2.type)
         pre = N.Distinct(N.Project(child, tuple(proj_exprs), tuple(proj_names)))
         new_groups = tuple(
             ir.ColumnRef(n, e.type) for n, e in zip(group_names, group_exprs)
         )
+
+        def final_input(a, ch):
+            inp = ir.ColumnRef(ch, a.input.type)
+            if ch in pair_names:
+                ch2, t2 = pair_names[ch]
+                # SQL count over multiple args: tuples with ANY null
+                # component do not count
+                guard = ir.Call(
+                    "and",
+                    (
+                        ir.Call("is_not_null", (inp,), T.BOOLEAN),
+                        ir.Call(
+                            "is_not_null",
+                            (ir.ColumnRef(ch2, t2),),
+                            T.BOOLEAN,
+                        ),
+                    ),
+                    T.BOOLEAN,
+                )
+                return ir.Call(
+                    "if", (guard, inp, ir.Literal(None, inp.type)),
+                    inp.type,
+                )
+            return inp
+
         new_aggs = tuple(
             dataclasses.replace(
                 a,
                 func=a.func.replace("distinct_", ""),
-                input=ir.ColumnRef(ch, a.input.type),
+                input=final_input(a, ch),
+                input2=None,
             )
             for a, ch in zip(distinct_specs, inner_names)
         )

@@ -219,3 +219,27 @@ def test_position_in_syntax(session):
     assert session.query("select position('x' in 'abc')").rows() == [(0,)]
     # plain call form unchanged
     assert session.query("select position('abc', 'b')").rows() == [(2,)]
+
+
+def test_count_distinct_two_columns():
+    from presto_tpu.page import Block, Page
+    from presto_tpu import types as T
+    import numpy as np
+
+    y = Block.from_numpy(
+        np.array([1, 2, 1, 1, 9], np.int64),
+        T.BIGINT,
+        valid=np.array([True, True, True, True, False]),
+    )
+    pg = Page.from_blocks(
+        [Block.from_numpy(np.array([1, 1, 2, 2, 3], np.int64), T.BIGINT), y],
+        ["x", "y"],
+    )
+    s = Session(MemoryCatalog({"t": pg}))
+    # tuples (1,1),(1,2),(2,1),(2,1),(3,NULL): 3 distinct non-null tuples
+    assert s.query("select count(distinct x, y) from t").rows() == [(3,)]
+    assert s.query(
+        "select x, count(distinct x, y) from t group by x order by x"
+    ).rows() == [(1, 2), (2, 1), (3, 0)]
+    with pytest.raises(Exception):
+        s.query("select count(distinct x, y, x) from t").rows()
