@@ -1111,6 +1111,38 @@ class Planner:
 
                 e = sctx.translate(call.args[0])
                 p = sctx.translate(call.args[1])
+                if (
+                    isinstance(p, ir.Call)
+                    and p.name == "array_constructor"
+                    and all(isinstance(x, ir.Literal) for x in p.args)
+                ):
+                    # approx_percentile(x, ARRAY[f...]) -> one percentile
+                    # aggregate per fraction + an array post-formula
+                    # (reference ApproximateLongPercentileArrayAggregations)
+                    if filt is not None:
+                        e = ir.Call(
+                            "if", (filt, e, ir.Literal(None, e.type)),
+                            e.type,
+                        )
+                    refs = []
+                    for x in p.args:
+                        frac = float(x.value)
+                        if not 0.0 <= frac <= 1.0:
+                            raise PlanningError(
+                                "percentile must be in [0, 1]"
+                            )
+                        sp = AggSpec(
+                            "percentile", e, self.channel(fname), e.type,
+                            input2=ir.Literal(frac, T.DOUBLE),
+                        )
+                        aggs.append(sp)
+                        refs.append(ir.ColumnRef(sp.name, sp.output_type))
+                    agg_map[orig_call] = ir.Call(
+                        "array_constructor",
+                        tuple(refs),
+                        T.ArrayType(e.type),
+                    )
+                    continue
                 if not isinstance(p, ir.Literal) or not isinstance(
                     p.value, (int, float, _dec.Decimal)
                 ):

@@ -243,3 +243,19 @@ def test_count_distinct_two_columns():
     ).rows() == [(1, 2), (2, 1), (3, 0)]
     with pytest.raises(Exception):
         s.query("select count(distinct x, y, x) from t").rows()
+
+
+def test_approx_percentile_array_form():
+    from presto_tpu.page import Page
+    import numpy as np
+
+    s = Session(
+        MemoryCatalog(
+            {"t": Page.from_dict({"x": np.arange(1, 101, dtype=np.int64)})}
+        )
+    )
+    assert s.query(
+        "select approx_percentile(x, array[0.5, 0.9]) from t"
+    ).rows() == [([51, 90],)]
+    scalar = s.query("select approx_percentile(x, 0.5) from t").rows()
+    assert scalar == [(51,)]
