@@ -1997,3 +1997,65 @@ def _parse_presto_data_size(a: Val, out_type: T.Type) -> Val:
         return float(m.group(1)) * units[m.group(2)], True
 
     return _dict_table_nullable(a, f, np.float64, T.DOUBLE)
+
+
+@register("array_concat", lambda ts: ts[0])
+def _array_concat(a: Val, b: Val, out_type: T.Type) -> Val:
+    """ARRAY || ARRAY (reference ArrayConcatFunction): output lane j is
+    a's element j while j < len(a), then b's element j - len(a) — two
+    take_along_axis gathers over the padded lanes, no per-row loops."""
+    if a.data.ndim != 2 or b.data.ndim != 2:
+        raise TypeError("array_concat requires array values")
+    da, db, did = a.data, b.data, a.dict_id
+    if (a.dict_id is not None or b.dict_id is not None) and (
+        a.dict_id != b.dict_id
+    ):
+        from .functions import unify_dictionaries
+
+        da, db, did = unify_dictionaries(a, b)
+    if da.dtype != db.dtype:
+        wide = jnp.promote_types(da.dtype, db.dtype)
+        da, db = da.astype(wide), db.astype(wide)
+    cap, wa = da.shape[0], da.shape[1]
+    wb = db.shape[1]
+    W = wa + wb
+    la = (
+        a.lengths
+        if a.lengths is not None
+        else jnp.full(cap, wa, jnp.int32)
+    )
+    lb = (
+        b.lengths
+        if b.lengths is not None
+        else jnp.full(cap, wb, jnp.int32)
+    )
+    j = jnp.arange(W, dtype=jnp.int32)[None, :]
+    from_a = j < la[:, None]
+    ia = jnp.clip(j, 0, wa - 1)
+    ib = jnp.clip(j - la[:, None], 0, wb - 1)
+    ga = jnp.take_along_axis(da, ia, axis=1)
+    gb = jnp.take_along_axis(db, ib, axis=1)
+    data = jnp.where(from_a, ga, gb)
+    eva = (
+        a.elem_valid
+        if a.elem_valid is not None
+        else jnp.ones((cap, wa), jnp.bool_)
+    )
+    evb = (
+        b.elem_valid
+        if b.elem_valid is not None
+        else jnp.ones((cap, wb), jnp.bool_)
+    )
+    ev = jnp.where(
+        from_a,
+        jnp.take_along_axis(eva, ia, axis=1),
+        jnp.take_along_axis(evb, ib, axis=1),
+    )
+    return Val(
+        data,
+        and_valid(a.valid, b.valid),
+        out_type,
+        did,
+        lengths=(la + lb).astype(jnp.int32),
+        elem_valid=ev,
+    )

@@ -2502,6 +2502,26 @@ class SelectContext:
             fn = _BINOP_FN[ast.op]
             if ast.op in _CMP_OPS:
                 return ir.Call(fn, (left, right), T.BOOLEAN)
+            if ast.op == "||" and (
+                isinstance(left.type, T.ArrayType)
+                or isinstance(right.type, T.ArrayType)
+            ):
+                # ARRAY || ARRAY, elem || ARRAY, ARRAY || elem (reference
+                # ArrayConcatFunction + the || operator on arrays)
+                def as_array(e):
+                    if isinstance(e.type, T.ArrayType):
+                        return e
+                    return ir.Call(
+                        "array_constructor", (e,), T.ArrayType(e.type)
+                    )
+
+                la, ra = as_array(left), as_array(right)
+                et = T.common_super_type(
+                    la.type.element, ra.type.element
+                )
+                return ir.Call(
+                    "array_concat", (la, ra), T.ArrayType(et)
+                )
             return ir.Call(
                 fn, (left, right), _infer(fn, (left.type, right.type))
             )
@@ -2759,6 +2779,28 @@ class SelectContext:
 
     def _function(self, ast: t.FunctionCall) -> ir.RowExpression:
         name = ast.name
+        if name == "concat" and len(ast.args) >= 2:
+            args = [self._tr(a) for a in ast.args]
+            if any(isinstance(a.type, T.ArrayType) for a in args):
+                # variadic array concat folds left (ArrayConcatFunction)
+                out = args[0]
+                if not isinstance(out.type, T.ArrayType):
+                    out = ir.Call(
+                        "array_constructor", (out,), T.ArrayType(out.type)
+                    )
+                for nxt in args[1:]:
+                    if not isinstance(nxt.type, T.ArrayType):
+                        nxt = ir.Call(
+                            "array_constructor", (nxt,),
+                            T.ArrayType(nxt.type),
+                        )
+                    et = T.common_super_type(
+                        out.type.element, nxt.type.element
+                    )
+                    out = ir.Call(
+                        "array_concat", (out, nxt), T.ArrayType(et)
+                    )
+                return out
         if name == "try":
             # reference TryFunction: NULL instead of an error. Device
             # kernels never raise data-dependent errors (XLA semantics:

@@ -259,3 +259,23 @@ def test_approx_percentile_array_form():
     ).rows() == [([51, 90],)]
     scalar = s.query("select approx_percentile(x, 0.5) from t").rows()
     assert scalar == [(51,)]
+
+
+def test_array_concat_operator(session):
+    q = session.query
+    assert q("select array[1,2] || array[3]").rows() == [([1, 2, 3],)]
+    assert q("select concat(array[1], array[2,3], array[4])").rows() == [
+        ([1, 2, 3, 4],)
+    ]
+    # element promotion on either side
+    assert q("select 2 || array[3]").rows() == [([2, 3],)]
+    assert q("select array[1] || 9").rows() == [([1, 9],)]
+    # varchar dictionaries unify; element NULLs survive
+    assert q("select array['a'] || array['b','c']").rows() == [
+        (["a", "b", "c"],)
+    ]
+    assert q("select array[1, null] || array[3]").rows() == [
+        ([1, None, 3],)
+    ]
+    # string || stays string concat
+    assert q("select 'a' || 'b'").rows() == [("ab",)]
