@@ -137,11 +137,17 @@ class Executor:
         return Page(tuple(blocks), page.names, page.count)
 
     def _est_rows(self, node):
-        """CBO row estimate for a node's output (cached per plan node)."""
+        """CBO row estimate for a node's output (cached per plan node).
+
+        Keyed by the node OBJECT (kept referenced by the cache, so ids
+        cannot be recycled mid-flight) and bounded: a long-lived server
+        session executes unboundedly many plans."""
         cache = getattr(self, "_est_cache", None)
         if cache is None:
             cache = self._est_cache = {}
-        key = id(node)
+        if len(cache) > 4096:
+            cache.clear()
+        key = node
         if key in cache:
             return cache[key]
         try:
