@@ -155,3 +155,24 @@ def test_tablesample_bernoulli_and_system():
     assert s.query(
         "select count(*) from lineitem tablesample bernoulli (100)"
     ).rows() == [(n,)]
+
+
+def test_tablesample_distributed_and_streaming():
+    """The Sample node flows through all three executors (local was
+    covered above; this exercises the shard_map stage and the per-batch
+    streaming wrapper)."""
+    from presto_tpu.connectors.tpch import TpchCatalog
+    from presto_tpu.parallel.mesh import default_mesh
+
+    cat = TpchCatalog(sf=0.01)
+    dist = Session(cat, mesh=default_mesh())
+    n = dist.query("select count(*) from lineitem").rows()[0][0]
+    a = dist.query(
+        "select count(*) from lineitem tablesample bernoulli (50)"
+    ).rows()[0][0]
+    assert 0.4 * n < a < 0.6 * n
+    st = Session(cat, streaming=True, batch_rows=4096)
+    b = st.query(
+        "select count(*) from lineitem tablesample bernoulli (50)"
+    ).rows()[0][0]
+    assert 0.4 * n < b < 0.6 * n
