@@ -374,6 +374,8 @@ def plan_tree_str(
         detail = f" {node.table} [{', '.join(c for c, _, _ in node.columns)}]"
     elif isinstance(node, Filter):
         detail = f" [{node.predicate}]"
+    elif isinstance(node, Sample):
+        detail = f" [bernoulli {node.fraction * 100:g}%]"
     elif isinstance(node, Project):
         detail = f" [{', '.join(f'{n} := {e}' for n, e in zip(node.names, node.exprs))}]"
     elif isinstance(node, Aggregate):
