@@ -175,6 +175,18 @@ def _literal_val(expr: Literal, cap: int) -> Val:
         scaled = int(
             (Decimal(str(expr.value)) * (10**t.scale)).to_integral_value()
         )
+        if t.is_long:
+            # beyond int64: (hi, lo) radix-2^32 lanes (ops/decimal128.py)
+            if abs(scaled) >= (1 << 95):
+                raise ValueError(
+                    f"decimal literal {expr.value} exceeds the two-lane "
+                    "range (~2^95)"
+                )
+            lanes = np.array(
+                [[scaled >> 32, scaled & 0xFFFFFFFF]], np.int64
+            )
+            data = jnp.broadcast_to(jnp.asarray(lanes), (cap, 2))
+            return Val(data, None, t, literal=expr.value)
         return Val(jnp.full(cap, scaled, jnp.int64), None, t, literal=expr.value)
     return Val(
         jnp.full(cap, expr.value, t.storage_dtype), None, t, literal=expr.value

@@ -2957,8 +2957,17 @@ def _number_literal(text: str) -> ir.Literal:
     if "e" in text.lower():
         return ir.Literal(float(text), T.DOUBLE)
     if "." in text:
-        frac = text.split(".")[1]
+        whole, frac = text.split(".")
         scale = len(frac)
+        digits = len(whole.lstrip("-")) + scale
+        if digits > 15:
+            # beyond double's exact-integer range: carry the literal as
+            # an exact Decimal and type it long (two-lane storage)
+            import decimal as _dec
+
+            return ir.Literal(
+                _dec.Decimal(text), T.DecimalType(max(digits, 19), scale)
+            )
         return ir.Literal(float(text), T.DecimalType(18, scale))
     return ir.Literal(int(text), T.BIGINT)
 

@@ -297,3 +297,22 @@ def test_approx_percentile_long_decimal():
         "select approx_percentile(d, 0.5) from t"
     ).rows()[0][0]
     assert got == sorted(vals)[50]
+
+
+def test_big_decimal_literal_exact():
+    """Round-5 session-3: literals beyond double's 15 exact digits carry
+    as exact Decimals typed long (two-lane), not lossy floats typed
+    decimal(18)."""
+    import decimal
+
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.session import Session
+
+    s = Session(MemoryCatalog({}))
+    assert s.query(
+        "select 99999999999999999999.99 + 0.01"
+    ).rows() == [(decimal.Decimal("100000000000000000000.00"),)]
+    assert s.query(
+        "select cast(99999999999999999999.99 as decimal(38,2)) "
+        "+ cast(0.01 as decimal(38,2))"
+    ).rows() == [(decimal.Decimal("100000000000000000000.00"),)]
