@@ -131,6 +131,12 @@ def _arith_infer(op):
                 if isinstance(a, T.DateType) and isinstance(b, T.DateType):
                     return T.BIGINT  # date difference in days
                 return T.DATE
+        if isinstance(a, T.TimestampType) or isinstance(b, T.TimestampType):
+            if op in ("add", "subtract") and any(
+                isinstance(x, (T.IntervalDayType, T.IntervalYearMonthType))
+                for x in (a, b)
+            ):
+                return T.TIMESTAMP
         da, db = isinstance(a, T.DecimalType), isinstance(b, T.DecimalType)
         if T.is_floating(a) or T.is_floating(b):
             return T.DOUBLE
@@ -240,9 +246,29 @@ def _numeric_align(a: Val, b: Val, out_type: T.Type):
 # ---------------------------------------------------------------------------
 
 
+def _ts_interval_shift(ts_data, delta: Val, sign: int):
+    """timestamp +/- interval: day intervals move whole microseconds;
+    year-month intervals move the day component via the civil-calendar
+    month add while preserving time-of-day."""
+    day_us = 86400 * _TS_US
+    if isinstance(delta.type, T.IntervalYearMonthType):
+        days = ts_data // day_us
+        rem = ts_data - days * day_us
+        return dt.add_months(days, sign * delta.data).astype(
+            jnp.int64
+        ) * day_us + rem
+    return ts_data + sign * delta.data.astype(jnp.int64) * day_us
+
+
 @register("add", _arith_infer("add"))
 def _add(a: Val, b: Val, out_type: T.Type) -> Val:
     valid = and_valid(a.valid, b.valid)
+    if isinstance(out_type, T.TimestampType) and any(
+        isinstance(x.type, (T.IntervalDayType, T.IntervalYearMonthType))
+        for x in (a, b)
+    ):
+        ts, delta = (a, b) if isinstance(a.type, T.TimestampType) else (b, a)
+        return Val(_ts_interval_shift(ts.data, delta, 1), valid, T.TIMESTAMP)
     if isinstance(out_type, T.DateType):
         date, delta = (a, b) if isinstance(a.type, T.DateType) else (b, a)
         if isinstance(delta.type, T.IntervalYearMonthType):
@@ -261,6 +287,10 @@ def _add(a: Val, b: Val, out_type: T.Type) -> Val:
 @register("subtract", _arith_infer("subtract"))
 def _subtract(a: Val, b: Val, out_type: T.Type) -> Val:
     valid = and_valid(a.valid, b.valid)
+    if isinstance(out_type, T.TimestampType) and isinstance(
+        b.type, (T.IntervalDayType, T.IntervalYearMonthType)
+    ):
+        return Val(_ts_interval_shift(a.data, b, -1), valid, T.TIMESTAMP)
     if isinstance(out_type, T.DateType):
         if isinstance(b.type, T.IntervalYearMonthType):
             data = dt.add_months(a.data, -b.data)
@@ -1272,7 +1302,7 @@ def _date_trunc(unit: Val, a: Val, out_type: T.Type) -> Val:
     return Val(out.astype(jnp.int32), a.valid, T.DATE)
 
 
-@register("date_add", _datetrunc_infer)
+@register("date_add", lambda ts: ts[2])  # result type = the datetime arg's
 def _date_add(unit: Val, n: Val, a: Val, out_type: T.Type) -> Val:
     u = _require_literal(unit, "date_add unit").lower()
     amount = n.data.astype(jnp.int64)

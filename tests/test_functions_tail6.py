@@ -279,3 +279,37 @@ def test_array_concat_operator(session):
     ]
     # string || stays string concat
     assert q("select 'a' || 'b'").rows() == [("ab",)]
+
+
+def test_timestamp_interval_arithmetic(session):
+    import datetime
+
+    def show(us):
+        return (
+            datetime.datetime(1970, 1, 1)
+            + datetime.timedelta(microseconds=us)
+        ).isoformat()
+
+    q = session.query
+    r = q(
+        "select timestamp '2001-01-01 12:00:00' + interval '1' day"
+    ).rows()[0][0]
+    assert show(r) == "2001-01-02T12:00:00"
+    # month add clamps to month end, preserves time of day
+    r = q(
+        "select timestamp '2001-01-31 01:02:03' + interval '1' month"
+    ).rows()[0][0]
+    assert show(r) == "2001-02-28T01:02:03"
+    r = q(
+        "select timestamp '2001-01-02 00:00:00' - interval '3' day"
+    ).rows()[0][0]
+    assert show(r) == "2000-12-30T00:00:00"
+
+
+def test_date_add_returns_date(session):
+    import numpy as np
+
+    r = session.query(
+        "select date_add('month', 1, date '2001-01-31')"
+    ).rows()[0][0]
+    assert r == np.datetime64("2001-02-28")
