@@ -1161,8 +1161,15 @@ class Parser:
             args.append(self._parse_arg())
             while self.accept(","):
                 args.append(self._parse_arg())
+            order_by = ()
+            if self.accept_kw("order"):
+                # agg(x ORDER BY k ...) (SqlBase.g4 aggregation orderBy)
+                self.expect_kw("by")
+                order_by = self.parse_sort_items()
             self.expect(")")
-            return self._call_suffix(name, args, distinct, is_star)
+            return self._call_suffix(
+                name, args, distinct, is_star, order_by
+            )
         else:
             return self._call_suffix(name, args, distinct, is_star)
         self.expect(")")
@@ -1194,7 +1201,8 @@ class Parser:
                 return t.LambdaExpr(tuple(params), self.parse_expr())
         return self.parse_expr()
 
-    def _call_suffix(self, name, args, distinct, is_star) -> t.Node:
+    def _call_suffix(self, name, args, distinct, is_star,
+                     order_by=()) -> t.Node:
         filt = None
         if self.accept_kw("filter"):
             self.expect("(")
@@ -1204,7 +1212,10 @@ class Parser:
         window = None
         if self.accept_kw("over"):
             window = self.parse_window_spec()
-        return t.FunctionCall(name, tuple(args), distinct, is_star, window, filt)
+        return t.FunctionCall(
+            name, tuple(args), distinct, is_star, window, filt,
+            tuple(order_by),
+        )
 
     def parse_window_spec(self) -> t.WindowSpec:
         self.expect("(")

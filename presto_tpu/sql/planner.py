@@ -794,6 +794,10 @@ class Planner:
                 aggs = [
                     AggSpec("count_star", None, self.channel("gcount"), T.BIGINT)
                 ]
+            agg_order = getattr(self, "_pending_agg_order", None)
+            if agg_order is not None:
+                holder.plan = N.Sort(holder.plan, agg_order)
+                self._pending_agg_order = None
             holder.plan, distinct_rewritten = self._build_aggregate(
                 holder.plan, group_exprs, group_names, aggs
             )
@@ -1059,6 +1063,24 @@ class Planner:
                 continue
             fname = call.name
             orig_call = call
+            if getattr(call, "order_by", ()) and call.window is None:
+                # agg(x ORDER BY k): pre-sort the aggregation input; the
+                # grouped machinery's stable group sort preserves the
+                # within-group order (reference AggregationNode orderBy +
+                # SortedAggregation)
+                keys = tuple(
+                    SortKey(
+                        sctx.translate(si.expr), si.ascending, si.nulls_first
+                    )
+                    for si in call.order_by
+                )
+                pend = getattr(self, "_pending_agg_order", None)
+                if pend is not None and pend != keys:
+                    raise PlanningError(
+                        "aggregates with DIFFERENT ORDER BY orderings in "
+                        "one aggregation are not supported"
+                    )
+                self._pending_agg_order = keys
             if fname == "approx_distinct":
                 # real HyperLogLog estimate (reference
                 # ApproximateCountDistinctAggregations + airlift HLL) with

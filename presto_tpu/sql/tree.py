@@ -211,6 +211,7 @@ class FunctionCall(Node):
     is_star: bool = False  # count(*)
     window: Optional["WindowSpec"] = None
     filter: Optional[Node] = None
+    order_by: Tuple["SortItem", ...] = ()  # agg(x ORDER BY ...)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -313,3 +313,32 @@ def test_date_add_returns_date(session):
         "select date_add('month', 1, date '2001-01-31')"
     ).rows()[0][0]
     assert r == np.datetime64("2001-02-28")
+
+
+def test_array_agg_order_by():
+    from presto_tpu.page import Page
+    import numpy as np
+
+    s = Session(
+        MemoryCatalog(
+            {
+                "t": Page.from_dict(
+                    {
+                        "x": np.array([3, 1, 2, 5, 4], np.int64),
+                        "g": ["a", "a", "b", "b", "b"],
+                    }
+                )
+            }
+        )
+    )
+    assert s.query(
+        "select g, array_agg(x order by x desc) from t group by g order by g"
+    ).rows() == [("a", [3, 1]), ("b", [5, 4, 2])]
+    assert s.query(
+        "select array_agg(g order by x) from t"
+    ).rows() == [(["a", "b", "a", "b", "b"],)]
+    with pytest.raises(Exception):
+        s.query(
+            "select array_agg(x order by x), array_agg(g order by g) "
+            "from t"
+        ).rows()
