@@ -76,6 +76,47 @@ class Executor:
             self._kernels[key] = fn
         return fn
 
+    def _kernel_guarded(self, breaker_name, key, make_fn, *args):
+        """Run a jitted kernel under a kernel-fault circuit breaker
+        (exec/breaker.py). The op layer consults `BREAKERS.allow(name)`
+        at TRACE time to pick the experimental path vs. the safe XLA
+        composition, so the breaker decision is part of the cache key.
+        A fault records a failure and retries ONCE with the fallback
+        FORCED — even when the breaker hasn't opened yet (streak below
+        threshold, or PRESTO_TPU_BREAKER_DISABLE=1), the call that just
+        faulted must still degrade rather than fail the query."""
+        import contextlib
+
+        from .breaker import BREAKERS
+
+        for attempt in (0, 1):
+            if attempt == 0:
+                allowed = BREAKERS.allow(breaker_name)
+                ctx = contextlib.nullcontext()
+            else:
+                allowed = False
+                ctx = BREAKERS.forced_fallback(breaker_name)
+            with ctx:
+                try:
+                    fn = self._kernel((key, breaker_name, allowed), make_fn)
+                    out = fn(*args)
+                except Exception as exc:
+                    if attempt == 0 and allowed:
+                        # the experimental path faulted: count it and
+                        # retry on the forced fallback
+                        BREAKERS.record_failure(breaker_name, repr(exc))
+                        continue
+                    if attempt:
+                        # the FALLBACK failed right after the experimental
+                        # path did: a semantic / user error, not a kernel
+                        # fault — neutralize the breaker hit so a bad
+                        # query can't degrade the kernel for the process
+                        BREAKERS.record_success(breaker_name)
+                    raise
+            if allowed:
+                BREAKERS.record_success(breaker_name)
+            return out
+
     # -- public --
     def run(self, node: N.PlanNode) -> Page:
         page = self._run(node)
@@ -240,19 +281,27 @@ class Executor:
 
             self.pallas_groupby = jax.default_backend() == "tpu"
         if self.pallas_groupby:
+            from .breaker import BREAKERS
             from ..ops.pallas_groupby import maybe_grouped_aggregate
 
-            try:
-                out = maybe_grouped_aggregate(
-                    page, node.group_exprs, node.group_names, node.aggs,
-                    node.mask,
-                )
-            except Exception:
-                # a Mosaic lowering/compile failure must degrade to the
-                # XLA composition, not fail the query (round-5 bench: the
-                # default-on kernel took down the whole SQL stage)
-                self.pallas_groupby = False
-                out = None
+            out = None
+            if BREAKERS.allow("pallas_groupby"):
+                try:
+                    out = maybe_grouped_aggregate(
+                        page, node.group_exprs, node.group_names, node.aggs,
+                        node.mask,
+                    )
+                except Exception as exc:
+                    # a Mosaic lowering/compile failure must degrade to
+                    # the XLA composition, not fail the query (round-5
+                    # bench: the default-on kernel took down the whole
+                    # SQL stage); the breaker keeps the faulting kernel
+                    # from being re-attempted until its recovery window
+                    BREAKERS.record_failure("pallas_groupby", repr(exc))
+                    out = None
+                else:
+                    if out is not None:
+                        BREAKERS.record_success("pallas_groupby")
             if out is not None:
                 self._strategy_note(node, "pallas")
                 return self._shrink(out, node)
@@ -356,7 +405,8 @@ class Executor:
             return self._exec_outer_join(node, left, right)
         right_names = right.names
         if node.unique_build:
-            fn = self._kernel(
+            out = self._kernel_guarded(
+                "join_probe",
                 (node, "n1"),
                 lambda: lambda l, r: join_n1(
                     l,
@@ -366,8 +416,8 @@ class Executor:
                     right_names,
                     kind=node.kind,
                 ),
+                left, right,
             )
-            out = fn(left, right)
             if node.residual is not None:
                 if node.kind != "inner":
                     raise ExecutionError(
@@ -384,7 +434,8 @@ class Executor:
         )
         while True:
             c = cap
-            fn = self._kernel(
+            out, overflow = self._kernel_guarded(
+                "join_probe",
                 (node, "expand", c),
                 lambda: lambda l, r: join_expand(
                     l,
@@ -395,8 +446,8 @@ class Executor:
                     out_capacity=c,
                     kind=node.kind,
                 ),
+                left, right,
             )
-            out, overflow = fn(left, right)
             if int(overflow) == 0:
                 break
             cap = round_capacity(cap + int(overflow))
@@ -629,8 +680,12 @@ class Executor:
 
     # -- ordering / limits --
     def _exec_sort(self, node: N.Sort, page: Page) -> Page:
-        fn = self._kernel(node, lambda: lambda p: sort_page(p, node.keys))
-        return fn(page)
+        return self._kernel_guarded(
+            "fused_sort",
+            (node, "sort"),
+            lambda: lambda p: sort_page(p, node.keys),
+            page,
+        )
 
     def _exec_topn(self, node: N.TopN, page: Page) -> Page:
         fn = self._kernel(

@@ -19,7 +19,7 @@ growth, which its stats never see).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -99,3 +99,29 @@ class StatsCollector:
 
     def total_wall_s(self) -> float:
         return sum(s.wall_s for s in self.by_node.values())
+
+
+def kernel_breaker_snapshot() -> Dict[str, dict]:
+    """State of every kernel circuit breaker (exec/breaker.py) — part of
+    the stats surface so EXPLAIN ANALYZE and operators can report that a
+    kernel path is degraded, not silently slower."""
+    from .breaker import BREAKERS
+
+    return BREAKERS.snapshot()
+
+
+def kernel_breaker_lines() -> List[str]:
+    """Formatted one-per-breaker report lines for non-closed breakers."""
+    lines = []
+    for name, snap in sorted(kernel_breaker_snapshot().items()):
+        if snap["state"] == "closed" and not snap["total_failures"]:
+            continue
+        parts = [f"breaker {name}: {snap['state']}"]
+        if snap["total_failures"]:
+            parts.append(f"{snap['total_failures']} failures")
+        if snap.get("retry_in_s") is not None:
+            parts.append(f"retry in {snap['retry_in_s']:.0f}s")
+        if snap["last_error"]:
+            parts.append(snap["last_error"].splitlines()[0][:80])
+        lines.append(", ".join(parts))
+    return lines

@@ -83,8 +83,18 @@ def build(page: Page, key_exprs) -> BuildSide:
     h = jnp.where(live, h, MAX_HASH)  # dead rows cluster at the end
     order = jnp.argsort(h)
     sh = h[order]
-    if os.environ.get("PRESTO_TPU_JOIN_PROBE", "directory") != "directory":
-        # chip-diagnosis escape hatch: probe via searchsorted only
+    use_directory = (
+        os.environ.get("PRESTO_TPU_JOIN_PROBE", "directory") == "directory"
+    )
+    if use_directory:
+        # kernel-fault circuit breaker (exec/breaker.py): a faulting
+        # directory build degrades every join in the process to the
+        # searchsorted probe until the recovery window elapses
+        from ..exec.breaker import BREAKERS
+
+        use_directory = BREAKERS.allow("join_probe")
+    if not use_directory:
+        # chip-diagnosis escape hatch / open breaker: searchsorted probe
         return BuildSide(sh, order, page, tuple(keys), page.count)
     bits = _pick_bucket_bits(page.capacity)
     nb = 1 << bits

@@ -98,9 +98,16 @@ def sort_permutation(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
 
     cap = page.capacity
     ops = _sort_operands(page, keys)
-    if os.environ.get("PRESTO_TPU_FUSED_SORT", "1") == "0":
-        # chip-diagnosis escape hatch: the pre-fused composition —
-        # iterated stable argsort, least-significant operand first
+    fused = os.environ.get("PRESTO_TPU_FUSED_SORT", "1") != "0"
+    if fused:
+        # kernel-fault circuit breaker (exec/breaker.py): a faulting
+        # fused sort degrades to the argsort composition process-wide
+        from ..exec.breaker import BREAKERS
+
+        fused = BREAKERS.allow("fused_sort")
+    if not fused:
+        # chip-diagnosis escape hatch / open breaker: the pre-fused
+        # composition — iterated stable argsort, least-significant first
         perm = jnp.arange(cap, dtype=jnp.int32)
         for op in reversed(ops):
             perm = perm[jnp.argsort(op[perm], stable=True)]

@@ -5,7 +5,11 @@ Re-designed equivalents (SURVEY L3 + L11 + §2.7):
 * NodeManager — DiscoveryNodeManager + HeartbeatFailureDetector
   (failureDetector/HeartbeatFailureDetector.java:77): periodic /v1/status
   probes, consecutive-failure threshold marks a worker FAILED and excludes
-  it from scheduling.
+  it from scheduling. Consecutive TASK failures additionally BLACKLIST a
+  worker (drained from scheduling even though its /v1/status is healthy —
+  the round-5 failure mode was exactly a live-but-faulting worker); after
+  `blacklist_recovery` seconds a healthy probe re-admits it. State
+  transitions emit worker-up/down events through server/events.py.
 * HttpScheduler — SqlQueryScheduler + SqlStageExecution + HttpRemoteTask
   (execution/scheduler/SqlQueryScheduler.java:112): cuts the fragmented
   plan (plan/fragment.py Exchange tree) at exchange boundaries into
@@ -14,36 +18,87 @@ Re-designed equivalents (SURVEY L3 + L11 + §2.7):
   partition w from every producer — the pull-based FIXED_HASH shuffle),
   and executes the root single-distribution fragment on the coordinator.
 
+Fault tolerance (docs/fault-tolerance.md): unlike the reference (a worker
+loss fails the whole query, SURVEY §5), tasks that fail to START — POST
+refused, or FAILED at the eager status check with a retryable cause — are
+retried with exponential backoff + jitter onto an alternate healthy
+worker, up to `max_task_retries` alternates. Failures past that point
+(mid-stream faults surfacing on the results pull) trigger a bounded
+QUERY-level re-execution against a fresh worker snapshot. Fatal causes
+(low-memory kill, memory exhaustion, protocol violations) are never
+retried. Sibling tasks of an unrecoverable failure are canceled eagerly.
+
 This is the DCN/multi-host data path; exec/dist.py's shard_map collectives
-remain the intra-slice ICI path. No mid-query recovery: a failed task
-fails the query (the reference behaves the same, SURVEY §5)."""
+remain the intra-slice ICI path."""
 
 from __future__ import annotations
 
 import base64
+import dataclasses
 import itertools
 import json
+import os
 import pickle
+import random
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from ..plan import nodes as N
 from ..plan.fragment import Exchange
-from .worker import FragmentExecutor, RemoteSource, _pull_buffer
+from .worker import (
+    _FATAL_MARKERS,
+    FragmentExecutor,
+    RemoteSource,
+    _pull_buffer,
+)
 from .serde import deserialize_page
+
+
+def _retryable_message(msg: str) -> bool:
+    """Classify an unstructured failure message: fatal causes would recur
+    identically on any worker / attempt (see worker._classify_failure)."""
+    return not any(m in msg for m in _FATAL_MARKERS)
+
+
+def _http_error_details(e: "urllib.error.HTTPError") -> Tuple[str, bool]:
+    """(detail, retryable) from a worker's structured error response —
+    a POST 500 carries errorInfo.retryable, which must not be blindly
+    retried away when it says false."""
+    try:
+        payload = json.loads(e.read())
+    except Exception:  # noqa: BLE001
+        payload = {}
+    if not isinstance(payload, dict):
+        payload = {}
+    detail = payload.get("error") or str(e)
+    info = payload.get("errorInfo") or {}
+    return detail, bool(info.get("retryable", _retryable_message(detail)))
 
 
 class NodeManager:
     """Tracks worker liveness via heartbeats; failed nodes are excluded
-    from scheduling until they respond again."""
+    from scheduling until they respond again. Consecutive task failures
+    blacklist (drain) a worker with timed re-admission."""
 
     def __init__(self, worker_uris: List[str], interval: float = 5.0,
-                 failure_threshold: int = 3):
-        self.workers = {u: {"state": "ACTIVE", "failures": 0} for u in worker_uris}
+                 failure_threshold: int = 3,
+                 task_failure_threshold: int = 3,
+                 blacklist_recovery: float = 30.0,
+                 event_bus=None):
+        self.workers = {
+            u: {"state": "ACTIVE", "failures": 0, "task_failures": 0,
+                "blacklisted_at": None}
+            for u in worker_uris
+        }
         self.interval = interval
         self.failure_threshold = failure_threshold
+        self.task_failure_threshold = task_failure_threshold
+        self.blacklist_recovery = blacklist_recovery
+        self.event_bus = event_bus
+        self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
@@ -55,22 +110,97 @@ class NodeManager:
         self._stop.set()
 
     def active_workers(self) -> List[str]:
-        return [u for u, s in self.workers.items() if s["state"] == "ACTIVE"]
+        with self._lock:
+            return [
+                u for u, s in self.workers.items() if s["state"] == "ACTIVE"
+            ]
+
+    def all_workers(self) -> List[str]:
+        with self._lock:
+            return list(self.workers)
+
+    # -- state transitions (events fire outside the lock) --
+
+    def _set_state(self, uri: str, state: str, reason: str) -> None:
+        with self._lock:
+            st = self.workers[uri]
+            if st["state"] == state:
+                return
+            st["state"] = state
+            if state == "BLACKLISTED":
+                st["blacklisted_at"] = time.time()
+            elif state == "ACTIVE":
+                st["failures"] = 0
+                st["task_failures"] = 0
+                st["blacklisted_at"] = None
+        if self.event_bus is not None:
+            self.event_bus.fire_worker_state(uri, state, reason)
+
+    def record_task_failure(self, uri: str, reason: str = "") -> None:
+        """A task on this worker failed to start/run. N consecutive
+        failures drain the worker (reference analog: the coordinator
+        operator manually shutting down a flaky node)."""
+        with self._lock:
+            st = self.workers.get(uri)
+            if st is None:
+                return
+            st["task_failures"] += 1
+            drain = (
+                st["state"] == "ACTIVE"
+                and st["task_failures"] >= self.task_failure_threshold
+            )
+        if drain:
+            self._set_state(
+                uri, "BLACKLISTED",
+                f"{self.task_failure_threshold} consecutive task failures"
+                + (f": {reason[:120]}" if reason else ""),
+            )
+
+    def record_task_success(self, uri: str) -> None:
+        with self._lock:
+            st = self.workers.get(uri)
+            if st is not None:
+                st["task_failures"] = 0
 
     def probe_all(self):
-        for uri, st in self.workers.items():
+        for uri in self.all_workers():
             try:
                 with urllib.request.urlopen(f"{uri}/v1/status", timeout=2) as r:
                     ok = json.loads(r.read()).get("state") == "ACTIVE"
             except Exception:  # noqa: BLE001 - network failure IS the signal
                 ok = False
-            if ok:
-                st["failures"] = 0
-                st["state"] = "ACTIVE"
-            else:
-                st["failures"] += 1
-                if st["failures"] >= self.failure_threshold:
-                    st["state"] = "FAILED"
+            with self._lock:
+                st = self.workers[uri]
+                state = st["state"]
+                if ok:
+                    st["failures"] = 0
+                else:
+                    st["failures"] += 1
+                # only an ACTIVE worker degrades to FAILED: a BLACKLISTED
+                # worker keeps serving its drain penalty (otherwise a
+                # restart would launder BLACKLISTED -> FAILED -> ACTIVE
+                # and skip the recovery window)
+                probe_failed = (
+                    not ok
+                    and state == "ACTIVE"
+                    and st["failures"] >= self.failure_threshold
+                )
+                blacklist_done = (
+                    ok
+                    and state == "BLACKLISTED"
+                    and st["blacklisted_at"] is not None
+                    and time.time() - st["blacklisted_at"]
+                    >= self.blacklist_recovery
+                )
+            if probe_failed:
+                self._set_state(uri, "FAILED", "heartbeat probes exhausted")
+            elif ok and state == "FAILED":
+                self._set_state(uri, "ACTIVE", "heartbeat recovered")
+            elif blacklist_done:
+                # drained worker served its penalty and probes healthy:
+                # re-admit (half-open — the next task failure streak
+                # drains it again)
+                self._set_state(uri, "ACTIVE", "blacklist recovery elapsed")
 
     def _loop(self):
         while not self._stop.wait(self.interval):
@@ -78,7 +208,32 @@ class NodeManager:
 
 
 class TaskFailure(RuntimeError):
-    pass
+    """A task (or its stage) failed. Carries the worker URI, task id,
+    attempt number, and whether the cause is retryable on another
+    worker / query attempt."""
+
+    def __init__(self, message: str, uri: str = "", task_id: str = "",
+                 attempt: int = 1, retryable: bool = True):
+        super().__init__(message)
+        self.uri = uri
+        self.task_id = task_id
+        self.attempt = attempt
+        self.retryable = retryable
+
+
+@dataclasses.dataclass
+class SchedulerStats:
+    """Observable retry accounting (acceptance: retries must be visible,
+    not inferred from timing)."""
+
+    task_retries: int = 0
+    query_retries: int = 0
+    tasks_failed: int = 0
+    worker_failures: Dict[str, int] = dataclasses.field(default_factory=dict)
+    last_error: str = ""
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 class HttpScheduler:
@@ -86,28 +241,87 @@ class HttpScheduler:
     the root fragment locally (its catalog serves coordinator-side scans
     of single-distribution subtrees, e.g. tiny dimension tables)."""
 
-    def __init__(self, catalog, nodes: NodeManager):
+    def __init__(self, catalog, nodes: NodeManager,
+                 max_task_retries: Optional[int] = None,
+                 max_query_retries: Optional[int] = None,
+                 task_deadline: Optional[float] = None,
+                 status_deadline: float = 10.0,
+                 status_timeout: float = 15.0,
+                 backoff_base: float = 0.2,
+                 backoff_cap: float = 5.0):
         self.catalog = catalog
         self.nodes = nodes
         self._task_ids = itertools.count(1)
+        env = os.environ.get
+        self.max_task_retries = (
+            int(env("PRESTO_TPU_TASK_RETRIES", "3"))
+            if max_task_retries is None else max_task_retries
+        )
+        self.max_query_retries = (
+            int(env("PRESTO_TPU_QUERY_RETRIES", "2"))
+            if max_query_retries is None else max_query_retries
+        )
+        # wall ceiling on any single task's results stream: a wedged
+        # worker (RUNNING forever, producing nothing) fails the pull
+        # instead of hanging the coordinator — the round-5 relay stall
+        self.task_deadline = (
+            float(env("PRESTO_TPU_TASK_DEADLINE_S", "300"))
+            if task_deadline is None else task_deadline
+        )
+        self.status_deadline = status_deadline
+        self.status_timeout = status_timeout
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.stats = SchedulerStats()
+        self._lock = threading.Lock()
 
     # -- public --
 
     def run(self, root: N.PlanNode, query_id: Optional[str] = None):
-        # snapshot membership for the whole query (threaded explicitly so
-        # concurrent queries can't clobber each other): producer partition
-        # counts must match consumer task counts even if a node fails
-        # mid-query (the query then fails on the task, not on skew)
-        workers = self.nodes.active_workers()
-        if not workers:
-            raise TaskFailure("no active workers")
-        all_tasks: List[Tuple[str, str]] = []
+        """Execute with bounded query-level re-execution: a retryable
+        failure that escaped per-task retry (e.g. a mid-stream worker
+        loss) re-runs the whole plan against a fresh worker snapshot."""
         if query_id is None:
             import uuid
 
             # unique across sessions sharing these workers: per-query
             # memory accounting must never merge two queries
             query_id = f"q_{uuid.uuid4().hex[:12]}"
+        for attempt in range(self.max_query_retries + 1):
+            # distinct per-attempt query id: a prior attempt's dying
+            # tasks must not share memory accounting with the re-run
+            qid = query_id if attempt == 0 else f"{query_id}.r{attempt}"
+            try:
+                return self._run_attempt(root, qid)
+            except RuntimeError as exc:
+                retryable = getattr(exc, "retryable", None)
+                if retryable is None:
+                    retryable = _retryable_message(str(exc))
+                if not retryable or attempt >= self.max_query_retries:
+                    raise
+                # a MID-STREAM failure attributed to a worker counts
+                # toward its blacklist streak too — a live-but-faulting
+                # worker must drain even when its tasks start cleanly
+                uri = getattr(exc, "uri", "")
+                if uri:
+                    self._note_task_failure(uri, str(exc))
+                with self._lock:
+                    self.stats.query_retries += 1
+                    self.stats.last_error = str(exc)[:300]
+                time.sleep(self._backoff(attempt))
+                if not self.nodes.active_workers():
+                    raise
+
+    def _run_attempt(self, root: N.PlanNode, query_id: str):
+        # snapshot membership for the whole attempt (threaded explicitly
+        # so concurrent queries can't clobber each other): producer
+        # partition counts must match consumer task counts even if a node
+        # fails mid-query (per-task retry then re-posts the SAME spec to
+        # an alternate member of the snapshot)
+        workers = self.nodes.active_workers()
+        if not workers:
+            raise TaskFailure("no active workers", retryable=False)
+        all_tasks: List[Tuple[str, str]] = []
         try:
             fragment, specs = self._cut(root)
             sources = self._resolve_sources(
@@ -117,15 +331,24 @@ class HttpScheduler:
             return ex.run(fragment)
         finally:
             # free worker-side output buffers (reference: task results are
-            # acknowledged and deleted after consumption)
-            for uri, task_id in all_tasks:
-                try:
-                    req = urllib.request.Request(
-                        f"{uri}/v1/task/{task_id}", method="DELETE"
-                    )
-                    urllib.request.urlopen(req, timeout=5).read()
-                except Exception:  # noqa: BLE001 - cleanup is best-effort
-                    pass
+            # acknowledged and deleted after consumption); on failure this
+            # doubles as sibling-task cancellation
+            self._cancel_tasks(all_tasks)
+
+    def _cancel_tasks(self, tasks: List[Tuple[str, str]]) -> None:
+        for uri, task_id in tasks:
+            try:
+                req = urllib.request.Request(
+                    f"{uri}/v1/task/{task_id}", method="DELETE"
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                pass
+
+    def _backoff(self, attempt: int) -> float:
+        """Exponential backoff with full jitter (attempt counts from 0)."""
+        ceiling = min(self.backoff_base * (2 ** attempt), self.backoff_cap)
+        return random.uniform(0, ceiling)
 
     # -- plan cutting --
 
@@ -197,8 +420,18 @@ class HttpScheduler:
         for sid, (kind, handles) in resolved.items():
             pages = []
             for uri, task in handles:
-                for data in _pull_buffer(uri, task, 0):
-                    pages.append(deserialize_page(data))
+                try:
+                    for data in _pull_buffer(
+                        uri, task, 0, deadline=self.task_deadline
+                    ):
+                        pages.append(deserialize_page(data))
+                except RuntimeError as e:
+                    # attribute the mid-stream failure to its worker so
+                    # query-level retry can feed the blacklist
+                    raise TaskFailure(
+                        str(e), uri=uri, task_id=task,
+                        retryable=_retryable_message(str(e)),
+                    ) from None
             out[sid] = pages
         return out
 
@@ -239,7 +472,7 @@ class HttpScheduler:
             part_keys_b64 = base64.b64encode(pickle.dumps(output[1])).decode()
             nparts = nw
 
-        handles = []
+        launched = []  # (uri, task_id, spec) — spec kept for retries
         for w, uri in enumerate(workers):
             sources = {}
             for sid, (kind, child_handles) in child_resolved.items():
@@ -261,18 +494,146 @@ class HttpScheduler:
                 "query_id": query_id,
                 "buffer_unbounded": unbounded_output,
             }
-            task_id = f"t_{next(self._task_ids)}"
-            self._post_task(uri, task_id, spec)
-            handles.append((uri, task_id))
-            all_tasks.append((uri, task_id))
-        # surface task failures eagerly (fail the query like the reference)
-        for uri, task_id in handles:
-            status = self._task_status(uri, task_id)
-            if status.get("state") == "FAILED":
-                raise TaskFailure(
-                    f"task {task_id} on {uri} failed:\n{status.get('error')}"
-                )
+            launched.append(
+                self._post_with_retry(uri, spec, all_workers, all_tasks)
+            )
+        # surface start failures eagerly, retrying each failed task onto
+        # an alternate healthy worker (catalogs are deterministic across
+        # nodes, so the same spec — splits, sources, partitioning — is
+        # valid anywhere in the snapshot)
+        handles = []
+        for uri, task_id, spec, _post_attempts in launched:
+            # fresh attempt budget: POST retries (connection-level) and
+            # start-failure retries (task-level) are separate concerns
+            handles.append(
+                self._ensure_started(uri, task_id, spec, all_workers,
+                                     all_tasks)
+            )
         return handles
+
+    # -- task start + retry --
+
+    def _post_with_retry(self, uri: str, spec: dict,
+                         snapshot: List[str], all_tasks):
+        """POST a task, retrying a refused connection onto alternates.
+        Returns (uri, task_id, spec, attempts_used)."""
+        attempt = 1
+        while True:
+            task_id = f"t_{next(self._task_ids)}"
+            failed = self._try_post(uri, task_id, spec, all_tasks)
+            if failed is None:
+                return uri, task_id, spec, attempt
+            error = failed["error"]
+            retryable = bool(failed["errorInfo"]["retryable"])
+            self._note_task_failure(uri, error)
+            if not retryable or attempt > self.max_task_retries:
+                raise TaskFailure(
+                    f"task {task_id} could not be started "
+                    f"(last worker {uri}, attempt {attempt}, "
+                    f"retryable={retryable}): {error}",
+                    uri=uri, task_id=task_id, attempt=attempt,
+                    retryable=retryable,
+                )
+            time.sleep(self._backoff(attempt - 1))
+            uri = self._pick_alternate(uri, snapshot)
+            attempt += 1
+            with self._lock:
+                self.stats.task_retries += 1
+
+    def _try_post(self, uri: str, task_id: str, spec: dict,
+                  all_tasks) -> Optional[dict]:
+        """POST a task; returns None on success, else a synthesized
+        FAILED status dict (never raises for transport errors). The task
+        id is registered for cleanup BEFORE posting: if the POST response
+        is lost after the worker already accepted the task, query cleanup
+        still deletes it (DELETE of an unknown task is a no-op)."""
+        all_tasks.append((uri, task_id))
+        try:
+            self._post_task(uri, task_id, spec)
+            return None
+        except urllib.error.HTTPError as e:
+            # the worker answered: honor its structured verdict
+            detail, retryable = _http_error_details(e)
+            return {
+                "state": "FAILED",
+                "error": detail,
+                "errorInfo": {"retryable": retryable},
+            }
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            return {
+                "state": "FAILED",
+                "error": f"POST to {uri} refused: {e}",
+                "errorInfo": {"retryable": True},
+            }
+
+    def _ensure_started(self, uri: str, task_id: str, spec: dict,
+                        snapshot: List[str], all_tasks,
+                        attempt: int = 1) -> Tuple[str, str]:
+        """Eager failure surfacing with bounded retry: a task FAILED at
+        the status check is re-posted (same spec) to an alternate worker
+        after backoff + jitter; unrecoverable failures cancel the
+        query's sibling tasks and raise."""
+        status: Optional[dict] = None  # None = POST itself failed
+        posted = True
+        while True:
+            if posted:
+                try:
+                    status = self._task_status(uri, task_id, attempt=attempt)
+                except TaskFailure as tf:
+                    status = {
+                        "state": "FAILED",
+                        "error": str(tf),
+                        "errorInfo": {"retryable": tf.retryable},
+                    }
+            if status.get("state") != "FAILED":
+                # started (RUNNING or FINISHED): reset the consecutive-
+                # failure streak feeding the blacklist
+                self.nodes.record_task_success(uri)
+                return uri, task_id
+            error = status.get("error") or "unknown"
+            info = status.get("errorInfo") or {}
+            retryable = bool(
+                info.get("retryable", _retryable_message(error))
+            )
+            self._note_task_failure(uri, error)
+            if not retryable or attempt > self.max_task_retries:
+                self._cancel_tasks(list(all_tasks))
+                raise TaskFailure(
+                    f"task {task_id} on worker {uri} failed "
+                    f"(attempt {attempt}/{self.max_task_retries + 1}, "
+                    f"retryable={retryable}):\n{error}",
+                    uri=uri, task_id=task_id, attempt=attempt,
+                    retryable=retryable,
+                )
+            time.sleep(self._backoff(attempt - 1))
+            uri = self._pick_alternate(uri, snapshot)
+            task_id = f"t_{next(self._task_ids)}"
+            failed = self._try_post(uri, task_id, spec, all_tasks)
+            posted = failed is None
+            if not posted:
+                status = failed  # skip the status poll: classify directly
+            attempt += 1
+            with self._lock:
+                self.stats.task_retries += 1
+
+    def _pick_alternate(self, failed_uri: str, snapshot: List[str]) -> str:
+        """Prefer a currently-active snapshot member that is not the
+        failed worker; fall back to any snapshot member (single-worker
+        clusters still get in-place retries)."""
+        active = set(self.nodes.active_workers())
+        candidates = [
+            u for u in snapshot if u != failed_uri and u in active
+        ] or [u for u in snapshot if u != failed_uri] or [failed_uri]
+        return random.choice(candidates)
+
+    def _note_task_failure(self, uri: str, error: str) -> None:
+        with self._lock:
+            self.stats.tasks_failed += 1
+            self.stats.worker_failures[uri] = (
+                self.stats.worker_failures.get(uri, 0) + 1
+            )
+            self.stats.last_error = error[:300]
+        self.nodes.record_task_failure(uri, error)
 
     @staticmethod
     def _scan_tables(node: N.PlanNode) -> List[str]:
@@ -298,12 +659,44 @@ class HttpScheduler:
         with urllib.request.urlopen(req, timeout=30) as resp:
             return json.loads(resp.read())
 
-    @staticmethod
-    def _task_status(uri: str, task_id: str) -> dict:
-        with urllib.request.urlopen(
-            f"{uri}/v1/task/{task_id}", timeout=300
-        ) as resp:
-            return json.loads(resp.read())
+    def _task_status(self, uri: str, task_id: str,
+                     attempt: int = 1) -> dict:
+        """Short-poll the task status endpoint under a configurable
+        deadline (replaces the raw 300 s blocking urlopen): the worker
+        answers within ~0.5 s, so looping only happens across transient
+        network errors; exhausting the deadline raises a TaskFailure
+        naming the worker, task, and attempt."""
+        deadline = time.time() + self.status_deadline
+        last = None
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/task/{task_id}", timeout=self.status_timeout
+                ) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                # the worker answered with an error status (404 unknown
+                # task after a restart, 500 handler bug): definitive —
+                # not worth polling out the deadline
+                try:
+                    detail = json.loads(e.read()).get("error") or str(e)
+                except Exception:  # noqa: BLE001
+                    detail = str(e)
+                raise TaskFailure(
+                    f"status of task {task_id} on worker {uri} "
+                    f"(attempt {attempt}): HTTP {e.code}: {detail}",
+                    uri=uri, task_id=task_id, attempt=attempt,
+                ) from None
+            except Exception as e:  # noqa: BLE001 - poll again until deadline
+                last = e
+            if time.time() >= deadline:
+                raise TaskFailure(
+                    f"status poll for task {task_id} on worker {uri} "
+                    f"(attempt {attempt}) exceeded "
+                    f"{self.status_deadline:.0f}s deadline: {last}",
+                    uri=uri, task_id=task_id, attempt=attempt,
+                ) from None
+            time.sleep(0.1)
 
 
 class ClusterMemoryManager:
@@ -391,7 +784,9 @@ class ClusterMemoryManager:
         return max(totals, key=lambda q: (totals[q], q))
 
     def kill(self, query_id: str) -> None:
-        for uri in self.nodes.active_workers():
+        # kill on EVERY known worker — a blacklisted (drained) worker
+        # can still hold tasks of the victim query
+        for uri in self.nodes.all_workers():
             try:
                 req = urllib.request.Request(
                     f"{uri}/v1/query/{query_id}", method="DELETE"
@@ -410,14 +805,17 @@ class HttpClusterSession:
 
     def __init__(self, catalog, nodes: NodeManager,
                  broadcast_threshold=None,  # None = cost-based
-                 memory_manager: bool = False):
+                 memory_manager: bool = False,
+                 scheduler_opts: Optional[dict] = None):
         from ..session import Session
 
         self._planner = Session(catalog)  # reuse parse/plan/fragment
         self._planner.mesh = None
         self.catalog = catalog
         self.broadcast_threshold = broadcast_threshold
-        self.scheduler = HttpScheduler(catalog, nodes)
+        self.scheduler = HttpScheduler(
+            catalog, nodes, **(scheduler_opts or {})
+        )
         self._query_ids = itertools.count(1)
         self.memory_manager = (
             ClusterMemoryManager(nodes).start() if memory_manager else None

@@ -42,6 +42,20 @@ class QueryCompletedEvent:
     rows: Optional[int]
 
 
+@dataclasses.dataclass(frozen=True)
+class WorkerStateEvent:
+    """A worker transitioned liveness state (reference analog: the
+    HeartbeatFailureDetector's state changes surfaced via node-state
+    JMX + the coordinator log). States: ACTIVE (re-admitted / up),
+    FAILED (heartbeat probes exhausted), BLACKLISTED (drained after
+    consecutive task failures)."""
+
+    uri: str
+    state: str  # ACTIVE | FAILED | BLACKLISTED
+    reason: str
+    time: float
+
+
 class EventListener:
     """Subclass and override the hooks you care about."""
 
@@ -49,6 +63,9 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent) -> None:  # noqa: B027
+        pass
+
+    def worker_state_changed(self, event: WorkerStateEvent) -> None:  # noqa: B027
         pass
 
 
@@ -62,6 +79,11 @@ class LoggingEventListener(EventListener):
         log.info(
             "query completed %s state=%s wall=%.3fs rows=%s",
             event.query_id, event.state, event.wall_s, event.rows,
+        )
+
+    def worker_state_changed(self, event: WorkerStateEvent) -> None:
+        log.warning(
+            "worker %s -> %s (%s)", event.uri, event.state, event.reason
         )
 
 
@@ -89,6 +111,12 @@ class EventBus:
             len(info.rows) if info.rows is not None else None,
         )
         self._fire("query_completed", ev)
+
+    def fire_worker_state(self, uri: str, state: str, reason: str) -> None:
+        self._fire(
+            "worker_state_changed",
+            WorkerStateEvent(uri, state, reason, time.time()),
+        )
 
     def _fire(self, hook: str, event) -> None:
         for listener in self.listeners:

@@ -234,10 +234,48 @@ class TaskState:
     def __init__(self, query_id: str = ""):
         self.state = "RUNNING"
         self.error: Optional[str] = None
+        # structured failure cause the coordinator classifies as
+        # retryable vs. fatal (see _classify_failure)
+        self.error_info: Optional[dict] = None
         self.buffers: Optional[OutputBuffers] = None
         self.done = threading.Event()
         self.query_id = query_id
         self.abort = threading.Event()  # set by the low-memory killer
+
+
+# message fragments marking failures that would recur identically on any
+# worker — retrying them only wastes the retry budget
+_FATAL_MARKERS = (
+    "Query killed",  # low-memory killer chose this query
+    "memory exhausted",  # worker pool limit: the retry would also exceed it
+    "protocol violation",
+    "not yet supported",
+)
+
+# exception-type / message fragments identifying accelerator kernel
+# faults (XLA / Mosaic): retryable, because the kernel circuit breaker
+# (exec/breaker.py) degrades the faulting kernel to its XLA fallback on
+# the retry attempt
+_KERNEL_FAULT_MARKERS = (
+    "XlaRuntimeError", "Mosaic", "INTERNAL:", "mosaic", "pallas",
+)
+
+
+def _classify_failure(exc: BaseException) -> dict:
+    """Serialize an exception into the structured error the coordinator's
+    retry policy consumes (reference: ExecutionFailureInfo + ErrorCode
+    retryability, spi/StandardErrorCode.java)."""
+    text = f"{type(exc).__name__}: {exc}"
+    kernel_fault = any(m in text for m in _KERNEL_FAULT_MARKERS)
+    retryable = not any(m in text for m in _FATAL_MARKERS)
+    if isinstance(exc, (QueryKilledError, MemoryError)):
+        retryable = False
+    return {
+        "type": type(exc).__name__,
+        "message": str(exc)[:500],
+        "retryable": retryable,
+        "kernelFault": kernel_fault,
+    }
 
 
 class FragmentExecutor(Executor):
@@ -325,12 +363,18 @@ class WorkerServer:
                  memory_limit: Optional[int] = None,
                  buffer_bound: Optional[int] = 32 << 20,
                  task_concurrency: int = 2,
-                 fault_rate: float = 0.0):
+                 fault_rate: float = 0.0,
+                 task_timeout: Optional[float] = None):
         from ..exec.taskqueue import MultilevelScheduler
 
         self.catalog = catalog
         # fault injection knob: probability a task fails at start
         self.fault_rate = float(fault_rate)
+        # wall-clock ceiling per task, checked between batches: a wedged
+        # kernel cannot hold a task RUNNING forever (the coordinator's
+        # per-task deadline is the outer guard; this one frees the
+        # worker's own slot)
+        self.task_timeout = task_timeout
         self.tasks: Dict[str, TaskState] = {}
         self.pool = WorkerMemoryPool(memory_limit)
         self.buffer_bound = buffer_bound
@@ -359,9 +403,20 @@ class WorkerServer:
             def do_POST(self):
                 parts = [p for p in self.path.split("/") if p]
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
-                    n = int(self.headers.get("Content-Length", 0))
-                    spec = json.loads(self.rfile.read(n))
-                    outer._start_task(parts[2], spec)
+                    # containment: a malformed spec must 500 with a
+                    # structured error, never tear down the connection
+                    # (the round-5 failure mode: one bad task wedged the
+                    # serving loop)
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        spec = json.loads(self.rfile.read(n))
+                        outer._start_task(parts[2], spec)
+                    except Exception as exc:  # noqa: BLE001
+                        self._send(500, {
+                            "error": traceback.format_exc(limit=10),
+                            "errorInfo": _classify_failure(exc),
+                        })
+                        return
                     self._send(200, {"taskId": parts[2], "state": "RUNNING"})
                     return
                 self._send(404, {"error": "not found"})
@@ -394,7 +449,10 @@ class WorkerServer:
                     t.done.wait(timeout=0.5)  # short-poll: consumers
                     # pipeline against RUNNING producers; failures also
                     # surface as 500s on the results pull
-                    self._send(200, {"state": t.state, "error": t.error})
+                    self._send(200, {
+                        "state": t.state, "error": t.error,
+                        "errorInfo": t.error_info,
+                    })
                     return
                 if (
                     parts[:2] == ["v1", "task"]
@@ -407,7 +465,8 @@ class WorkerServer:
                         self._send(404, {"error": "unknown task"})
                         return
                     if t.state == "FAILED":
-                        self._send(500, {"error": t.error})
+                        self._send(500, {"error": t.error,
+                                         "errorInfo": t.error_info})
                         return
                     if t.buffers is None:  # task thread not started yet
                         self._send(503, {"retry": True, "state": t.state})
@@ -418,7 +477,8 @@ class WorkerServer:
                     if t.state == "FAILED":
                         # finish() fires in the task's finally, so a failed
                         # producer must never look like a complete stream
-                        self._send(500, {"error": t.error})
+                        self._send(500, {"error": t.error,
+                                         "errorInfo": t.error_info})
                         return
                     if not ready:
                         self._send(503, {"retry": True, "state": t.state})
@@ -465,6 +525,7 @@ class WorkerServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
+        self.node_id = f"{self.host}:{self.port}"
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -539,7 +600,22 @@ class WorkerServer:
             # buffer emission stays outside the quantum — blocking on a
             # slow consumer must not hold an execution slot.
             stream_iter = iter(ex.stream(fragment))
+            deadline = (
+                time.time() + self.task_timeout
+                if self.task_timeout else None
+            )
             while True:
+                # crash containment checkpoints between batches: an
+                # aborted (killed/deleted) task stops producing, and a
+                # task past its deadline FAILS instead of holding its
+                # slot forever (the round-5 wedge)
+                if state.abort.is_set():
+                    raise QueryKilledError("task aborted")
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutError(
+                        f"task {task_id} exceeded task_timeout="
+                        f"{self.task_timeout}s on worker {self.node_id}"
+                    )
                 with self.scheduler.quantum(state.query_id):
                     page = next(stream_iter, None)
                 if page is None:
@@ -553,8 +629,13 @@ class WorkerServer:
                     else:
                         buffers.put(0, serialize_page(piece))
             state.state = "FINISHED"
-        except Exception:  # noqa: BLE001
+        except BaseException as exc:  # noqa: BLE001 - kernel faults
+            # (XLA/Mosaic aborts surface as various exception types)
+            # must transition the task to FAILED with a structured cause
+            # the coordinator can classify — never tear down the thread
+            # silently or wedge the HTTP serving side
             state.error = traceback.format_exc(limit=20)
+            state.error_info = _classify_failure(exc)
             state.state = "FAILED"
         finally:
             buffers.finish()
@@ -639,15 +720,27 @@ def _hash_partition(page: Page, key_exprs, nparts: int) -> Dict[int, List[bytes]
     return out
 
 
-def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True):
+def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True,
+                 deadline: Optional[float] = None):
     """Generator of serialized pages from an upstream buffer, one page per
     long-poll, acknowledging each consumed page so the bounded producer
     buffer frees its bytes (reference ExchangeClient.java:55,201 +
-    HttpPageBufferClient pull/ack/delete loop)."""
+    HttpPageBufferClient pull/ack/delete loop).
+
+    `deadline` caps the wall time between PAGES (a progress deadline): a
+    wedged producer (RUNNING forever, producing nothing) must fail the
+    pull — retryably — instead of hanging its consumer forever (the
+    round-5 relay stall). None reads PRESTO_TPU_TASK_DEADLINE_S
+    (default 600)."""
     import base64 as b64
     import json as js
+    import os
     import urllib.error
     import urllib.request
+
+    if deadline is None:
+        deadline = float(os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600"))
+    give_up = time.time() + deadline
 
     token = 0
     while True:
@@ -657,6 +750,12 @@ def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True):
                 payload = js.loads(resp.read())
         except urllib.error.HTTPError as e:
             if e.code == 503:  # producer still running: long-poll again
+                if time.time() >= give_up:
+                    raise RuntimeError(
+                        f"upstream task {task_id} on {uri} produced no "
+                        f"page within the {deadline:.0f}s task deadline "
+                        "(wedged worker?)"
+                    ) from None
                 continue
             # surface the UPSTREAM failure cause (e.g. a low-memory kill),
             # not a bare HTTP 500 — the coordinator matches on the message
@@ -666,10 +765,20 @@ def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True):
             except Exception:  # noqa: BLE001
                 detail = str(e)
             raise RuntimeError(
-                f"upstream task {task_id} results fetch failed: {detail}"
+                f"upstream task {task_id} on {uri} results fetch "
+                f"failed: {detail}"
+            ) from None
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            # a worker dying mid-stream must surface as a RETRYABLE
+            # RuntimeError (the query-level retry contract), never as a
+            # raw URLError that escapes the scheduler's retry handler
+            raise RuntimeError(
+                f"upstream task {task_id} on {uri} connection lost "
+                f"mid-stream: {e}"
             ) from None
         if payload.get("page"):
             yield b64.b64decode(payload["page"])
+            give_up = time.time() + deadline  # progress resets the clock
             token += 1
             if ack:
                 try:

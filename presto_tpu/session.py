@@ -1034,9 +1034,14 @@ class Session:
         tree = N.plan_tree_str(node, collector=collector)
         total_ms = collector.total_wall_s() * 1e3
         peak = collector.peak_bytes / (1024 * 1024)
+        from .exec.stats import kernel_breaker_lines
+
+        breakers = kernel_breaker_lines()
+        breaker_txt = "".join(f"\n-- {line}" for line in breakers)
         return (
             f"{tree}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
+            f"{breaker_txt}"
         )
 
     def explain_analyze(self, sql: str) -> str:

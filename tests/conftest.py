@@ -31,6 +31,116 @@ def rng():
     return np.random.default_rng(42)
 
 
+# -- per-test wall-clock guard (no pytest-timeout in the image) --------------
+#
+# The distributed/cluster modules talk to real HTTP worker threads; a wedged
+# worker once stalled the whole tier-1 relay (round 5). An alarm-based guard
+# fails the TEST instead of hanging the RUN. Only modules that spin up
+# workers/servers get a default; any test can override with
+# @pytest.mark.timeout(seconds).
+
+_MODULE_TIMEOUTS = {
+    "test_server.py": 240,
+    "test_cluster_memory.py": 240,
+    "test_streaming_exchange.py": 240,
+    "test_fault_tolerance.py": 240,
+    "test_taskqueue.py": 240,
+    "test_tpch_distributed.py": 300,
+    "test_distributed_sort.py": 300,
+    "test_grouped_exchange.py": 300,
+    "test_parallel.py": 300,
+    "test_jdbc.py": 240,
+    "test_auth_tls.py": 240,
+}
+
+_SLOW_CANDIDATE_S = 30.0
+_slow_candidates = []
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test wall-clock guard override"
+    )
+
+
+def _alarm_guard(item, phase):
+    """Context manager arming SIGALRM for one runtest phase — setup and
+    teardown too: a cluster fixture wedging while starting/stopping
+    workers is the same hazard as a wedged test body."""
+    import contextlib
+    import signal
+    import threading
+
+    marker = item.get_closest_marker("timeout")
+    limit = (
+        float(marker.args[0]) if marker and marker.args
+        else _MODULE_TIMEOUTS.get(item.path.name)
+    )
+    usable = (
+        limit
+        and hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+    )
+
+    @contextlib.contextmanager
+    def guard():
+        if not usable:
+            yield
+            return
+
+        def _on_timeout(signum, frame):
+            raise TimeoutError(
+                f"{item.nodeid} [{phase}] exceeded the {limit:.0f}s "
+                "wall-clock guard (wedged worker?)"
+            )
+
+        old = signal.signal(signal.SIGALRM, _on_timeout)
+        signal.setitimer(signal.ITIMER_REAL, limit)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old)
+
+    return guard()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    with _alarm_guard(item, "setup"):
+        yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    with _alarm_guard(item, "teardown"):
+        yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    import time as _time
+
+    start = _time.monotonic()
+    with _alarm_guard(item, "call"):
+        yield
+    wall = _time.monotonic() - start
+    if wall > _SLOW_CANDIDATE_S and not item.get_closest_marker("slow"):
+        _slow_candidates.append((item.nodeid, wall))
+
+
+def pytest_terminal_summary(terminalreporter):
+    if _slow_candidates:
+        terminalreporter.write_sep(
+            "-", "slow-test candidates (>30s; consider @pytest.mark.slow)"
+        )
+        for nodeid, wall in sorted(_slow_candidates, key=lambda x: -x[1]):
+            terminalreporter.write_line(f"  {wall:6.1f}s  {nodeid}")
+
+
 _EXIT_STATUS = [0]
 
 

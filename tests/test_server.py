@@ -297,32 +297,41 @@ def test_web_ui_timeline_and_stages():
         srv.stop()
 
 
-def test_fault_injection_fails_query_cleanly():
-    """Fault injection (SURVEY §5): a worker with fault_rate=1 fails every
-    task at start; the query must fail with the injected cause propagated
-    to the client, and the cluster must stay usable for the next query
-    once the faulty worker is excluded."""
+def test_fault_injection_survived_by_retries():
+    """Fault injection (SURVEY §5, docs/fault-tolerance.md): a worker
+    with fault_rate=1 fails every task at start; the query must SURVIVE
+    via per-task retry onto the healthy worker, the retries must be
+    observable in scheduler stats, and the faulty worker must end up
+    blacklisted (drained) after its consecutive-failure streak."""
     good = WorkerServer(TpchCatalog(sf=0.002)).start()
     bad = WorkerServer(TpchCatalog(sf=0.002), fault_rate=1.0).start()
     nodes = NodeManager([good.uri, bad.uri], interval=3600,
-                        failure_threshold=1)
-    sess = HttpClusterSession(TpchCatalog(sf=0.002), nodes)
+                        failure_threshold=1, task_failure_threshold=2)
+    sess = HttpClusterSession(
+        TpchCatalog(sf=0.002), nodes,
+        scheduler_opts={"backoff_base": 0.02, "backoff_cap": 0.1},
+    )
     try:
-        with pytest.raises(Exception) as exc_info:
-            sess.query(
-                "select count(*) n, sum(o_totalprice) s from orders "
-                "group by o_shippriority"
-            ).rows()
-        assert "injected fault" in str(exc_info.value)
-        # exclude the faulty worker (the heartbeat prober does this for
-        # dead workers; injected faults leave /v1/status healthy, so the
-        # operator-level exclusion is explicit here)
-        nodes.workers[bad.uri]["state"] = "FAILED"
-        got = sess.query("select count(*) from orders").rows()
-        want = Session(TpchCatalog(sf=0.002)).query(
+        sql = (
+            "select count(*) n, sum(o_totalprice) s from orders "
+            "group by o_shippriority"
+        )
+        got = sess.query(sql).rows()
+        want = Session(TpchCatalog(sf=0.002)).query(sql).rows()
+        assert got == want
+        stats = sess.scheduler.stats
+        assert stats.task_retries + stats.query_retries > 0
+        assert "injected fault" in stats.last_error or stats.query_retries
+        # the 100%-faulty worker accumulated consecutive task failures
+        # past the threshold: drained from scheduling
+        assert nodes.workers[bad.uri]["state"] == "BLACKLISTED"
+        assert nodes.active_workers() == [good.uri]
+        # cluster stays usable on the surviving worker
+        got2 = sess.query("select count(*) from orders").rows()
+        want2 = Session(TpchCatalog(sf=0.002)).query(
             "select count(*) from orders"
         ).rows()
-        assert got == want
+        assert got2 == want2
     finally:
         good.stop()
         bad.stop()
