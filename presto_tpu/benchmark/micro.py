@@ -371,12 +371,10 @@ def bench_join_probe(sf: float) -> Bench:
     )
 
 
-def bench_sort(sf: float) -> Bench:
-    """Full-table sort (ref: OrderByBenchmark / BenchmarkWindowOperator's
-    sort phase)."""
+def _sort_bench_inputs(sf: float):
     from .. import types as T
     from ..expr.ir import col
-    from ..ops.sort import SortKey, sort_page
+    from ..ops.sort import SortKey
     from .handcoded import DEC12_2, _table_page
 
     page = _table_page("lineitem", sf, ("l_extendedprice", "l_orderkey"))
@@ -384,6 +382,27 @@ def bench_sort(sf: float) -> Bench:
         SortKey(col("l_extendedprice", DEC12_2), ascending=False),
         SortKey(col("l_orderkey", T.BIGINT)),
     )
+    return page, keys
+
+
+def bench_sort(sf: float) -> Bench:
+    """Full-table sort, ENGINE-DEFAULT path (ref: OrderByBenchmark /
+    BenchmarkWindowOperator's sort phase). With keypack on (the default)
+    this is the packed composite-key sort the executor would pick;
+    PRESTO_TPU_KEYPACK=0 measures the legacy variadic sort — diff against
+    sort_2key_packed for the packed-vs-legacy delta."""
+    from ..ops.keypack import keypack_enabled, plan_from_page
+    from ..ops.sort import sort_page, sort_page_packed
+
+    page, keys = _sort_bench_inputs(sf)
+    plan = plan_from_page(page, keys) if keypack_enabled() else None
+    if plan is not None:
+        def step(acc, p):
+            out, _ok = sort_page_packed(_chained_page(p, acc), keys, plan)
+            return _consume(out)
+
+        return Bench("sort_2key", int(page.count), step, (page,),
+                     note=f"keypack={plan.strategy}")
 
     def step(acc, p):
         return _consume(sort_page(_chained_page(p, acc), keys))
@@ -391,14 +410,45 @@ def bench_sort(sf: float) -> Bench:
     return Bench("sort_2key", int(page.count), step, (page,))
 
 
+def bench_sort_packed(sf: float) -> Bench:
+    """sort_2key FORCED through the packed composite-key path
+    (ops/keypack.py), regardless of the engine default — keeps the
+    packed-vs-legacy delta visible in every BENCH_r* artifact."""
+    from ..ops.keypack import plan_from_page
+    from ..ops.sort import sort_page_packed
+
+    page, keys = _sort_bench_inputs(sf)
+    plan = plan_from_page(page, keys)
+    if plan is None:
+        raise RuntimeError("sort_2key keys unexpectedly unpackable")
+
+    def step(acc, p):
+        out, _ok = sort_page_packed(_chained_page(p, acc), keys, plan)
+        return _consume(out)
+
+    return Bench("sort_2key_packed", int(page.count), step, (page,),
+                 note=f"keypack={plan.strategy}")
+
+
 def bench_top_n(sf: float) -> Bench:
-    """TopN (ref: TopNBenchmark / BenchmarkTopNOperator)."""
+    """TopN, engine-default path (ref: TopNBenchmark /
+    BenchmarkTopNOperator). Packed single-lane keys select via
+    `lax.top_k` instead of any sort."""
     from ..expr.ir import col
-    from ..ops.sort import SortKey, top_n
+    from ..ops.keypack import keypack_enabled, plan_from_page
+    from ..ops.sort import SortKey, top_n, top_n_packed
     from .handcoded import DEC12_2, _table_page
 
     page = _table_page("lineitem", sf, ("l_extendedprice", "l_orderkey"))
     keys = (SortKey(col("l_extendedprice", DEC12_2), ascending=False),)
+    plan = plan_from_page(page, keys) if keypack_enabled() else None
+    if plan is not None:
+        def step(acc, p):
+            out, _ok = top_n_packed(_chained_page(p, acc), keys, 100, plan)
+            return _consume(out)
+
+        return Bench("top_n_100", int(page.count), step, (page,),
+                     note=f"keypack={plan.strategy}")
 
     def step(acc, p):
         return _consume(top_n(_chained_page(p, acc), keys, 100))
@@ -407,13 +457,15 @@ def bench_top_n(sf: float) -> Bench:
 
 
 def bench_window(sf: float) -> Bench:
-    """Partitioned window: rank + running sum over o_custkey (ref:
-    BenchmarkWindowOperator)."""
+    """Partitioned window: rank + running sum over o_custkey, engine-
+    default path (ref: BenchmarkWindowOperator). A single-lane packed
+    (partition, order) key collapses the hash + per-key stable-argsort
+    cascade into one sort with boundaries from integer compares."""
     from .. import types as T
     from ..expr.ir import col
+    from ..ops.keypack import keypack_enabled, plan_from_page
     from ..ops.sort import SortKey
-    from ..ops.window import WindowFunc, window_op
-    from .handcoded import _table_page
+    from ..ops.window import WindowFunc, window_op, window_op_packed
 
     page = _orders_keys_page(sf)
     DEC = T.DecimalType(12, 2)
@@ -429,6 +481,21 @@ def bench_window(sf: float) -> Bench:
     )
     parts = (col("o_custkey", T.BIGINT),)
     order = (SortKey(col("o_orderkey", T.BIGINT)),)
+    plan = None
+    if keypack_enabled():
+        specs = tuple(SortKey(e) for e in parts) + order
+        plan = plan_from_page(
+            page, specs, single_lane=True, n_order_keys=len(order)
+        )
+    if plan is not None:
+        def step(acc, p):
+            out, _ok = window_op_packed(
+                _chained_page(p, acc), parts, order, funcs, plan
+            )
+            return _consume(out)
+
+        return Bench("window_rank_runsum", int(page.count), step, (page,),
+                     note=f"keypack={plan.strategy}")
 
     def step(acc, p):
         return _consume(window_op(_chained_page(p, acc), parts, order, funcs))
@@ -483,19 +550,60 @@ def bench_semi_join(sf: float) -> Bench:
     return Bench("semi_join_mark", int(probe.count), step, (probe,))
 
 
+def _distinct_plan(page, equality_only=True):
+    from ..expr.ir import ColumnRef
+    from ..ops.keypack import plan_from_page
+
+    exprs = tuple(
+        ColumnRef(n, b.type) for n, b in zip(page.names, page.blocks)
+    )
+    return plan_from_page(
+        page, exprs, equality_only=equality_only, allow_hashed=True
+    )
+
+
 def bench_distinct(sf: float) -> Bench:
-    """High-NDV DISTINCT over two key columns (ref: BenchmarkGroupByHash
-    distinct mode / MarkDistinctOperator)."""
-    from ..ops.sort import distinct_page
+    """High-NDV DISTINCT over two key columns, engine-default path (ref:
+    BenchmarkGroupByHash distinct mode / MarkDistinctOperator): packed
+    sorted-adjacent-unique instead of the grouped-aggregation machinery."""
+    from ..ops.keypack import keypack_enabled
+    from ..ops.sort import distinct_packed, distinct_page
     from .handcoded import _table_page
 
     page = _table_page("lineitem", sf, ("l_suppkey", "l_partkey"))
     cap = int(page.capacity)
+    plan = _distinct_plan(page) if keypack_enabled() else None
+    if plan is not None:
+        def step(acc, p):
+            out, _ok = distinct_packed(_chained_page(p, acc), plan)
+            return _consume(out)
+
+        return Bench("distinct_2key", int(page.count), step, (page,),
+                     note=f"keypack={plan.strategy}")
 
     def step(acc, p):
         return _consume(distinct_page(_chained_page(p, acc), cap))
 
     return Bench("distinct_2key", int(page.count), step, (page,))
+
+
+def bench_distinct_packed(sf: float) -> Bench:
+    """distinct_2key FORCED through the packed path (see
+    sort_2key_packed)."""
+    from ..ops.sort import distinct_packed
+    from .handcoded import _table_page
+
+    page = _table_page("lineitem", sf, ("l_suppkey", "l_partkey"))
+    plan = _distinct_plan(page)
+    if plan is None:
+        raise RuntimeError("distinct_2key keys unexpectedly unpackable")
+
+    def step(acc, p):
+        out, _ok = distinct_packed(_chained_page(p, acc), plan)
+        return _consume(out)
+
+    return Bench("distinct_2key_packed", int(page.count), step, (page,),
+                 note=f"keypack={plan.strategy}")
 
 
 def bench_expr_case_chain(sf: float) -> Bench:
@@ -609,7 +717,9 @@ DEVICE_BENCHES = {
     "join_probe_n1": bench_join_probe,
     "semi_join_mark": bench_semi_join,
     "distinct_2key": bench_distinct,
+    "distinct_2key_packed": bench_distinct_packed,
     "sort_2key": bench_sort,
+    "sort_2key_packed": bench_sort_packed,
     "top_n_100": bench_top_n,
     "window_rank_runsum": bench_window,
     "hash_rows_2key": bench_hash_rows,

@@ -134,11 +134,22 @@ class Executor:
 
         from .stats import page_device_bytes
 
-        rows_in = sum(int(p.count) for p in pages)
+        sync = getattr(self.collector, "sync_counts", True)
+        if sync:
+            rows_in = sum(int(p.count) for p in pages)
         retries_before = self._retries
         t0 = time.perf_counter()
         out = self.exec_node(node, *pages)
-        rows_out = int(out.count)  # blocks until the kernel finishes
+        if sync:
+            rows_out = int(out.count)  # blocks until the kernel finishes
+        else:
+            # keep row counts as device scalars — each int() here is a
+            # blocking host round trip per plan node (the TPU_STATUS §4b
+            # cost PR-1's _shrink already avoids); the collector resolves
+            # them in one batch at query end. Wall then measures dispatch
+            # + any syncs the node itself performs.
+            rows_in = [p.count for p in pages]
+            rows_out = out.count
         wall = time.perf_counter() - t0
         self.collector.record(
             node, wall, rows_in, rows_out, page_device_bytes(out),
@@ -176,6 +187,100 @@ class Executor:
         idx = slice(0, cap)
         blocks = [b.take_rows(idx) for b in page.blocks]
         return Page(tuple(blocks), page.names, page.count)
+
+    def _node_plan_stats(self, node):
+        """Memoized full CBO PlanStats for a node (column min/max/NDV —
+        the keypack planner's input). Same keying/bounding rules as
+        _est_rows."""
+        cache = getattr(self, "_ps_cache", None)
+        if cache is None:
+            cache = self._ps_cache = {}
+        if len(cache) > 1024:
+            cache.clear()
+        if node in cache:
+            return cache[node]
+        try:
+            from ..plan.stats import derive
+
+            ps = derive(node, self.catalog)
+        except Exception:  # noqa: BLE001 — estimation is best-effort
+            ps = None
+        cache[node] = ps
+        return ps
+
+    # -- composite-key packing (ops/keypack.py) --
+    def _keypack_plan(self, node, keys, page: Page, equality_only=False,
+                      allow_hashed=False, single_lane=False,
+                      n_order_keys=0):
+        """Choose a packing strategy for one order-sensitive node from the
+        input page's blocks (types, nullability, dictionaries) plus the
+        child's CBO column stats (sampled min/max tightens 64-bit keys;
+        sampled lanes carry a runtime range check). Returns None when the
+        keys don't pack — the node runs its legacy kernel."""
+        from ..ops.keypack import (
+            KeyInfo,
+            key_info_from_block,
+            keypack_enabled,
+            plan_keypack,
+        )
+        from ..plan.stats import storage_bounds
+
+        if not keypack_enabled():
+            return None
+        ps = self._node_plan_stats(node.children[0])
+        infos = []
+        for k in keys:
+            e = getattr(k, "expr", k)
+            typ = getattr(e, "type", None)
+            if typ is None:
+                return None
+            if isinstance(e, ir.ColumnRef) and e.name in page.names:
+                b = page.block(e.name)
+                lo = hi = None
+                if ps is not None:
+                    bounds = storage_bounds(ps.column(e.name), b.type)
+                    if bounds is not None:
+                        lo, hi = bounds
+                infos.append(key_info_from_block(b, lo=lo, hi=hi))
+            else:
+                infos.append(KeyInfo(type=typ, nullable=True))
+        try:
+            return plan_keypack(
+                keys,
+                infos,
+                equality_only=equality_only,
+                allow_hashed=allow_hashed,
+                single_lane=single_lane,
+                n_order_keys=n_order_keys,
+            )
+        except Exception:  # noqa: BLE001 — planning is best-effort
+            return None
+
+    def _run_packed(self, node, breaker_name: str, label: str, make_fn,
+                    page: Page, plan):
+        """Attempt one packed kernel behind its circuit breaker. Returns
+        the output page, or None when the caller must run the legacy
+        kernel (breaker open, kernel fault, or the plan's runtime range
+        check tripped — sampled CBO bounds missed / a hash collided,
+        which is expected adaptivity rather than a kernel fault)."""
+        from .breaker import BREAKERS
+
+        if not BREAKERS.allow(breaker_name):
+            return None
+        try:
+            fn = self._kernel((node, label, plan), make_fn)
+            out, ok = fn(page)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure(breaker_name, repr(exc))
+            return None
+        if ok is not None and not bool(ok):
+            self._strategy_note(
+                node, f"keypack={plan.strategy}->legacy(range)"
+            )
+            return None
+        BREAKERS.record_success(breaker_name)
+        self._strategy_note(node, f"keypack={plan.strategy}")
+        return out
 
     def _est_rows(self, node):
         """CBO row estimate for a node's output (cached per plan node).
@@ -372,6 +477,29 @@ class Executor:
         return self._shrink(out, node)
 
     def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:
+        from ..expr.ir import ColumnRef
+
+        key_exprs = tuple(
+            ColumnRef(n, b.type) for n, b in zip(page.names, page.blocks)
+        )
+        # collection columns carry equality in companion arrays
+        # (lengths/elem_valid/key_block) the packed key cannot see
+        packable = all(
+            b.lengths is None and b.key_block is None for b in page.blocks
+        )
+        plan = self._keypack_plan(
+            node, key_exprs, page, equality_only=True, allow_hashed=True
+        ) if packable else None
+        if plan is not None:
+            from ..ops.sort import distinct_packed
+
+            out = self._run_packed(
+                node, "keypack_distinct", "pdistinct",
+                lambda: lambda p: distinct_packed(p, plan),
+                page, plan,
+            )
+            if out is not None:
+                return self._shrink(out, node)
         if self.matmul_groupby is None:
             self.matmul_groupby = jax.default_backend() == "tpu"
         if self.matmul_groupby:
@@ -668,8 +796,29 @@ class Executor:
         return Page(tuple(blocks), tuple(names), page.count)
 
     def _exec_window(self, node: N.Window, page: Page) -> Page:
+        from ..ops.sort import SortKey
         from ..ops.window import window_op
 
+        specs = tuple(SortKey(e) for e in node.partition_exprs) + tuple(
+            node.order_keys
+        )
+        plan = self._keypack_plan(
+            node, specs, page, single_lane=True,
+            n_order_keys=len(node.order_keys),
+        ) if specs else None
+        if plan is not None:
+            from ..ops.window import window_op_packed
+
+            out = self._run_packed(
+                node, "keypack_window", "pwindow",
+                lambda: lambda p: window_op_packed(
+                    p, node.partition_exprs, node.order_keys, node.funcs,
+                    plan,
+                ),
+                page, plan,
+            )
+            if out is not None:
+                return out
         fn = self._kernel(
             node,
             lambda: lambda p: window_op(
@@ -680,6 +829,17 @@ class Executor:
 
     # -- ordering / limits --
     def _exec_sort(self, node: N.Sort, page: Page) -> Page:
+        plan = self._keypack_plan(node, node.keys, page)
+        if plan is not None:
+            from ..ops.sort import sort_page_packed
+
+            out = self._run_packed(
+                node, "keypack_sort", "psort",
+                lambda: lambda p: sort_page_packed(p, node.keys, plan),
+                page, plan,
+            )
+            if out is not None:
+                return out
         return self._kernel_guarded(
             "fused_sort",
             (node, "sort"),
@@ -688,6 +848,19 @@ class Executor:
         )
 
     def _exec_topn(self, node: N.TopN, page: Page) -> Page:
+        plan = self._keypack_plan(node, node.keys, page)
+        if plan is not None:
+            from ..ops.sort import top_n_packed
+
+            out = self._run_packed(
+                node, "keypack_topn", "ptopn",
+                lambda: lambda p: top_n_packed(
+                    p, node.keys, node.count, plan
+                ),
+                page, plan,
+            )
+            if out is not None:
+                return out
         fn = self._kernel(
             node, lambda: lambda p: top_n(p, node.keys, node.count)
         )

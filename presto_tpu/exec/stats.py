@@ -69,11 +69,23 @@ def page_device_bytes(page) -> int:
 
 class StatsCollector:
     """Collects per-node stats keyed by plan-node identity (two structurally
-    equal nodes at different tree positions stay distinct)."""
+    equal nodes at different tree positions stay distinct).
 
-    def __init__(self):
+    Row counts are collected LAZILY by default: `record` accepts device
+    int32 scalars (or lists of them) for rows_in/rows_out and parks them
+    unresolved — reading a device scalar is a blocking host sync, and one
+    per plan node was the dominant term in on-chip SQL wall time
+    (TPU_STATUS §4b: ~5 syncs ≈ 2.5 s around a 14 ms aggregation).
+    `resolve()` drains them in one batch at query end, which is when the
+    EXPLAIN ANALYZE renderer needs integers anyway. Pass
+    `sync_counts=True` to restore the old per-node blocking reads (then
+    per-node wall time includes kernel completion, not just dispatch)."""
+
+    def __init__(self, sync_counts: bool = False):
         self.by_node: Dict[int, NodeStats] = {}
         self.peak_bytes: int = 0  # high-water of summed live output bytes
+        self.sync_counts = sync_counts
+        self._pending: list = []  # (NodeStats, rows_in, rows_out) scalars
 
     def stats_for(self, node) -> NodeStats:
         s = self.by_node.get(id(node))
@@ -82,17 +94,35 @@ class StatsCollector:
             self.by_node[id(node)] = s
         return s
 
-    def record(self, node, wall_s: float, rows_in: int, rows_out: int,
+    @staticmethod
+    def _count(x) -> int:
+        if isinstance(x, (list, tuple)):
+            return sum(int(v) for v in x)
+        return int(x)
+
+    def record(self, node, wall_s: float, rows_in, rows_out,
                out_bytes: int, retries: int = 0) -> None:
         s = self.stats_for(node)
         s.calls += 1
         s.wall_s += wall_s
-        s.rows_in += rows_in
-        s.rows_out += rows_out
         s.retries += retries
         s.out_bytes = out_bytes
+        if self.sync_counts:
+            s.rows_in += self._count(rows_in)
+            s.rows_out += self._count(rows_out)
+        else:
+            # keep the device scalars; resolved once at query end
+            self._pending.append((s, rows_in, rows_out))
         live = sum(st.out_bytes for st in self.by_node.values())
         self.peak_bytes = max(self.peak_bytes, live)
+
+    def resolve(self) -> None:
+        """Fold all parked device row-count scalars into the integer
+        stats — ONE sync point at query end instead of one per node."""
+        pending, self._pending = self._pending, []
+        for s, rows_in, rows_out in pending:
+            s.rows_in += self._count(rows_in)
+            s.rows_out += self._count(rows_out)
 
     def lookup(self, node) -> Optional[NodeStats]:
         return self.by_node.get(id(node))

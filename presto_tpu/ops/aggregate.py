@@ -427,6 +427,36 @@ def _neq_adjacent(d):
     return jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
 
 
+def _canon_cmp(d):
+    """Canonical EQUALITY key for run/boundary detection: float columns
+    map through the total-order transform so ±0.0 tie and ALL NaNs
+    compare equal — the reference's doubleToLongBits canonicalization
+    (GROUP BY / DISTINCT / window peers treat NaN as one value). A raw
+    `!=` on float storage would make every NaN row its own group."""
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        from .sort import _float_total_order
+
+        return _float_total_order(d)
+    return d
+
+
+def _neq_adjacent_nullaware(data, valid):
+    """Adjacent-row inequality under SQL grouping semantics: float values
+    compare canonically (_canon_cmp), a NULL differs from any non-NULL,
+    and two adjacent NULLs are EQUAL regardless of their garbage storage.
+    Leading element True. `valid` may be None (no nulls)."""
+    neq = _neq_adjacent(_canon_cmp(data))
+    if valid is None:
+        return neq
+    vneq = jnp.concatenate(
+        [jnp.zeros((1,), jnp.bool_), valid[1:] != valid[:-1]]
+    )
+    both_null = jnp.concatenate(
+        [jnp.zeros((1,), jnp.bool_), (~valid[1:]) & (~valid[:-1])]
+    )
+    return (neq & ~both_null) | vneq
+
+
 def _mask_reduce(func, data, contributes, gid, num_groups: int, wide=False):
     """_segment_reduce over a SMALL static group count via per-group masked
     full reductions — no scatter. On TPU, scatter-add (what segment_sum
@@ -641,18 +671,7 @@ def grouped_aggregate_sorted(
     # run boundaries on actual key values (collision-proof)
     boundary = jnp.zeros(page.capacity, jnp.bool_).at[0].set(True)
     for v in keys_s:
-        neq = _neq_adjacent(v.data)
-        if v.valid is not None:
-            vd = v.valid
-            neq = neq | jnp.concatenate(
-                [jnp.zeros((1,), jnp.bool_), vd[1:] != vd[:-1]]
-            )
-            # two adjacent nulls are the same group regardless of data
-            both_null = jnp.concatenate(
-                [jnp.zeros((1,), jnp.bool_), (~vd[1:]) & (~vd[:-1])]
-            )
-            neq = neq & ~both_null
-        boundary = boundary | neq
+        boundary = boundary | _neq_adjacent_nullaware(v.data, v.valid)
 
     boundary = boundary & live_s
     gid_s = jnp.cumsum(boundary.astype(jnp.int32)) - 1

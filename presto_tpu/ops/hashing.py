@@ -41,8 +41,11 @@ def hash_column(data, valid: Optional[jnp.ndarray] = None):
             h = jnp.where(valid, h, _NULL_HASH)
         return h
     if jnp.issubdtype(data.dtype, jnp.floating):
-        # canonicalize -0.0 == 0.0 before bitcasting
+        # canonicalize -0.0 == 0.0 and ALL NaN payloads to one quiet NaN
+        # before bitcasting (reference doubleToLongBits semantics: every
+        # NaN hashes and groups as the same value)
         data = jnp.where(data == 0, jnp.zeros_like(data), data)
+        data = jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
         width = data.dtype.itemsize
         idtype = {4: jnp.uint32, 8: jnp.uint64}[width]
         bits = jnp.asarray(data).view(idtype).astype(jnp.uint64)

@@ -132,6 +132,12 @@ def _key_operands(page: Page, keys: Sequence[SortKey]):
             # nulls to the requested end: leading per-key flag operand
             flag = v.valid if k.effective_nulls_first else ~v.valid
             ops.append(flag.astype(jnp.int8))
+            # canonicalize NULL slots: their storage is garbage and must
+            # not order null-tied rows ahead of the NEXT sort key (the
+            # window sort does the same; SQL ties on NULL break by the
+            # remaining keys)
+            mask = v.valid if data.ndim == 1 else v.valid[:, None]
+            data = jnp.where(mask, data, jnp.zeros_like(data))
         if data.ndim == 2:
             # long-decimal lanes: (hi, lo) lexicographic == numeric
             # (lo >= 0); bitwise NOT reverses order without overflow
@@ -231,11 +237,8 @@ def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:
         count = jnp.minimum(page.count, cap).astype(jnp.int32)
         return Page(tuple(blocks), page.names, count)
     s = sort_page(page, keys)
-    blocks = []
-    for b in s.blocks:
-        data = b.data[:cap]
-        valid = None if b.valid is None else b.valid[:cap]
-        blocks.append(Block(data, b.type, valid, b.dict_id))
+    # take_rows keeps collection companions (lengths/elem_valid/key_block)
+    blocks = [b.take_rows(slice(0, cap)) for b in s.blocks]
     count = jnp.minimum(s.count, cap).astype(jnp.int32)
     return Page(tuple(blocks), s.names, count)
 
@@ -243,6 +246,217 @@ def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:
 def limit_page(page: Page, n: int) -> Page:
     """LIMIT without ORDER BY: keep the first n live rows."""
     return Page(page.blocks, page.names, jnp.minimum(page.count, n).astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# packed composite-key paths (ops/keypack.py): ONE sort on ONE key
+# ---------------------------------------------------------------------------
+
+
+def _packed_key_vals(page: Page, keys: Sequence[SortKey]):
+    return [evaluate(k.expr, page) for k in keys]
+
+
+def _host_argsort(*lanes):
+    """numpy stable argsort of the packed lane(s) (lexicographic across
+    lanes). ~8M rows/s vs ~2M for XLA's CPU comparison sort. Operands
+    arrive as jax ArrayImpls — materialize to real numpy buffers first
+    or numpy's sort runs ~3x slower through the buffer protocol."""
+    import numpy as np
+
+    lanes = [np.asarray(l) for l in lanes]
+    if len(lanes) == 1:
+        return np.argsort(lanes[0], kind="stable").astype(np.int32)
+    return np.lexsort(tuple(reversed(lanes))).astype(np.int32)
+
+
+def _host_topn(n: int):
+    """numpy n-smallest row selection: argpartition + a stable sort of
+    the <=n-ish candidates, ties broken by lower row index (the legacy
+    stable order)."""
+    import numpy as np
+
+    def select(k):
+        k = np.asarray(k)
+        part = np.argpartition(k, n - 1)[:n]
+        thresh = k[part].max()
+        cand = np.flatnonzero(k <= thresh)
+        return cand[np.argsort(k[cand], kind="stable")][:n].astype(np.int32)
+
+    return select
+
+
+def packed_sort_perm(lanes, plan, cap: int) -> jnp.ndarray:
+    """Stable permutation sorting the packed lane(s) ascending — ONE
+    device sort, or one numpy argsort through `jax.pure_callback` when
+    the plan was made for the CPU backend (plan.host_sort)."""
+    import jax
+
+    if plan.host_sort:
+        return jax.pure_callback(
+            _host_argsort,
+            jax.ShapeDtypeStruct((cap,), jnp.int32),
+            *lanes,
+        )
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    out = jax.lax.sort(
+        tuple(lanes) + (idx,), num_keys=len(lanes), is_stable=True
+    )
+    return out[-1]
+
+
+def sort_page_packed(page: Page, keys: Sequence[SortKey], plan):
+    """Multi-key ORDER BY as ONE argsort on the packed composite key
+    (instead of a K-operand variadic sort / K iterated stable argsorts).
+
+    Returns (sorted page, ok): `ok` is None unless the plan packs through
+    sampled CBO bounds, in which case a False `ok` means some key fell
+    outside the planned range and the caller must rerun the legacy path."""
+    from .keypack import pack_keys
+
+    vals = _packed_key_vals(page, keys)
+    lanes, ok = pack_keys(vals, plan, page.live_mask())
+    perm = packed_sort_perm(lanes, plan, page.capacity)
+    return apply_permutation(page, perm), ok
+
+
+def top_n_packed(page: Page, keys: Sequence[SortKey], n: int, plan):
+    """TopN on the single-lane packed key: `lax.top_k` of the negated key
+    (a selection network over ONE int64 array instead of any full sort)
+    or a numpy argpartition under plan.host_sort. Both break ties in
+    favor of the lower index, matching the legacy stable order exactly.
+    Returns (page, ok) like sort_page_packed."""
+    import jax
+
+    from .keypack import pack_keys
+
+    if not plan.single_lane:
+        out, ok = sort_page_packed(page, keys, plan)
+        cap = min(n, page.capacity)
+        # take_rows keeps collection companions (lengths/elem_valid/...)
+        blocks = [b.take_rows(slice(0, cap)) for b in out.blocks]
+        count = jnp.minimum(out.count, cap).astype(jnp.int32)
+        return Page(tuple(blocks), out.names, count), ok
+    vals = _packed_key_vals(page, keys)
+    lanes, ok = pack_keys(vals, plan, page.live_mask())
+    cap = min(n, page.capacity)
+    if plan.host_sort and cap < page.capacity:
+        perm = jax.pure_callback(
+            _host_topn(cap),
+            jax.ShapeDtypeStruct((cap,), jnp.int32),
+            lanes[0],
+        )
+    else:
+        # packed keys are < 2**62 (dead rows INT64_MAX): negation is safe
+        # and turns "n smallest" into top_k's "n largest"
+        _, perm = jax.lax.top_k(-lanes[0], cap)
+    blocks = [b.take_rows(perm) for b in page.blocks]
+    count = jnp.minimum(page.count, cap).astype(jnp.int32)
+    return Page(tuple(blocks), page.names, count), ok
+
+
+def _host_distinct_sel(count, *lanes):
+    """numpy distinct: one representative row index per distinct packed
+    key among the first `count` (live) rows. Returns (selection indices
+    padded to capacity, distinct count)."""
+    import numpy as np
+
+    n = int(count)
+    cap = lanes[0].shape[0]
+    ls = [np.asarray(l)[:n] for l in lanes]
+    if n == 0:
+        return np.zeros(cap, np.int32), np.int32(0)
+    if len(ls) == 1:
+        order = np.argsort(ls[0])  # unstable: any representative works
+    else:
+        order = np.lexsort(tuple(reversed(ls)))
+    flag = np.zeros(n, bool)
+    flag[0] = True
+    for l in ls:
+        s = l[order]
+        flag[1:] |= s[1:] != s[:-1]
+    sel = order[flag]
+    out = np.zeros(cap, np.int32)
+    out[: sel.size] = sel
+    return out, np.int32(sel.size)
+
+
+def _adjacent_run_starts(lanes_sorted, live_s):
+    """First-of-run flags over sorted lane arrays (leading row True)."""
+    from .aggregate import _neq_adjacent
+
+    boundary = jnp.zeros(live_s.shape, jnp.bool_).at[0].set(True)
+    for lane in lanes_sorted:
+        boundary = boundary | _neq_adjacent(lane)
+    return boundary & live_s
+
+
+def distinct_packed(page: Page, plan):
+    """SELECT DISTINCT as sorted-adjacent-unique on the packed key.
+
+    bitpack/two_lane plans are exact (distinct packed keys == distinct
+    rows); the hashed plan compares the raw key columns across every
+    adjacent equal-hash pair and flips `ok` on a collision so the caller
+    degrades to the legacy grouped-aggregation path."""
+    import jax
+
+    from .filter import compact
+    from .keypack import pack_keys
+
+    live = page.live_mask()
+    idx = jnp.arange(page.capacity, dtype=jnp.int32)
+    if plan.strategy == "hashed":
+        from .hashing import hash_rows
+
+        h = hash_rows(page.blocks)
+        h = jnp.where(live, h, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        out = jax.lax.sort((h, idx), num_keys=1, is_stable=True)
+        h_s, perm = out
+        live_s = live[perm]
+        from .aggregate import _neq_adjacent
+
+        boundary = (
+            jnp.zeros(page.capacity, jnp.bool_).at[0].set(True)
+            | _neq_adjacent(h_s)
+        ) & live_s
+        # post-hoc collision check: an adjacent pair with EQUAL hash but
+        # UNEQUAL key values means 64 bits were not enough for this batch
+        same_hash = (~_neq_adjacent(h_s)) & live_s
+        differs = jnp.zeros(page.capacity, jnp.bool_)
+        for b in page.blocks:
+            from .aggregate import _neq_adjacent_nullaware
+
+            differs = differs | _neq_adjacent_nullaware(
+                b.data[perm], None if b.valid is None else b.valid[perm]
+            )
+        ok = ~jnp.any(same_hash & differs)
+        sorted_page = apply_permutation(page, perm)
+        return compact(sorted_page, boundary), ok
+    lanes, ok = pack_keys(page.blocks, plan, live)
+    if plan.host_sort:
+        # numpy first-of-run selection over the live prefix (live rows
+        # occupy [0, count) by the Page contract); equal packed keys are
+        # identical rows, so representative choice is free and the
+        # unstable (faster) numpy sort kinds are safe
+        sel, cnt = jax.pure_callback(
+            _host_distinct_sel,
+            (
+                jax.ShapeDtypeStruct((page.capacity,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            ),
+            page.count,
+            *lanes,
+        )
+        blocks = [b.take_rows(sel) for b in page.blocks]
+        return Page(tuple(blocks), page.names, cnt), ok
+    out = jax.lax.sort(
+        tuple(lanes) + (idx,), num_keys=len(lanes), is_stable=True
+    )
+    perm = out[-1]
+    live_s = live[perm]
+    boundary = _adjacent_run_starts(out[:-1], live_s)
+    sorted_page = apply_permutation(page, perm)
+    return compact(sorted_page, boundary), ok
 
 
 def distinct_page(page: Page, max_groups: int) -> Page:

@@ -111,27 +111,14 @@ def _partition_bounds(page: Page, partition_exprs, perm):
     live_s = page.live_mask()[perm]
     boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
     for e in partition_exprs:
-        from .aggregate import _neq_adjacent
+        from .aggregate import _neq_adjacent_nullaware
 
         v = evaluate(e, page)
-        neq = _neq_adjacent(v.data[perm])
-        if v.valid is not None:
-            vd = v.valid[perm]
-            neq = neq | jnp.concatenate(
-                [jnp.zeros((1,), jnp.bool_), vd[1:] != vd[:-1]]
-            )
-            both_null = jnp.concatenate(
-                [jnp.zeros((1,), jnp.bool_), (~vd[1:]) & (~vd[:-1])]
-            )
-            neq = neq & ~both_null
-        boundary = boundary | neq
+        boundary = boundary | _neq_adjacent_nullaware(
+            v.data[perm], None if v.valid is None else v.valid[perm]
+        )
     boundary = boundary & live_s
-    pid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    pid = jnp.where(live_s, pid, cap)  # dead rows own segment
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    start = jax.lax.cummax(jnp.where(boundary, idx, 0))
-    sizes = jax.ops.segment_sum(live_s.astype(jnp.int32), pid, cap + 1)
-    part_size = sizes[jnp.minimum(pid, cap)]
+    pid, start, part_size = _bounds_from_boundary(boundary, live_s, cap)
     return boundary, pid, start, part_size, live_s
 
 
@@ -140,17 +127,34 @@ def _peer_bounds(page: Page, order_keys: Sequence[SortKey], perm, boundary):
     cap = page.capacity
     peer = boundary
     for k in order_keys:
-        from .aggregate import _neq_adjacent
+        from .aggregate import _neq_adjacent_nullaware
 
         v = evaluate(k.expr, page)
-        neq = _neq_adjacent(v.data[perm])
-        if v.valid is not None:
-            vd = v.valid[perm]
-            neq = neq | jnp.concatenate(
-                [jnp.zeros((1,), jnp.bool_), vd[1:] != vd[:-1]]
-            )
-        peer = peer | neq
+        peer = peer | _neq_adjacent_nullaware(
+            v.data[perm], None if v.valid is None else v.valid[perm]
+        )
     return peer
+
+
+def _need_peer(funcs, order_keys) -> bool:
+    return any(
+        f.func in ("rank", "dense_rank", "percent_rank", "cume_dist")
+        or (f.func in AGGREGATE | VALUE and order_keys)
+        for f in funcs
+    )
+
+
+def _bounds_from_boundary(boundary, live_s, cap):
+    """(pid, start_idx, part_size) over the sorted order, given the
+    partition-start flags (shared by the legacy per-key detection and the
+    packed-key shift detection)."""
+    pid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    pid = jnp.where(live_s, pid, cap)  # dead rows own segment
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    sizes = jax.ops.segment_sum(live_s.astype(jnp.int32), pid, cap + 1)
+    part_size = sizes[jnp.minimum(pid, cap)]
+    return pid, start, part_size
 
 
 def window_op(
@@ -160,21 +164,77 @@ def window_op(
     funcs: Sequence[WindowFunc],
 ) -> Page:
     perm = _sort_for_window(page, partition_exprs, order_keys)
+    boundary, pid, start, part_size, live_s = _partition_bounds(
+        page, partition_exprs, perm
+    )
+    peer = None
+    if _need_peer(funcs, order_keys):
+        peer = _peer_bounds(page, order_keys, perm, boundary)
+    return _window_body(
+        page, perm, boundary, pid, start, part_size, live_s, peer,
+        order_keys, funcs,
+    )
+
+
+def window_op_packed(
+    page: Page,
+    partition_exprs,
+    order_keys: Sequence[SortKey],
+    funcs: Sequence[WindowFunc],
+    plan,
+):
+    """Window functions over a SINGLE-LANE packed (partition, order) key
+    (ops/keypack.py): one `lax.sort` replaces the legacy hash +
+    per-partition-key stable-argsort cascade, and partition/peer
+    boundaries fall out of integer compares on the sorted key — partition
+    identity is the key shifted right past the order-key bits.
+
+    Returns (page, ok); a False `ok` (sampled-stats range miss) means the
+    caller must rerun the legacy window_op."""
+    from .aggregate import _neq_adjacent
+    from .keypack import pack_keys
+    from .sort import packed_sort_perm
+
+    cap = page.capacity
+    vals = [evaluate(e, page) for e in partition_exprs] + [
+        evaluate(k.expr, page) for k in order_keys
+    ]
+    live = page.live_mask()
+    lanes, ok = pack_keys(vals, plan, live)
+    packed = lanes[0]
+    perm = packed_sort_perm(lanes, plan, cap)
+    packed_s = packed[perm]
+    live_s = live[perm]
+    boundary = _neq_adjacent(packed_s >> plan.order_bits) & live_s
+    pid, start, part_size = _bounds_from_boundary(boundary, live_s, cap)
+    peer = None
+    if _need_peer(funcs, order_keys):
+        peer = boundary | _neq_adjacent(packed_s)
+    out = _window_body(
+        page, perm, boundary, pid, start, part_size, live_s, peer,
+        order_keys, funcs,
+    )
+    return out, ok
+
+
+def _window_body(
+    page: Page,
+    perm,
+    boundary,
+    pid,
+    start,
+    part_size,
+    live_s,
+    peer,
+    order_keys: Sequence[SortKey],
+    funcs: Sequence[WindowFunc],
+) -> Page:
     sorted_page = apply_permutation(page, perm)
     cap = page.capacity
     idx = jnp.arange(cap, dtype=jnp.int32)
 
-    boundary, pid, start, part_size, live_s = _partition_bounds(
-        page, partition_exprs, perm
-    )
-    need_peer = any(
-        f.func in ("rank", "dense_rank", "percent_rank", "cume_dist")
-        or (f.func in AGGREGATE | VALUE and order_keys)
-        for f in funcs
-    )
-    peer = peer_start = next_peer = None
-    if need_peer:
-        peer = _peer_bounds(page, order_keys, perm, boundary)
+    peer_start = next_peer = None
+    if peer is not None:
         peer_start = jax.lax.cummax(jnp.where(peer, idx, 0))
         next_peer = _next_peer_start(peer, cap)
 
@@ -384,7 +444,12 @@ def _frame_bounds(
         lo = bound(frame.start_kind, frame.start_offset, True)
         hi = bound(frame.end_kind, frame.end_offset, False)
     else:  # range
-        data, kvalid, asc = order_vals
+        # order_vals is None for multi-key ORDER BY — legal as long as no
+        # bound needs a key offset (CURRENT/UNBOUNDED use peer bounds);
+        # bounds_for() rejects offset frames before reaching here
+        data, kvalid, asc = (
+            order_vals if order_vals is not None else (None, None, True)
+        )
         knull = (
             jnp.zeros(cap, jnp.bool_) if kvalid is None else ~kvalid
         )

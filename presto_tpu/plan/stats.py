@@ -412,6 +412,29 @@ def derive(node: N.PlanNode, catalog) -> PlanStats:
     return StatsDeriver(catalog).stats(node)
 
 
+def storage_bounds(cs: ColumnStats, typ):
+    """[lo, hi] in STORAGE units from a column's LOGICAL min/max stats —
+    the keypack planner's input (ops/keypack.py). ColumnStats min/max are
+    logical (scaled decimals divided out, dates as epoch days); bit
+    packing operates on storage integers, so scale is multiplied back in
+    with a +-1 margin against float rounding. Floats are returned as
+    float bounds (the planner transforms them through the total-order
+    map). None = unknown / unbounded — the column can't be
+    stats-packed."""
+    import math
+
+    if cs is None or cs.min is None or cs.max is None:
+        return None
+    lo_f, hi_f = float(cs.min), float(cs.max)
+    if not (math.isfinite(lo_f) and math.isfinite(hi_f)) or hi_f < lo_f:
+        return None
+    if isinstance(typ, (T.DoubleType, T.RealType)):
+        return lo_f, hi_f
+    scale = getattr(typ, "scale", 0) or 0
+    mul = 10 ** scale
+    return math.floor(lo_f * mul) - 1, math.ceil(hi_f * mul) + 1
+
+
 def stats_from_column(
     data, valid, typ, dictionary, total_rows: int
 ) -> ColumnStats:

@@ -1031,6 +1031,9 @@ class Session:
         if self.matmul_groupby is not None and hasattr(local, "matmul_groupby"):
             local.matmul_groupby = self.matmul_groupby
         ex.run(node)
+        # fold parked device row-count scalars in one batch (the lazy
+        # collector avoids a blocking host sync per plan node)
+        collector.resolve()
         tree = N.plan_tree_str(node, collector=collector)
         total_ms = collector.total_wall_s() * 1e3
         peak = collector.peak_bytes / (1024 * 1024)

@@ -1,0 +1,101 @@
+"""tools/bench_gate.py — the micro-bench perf regression gate.
+
+Slow-marked (runs real kernel benchmarks); tier-1 (-m 'not slow') skips
+it. The gate compares the four keypack-targeted kernels against the
+BENCH_r05 floors recorded in BASELINE.json `micro_gate` and exits
+non-zero on a >10% regression.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "bench_gate.py")
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_bench_gate_passes_vs_recorded_baseline():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, GATE, "--runs", "2"],
+        capture_output=True,
+        text=True,
+        timeout=850,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, f"bench gate failed:\n{r.stdout}\n{r.stderr}"
+    assert "bench_gate:" in r.stdout
+
+
+def test_bench_gate_skips_on_sf_mismatch(tmp_path):
+    """A baseline recorded at another scale factor must SKIP (exit 0)
+    before any benchmark runs."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({
+        "micro_gate": {
+            "backend": "cpu",
+            "sf": 0.1,
+            "values": {"sort_2key": 10**12},
+        }
+    }))
+    assert bench_gate.run_gate(sf=9.9, baseline_path=str(baseline)) == 0
+
+
+def test_bench_gate_skips_on_backend_mismatch(tmp_path, monkeypatch):
+    """A baseline recorded on another backend must SKIP (exit 0), never
+    compare cross-backend numbers — even when the floor is unreachable."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    import presto_tpu.benchmark.micro as micro
+
+    monkeypatch.setattr(
+        micro, "run_suite",
+        lambda sf, runs, only: {
+            "backend": "cpu",
+            "results": [{"name": "sort_2key", "rows_per_s": 1}],
+            "errors": {},
+        },
+    )
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({
+        "micro_gate": {
+            "backend": "tpu-imaginary",
+            "sf": 0.1,
+            "values": {"sort_2key": 10**12},
+        }
+    }))
+    assert bench_gate.run_gate(sf=0.1, baseline_path=str(baseline)) == 0
+    # same backend: the unreachable floor must FAIL the gate
+    baseline.write_text(json.dumps({
+        "micro_gate": {
+            "backend": "cpu",
+            "sf": 0.1,
+            "values": {"sort_2key": 10**12},
+        }
+    }))
+    assert bench_gate.run_gate(sf=0.1, baseline_path=str(baseline)) == 1
+
+
+def test_bench_gate_skips_without_baseline(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {}}))
+    assert bench_gate.run_gate(baseline_path=str(baseline)) == 0

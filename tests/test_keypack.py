@@ -1,0 +1,501 @@
+"""Composite-key packing (ops/keypack.py) — packed kernels vs legacy oracle.
+
+Covers the ISSUE-2 acceptance surface: a property loop over dtypes, key
+counts 1-4, NULL orderings, duplicates and ±0.0/NaN asserting packed
+sort/topn/distinct/window output == the legacy-path oracle (on both the
+device-sort and host-numpy-sort variants); DESC + NULLS FIRST + NaN
+regressions for both paths; plan-selection unit tests; the runtime
+range-check fallback for sampled CBO bounds; the hashed-distinct
+collision check; and the breaker-forced legacy fallback with EXPLAIN
+ANALYZE strategy visibility.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.expr.ir import ColumnRef, col
+from presto_tpu.ops import keypack as KP
+from presto_tpu.ops.sort import (
+    SortKey,
+    distinct_packed,
+    distinct_page,
+    sort_page,
+    sort_page_packed,
+    top_n,
+    top_n_packed,
+)
+from presto_tpu.page import Block, Page
+
+
+def _norm(rows):
+    """NaN-safe row normalization for equality checks."""
+    out = []
+    for r in rows:
+        out.append(tuple(
+            "nan" if isinstance(v, float) and math.isnan(v) else v
+            for v in r
+        ))
+    return out
+
+
+def _sorted_rows(page):
+    return sorted(_norm(page.to_pylist()), key=repr)
+
+
+# ---------------------------------------------------------------------------
+# column generators for the property loop
+# ---------------------------------------------------------------------------
+
+
+def _gen_column(rng, n, kind, with_nulls):
+    if kind == "bigint":
+        data = rng.integers(-1000, 1000, n).astype(np.int64)
+        typ = T.BIGINT
+    elif kind == "bigint_wide":
+        data = rng.integers(-(1 << 50), 1 << 50, n).astype(np.int64)
+        typ = T.BIGINT
+    elif kind == "integer":
+        data = rng.integers(-100, 100, n).astype(np.int32)
+        typ = T.INTEGER
+    elif kind == "smallint":
+        data = rng.integers(-30, 30, n).astype(np.int16)
+        typ = T.SMALLINT
+    elif kind == "boolean":
+        data = rng.random(n) < 0.5
+        typ = T.BOOLEAN
+    elif kind == "date":
+        data = rng.integers(8000, 12000, n).astype(np.int32)
+        typ = T.DATE
+    elif kind == "decimal":
+        data = rng.integers(-10**6, 10**6, n).astype(np.int64)
+        typ = T.DecimalType(12, 2)
+    elif kind == "double":
+        data = rng.normal(size=n)
+        data[rng.random(n) < 0.1] = np.nan
+        data[rng.random(n) < 0.05] = 0.0
+        data[rng.random(n) < 0.05] = -0.0
+        data[rng.random(n) < 0.02] = np.inf
+        data[rng.random(n) < 0.02] = -np.inf
+        typ = T.DOUBLE
+    elif kind == "real":
+        data = rng.normal(size=n).astype(np.float32)
+        data[rng.random(n) < 0.1] = np.nan
+        data[rng.random(n) < 0.05] = -0.0
+        typ = T.REAL
+    else:
+        raise KeyError(kind)
+    # heavy duplication so ties and multi-key ordering actually bite
+    dup = rng.integers(0, n, n)
+    mask = rng.random(n) < 0.5
+    data = np.where(mask, data[dup], data) if data.dtype != np.bool_ else data
+    valid = (rng.random(n) > 0.25) if with_nulls else None
+    return Block.from_numpy(data, typ, valid=valid), typ
+
+
+PROP_CASES = [
+    # (key kinds, null flags, ascending flags, nulls_first flags)
+    (("bigint",), (True,), (True,), (None,)),
+    (("double",), (False,), (False,), (None,)),
+    (("double",), (True,), (False,), (True,)),
+    (("bigint", "double"), (True, False), (False, True), (True, None)),
+    (("decimal", "bigint"), (False, False), (False, True), (None, None)),
+    (("integer", "real"), (True, True), (True, False), (False, True)),
+    (("boolean", "date", "smallint"), (True, False, True),
+     (False, True, True), (None, None, True)),
+    (("bigint", "integer", "double", "boolean"),
+     (True, True, True, True), (True, False, True, False),
+     (None, True, False, None)),
+    (("bigint_wide", "bigint"), (False, True), (True, False), (None, False)),
+]
+
+
+def _prop_page_and_keys(seed, kinds, nulls, ascs, nfs, n=257, cap=512):
+    rng = np.random.default_rng(seed)
+    cols, keys = {}, []
+    for i, (kind, wn, asc, nf) in enumerate(zip(kinds, nulls, ascs, nfs)):
+        name = f"k{i}"
+        blk, typ = _gen_column(rng, n, kind, wn)
+        cols[name] = blk
+        keys.append(SortKey(col(name, typ), ascending=asc, nulls_first=nf))
+    page = Page.from_dict(cols, pad_to=cap)
+    return page, tuple(keys)
+
+
+@pytest.mark.parametrize("case_idx", range(len(PROP_CASES)))
+@pytest.mark.parametrize("host_sort", [False, True])
+def test_property_packed_sort_topn_matches_legacy(case_idx, host_sort):
+    kinds, nulls, ascs, nfs = PROP_CASES[case_idx]
+    page, keys = _prop_page_and_keys(31 + case_idx, kinds, nulls, ascs, nfs)
+    plan = KP.plan_from_page(page, keys, host_sort=host_sort)
+    if plan is None:
+        pytest.skip(f"keys {kinds} not packable (legacy path covers this)")
+    legacy = _norm(sort_page(page, keys).to_pylist())
+    packed, ok = sort_page_packed(page, keys, plan)
+    assert ok is None or bool(ok)
+    assert _norm(packed.to_pylist()) == legacy
+    for n_top in (1, 13, 100):
+        lt = _norm(top_n(page, keys, n_top).to_pylist())
+        pt, ok = top_n_packed(page, keys, n_top, plan)
+        assert ok is None or bool(ok)
+        assert _norm(pt.to_pylist()) == lt
+
+
+@pytest.mark.parametrize("case_idx", range(len(PROP_CASES)))
+@pytest.mark.parametrize("host_sort", [False, True])
+def test_property_packed_distinct_matches_legacy(case_idx, host_sort):
+    kinds, nulls, ascs, nfs = PROP_CASES[case_idx]
+    page, _keys = _prop_page_and_keys(77 + case_idx, kinds, nulls, ascs, nfs)
+    exprs = tuple(
+        ColumnRef(n, b.type) for n, b in zip(page.names, page.blocks)
+    )
+    plan = KP.plan_from_page(
+        page, exprs, equality_only=True, allow_hashed=True,
+        host_sort=host_sort,
+    )
+    assert plan is not None  # hashed backstop always packs
+    legacy = _sorted_rows(distinct_page(page, page.capacity))
+    packed, ok = distinct_packed(page, plan)
+    assert ok is None or bool(ok)
+    assert _sorted_rows(packed) == legacy
+
+
+@pytest.mark.parametrize("case_idx", [0, 3, 4, 5, 6])
+@pytest.mark.parametrize("host_sort", [False, True])
+def test_property_packed_window_matches_legacy(case_idx, host_sort):
+    from presto_tpu.ops.window import WindowFunc, window_op, window_op_packed
+
+    kinds, nulls, ascs, nfs = PROP_CASES[case_idx]
+    page, keys = _prop_page_and_keys(113 + case_idx, kinds, nulls, ascs, nfs)
+    # first key partitions, the rest order (single-key cases: no order)
+    parts = (keys[0].expr,)
+    order = keys[1:]
+    specs = tuple(SortKey(e) for e in parts) + order
+    plan = KP.plan_from_page(
+        page, specs, single_lane=True, n_order_keys=len(order),
+        host_sort=host_sort,
+    )
+    if plan is None:
+        pytest.skip(f"window keys {kinds} not single-lane packable")
+    in_t = page.blocks[0].type
+    funcs = [
+        WindowFunc("row_number", None, "rn", T.BIGINT),
+        WindowFunc("count", None, "cnt", T.BIGINT),
+    ]
+    if order:
+        funcs.append(WindowFunc("rank", None, "rk", T.BIGINT))
+        funcs.append(WindowFunc("dense_rank", None, "dr", T.BIGINT))
+    funcs = tuple(funcs)
+    legacy = _sorted_rows(window_op(page, parts, order, funcs))
+    packed, ok = window_op_packed(page, parts, order, funcs, plan)
+    assert ok is None or bool(ok)
+    assert _sorted_rows(packed) == legacy
+
+
+# ---------------------------------------------------------------------------
+# DESC float + NULLS FIRST + NaN regressions (ISSUE-2 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _nan_page():
+    data = np.array(
+        [3.5, float("nan"), -0.0, 0.0, float("-inf"), float("inf"),
+         -3.5, float("nan"), 1e-300, -1e-300],
+        np.float64,
+    )
+    valid = np.array(
+        [True, True, True, True, False, True, True, True, False, True]
+    )
+    return Page.from_dict(
+        {"v": Block.from_numpy(data, T.DOUBLE, valid=valid),
+         "tag": np.arange(10, dtype=np.int64)},
+        pad_to=16,
+    )
+
+
+@pytest.mark.parametrize("nulls_first", [True, False])
+def test_desc_nulls_nan_legacy(nulls_first):
+    """DESC + NULLS FIRST/LAST + NaN together: NULLs go to the requested
+    end, NaNs sort after every non-null float in BOTH directions."""
+    page = _nan_page()
+    keys = (SortKey(col("v", T.DOUBLE), ascending=False,
+                    nulls_first=nulls_first),)
+    got = [r[0] for r in _norm(sort_page(page, keys).to_pylist())]
+    non_null = [v for v in got if v is not None]
+    nulls = [v for v in got if v is None]
+    assert len(nulls) == 2
+    if nulls_first:
+        assert got[:2] == [None, None]
+    else:
+        assert got[-2:] == [None, None]
+    # among non-nulls: descending floats, NaNs pinned last
+    assert non_null[-2:] == ["nan", "nan"]
+    floats = non_null[:-2]
+    assert floats == sorted(floats, reverse=True)
+    assert floats[0] == float("inf")
+
+
+@pytest.mark.parametrize("nulls_first", [True, False])
+@pytest.mark.parametrize("host_sort", [False, True])
+def test_desc_nulls_nan_packed_matches_legacy(nulls_first, host_sort):
+    # float64 total-order keys span ~63 bits, so a DESC+NULLS FIRST
+    # double packs as (null bit in lane0, native 64-bit lane1) behind an
+    # exactly-bounded leading key — the two_lane shape
+    page = _nan_page()
+    keys = (
+        SortKey(col("tag", T.BIGINT)),
+        SortKey(col("v", T.DOUBLE), ascending=False,
+                nulls_first=nulls_first),
+    )
+    plan = KP.plan_from_page(page, keys, host_sort=host_sort)
+    assert plan is not None and plan.strategy == "two_lane"
+    legacy = _norm(sort_page(page, keys).to_pylist())
+    packed, ok = sort_page_packed(page, keys, plan)
+    assert _norm(packed.to_pylist()) == legacy
+    pt, _ = top_n_packed(page, keys, 5, plan)
+    assert _norm(pt.to_pylist()) == _norm(top_n(page, keys, 5).to_pylist())
+
+
+@pytest.mark.parametrize("nulls_first", [True, False])
+@pytest.mark.parametrize("host_sort", [False, True])
+def test_desc_nulls_nan_real_primary_packed(nulls_first, host_sort):
+    """DESC + NULLS FIRST + NaN on a PRIMARY float key: REAL's 32-bit
+    total-order key bit-packs, so the whole ordering (null bit, flipped
+    payload, NaN pinned last) lives in one lane."""
+    rng = np.random.default_rng(9)
+    data = rng.normal(size=40).astype(np.float32)
+    data[::5] = np.nan
+    data[1] = np.inf
+    data[2] = -np.inf
+    data[3], data[4] = 0.0, -0.0
+    valid = rng.random(40) > 0.3
+    page = Page.from_dict(
+        {"v": Block.from_numpy(data, T.REAL, valid=valid),
+         "tag": np.arange(40, dtype=np.int64)},
+        pad_to=64,
+    )
+    keys = (
+        SortKey(col("v", T.REAL), ascending=False, nulls_first=nulls_first),
+        SortKey(col("tag", T.BIGINT)),
+    )
+    plan = KP.plan_from_page(page, keys, host_sort=host_sort)
+    assert plan is not None and plan.strategy == "bitpack"
+    legacy = _norm(sort_page(page, keys).to_pylist())
+    packed, _ = sort_page_packed(page, keys, plan)
+    assert _norm(packed.to_pylist()) == legacy
+
+
+def test_negzero_ties_poszero_both_paths():
+    data = np.array([0.0, -0.0, 1.0, -0.0, 0.0], np.float64)
+    tag = np.arange(5, dtype=np.int64)
+    page = Page.from_dict(
+        {"v": Block.from_numpy(data, T.DOUBLE), "tag": tag}, pad_to=8
+    )
+    keys = (SortKey(col("v", T.DOUBLE)), SortKey(col("tag", T.BIGINT)))
+    plan = KP.plan_from_page(page, keys)
+    legacy = sort_page(page, keys).to_pylist()
+    # ±0.0 tie: order falls to the tag key
+    assert [r[1] for r in legacy] == [0, 1, 3, 4, 2]
+    packed, _ = sort_page_packed(page, keys, plan)
+    assert packed.to_pylist() == legacy
+
+
+# ---------------------------------------------------------------------------
+# plan selection
+# ---------------------------------------------------------------------------
+
+
+def test_plan_exact_widths_bitpack_no_check():
+    keys = (
+        SortKey(col("a", T.INTEGER)),
+        SortKey(col("b", T.BOOLEAN), ascending=False),
+    )
+    infos = (
+        KP.KeyInfo(type=T.INTEGER, nullable=False),
+        KP.KeyInfo(type=T.BOOLEAN, nullable=True),
+    )
+    plan = KP.plan_keypack(keys, infos, host_sort=False)
+    assert plan is not None and plan.strategy == "bitpack"
+    assert not plan.needs_check  # exact type widths: no runtime check
+    assert sum(f.bits for f in plan.lanes[0]) == 32 + 1 + 1
+
+
+def test_plan_stats_tighten_int64_with_check():
+    keys = (SortKey(col("a", T.BIGINT)), SortKey(col("b", T.BIGINT)))
+    infos = (
+        KP.KeyInfo(type=T.BIGINT, nullable=False, lo=0, hi=10**6),
+        KP.KeyInfo(type=T.BIGINT, nullable=False, lo=-500, hi=500),
+    )
+    plan = KP.plan_keypack(keys, infos, host_sort=False)
+    assert plan.strategy == "bitpack"
+    assert plan.needs_check  # sampled CBO bounds carry the range check
+    exact = KP.plan_keypack(
+        keys,
+        tuple(KP.KeyInfo(type=T.BIGINT, nullable=False, lo=i.lo, hi=i.hi,
+                         exact_bounds=True) for i in infos),
+        host_sort=False,
+    )
+    assert exact.strategy == "bitpack" and not exact.needs_check
+
+
+def test_plan_two_lane_and_hashed_fallback():
+    keys = (SortKey(col("a", T.BIGINT)), SortKey(col("b", T.DOUBLE)))
+    infos = (
+        KP.KeyInfo(type=T.BIGINT, nullable=False, lo=0, hi=1000),
+        KP.KeyInfo(type=T.DOUBLE, nullable=False),  # no bounds: native lane
+    )
+    plan = KP.plan_keypack(keys, infos, host_sort=False)
+    assert plan is not None and plan.strategy == "two_lane"
+    assert plan.lanes[1][0].kind == "native"
+    # a native lane cannot lead: double-first is unpackable for ORDER...
+    rev = KP.plan_keypack(tuple(reversed(keys)), tuple(reversed(infos)),
+                          host_sort=False)
+    assert rev is None
+    # ...but equality-only consumers degrade to the hashed strategy
+    h = KP.plan_keypack(
+        tuple(reversed(keys)), tuple(reversed(infos)),
+        equality_only=True, allow_hashed=True, host_sort=False,
+    )
+    assert h.strategy == "hashed" and h.needs_check
+
+
+def test_plan_window_order_bits_requires_single_lane():
+    keys = (SortKey(col("p", T.SMALLINT)), SortKey(col("o", T.DATE)))
+    infos = (
+        KP.KeyInfo(type=T.SMALLINT, nullable=False),
+        KP.KeyInfo(type=T.DATE, nullable=True),
+    )
+    plan = KP.plan_keypack(
+        keys, infos, single_lane=True, n_order_keys=1, host_sort=False
+    )
+    assert plan.single_lane and plan.order_bits == 33  # null bit + 32
+    # INTEGER partition + nullable DATE order = 65 bits: no single lane,
+    # so the window consumer gets no plan (legacy path)
+    wide = KP.plan_keypack(
+        (SortKey(col("p", T.INTEGER)),) + keys[1:],
+        (KP.KeyInfo(type=T.INTEGER, nullable=False),) + infos[1:],
+        single_lane=True, n_order_keys=1, host_sort=False,
+    )
+    assert wide is None
+
+
+# ---------------------------------------------------------------------------
+# runtime guards: range check + hash collision
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_bounds_miss_flips_ok():
+    """Stats that lie (sampling missed the extremes) must flip `ok` so
+    the caller reruns the legacy path — never silently misorder."""
+    data = np.array([5, 1, 9, 1000, -7, 3], np.int64)
+    page = Page.from_dict({"a": Block.from_numpy(data, T.BIGINT)}, pad_to=8)
+    keys = (SortKey(col("a", T.BIGINT)), )
+    infos = (KP.KeyInfo(type=T.BIGINT, nullable=False, lo=-10, hi=20),)
+    plan = KP.plan_keypack(keys, infos, host_sort=False)
+    assert plan.needs_check
+    _, ok = sort_page_packed(page, keys, plan)
+    assert not bool(ok)
+    # in-range data keeps ok True
+    data2 = np.array([5, 1, 9, 10, -7, 3], np.int64)
+    page2 = Page.from_dict({"a": Block.from_numpy(data2, T.BIGINT)}, pad_to=8)
+    out, ok2 = sort_page_packed(page2, keys, plan)
+    assert bool(ok2)
+    assert out.to_pylist() == sort_page(page2, keys).to_pylist()
+
+
+def test_hashed_collision_check_flips_ok(monkeypatch):
+    """Force a degenerate 64-bit hash: distinct keys collide, and the
+    post-hoc adjacent-key comparison must flip `ok` (the executor then
+    degrades to the legacy path)."""
+    import jax.numpy as jnp
+
+    import presto_tpu.ops.sort as sort_mod
+
+    page = Page.from_dict(
+        {"a": np.array([1, 2, 3, 2, 1], np.int64)}, pad_to=8
+    )
+    plan = KP.KeyPackPlan(strategy="hashed", lanes=(), needs_check=True)
+    out, ok = distinct_packed(page, plan)
+    assert bool(ok)
+    assert _sorted_rows(out) == _sorted_rows(distinct_page(page, 8))
+
+    from presto_tpu.ops import hashing
+
+    monkeypatch.setattr(
+        hashing, "hash_rows",
+        lambda cols: jnp.zeros(cols[0].data.shape[0], jnp.uint64),
+    )
+    _, ok = distinct_packed(page, plan)
+    assert not bool(ok)
+
+
+# ---------------------------------------------------------------------------
+# executor integration: strategy notes, breaker fallback, env toggle
+# ---------------------------------------------------------------------------
+
+
+def _exec_session():
+    from presto_tpu.connectors.memory import MemoryCatalog
+    from presto_tpu.session import Session
+
+    rng = np.random.default_rng(5)
+    n = 400
+    page = Page.from_dict({
+        "g": Block.from_numpy(rng.integers(0, 7, n).astype(np.int64), T.BIGINT),
+        "v": Block.from_numpy(rng.integers(-100, 100, n).astype(np.int64), T.BIGINT),
+        "f": Block.from_numpy(rng.normal(size=n), T.DOUBLE),
+    })
+    return Session(MemoryCatalog({"t": page}))
+
+
+Q_ORDER = "select g, v from t order by g desc, v"
+Q_TOPN = "select g, v from t order by v, g limit 7"
+Q_DISTINCT = "select distinct g, v from t"
+Q_WINDOW = (
+    "select g, v, row_number() over (partition by g order by v) as rn, "
+    "rank() over (partition by g order by v) as rk from t"
+)
+
+
+@pytest.mark.parametrize("q", [Q_ORDER, Q_TOPN, Q_DISTINCT, Q_WINDOW])
+def test_executor_packed_matches_keypack_disabled(q, monkeypatch):
+    s = _exec_session()
+    packed = s.query(q).rows()
+    monkeypatch.setenv("PRESTO_TPU_KEYPACK", "0")
+    s2 = _exec_session()
+    legacy = s2.query(q).rows()
+    assert sorted(_norm(packed), key=repr) == sorted(_norm(legacy), key=repr)
+
+
+def test_explain_analyze_shows_keypack_strategy():
+    s = _exec_session()
+    text = s.explain_analyze(Q_ORDER)
+    assert "keypack=bitpack" in text or "keypack=two_lane" in text
+    text = s.explain_analyze(Q_WINDOW)
+    assert "keypack=" in text
+
+
+def test_breaker_forced_fallback_runs_legacy_equivalently():
+    """An open keypack breaker must degrade every consumer to the legacy
+    kernel with identical results — the ISSUE-2 acceptance proof."""
+    from presto_tpu.exec.breaker import BREAKERS
+
+    s = _exec_session()
+    want = {q: sorted(_norm(s.query(q).rows()), key=repr)
+            for q in (Q_ORDER, Q_TOPN, Q_DISTINCT, Q_WINDOW)}
+    BREAKERS.reset()
+    try:
+        for name in ("keypack_sort", "keypack_topn", "keypack_distinct",
+                     "keypack_window"):
+            BREAKERS.record_failure(name, "forced by test")
+            assert not BREAKERS.allow(name)
+        s2 = _exec_session()
+        for q, rows in want.items():
+            assert sorted(_norm(s2.query(q).rows()), key=repr) == rows
+        text = s2.explain_analyze(Q_ORDER)
+        assert "keypack=" not in text  # breaker open: legacy ran
+        assert "breaker keypack_sort" in text  # ...and EXPLAIN says why
+    finally:
+        BREAKERS.reset()

@@ -1,0 +1,103 @@
+"""Bench regression gate: fail when a hot kernel regresses vs BASELINE.json.
+
+Runs the per-operator micro suite (presto_tpu.benchmark.micro) for the
+order-sensitive kernels the keypack work targets and compares rows/s
+against the values recorded under BASELINE.json `micro_gate`. Exits
+non-zero when any gated kernel falls more than `--tolerance` (default
+10%) below its recorded value, so CI catches a perf regression the same
+way it catches a correctness one.
+
+The recorded values are backend+scale specific (BENCH_r05 ran cpu at
+sf=0.1); when the live backend or scale differs the gate SKIPS (exit 0)
+rather than comparing apples to TPUs.
+
+Usage:
+    python tools/bench_gate.py [--sf 0.1] [--runs 3] [--tolerance 0.10]
+
+Wired into the test suite as a `slow`-marked test
+(tests/test_bench_gate.py) so tier-1 stays fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+GATED = ("sort_2key", "top_n_100", "distinct_2key", "window_rank_runsum")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
+
+
+def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
+             baseline_path: str = DEFAULT_BASELINE) -> int:
+    with open(baseline_path) as f:
+        gate = json.load(f).get("micro_gate")
+    if not gate or not gate.get("values"):
+        print("bench_gate: no micro_gate baseline recorded — skipping")
+        return 0
+    if abs(float(gate.get("sf", sf)) - sf) > 1e-9:
+        print(
+            f"bench_gate: baseline recorded at sf={gate.get('sf')}, "
+            f"run requested sf={sf} — skipping"
+        )
+        return 0
+
+    repo_root = os.path.abspath(os.path.join(_HERE, os.pardir))
+    if repo_root not in sys.path:  # `python tools/bench_gate.py` puts only
+        sys.path.insert(0, repo_root)  # tools/ on sys.path
+    from presto_tpu.benchmark.micro import run_suite
+
+    table = run_suite(sf=sf, runs=runs, only=list(GATED))
+    if table["backend"] != gate.get("backend"):
+        print(
+            f"bench_gate: baseline backend {gate.get('backend')!r} != live "
+            f"backend {table['backend']!r} — skipping"
+        )
+        return 0
+    got = {r["name"]: r for r in table["results"]}
+    failures = []
+    for name in GATED:
+        base = gate["values"].get(name)
+        if base is None:
+            continue
+        r = got.get(name)
+        if r is None:
+            failures.append(
+                f"{name}: missing from fresh run "
+                f"({table['errors'].get(name, 'no result')})"
+            )
+            continue
+        cur = r["rows_per_s"]
+        ratio = cur / base
+        note = f" [{r['note']}]" if r.get("note") else ""
+        line = f"{name}: {cur:,} rows/s vs baseline {base:,} ({ratio:.2f}x){note}"
+        print(line)
+        if ratio < 1.0 - tolerance:
+            failures.append(line)
+    if failures:
+        print(f"\nbench_gate: FAIL — {len(failures)} kernel(s) regressed "
+              f">{tolerance:.0%} vs {os.path.basename(baseline_path)}:")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("bench_gate: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--tolerance", type=float, default=0.10)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    args = ap.parse_args(argv)
+    return run_gate(args.sf, args.runs, args.tolerance, args.baseline)
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)  # skip native teardown (see bench.py)
