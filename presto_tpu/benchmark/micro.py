@@ -371,6 +371,114 @@ def bench_join_probe(sf: float) -> Bench:
     )
 
 
+def bench_bloom_build_query(sf: float) -> Bench:
+    """Blocked bloom filter: build over the orders key domain + query every
+    lineitem key (ops/bloomfilter.py) — the dynamic-filter membership
+    kernel (reference: BloomFilter in dynamic filtering). rows/s counts
+    PROBE rows; the build rides inside the step like join_build does."""
+    import jax.numpy as jnp
+
+    from ..ops.bloomfilter import bloom_build, bloom_query, choose_log2_bits
+    from ..ops.hashing import hash_column
+    from .handcoded import _table_page
+
+    bpage = _orders_keys_page(sf)
+    probe = _table_page("lineitem", sf, ("l_orderkey",))
+    lb = choose_log2_bits(int(bpage.count))
+    bkeys = bpage.block("o_orderkey").data
+    bvalid = jnp.arange(bpage.capacity) < bpage.count
+
+    def step(acc, bk, p):
+        words = bloom_build(hash_column(_chain(bk, acc)), bvalid, lb)
+        hits = bloom_query(words, hash_column(p.block("l_orderkey").data), lb)
+        return _consume(hits)
+
+    return Bench(
+        "bloom_build_query", int(probe.count), step, (bkeys, probe),
+        note=f"bits=2^{lb}",
+    )
+
+
+def bench_join_probe_filtered(sf: float) -> Bench:
+    """The dynamic-filter probe path end-to-end: bloom mask over the full
+    probe, compact + slice to the survivor bucket, then join_n1 against a
+    SELECTIVE build side (1/16 of orders — the Q3/Q5/Q17 shape where most
+    probe rows cannot match). rows/s counts ORIGINAL probe rows, so this
+    is directly comparable with the unfiltered join_probe_n1 floor."""
+    import jax.numpy as jnp
+
+    from .. import types as T
+    from ..exec.dynfilter import derive_filter
+    from ..expr.ir import col
+    from ..ops.filter import compact
+    from ..ops.join import build, join_n1
+    from ..page import Page, round_capacity
+    from .handcoded import _table_page
+
+    import jax
+
+    orders = _orders_keys_page(sf)
+    probe = _table_page("lineitem", sf, ("l_orderkey", "l_extendedprice"))
+    # selective build: orders with o_orderkey % 16 == 0
+    okey = orders.block("o_orderkey")
+    sel = (okey.data % 16 == 0) & (jnp.arange(orders.capacity) < orders.count)
+    bpage = compact(orders, sel)
+    bs = build(bpage, (col("o_orderkey", T.BIGINT),))
+    df = derive_filter(okey, sel)
+    if df is None:
+        raise RuntimeError("derive_filter unexpectedly ineligible")
+    pkeys = (col("l_orderkey", T.BIGINT),)
+    # static survivor bucket: ~1/16 of probes match (+ bloom fp margin)
+    out_cap = round_capacity(max(int(probe.count) // 8, 1024))
+    host_route = jax.default_backend() == "cpu"
+
+    def host_sel(keep):
+        # the executor's CPU compaction route (Executor._dyn_compact):
+        # ONE flatnonzero pass + a small gather instead of a
+        # full-capacity sort-based compact
+        nz = np.flatnonzero(np.asarray(keep))[:out_cap]
+        idx = np.zeros(out_cap, np.int64)
+        idx[: nz.size] = nz
+        return idx, np.int32(nz.size)
+
+    def step(acc, p):
+        page = _chained_page(p, acc)
+        keep = df.mask(page.block("l_orderkey")) & (
+            jnp.arange(page.capacity) < page.count
+        )
+        if host_route:
+            idx, n = jax.pure_callback(
+                host_sel,
+                (
+                    jax.ShapeDtypeStruct((out_cap,), jnp.int64),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                ),
+                keep,
+            )
+            sliced = Page(
+                tuple(b.take_rows(idx) for b in page.blocks),
+                page.names,
+                n,
+            )
+        else:
+            small = compact(page, keep)
+            sliced = Page(
+                tuple(b.take_rows(slice(0, out_cap)) for b in small.blocks),
+                small.names,
+                jnp.minimum(small.count, out_cap),
+            )
+        out = join_n1(
+            sliced, bs, pkeys, ("o_custkey",), ("o_custkey",)
+        )
+        return _consume(out)
+
+    return Bench(
+        "join_probe_filtered", int(probe.count), step, (probe,),
+        note=f"df={df.strategy}, out_cap={out_cap}"
+        + (", host-compact" if host_route else ""),
+    )
+
+
 def _sort_bench_inputs(sf: float):
     from .. import types as T
     from ..expr.ir import col
@@ -715,6 +823,8 @@ DEVICE_BENCHES = {
     "agg_matmul_suppkey": bench_agg_matmul,
     "join_build": bench_join_build,
     "join_probe_n1": bench_join_probe,
+    "join_probe_filtered": bench_join_probe_filtered,
+    "bloom_build_query": bench_bloom_build_query,
     "semi_join_mark": bench_semi_join,
     "distinct_2key": bench_distinct,
     "distinct_2key_packed": bench_distinct_packed,

@@ -60,6 +60,11 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
                 rows = sess.query(sql).rows()
                 samples.append((time.perf_counter() - t0) * 1e3)
             best = min(samples)
+            # dynamic-filter pruning observability (exec/dynfilter.py):
+            # rows the runtime filters dropped before probe kernels, per
+            # query, alongside wall-clock
+            dyn = getattr(sess.executor, "dyn_ctx", None)
+            snap = dyn.snapshot() if dyn is not None else {}
             out["results"].append(
                 {
                     "name": name,
@@ -67,6 +72,11 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
                     "mean_ms": round(sum(samples) / len(samples), 1),
                     "lineitem_rows_per_s": round(li_rows / (best / 1e3)),
                     "out_rows": len(rows),
+                    "rows_pruned": (
+                        sum(snap.get("scan_pruned", {}).values())
+                        + sum(snap.get("preprobe_pruned", {}).values())
+                    ),
+                    "dyn_filters": snap.get("filters") or {},
                 }
             )
         except Exception as e:  # noqa: BLE001 — record, keep going

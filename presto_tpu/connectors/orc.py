@@ -167,15 +167,32 @@ class OrcCatalog(FileWriteMixin, WritableConnector):
                     return b
             return b
 
+        def canon(v):
+            if hasattr(v, "isoformat"):
+                return v.isoformat()
+            if isinstance(v, bool):
+                return int(v)
+            return v
+
         for col, op, value in predicate:
             mn = st["min"].get(col)
             mx = st["max"].get(col)
             if mn is None or mx is None:
                 continue
-            if hasattr(value, "isoformat"):
-                value = value.isoformat()
-            if isinstance(value, bool):
-                value = int(value)
+            if op == "in":
+                if not value:
+                    return True  # empty IN-list matches nothing
+                try:
+                    vals = [canon(v) for v in value]
+                    if vals and all(
+                        v < numeric_bound(mn, v) or v > numeric_bound(mx, v)
+                        for v in vals
+                    ):
+                        return True
+                except TypeError:
+                    pass  # incomparable: keep the stripe
+                continue
+            value = canon(value)
             mn = numeric_bound(mn, value)
             mx = numeric_bound(mx, value)
             try:

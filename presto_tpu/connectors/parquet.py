@@ -303,6 +303,14 @@ class ParquetCatalog(FileWriteMixin, WritableConnector):
                 continue
             mn, mx = st.min, st.max
             try:
+                if op == "in":
+                    # refuted when NO candidate value can be in the group:
+                    # all outside [mn, mx] (and for low-NDV groups where
+                    # the page dictionary is the whole row group, the
+                    # min==max case degenerates to exact membership)
+                    if all(v < mn or v > mx for v in value):
+                        return True
+                    continue
                 if op == "eq" and (value < mn or value > mx):
                     return True
                 if op in ("lt",) and mn >= value:

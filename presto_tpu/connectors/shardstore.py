@@ -381,6 +381,18 @@ class ShardStoreCatalog(WritableConnector):
             st = stats.get(col)
             if st is None:
                 continue
+            if op == "in":
+                if not value:
+                    return True  # empty IN-list matches nothing
+                mn, mx = st
+                try:
+                    vals = [_coerce_hint(v) for v in value]
+                    vals = [v for v in vals if v is not None]
+                    if vals and all(v < mn or v > mx for v in vals):
+                        return True
+                except TypeError:
+                    pass  # incomparable: keep the shard
+                continue
             v = _coerce_hint(value)
             if v is None:
                 continue

@@ -28,12 +28,16 @@ Data methods (executor-facing, reference ConnectorPageSource):
       re-applies the real Filter)
 
 `predicate` is a list of (column, op, value) conjuncts with op in
-{'lt','le','gt','ge','eq'} and `value` a LOGICAL Python value
+{'lt','le','gt','ge','eq','in'} and `value` a LOGICAL Python value
 (datetime.date for DATE, float/Decimal for decimals, str for varchar,
 int for integers — matching what file-format statistics expose, NOT the
 engine's scaled storage units) — enough to prune row groups / partitions
 by min-max statistics (reference TupleDomainOrcPredicate / Parquet
-predicate pushdown).
+predicate pushdown). The 'in' op carries a tuple of logical values (from
+IN-lists, OR-of-equals rewrites, and small-domain dynamic filters —
+exec/dynfilter.py); a reader refutes it when NO value can fall inside the
+unit's min/max range (and, where dictionary/value metadata is present,
+when no value is actually in the unit).
 
 The base class supplies scan() by slicing page() so minimal connectors
 only implement metadata + page().

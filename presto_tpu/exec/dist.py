@@ -54,6 +54,26 @@ from ..plan.fragment import AggFinalize, Exchange
 from .executor import ExecutionError, Executor
 
 
+def _shard_map(step, mesh, in_specs, out_specs):
+    """shard_map across jax versions: jax>=0.8 exposes jax.shard_map with
+    check_vma; older releases only have the experimental home with
+    check_rep (same benchmark/micro.py compat shim)."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    try:
+        return _sm(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return _sm(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 @dataclasses.dataclass
 class SPage:
     """Host handle to a mesh-sharded page: global arrays whose leading dim is
@@ -97,10 +117,15 @@ replicated subtrees delegate to the single-node Executor."""
         # backpressure role). None = materialize whole intermediates.
         self.exchange_budget = exchange_budget
         self.exchange_events: List[dict] = []
+        # dynamic filters shared with the local delegate: sharded joins
+        # publish, and scans (which run through local.exec_node before
+        # sharding) consume (exec/dynfilter.py)
+        self.dyn_ctx = self.local.dyn_ctx
 
     # -- public --
 
     def run(self, root: N.PlanNode) -> Page:
+        self.dyn_ctx.reset()  # filters are per-query state
         # per-query subtree memo: a node instance executes at most once
         # (the grouped-join probe may walk children the fallback path
         # revisits; without the memo that would double-execute stages)
@@ -154,12 +179,11 @@ replicated subtrees delegate to the single-node Executor."""
                 tuple(jnp.asarray(e).reshape(1) for e in extras),
             )
 
-        smapped = jax.shard_map(
+        smapped = _shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P()),
             out_specs=P(self.axis),
-            check_vma=False,
         )
         fn = jax.jit(smapped)
 
@@ -341,7 +365,53 @@ replicated subtrees delegate to the single-node Executor."""
     # -- leaves --
 
     def _d_tablescan(self, node: N.TableScan):
-        return self.from_page(self.local.exec_node(node))
+        page = self.local.exec_node(node)  # applies apply_mask entries
+        if node.dynamic_filters:
+            # ALSO apply the hint-only entries: the SPMD Filter stages
+            # above run pre-compiled shard_map kernels that cannot see
+            # runtime filters, so the scan is this path's prune point
+            page = self.local._apply_scan_masks(node, page, hint_entries=True)
+        return self.from_page(page)
+
+    # -- dynamic filters over sharded build sides --
+
+    def _publish_dyn_filters_any(self, node, side) -> None:
+        """Publish build-side filters from either a plain Page or an
+        SPage (global leaves with per-shard live prefixes)."""
+        from ..expr.compiler import evaluate as _ev
+        from .breaker import BREAKERS
+        from .dynfilter import derive_filter
+
+        if isinstance(side, Page):
+            self.local._publish_dynamic_filters(node, side)
+            return
+        if not self.local._dyn_enabled() or not self.local._dyn_worthwhile(
+            node
+        ):
+            return
+        sp: SPage = side
+        cap = sp.shard_capacity
+        page = page_from_arrays(
+            sp.leaves, sp.schema, jnp.asarray(self.n * cap, jnp.int32)
+        )
+        # per-shard live prefix (NOT a global prefix): shard i's live rows
+        # occupy [i*cap, i*cap + counts[i])
+        occ = (
+            jnp.arange(cap, dtype=jnp.int32)[None, :] < sp.counts[:, None]
+        ).reshape(-1)
+        keys = (
+            node.right_keys if isinstance(node, N.Join) else node.source_keys
+        )
+        for fid, i, _c in node.dynamic_filters:
+            try:
+                val = _ev(keys[i], page)
+                df = derive_filter(val, occ)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                BREAKERS.record_failure("dynamic_filter", repr(exc))
+                return
+            if df is not None:
+                BREAKERS.record_success("dynamic_filter")
+                self.dyn_ctx.publish(fid, df)
 
     # -- stateless row ops --
 
@@ -533,7 +603,7 @@ replicated subtrees delegate to the single-node Executor."""
                 )
                 out, overflow = join_expand(
                     lx,
-                    build(rx, node.right_keys),
+                    build(rx, node.right_keys, host_probe=False),
                     node.left_keys,
                     lx.names,
                     [(nm, nm) for nm in right_names],
@@ -591,8 +661,14 @@ replicated subtrees delegate to the single-node Executor."""
         grouped = self._maybe_grouped_join(node)
         if grouped is not None:
             return grouped
-        left = self._run(node.left)
-        right = self._run(node.right)
+        if node.dynamic_filters:
+            # build side first: probe-side scans then see the filters
+            right = self._run(node.right)
+            self._publish_dyn_filters_any(node, right)
+            left = self._run(node.left)
+        else:
+            left = self._run(node.left)
+            right = self._run(node.right)
         if not isinstance(left, SPage):
             if isinstance(right, SPage):
                 right = self.to_single(right)
@@ -604,7 +680,7 @@ replicated subtrees delegate to the single-node Executor."""
         def make_n1(l: Page, r: Page) -> Page:
             return join_n1(
                 l,
-                build(r, node.right_keys),
+                build(r, node.right_keys, host_probe=False),
                 node.left_keys,
                 right_names,
                 right_names,
@@ -631,7 +707,7 @@ replicated subtrees delegate to the single-node Executor."""
             def make_expand(l: Page, r: Page):
                 return join_expand(
                     l,
-                    build(r, node.right_keys),
+                    build(r, node.right_keys, host_probe=False),
                     node.left_keys,
                     l.names,
                     [(nm, nm) for nm in right_names],
@@ -664,8 +740,13 @@ replicated subtrees delegate to the single-node Executor."""
         return [left], [right]
 
     def _d_semijoin(self, node: N.SemiJoin):
-        probe = self._run(node.child)
-        source = self._run(node.source)
+        if node.dynamic_filters:
+            source = self._run(node.source)
+            self._publish_dyn_filters_any(node, source)
+            probe = self._run(node.child)
+        else:
+            probe = self._run(node.child)
+            source = self._run(node.source)
         if not isinstance(probe, SPage):
             if isinstance(source, SPage):
                 source = self.to_single(source)
@@ -675,7 +756,7 @@ replicated subtrees delegate to the single-node Executor."""
         if node.residual is None:
 
             def local(p: Page, s: Page) -> Page:
-                bs = build(s, node.source_keys)
+                bs = build(s, node.source_keys, host_probe=False)
                 return join_n1(
                     p,
                     bs,
@@ -704,7 +785,7 @@ replicated subtrees delegate to the single-node Executor."""
 
             def local(p: Page, s: Page):
                 p2 = self.local._with_row_id(p, rid)
-                bs = build(s, node.source_keys)
+                bs = build(s, node.source_keys, host_probe=False)
                 probe_out = [rid] + [nm for nm in p.names if nm in needed]
                 build_out = [(nm, nm) for nm in s.names if nm in needed]
                 expanded, overflow = join_expand(
@@ -717,7 +798,7 @@ replicated subtrees delegate to the single-node Executor."""
                     kind="inner",
                 )
                 matched = filter_page(expanded, node.residual)
-                bs2 = build(matched, (ir.ColumnRef(rid, rid_t),))
+                bs2 = build(matched, (ir.ColumnRef(rid, rid_t),), host_probe=False)
                 out = join_n1(
                     p2,
                     bs2,

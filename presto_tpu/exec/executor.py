@@ -65,6 +65,14 @@ class Executor:
         # EXPLAIN ANALYZE support (exec/stats.py); None = no accounting
         self.collector = collector
         self._retries = 0  # adaptive-capacity re-runs since last snapshot
+        # runtime dynamic filters (exec/dynfilter.py): per-query registry
+        # of build-side summaries consumed by probe-side scans/filters
+        from .dynfilter import DynamicFilterContext
+
+        self.dyn_ctx = DynamicFilterContext()
+        # session override (the `dynamic_filtering` session property);
+        # PRESTO_TPU_DYNFILTER=0 disables engine-wide
+        self.dynamic_filtering = True
 
     def _kernel(self, key, make_fn):
         """Compile-once cache for per-node kernels. jax.jit retraces per
@@ -119,6 +127,7 @@ class Executor:
 
     # -- public --
     def run(self, node: N.PlanNode) -> Page:
+        self.dyn_ctx.reset()  # filters are per-query state
         page = self._run(node)
         return page
 
@@ -126,8 +135,23 @@ class Executor:
         return self.run(node).to_pylist()
 
     # -- dispatch --
+    def _run_children(self, node: N.PlanNode) -> List[Page]:
+        """Execute a node's children — BUILD SIDE FIRST for dynamic-filter
+        joins, so the derived filter is published before the probe side's
+        scans run (the single-process analog of the reference's
+        LocalDynamicFiltersCollector ordering)."""
+        if (
+            isinstance(node, (N.Join, N.SemiJoin))
+            and getattr(node, "dynamic_filters", ())
+        ):
+            build = self._run(node.children[1])
+            self._publish_dynamic_filters(node, build)
+            probe = self._run(node.children[0])
+            return [probe, build]
+        return [self._run(c) for c in node.children]
+
     def _run(self, node: N.PlanNode) -> Page:
-        pages = [self._run(c) for c in node.children]
+        pages = self._run_children(node)
         if self.collector is None:
             return self.exec_node(node, *pages)
         import time
@@ -267,6 +291,13 @@ class Executor:
 
         if not BREAKERS.allow(breaker_name):
             return None
+        if plan.host_sort:
+            # host-routed plans run numpy through jax.pure_callback, which
+            # DEADLOCKS on mesh-resident (multi-device) inputs — pages
+            # gathered from the distributed executor arrive that way.
+            # Commit them to one device first (cheap on the CPU backend,
+            # and host-sort plans only exist there).
+            page = self._commit_single_device(page)
         try:
             fn = self._kernel((node, label, plan), make_fn)
             out, ok = fn(page)
@@ -281,6 +312,33 @@ class Executor:
         BREAKERS.record_success(breaker_name)
         self._strategy_note(node, f"keypack={plan.strategy}")
         return out
+
+    @staticmethod
+    def _commit_single_device(page: Page) -> Page:
+        """Move a page's arrays onto ONE device when any block is
+        mesh-sharded. No-op for already-single-device pages."""
+        try:
+            multi = any(
+                len(b.data.devices()) > 1 for b in page.blocks
+            )
+        except Exception:  # noqa: BLE001 — non-Array leaves: leave as-is
+            return page
+        if not multi:
+            return page
+        dev = jax.devices()[0]
+        blocks = tuple(
+            Block(
+                jax.device_put(b.data, dev),
+                b.type,
+                None if b.valid is None else jax.device_put(b.valid, dev),
+                b.dict_id,
+            )
+            for b in page.blocks
+        )
+        count = page.count
+        if hasattr(count, "devices"):
+            count = jax.device_put(count, dev)
+        return Page(blocks, page.names, count)
 
     def _est_rows(self, node):
         """CBO row estimate for a node's output (cached per plan node).
@@ -305,6 +363,218 @@ class Executor:
         cache[key] = est
         return est
 
+    # -- dynamic filters (exec/dynfilter.py) --
+
+    def _dyn_enabled(self) -> bool:
+        from .breaker import BREAKERS
+        from .dynfilter import dynamic_filtering_enabled
+
+        return (
+            self.dynamic_filtering
+            and dynamic_filtering_enabled()
+            and BREAKERS.allow("dynamic_filter")
+        )
+
+    def _dyn_worthwhile(self, node) -> bool:
+        """CBO benefit gate: deriving costs a build-side pass plus a probe
+        mask, so skip when the join barely filters (est output close to
+        the probe input — e.g. an unfiltered FK->PK join keeps every
+        row). Stats-less plans derive anyway (best-effort)."""
+        import os
+
+        if os.environ.get("PRESTO_TPU_DYNFILTER_FORCE") == "1":
+            return True
+        max_sel = float(
+            os.environ.get("PRESTO_TPU_DYNFILTER_MAX_SEL", "0.7")
+        )
+        out_est = self._est_rows(node)
+        probe_est = self._est_rows(node.children[0])
+        if out_est is None or probe_est is None or probe_est <= 0:
+            return True
+        return out_est < max_sel * probe_est
+
+    def _publish_dynamic_filters(self, node, build_page: Page) -> None:
+        """Derive per-key summaries from a materialized build side and
+        publish them under the planner-assigned ids. Behind the
+        `dynamic_filter` breaker: a faulting derivation degrades the whole
+        path to legacy no-filter execution, never fails the query."""
+        import time
+
+        from .breaker import BREAKERS
+        from ..expr.compiler import evaluate
+        from .dynfilter import derive_filter
+
+        if not self._dyn_enabled() or not self._dyn_worthwhile(node):
+            return
+        keys = (
+            node.right_keys
+            if isinstance(node, N.Join)
+            else node.source_keys
+        )
+        notes = []
+        t0 = time.perf_counter()
+        live = build_page.live_mask()
+        for fid, i, _consumed in node.dynamic_filters:
+            try:
+                val = evaluate(keys[i], build_page)
+                df = derive_filter(val, live)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                BREAKERS.record_failure("dynamic_filter", repr(exc))
+                return
+            if df is None:
+                continue
+            BREAKERS.record_success("dynamic_filter")
+            self.dyn_ctx.publish(fid, df)
+            notes.append(f"{fid}={df.describe()}")
+        if notes and self.collector is not None:
+            ms = (time.perf_counter() - t0) * 1e3
+            self._append_detail(
+                node, f"df[{', '.join(notes)}, derive {ms:.1f}ms]"
+            )
+
+    def _append_detail(self, node, txt: str) -> None:
+        if self.collector is None:
+            return
+        s = self.collector.stats_for(node)
+        if txt not in s.detail:
+            s.detail = f"{s.detail}; {txt}" if s.detail else txt
+
+    def _dyn_compact(self, page: Page, keep) -> Tuple[Page, int]:
+        """Compact + shrink for dynamic-filter masks, which are typically
+        VERY selective. The generic `compact` (argsort on the drop flag)
+        pays a full-capacity sort and then `_shrink`'s CBO gate — which
+        knows nothing about runtime filters — skips the slice. Here the
+        exact survivor count is known (pruned-row accounting syncs it
+        anyway), so the output is always sliced to the count's bucket; on
+        the CPU backend the whole compaction routes through ONE host
+        `np.flatnonzero` pass + a small gather instead of XLA's
+        comparison sort (the keypack host-sort pattern, ops/keypack.py).
+        Returns (page, survivor count)."""
+        import numpy as np
+
+        from ..ops.filter import compact
+
+        keep = keep & page.live_mask()
+        if jax.default_backend() == "cpu":
+            nz = np.flatnonzero(np.asarray(keep))
+            n = int(nz.size)
+            cap = round_capacity(max(n, 1))
+            idx = np.zeros(cap, np.int64)
+            idx[:n] = nz
+            idxd = jnp.asarray(idx)
+            blocks = [b.take_rows(idxd) for b in page.blocks]
+            return (
+                Page(
+                    tuple(blocks), page.names,
+                    jnp.asarray(n, dtype=jnp.int32),
+                ),
+                n,
+            )
+        out = compact(page, keep)
+        n = int(out.count)
+        cap = round_capacity(max(n, 1))
+        if cap < out.capacity:
+            idx = slice(0, cap)
+            out = Page(
+                tuple(b.take_rows(idx) for b in out.blocks),
+                out.names,
+                out.count,
+            )
+        return out, n
+
+    def _dyn_mask_page(self, node, page: Page, entries, where: str) -> Page:
+        """AND every available dynamic-filter mask over `page` and compact.
+        `entries` is [(fid, value_source)] where value_source is a channel
+        name or a key RowExpression. No-ops when nothing is published."""
+        from .breaker import BREAKERS
+        from ..expr.compiler import evaluate
+
+        picked = []
+        for fid, src in entries:
+            df = self.dyn_ctx.get(fid)
+            if df is not None:
+                picked.append((fid, src, df))
+        if not picked or not self._dyn_enabled():
+            return page
+        try:
+            keep = None
+            for fid, src, df in picked:
+                val = (
+                    page.block(src)
+                    if isinstance(src, str)
+                    else evaluate(src, page)
+                )
+                m = df.mask(val)
+                keep = m if keep is None else (keep & m)
+            before = int(page.count)
+            out, n = self._dyn_compact(page, keep)
+            pruned = before - n
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure("dynamic_filter", repr(exc))
+            return page
+        BREAKERS.record_success("dynamic_filter")
+        self._note_dyn_pruned(
+            node, picked[0][0], pruned, where,
+            ",".join(f"{fid}:{df.strategy}" for fid, _s, df in picked),
+        )
+        return out
+
+    def _note_dyn_pruned(
+        self, node, lead_fid: str, pruned: int, where: str, descs: str
+    ) -> None:
+        """Book pruned rows (combined mask attributed once, to the lead
+        filter id) and refresh the node's EXPLAIN ANALYZE tag with the
+        accumulated total (streaming overwrites it per batch)."""
+        import re
+
+        self.dyn_ctx.note_pruned(lead_fid, pruned, where)
+        if self.collector is None:
+            return
+        book = self.dyn_ctx.scan_pruned if where == "scan" else (
+            self.dyn_ctx.preprobe_pruned
+        )
+        total = book.get(lead_fid, pruned)
+        s = self.collector.stats_for(node)
+        tag = f"dyn_pruned={total:,} ({descs})"
+        s.detail = (
+            re.sub(r"dyn_pruned=[^;]*", tag, s.detail)
+            if "dyn_pruned=" in s.detail
+            else (f"{s.detail}; {tag}" if s.detail else tag)
+        )
+
+    def _apply_scan_masks(
+        self, node: N.TableScan, page: Page, hint_entries: bool = False
+    ) -> Page:
+        """Scan-level dynamic pruning. Default: entries marked apply_mask
+        (no Filter above fuses them). With `hint_entries`, ONLY the
+        hint-only entries — the distributed executor applies those at the
+        scan because its SPMD filter stages run pre-compiled kernels that
+        cannot see runtime filters (apply-marked entries already ran in
+        _exec_tablescan; re-applying them would pay a second compaction)."""
+        entries = [
+            (fid, ch)
+            for fid, ch, _src, apply in node.dynamic_filters
+            if apply != hint_entries
+        ]
+        if not entries:
+            return page
+        return self._dyn_mask_page(node, page, entries, "scan")
+
+    def _apply_preprobe(self, node, probe: Page) -> Page:
+        """On-device pre-probe filter for produced ids with NO scan
+        consumer — join_n1/semi_match_mask then see only surviving rows."""
+        keys = (
+            node.left_keys if isinstance(node, N.Join) else node.probe_keys
+        )
+        entries = [
+            (fid, keys[i])
+            for fid, i, consumed in getattr(node, "dynamic_filters", ())
+            if not consumed
+        ]
+        if not entries:
+            return probe
+        return self._dyn_mask_page(node, probe, entries, "preprobe")
+
     # -- physical nodes (fragmented plans executed single-node) --
     def _exec_exchange(self, node, page: Page) -> Page:
         return page  # all exchange kinds are identities on a single worker
@@ -328,7 +598,10 @@ class Executor:
         for ch, col, _typ in node.columns:
             blocks.append(src.block(col))
             names.append(ch)
-        return Page(tuple(blocks), tuple(names), src.count)
+        page = Page(tuple(blocks), tuple(names), src.count)
+        if node.dynamic_filters:
+            page = self._apply_scan_masks(node, page)
+        return page
 
     # -- stateless row ops --
     def _exec_unnest(self, node: N.Unnest, page: Page) -> Page:
@@ -353,8 +626,55 @@ class Executor:
         return self._shrink(fn(page), node)
 
     def _exec_filter(self, node: N.Filter, page: Page) -> Page:
+        if node.dynamic_filters and any(
+            self.dyn_ctx.get(fid) is not None
+            for fid, _ch in node.dynamic_filters
+        ):
+            return self._exec_filter_dyn(node, page)
         fn = self._kernel(node, lambda: lambda p: filter_page(p, node.predicate))
         return self._shrink(fn(page), node)
+
+    def _exec_filter_dyn(self, node: N.Filter, page: Page) -> Page:
+        """Filter with fused dynamic-filter masks: ONE compaction pass for
+        the predicate AND every published runtime filter (the fusion that
+        makes dynamic pruning free of extra compactions). Runs eagerly —
+        filter arrays are per-query runtime values, not plan constants."""
+        from .breaker import BREAKERS
+        from ..expr.compiler import evaluate
+        from ..ops.filter import compact
+
+        v = evaluate(node.predicate, page)
+        keep = v.data
+        if v.valid is not None:
+            keep = keep & v.valid
+        try:
+            dmask = None
+            picked = []
+            for fid, ch in node.dynamic_filters:
+                df = self.dyn_ctx.get(fid)
+                if df is None:
+                    continue
+                m = df.mask(page.block(ch))
+                dmask = m if dmask is None else (dmask & m)
+                picked.append((fid, df))
+            if dmask is None:
+                return self._shrink(compact(page, keep), node)
+            live_keep = keep & page.live_mask()
+            would_keep = jnp.sum(live_keep.astype(jnp.int32))
+            out, n = self._dyn_compact(page, live_keep & dmask)
+            pruned = int(would_keep) - n
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure("dynamic_filter", repr(exc))
+            fn = self._kernel(
+                node, lambda: lambda p: filter_page(p, node.predicate)
+            )
+            return self._shrink(fn(page), node)
+        BREAKERS.record_success("dynamic_filter")
+        self._note_dyn_pruned(
+            node, picked[0][0], pruned, "scan",
+            ",".join(f"{fid}:{df.strategy}" for fid, df in picked),
+        )
+        return out
 
     def _exec_project(self, node: N.Project, page: Page) -> Page:
         fn = self._kernel(
@@ -531,6 +851,8 @@ class Executor:
             node.kind != "inner" and node.residual is not None
         ):
             return self._exec_outer_join(node, left, right)
+        if node.dynamic_filters:
+            left = self._apply_preprobe(node, left)
         right_names = right.names
         if node.unique_build:
             out = self._kernel_guarded(
@@ -684,6 +1006,8 @@ class Executor:
         )
 
     def _exec_semijoin(self, node: N.SemiJoin, probe: Page, source: Page) -> Page:
+        if node.dynamic_filters:
+            probe = self._apply_preprobe(node, probe)
         if node.residual is None:
             bs = build(source, node.source_keys)
             if node.mark is not None:

@@ -196,8 +196,50 @@ def _pushdown_hints(predicate, scan_node: N.TableScan):
             return v if isinstance(v, str) else None
         return v
 
+    def in_values(e):
+        """(channel, values) for `col IN (lit...)` and OR-of-equals over
+        ONE column — both become the SPI 'in' hint (reference
+        TupleDomain's discrete value sets)."""
+        if e.name == "in" and isinstance(e.args[0], ir.ColumnRef):
+            col, opts = e.args[0], e.args[1:]
+            if all(isinstance(o, ir.Literal) for o in opts):
+                return col.name, opts
+            return None
+        if e.name == "or":
+            col = None
+            opts = []
+            for part in e.args:
+                if not (
+                    isinstance(part, ir.Call)
+                    and part.name == "eq"
+                    and len(part.args) == 2
+                ):
+                    return None
+                a, b = part.args
+                if isinstance(b, ir.ColumnRef) and isinstance(a, ir.Literal):
+                    a, b = b, a
+                if not (
+                    isinstance(a, ir.ColumnRef) and isinstance(b, ir.Literal)
+                ):
+                    return None
+                if col is None:
+                    col = a.name
+                elif col != a.name:
+                    return None
+                opts.append(b)
+            return (col, tuple(opts)) if col is not None else None
+        return None
+
     for e in conjuncts:
         if not isinstance(e, ir.Call):
+            continue
+        iv = in_values(e)
+        if iv is not None:
+            col_name, opts = iv
+            if col_name in to_source:
+                vals = tuple(value_for(col_name, o) for o in opts)
+                if all(v is not None for v in vals):
+                    hints.append((to_source[col_name], "in", vals))
             continue
         if e.name == "between" and isinstance(e.args[0], ir.ColumnRef):
             col, lo, hi = e.args
@@ -241,6 +283,10 @@ class StreamingExecutor:
         self.pool = MemoryPool(memory_budget)
         self.local = Executor(catalog, collector=collector)
         self.collector = collector
+        # dynamic filters are shared with the delegate executor: joins
+        # publish there, and scans/filters running through exec_node
+        # consume the same registry (exec/dynfilter.py)
+        self.dyn_ctx = self.local.dyn_ctx
         # which operators offloaded to host this query (tests/EXPLAIN assert
         # the spill path actually fired; reference: OperatorStats spill
         # counters)
@@ -286,6 +332,7 @@ class StreamingExecutor:
     # -- public --
 
     def run(self, node: N.PlanNode) -> Page:
+        self.dyn_ctx.reset()  # filters are per-query state
         out = self._run(node)
         return out
 
@@ -375,14 +422,45 @@ class StreamingExecutor:
         pages = [self._run(c) for c in node.children]
         return self.local.exec_node(node, *pages)
 
+    def _dyn_scan_hints(self, node: N.TableScan):
+        """SPI pruning conjuncts from published dynamic filters (the
+        scan-side half of dynamic filtering: connectors prune row groups /
+        stripes before decode + upload)."""
+        hints = []
+        types = {ch: typ for ch, _col, typ in node.columns}
+        for fid, ch, src_col, _apply in node.dynamic_filters:
+            df = self.local.dyn_ctx.get(fid)
+            if df is not None:
+                try:
+                    # the scan knows the channel's type — authoritative
+                    # for wire-reconstructed (typeless) filters
+                    hints.extend(df.spi_conjuncts(src_col, typ=types.get(ch)))
+                except Exception:  # noqa: BLE001 — hints are best-effort
+                    continue
+        return hints
+
+    def _scan_out(self, node: N.TableScan, page: Page) -> Page:
+        """Post-scan dynamic mask for scans with no Filter above (the
+        annotation's apply_mask entries); fused-into-Filter entries are
+        applied by exec_node(Filter) downstream."""
+        if node.dynamic_filters:
+            return self.local._apply_scan_masks(node, page)
+        return page
+
     def _stream_scan(self, node: N.TableScan, predicate=None) -> Iterator[Page]:
         # row_count is a planner ESTIMATE (statistics); drive the scan off
         # the actual batches until a short batch marks the end of the table
         est = self.catalog.row_count(node.table)
         B = self.batch_rows
+        if node.dynamic_filters:
+            dyn = self._dyn_scan_hints(node)
+            if dyn:
+                predicate = list(predicate or []) + dyn
         scan = getattr(self.catalog, "scan", None)
         if scan is None:
-            yield self._rename_scan(node, self.catalog.page(node.table))
+            yield self._scan_out(
+                node, self._rename_scan(node, self.catalog.page(node.table))
+            )
             return
         if est <= B // 2 and not predicate:
             try:
@@ -390,7 +468,7 @@ class StreamingExecutor:
             except MemoryError:
                 pass  # chunked catalogs refuse to materialize; stream below
             else:
-                yield self._rename_scan(node, src)
+                yield self._scan_out(node, self._rename_scan(node, src))
                 return
         cols = [col for _, col, _ in node.columns]
         exact = getattr(self.catalog, "exact_row_count", None)
@@ -421,7 +499,7 @@ class StreamingExecutor:
             )
             n = int(src.count)
             if n > 0 or start == 0:
-                yield self._rename_scan(node, src)
+                yield self._scan_out(node, self._rename_scan(node, src))
             start += B
             done = (start >= total) if total is not None else (n < B)
             # n < B only marks table end without pruning (predicate hints
@@ -658,6 +736,10 @@ class StreamingExecutor:
         right_names = tuple(n for n, _ in node.right.fields)
         if kind == "device":
             right_page, held = side
+            if getattr(node, "dynamic_filters", ()):
+                # the build side is complete: derive + publish BEFORE the
+                # probe stream's scan generators start pulling batches
+                self.local._publish_dynamic_filters(node, right_page)
             try:
                 yield from self._probe_stream(node, right_page, right_names)
             finally:
@@ -670,6 +752,8 @@ class StreamingExecutor:
                 "(chunked execution covers inner joins)"
             )
         host: HostTable = side
+        if getattr(node, "dynamic_filters", ()):
+            self._publish_host_filters(node, host)
         budget = self.pool.max_bytes or (1 << 62)
         # size chunks from the budget REMAINING after state already held
         # (aggregation state, other build sides), not the full budget
@@ -686,11 +770,45 @@ class StreamingExecutor:
             finally:
                 self.pool.free(nb)
 
+    def _publish_host_filters(self, node: N.Join, host: HostTable) -> None:
+        """Derive filters from a host-offloaded build side (numpy columns;
+        the spilled-build analog of _publish_dynamic_filters)."""
+        from ..expr import ir as _ir
+        from .breaker import BREAKERS
+        from .dynfilter import HostFilterAccumulator, filter_from_summary
+
+        if not self.local._dyn_enabled() or not self.local._dyn_worthwhile(
+            node
+        ):
+            return
+        for fid, i, _c in node.dynamic_filters:
+            key = node.right_keys[i]
+            if not isinstance(key, _ir.ColumnRef) or key.name not in host.names:
+                continue
+            try:
+                idx = host.names.index(key.name)
+                acc = HostFilterAccumulator(key.name)
+                acc.add_numpy(
+                    host.columns[idx], host.valids[idx], host.types[idx]
+                )
+                df = filter_from_summary(acc.summary(), host.types[idx])
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                BREAKERS.record_failure("dynamic_filter", repr(exc))
+                return
+            if df is not None:
+                BREAKERS.record_success("dynamic_filter")
+                self.local.dyn_ctx.publish(fid, df)
+
     def _probe_stream(
         self, node: N.Join, right_page: Page, right_names, probe=None
     ) -> Iterator[Page]:
         bs = build(right_page, node.right_keys)
+        preprobe = getattr(node, "dynamic_filters", ()) and any(
+            not consumed for _f, _i, consumed in node.dynamic_filters
+        )
         for batch in (probe if probe is not None else self.stream(node.left)):
+            if preprobe:
+                batch = self.local._apply_preprobe(node, batch)
             if node.unique_build:
                 out = join_n1(
                     batch, bs, node.left_keys, right_names, right_names,
@@ -757,10 +875,17 @@ class StreamingExecutor:
 
     def _stream_semijoin(self, node: N.SemiJoin) -> Iterator[Page]:
         source = self._run(node.source)
+        if getattr(node, "dynamic_filters", ()):
+            self.local._publish_dynamic_filters(node, source)
+        preprobe = getattr(node, "dynamic_filters", ()) and any(
+            not consumed for _f, _i, consumed in node.dynamic_filters
+        )
         held = self.pool.reserve(page_device_bytes(source), "semijoin source")
         try:
             bs = build(source, node.source_keys)
             for batch in self.stream(node.child):
+                if preprobe:
+                    batch = self.local._apply_preprobe(node, batch)
                 if node.mark is not None:
                     from ..ops.join import semi_match_mask
 

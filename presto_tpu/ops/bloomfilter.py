@@ -1,0 +1,114 @@
+"""TPU-vectorized blocked bloom filter — the dynamic-filter membership kernel.
+
+Re-designed equivalent of the reference's BloomFilter used by dynamic
+filtering (presto-main/.../operator/DynamicFilterSourceOperator collecting
+build-side values, com.facebook.presto.util.BloomFilter) — pure-`jnp`
+reduction:
+
+* The bit array is a power-of-two number of bits stored packed in uint32
+  lanes (2^log2_bits / 32 words), so querying is lane-gather + shift/mask —
+  plain vectorized gathers with no host involvement.
+* The k probe positions derive from the engine's existing 64-bit row hash
+  (ops/hashing.mix64 family) by Kirsch-Mitzenmacher double hashing: the one
+  hash splits into two 32-bit halves h1/h2 and position_i = h1 + i*h2
+  (mod 2^log2_bits). One hash pass serves every k.
+* Build is a boolean scatter-set (duplicate positions are idempotent) then a
+  pack to uint32 via shift+sum — no bitwise-OR scatter, which XLA has no
+  primitive for. NOTE: XLA:TPU lowers large scatters to serial loops (see
+  ops/join.py directory build), so builds over multi-million-row build sides
+  are CPU-friendly but TPU-suspect; the executor only derives bloom filters
+  from *build* sides (the small side of a selective join) and the whole
+  dynamic-filter path degrades through the `dynamic_filter` circuit breaker
+  (exec/breaker.py) if the kernel faults.
+
+No false negatives by construction: every inserted key's k bits are set, and
+a query ANDs exactly those bits. False-positive rate with k=3 at ~10 bits
+per key is ~1-2% (property-tested in tests/test_dynfilter.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# number of hash probes per key (Kirsch-Mitzenmacher from one 64-bit hash)
+BLOOM_K = 3
+# target bits per distinct build key (~1.7% fpr at k=3)
+BITS_PER_KEY = 10
+MIN_LOG2_BITS = 10  # 1k bits = 128 B floor
+MAX_LOG2_BITS = 23  # 8M bits = 1 MiB of words ceiling
+
+
+def choose_log2_bits(n_keys: int) -> int:
+    """Power-of-two bloom size for ~BITS_PER_KEY bits per key, clamped."""
+    want = max(int(n_keys) * BITS_PER_KEY, 1)
+    bits = int(np.ceil(np.log2(want)))
+    return min(max(bits, MIN_LOG2_BITS), MAX_LOG2_BITS)
+
+
+def _positions(hashes: jnp.ndarray, log2_bits: int):
+    """(k, n) int32 bit positions in [0, 2^log2_bits) from uint64 hashes."""
+    h = hashes.astype(jnp.uint64)
+    h1 = (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    h2 = (h >> jnp.uint64(32)).astype(jnp.uint32)
+    # force h2 odd so the k probe positions never collapse onto one bit
+    h2 = h2 | jnp.uint32(1)
+    mask = jnp.uint32((1 << log2_bits) - 1)
+    return jnp.stack(
+        [(h1 + jnp.uint32(i) * h2) & mask for i in range(BLOOM_K)]
+    ).astype(jnp.int32)
+
+
+def bloom_build(
+    hashes: jnp.ndarray, valid: jnp.ndarray, log2_bits: int
+) -> jnp.ndarray:
+    """Build the packed filter from (n,) uint64 hashes; rows with a False
+    `valid` flag contribute no bits (dead page padding / NULL build keys,
+    which can never equi-match). Returns (2^log2_bits / 32,) uint32."""
+    nbits = 1 << log2_bits
+    pos = _positions(hashes, log2_bits)  # (k, n)
+    # invalid rows are redirected to a sacrificial slot past the real bits
+    pos = jnp.where(valid[None, :], pos, nbits)
+    bits = jnp.zeros(nbits + 1, jnp.bool_).at[pos.reshape(-1)].set(True)
+    lanes = bits[:nbits].reshape(-1, 32).astype(jnp.uint32)
+    return jnp.sum(lanes << jnp.arange(32, dtype=jnp.uint32), axis=1).astype(
+        jnp.uint32
+    )
+
+
+def bloom_query(
+    words: jnp.ndarray, hashes: jnp.ndarray, log2_bits: int
+) -> jnp.ndarray:
+    """(n,) bool: True when the key MAY be in the set (no false negatives)."""
+    pos = _positions(hashes, log2_bits)  # (k, n)
+    word = words[pos >> 5]  # lane gather
+    bit = (word >> (pos & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    return jnp.all(bit.astype(jnp.bool_), axis=0)
+
+
+# -- host (numpy) replicas: cross-task filter summaries are accumulated on
+# the worker host side over output pages (server/worker.py), merged by the
+# coordinator, and re-uploaded on the probe worker. Same positions, same
+# packing — a key inserted on any host is found by the device query. --
+
+
+def bloom_build_host(
+    hashes: np.ndarray, log2_bits: int, words: "np.ndarray | None" = None
+) -> np.ndarray:
+    """Accumulate uint64 hashes into a packed uint32 word array (numpy)."""
+    nbits = 1 << log2_bits
+    if words is None:
+        words = np.zeros(nbits // 32, np.uint32)
+    h = hashes.astype(np.uint64)
+    h1 = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    h2 = ((h >> np.uint64(32)).astype(np.uint32)) | np.uint32(1)
+    mask = np.uint32(nbits - 1)
+    for i in range(BLOOM_K):
+        pos = (h1 + np.uint32(i) * h2) & mask
+        np.bitwise_or.at(words, pos >> 5, np.uint32(1) << (pos & 31))
+    return words
+
+
+def bloom_merge_host(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """OR-merge two same-size host word arrays (per-task summaries)."""
+    return np.bitwise_or(a, b)

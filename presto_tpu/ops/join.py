@@ -57,6 +57,27 @@ class BuildSide:
     # `bucket_bits` of the hash) span [bucket_start[b], bucket_start[b+1])
     bucket_start: Optional[jnp.ndarray] = None  # int32, (2^bits + 1,)
     bucket_bits: int = 0  # static per build shape
+    # CPU backend: candidate ranges via numpy searchsorted through
+    # jax.pure_callback (see _host_probe_ranges) instead of device gathers
+    host_probe: bool = False
+
+
+def _default_host_probe() -> bool:
+    """Whether to route the sorted-build binary-search probe through numpy
+    via jax.pure_callback (mirroring the keypack CPU sort routing,
+    ops/keypack.py). Resolved at PLAN (trace) time from the env.
+
+    Default OFF everywhere, by measurement: at the join_probe_n1 shape
+    (600k probes x 256k-cap build, CPU backend) numpy's searchsorted runs
+    ~300ms — binary search over random uint64 is cache-miss-bound and
+    single-threaded — while the bucket-directory probe (two vectorized
+    gathers) runs the same probe in ~77ms (~7.8M rows/s). The callback
+    marshalling itself is cheap (~7ms); numpy just loses this race, unlike
+    the keypack sorts where numpy beats XLA's comparison sort 8-70x. The
+    route stays available (PRESTO_TPU_JOIN_PROBE_HOST=1) as a diagnosis
+    escape hatch for backends where gather-heavy probes misbehave, behind
+    the join_probe_cpu breaker."""
+    return os.environ.get("PRESTO_TPU_JOIN_PROBE_HOST", "0") == "1"
 
 
 def _pick_bucket_bits(capacity: int) -> int:
@@ -66,7 +87,7 @@ def _pick_bucket_bits(capacity: int) -> int:
     return min(bits, 22)  # cap the directory at 4M entries
 
 
-def build(page: Page, key_exprs) -> BuildSide:
+def build(page: Page, key_exprs, host_probe: Optional[bool] = None) -> BuildSide:
     """Sort the build side by key hash (HashBuilderOperator.finish analog).
     Empty key_exprs = all rows in one bucket (cross join support).
 
@@ -83,6 +104,19 @@ def build(page: Page, key_exprs) -> BuildSide:
     h = jnp.where(live, h, MAX_HASH)  # dead rows cluster at the end
     order = jnp.argsort(h)
     sh = h[order]
+    if host_probe is None:
+        host_probe = _default_host_probe()
+    if host_probe:
+        # host-probe plans still degrade through a breaker: a faulting
+        # callback (e.g. under an unsupported transform) reroutes every
+        # join in the process back to the device probe
+        from ..exec.breaker import BREAKERS
+
+        host_probe = BREAKERS.allow("join_probe_cpu")
+    if host_probe:
+        return BuildSide(
+            sh, order, page, tuple(keys), page.count, host_probe=True
+        )
     use_directory = (
         os.environ.get("PRESTO_TPU_JOIN_PROBE", "directory") == "directory"
     )
@@ -123,6 +157,9 @@ def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val], capacity: int):
         hi = jnp.broadcast_to(bs.count.astype(jnp.int32), (capacity,))
         return None, lo, hi
     h = hash_rows(probe_keys)
+    if bs.host_probe:
+        lo, hi = _host_probe_ranges(bs.sorted_hash, h, capacity)
+        return h, lo, hi
     if bs.bucket_start is not None:
         b = (h >> np.uint64(64 - bs.bucket_bits)).astype(jnp.int32)
         cnt = bs.count.astype(jnp.int32)
@@ -135,6 +172,26 @@ def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val], capacity: int):
     lo = jnp.searchsorted(bs.sorted_hash, h, side="left")
     hi = jnp.searchsorted(bs.sorted_hash, h, side="right")
     return h, lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _host_np_ranges(sh, h):
+    """numpy binary search for probe candidate ranges — runs on the host
+    CPU where it is a multi-pass-free C loop, not an XLA gather cascade."""
+    sh = np.asarray(sh)
+    h = np.asarray(h)
+    lo = np.searchsorted(sh, h, side="left").astype(np.int32)
+    hi = np.searchsorted(sh, h, side="right").astype(np.int32)
+    return lo, hi
+
+
+def _host_probe_ranges(sorted_hash, h, capacity: int):
+    """Exact-hash-run candidate ranges via jax.pure_callback (CPU-backend
+    plans only; see _default_host_probe). Downstream consumers see the
+    same [lo, hi) contract as the searchsorted probe."""
+    out_t = jax.ShapeDtypeStruct((capacity,), jnp.int32)
+    return jax.pure_callback(
+        _host_np_ranges, (out_t, out_t), sorted_hash, h, vmap_method="sequential"
+    )
 
 
 def _keys_equal(bs: BuildSide, probe_keys: Sequence[Val], build_rows):

@@ -114,9 +114,11 @@ def _agg_step(
     out_schema = page_schema(final_struct)
     n_leaves = len(page_to_arrays(final_struct))
 
+    from ..exec.dist import _shard_map
+
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(tuple(P(axis) for _ in schema_leaf_count(schema)), P(axis)),
         out_specs=(
@@ -125,7 +127,6 @@ def _agg_step(
             P(axis),
             P(axis),
         ),
-        check_vma=False,
     )
     def step(shard_leaves, counts):
         partial = local_partial(shard_leaves, counts[0])

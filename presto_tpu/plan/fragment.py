@@ -172,7 +172,8 @@ class Fragmenter:
 
     def _v_filter(self, node):
         child, dist = self._visit(node.child)
-        return N.Filter(child, node.predicate), dist
+        # dataclasses.replace keeps the dynamic-filter consumer annotation
+        return dataclasses.replace(node, child=child), dist
 
     def _v_project(self, node):
         child, dist = self._visit(node.child)

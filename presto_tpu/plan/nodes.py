@@ -50,6 +50,11 @@ class TableScan(PlanNode):
     catalog: str
     table: str
     columns: Tuple[Tuple[str, str, T.Type], ...]  # (channel, source col, type)
+    # runtime dynamic-filter consumers (plan/rules.annotate_dynamic_filters):
+    # (filter_id, channel, source column, apply_mask). apply_mask=False
+    # means a Filter above this scan applies the device mask (fused into
+    # its compaction) and the scan only forwards SPI pruning hints.
+    dynamic_filters: Tuple[Tuple[str, str, str, bool], ...] = ()
 
     @property
     def fields(self):
@@ -121,6 +126,9 @@ class SingleRow(PlanNode):
 class Filter(PlanNode):
     child: PlanNode
     predicate: RowExpression
+    # dynamic-filter consumers fused into this filter's keep mask:
+    # (filter_id, channel) — pruning shares the predicate's one compaction
+    dynamic_filters: Tuple[Tuple[str, str], ...] = ()
 
     @property
     def fields(self):
@@ -187,6 +195,11 @@ class Join(PlanNode):
     right_keys: Tuple[RowExpression, ...]
     residual: Optional[RowExpression] = None  # over combined channels
     unique_build: bool = False  # planner knows build keys are unique (n:1)
+    # dynamic filters PRODUCED from this join's build side after it
+    # materializes: (filter_id, build key index, has_scan_consumer). With
+    # no scan consumer the executor applies the filter as an on-device
+    # pre-probe mask instead (inner joins only).
+    dynamic_filters: Tuple[Tuple[str, int, bool], ...] = ()
 
     @property
     def fields(self):
@@ -215,6 +228,9 @@ class SemiJoin(PlanNode):
     anti: bool = False
     residual: Optional[RowExpression] = None
     mark: Optional[str] = None
+    # dynamic filters produced from `source` (plain semi joins only —
+    # anti/mark keep or annotate non-matching probe rows)
+    dynamic_filters: Tuple[Tuple[str, int, bool], ...] = ()
 
     @property
     def fields(self):
@@ -372,8 +388,18 @@ def plan_tree_str(
     detail = ""
     if isinstance(node, TableScan):
         detail = f" {node.table} [{', '.join(c for c, _, _ in node.columns)}]"
+        if node.dynamic_filters:
+            dfs = ", ".join(
+                f"{fid}->{ch}" + ("" if apply else " (hints)")
+                for fid, ch, _src, apply in node.dynamic_filters
+            )
+            detail += f" [df: {dfs}]"
     elif isinstance(node, Filter):
         detail = f" [{node.predicate}]"
+        if node.dynamic_filters:
+            detail += " [df: " + ", ".join(
+                f"{fid}->{ch}" for fid, ch in node.dynamic_filters
+            ) + "]"
     elif isinstance(node, Sample):
         detail = f" [bernoulli {node.fraction * 100:g}%]"
     elif isinstance(node, Project):
@@ -396,6 +422,10 @@ def plan_tree_str(
         detail = f" [{node.kind}] [{pairs}]" + (
             f" [residual: {node.residual}]" if node.residual else ""
         )
+        if node.dynamic_filters:
+            detail += " [df: " + ", ".join(
+                f"{fid}<-key{i}" for fid, i, _c in node.dynamic_filters
+            ) + "]"
     elif isinstance(node, SemiJoin):
         pairs = ", ".join(
             f"{l} = {r}" for l, r in zip(node.probe_keys, node.source_keys)

@@ -223,10 +223,14 @@ def fuse_filter_into_aggregates(node: N.PlanNode) -> N.PlanNode:
 
 
 def optimize(root: N.PlanNode) -> N.PlanNode:
-    from .rules import rewrite
+    from .rules import annotate_dynamic_filters, rewrite
 
     root = rewrite(root)  # iterative rule pass (plan/rules.py)
     root = fuse_filter_into_aggregates(root)
     if isinstance(root, N.Output):
-        return prune_columns(root, set(root.channels))
-    return prune_columns(root, set(root.field_names()))
+        root = prune_columns(root, set(root.channels))
+    else:
+        root = prune_columns(root, set(root.field_names()))
+    # LAST: channel names are final after pruning, so build->probe dynamic
+    # filter links (runtime filtering, exec/dynfilter.py) bind correctly
+    return annotate_dynamic_filters(root)

@@ -698,3 +698,161 @@ def default_rules() -> List[Rule]:
 
 def rewrite(root: N.PlanNode, trace: Optional[list] = None) -> N.PlanNode:
     return rewrite_tree(root, default_rules(), trace)
+
+
+# ---------------------------------------------------------------------------
+# dynamic-filter annotation (reference: PredicatePushDown's dynamic filter
+# placeholders + DynamicFilterSourceOperator placement). Runs LAST in
+# optimize(), over the pruned tree, so channel names are final.
+# ---------------------------------------------------------------------------
+
+
+def _df_attach_consumer(node: N.PlanNode, channel: str, fid: str):
+    """Push a dynamic-filter consumer annotation down the probe side to the
+    TableScan producing `channel`. Returns the rewritten subtree or None
+    when no scan is reachable through row-pruning-safe nodes.
+
+    Safety contract: the filter only drops rows that CANNOT survive the
+    annotated join. That is sound exactly through nodes where one input
+    row maps to output rows carrying the same traced-channel value and
+    dropping it drops only those outputs: Filter, renaming Project, the
+    streamed side of joins (both sides of inner, the probe side of left),
+    and a plain semi join's child. Aggregates, windows, sorts, limits,
+    samples, unions change other rows' results when inputs vanish — stop.
+    """
+    if isinstance(node, N.TableScan):
+        src = {ch: col for ch, col, _ in node.columns}
+        if channel not in src:
+            return None
+        return dataclasses.replace(
+            node,
+            dynamic_filters=node.dynamic_filters
+            + ((fid, channel, src[channel], True),),
+        )
+    if isinstance(node, N.Filter):
+        child = _df_attach_consumer(node.child, channel, fid)
+        if child is None:
+            return None
+        if (
+            isinstance(child, N.TableScan)
+            and child.dynamic_filters
+            and child.dynamic_filters[-1][0] == fid
+        ):
+            # fuse the device mask into THIS filter's compaction (one
+            # compact pass); the scan keeps the entry for SPI hints only
+            fe = child.dynamic_filters[-1]
+            child = dataclasses.replace(
+                child,
+                dynamic_filters=child.dynamic_filters[:-1]
+                + ((fe[0], fe[1], fe[2], False),),
+            )
+            return dataclasses.replace(
+                node,
+                child=child,
+                dynamic_filters=node.dynamic_filters + ((fid, channel),),
+            )
+        return dataclasses.replace(node, child=child)
+    if isinstance(node, N.Project):
+        env = dict(zip(node.names, node.exprs))
+        e = env.get(channel)
+        if not isinstance(e, ir.ColumnRef):
+            return None
+        child = _df_attach_consumer(node.child, e.name, fid)
+        if child is None:
+            return None
+        return dataclasses.replace(node, child=child)
+    if isinstance(node, N.Join):
+        if node.kind not in ("inner", "left"):
+            return None
+        lnames = {n for n, _ in node.left.fields}
+        if channel in lnames:
+            child = _df_attach_consumer(node.left, channel, fid)
+            return (
+                None
+                if child is None
+                else dataclasses.replace(node, left=child)
+            )
+        if node.kind == "inner":
+            child = _df_attach_consumer(node.right, channel, fid)
+            return (
+                None
+                if child is None
+                else dataclasses.replace(node, right=child)
+            )
+        return None
+    if isinstance(node, N.SemiJoin):
+        if node.mark is not None:
+            return None  # mark joins keep every probe row
+        child = _df_attach_consumer(node.child, channel, fid)
+        return (
+            None if child is None else dataclasses.replace(node, child=child)
+        )
+    return None
+
+
+def _df_comparable_types(a, b) -> bool:
+    """Key pair eligible for a storage-level dynamic filter: identical
+    types (the planner coerces equi-join keys, so this is the common
+    case); differing types would compare different storage units."""
+    return a == b
+
+
+def annotate_dynamic_filters(root: N.PlanNode) -> N.PlanNode:
+    """Assign dynamic-filter ids linking each eligible equi-join's build
+    keys to probe-side Filter/TableScan consumers. Eligible: INNER joins
+    and plain semi joins — kinds where dropping provably-non-matching
+    probe rows early is an identity on the result."""
+    counter = [0]
+
+    def visit(node: N.PlanNode) -> N.PlanNode:
+        replace = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, N.PlanNode):
+                nv = visit(v)
+                if nv is not v:
+                    replace[f.name] = nv
+            elif isinstance(v, tuple) and v and isinstance(v[0], N.PlanNode):
+                nv = tuple(visit(c) for c in v)
+                if nv != v:
+                    replace[f.name] = nv
+        if replace:
+            node = dataclasses.replace(node, **replace)
+
+        if isinstance(node, N.Join) and node.kind == "inner" and node.left_keys:
+            probe_attr, probe_keys, build_keys = (
+                "left", node.left_keys, node.right_keys
+            )
+        elif (
+            isinstance(node, N.SemiJoin)
+            and not node.anti
+            and node.mark is None
+            and node.probe_keys
+        ):
+            probe_attr, probe_keys, build_keys = (
+                "child", node.probe_keys, node.source_keys
+            )
+        else:
+            return node
+
+        produce = []
+        probe = getattr(node, probe_attr)
+        for i, (pk, bk) in enumerate(zip(probe_keys, build_keys)):
+            if not _df_comparable_types(pk.type, bk.type):
+                continue
+            fid = f"df{counter[0]}"
+            consumed = False
+            if isinstance(pk, ir.ColumnRef):
+                new_probe = _df_attach_consumer(probe, pk.name, fid)
+                if new_probe is not None:
+                    probe = new_probe
+                    consumed = True
+            produce.append((fid, i, consumed))
+            counter[0] += 1
+        if not produce:
+            return node
+        return dataclasses.replace(
+            node, **{probe_attr: probe, "dynamic_filters": tuple(produce)}
+        )
+
+    return visit(root)

@@ -231,6 +231,12 @@ class SchedulerStats:
     tasks_failed: int = 0
     worker_failures: Dict[str, int] = dataclasses.field(default_factory=dict)
     last_error: str = ""
+    # cross-task dynamic filtering (exec/dynfilter.py): filters shipped
+    # from build stages into probe-stage task specs, seconds spent in the
+    # bounded wait, and waits that expired (proceed-without-filter)
+    dynfilters_shipped: int = 0
+    dynfilter_wait_s: float = 0.0
+    dynfilter_timeouts: int = 0
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -272,6 +278,11 @@ class HttpScheduler:
         self.status_timeout = status_timeout
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        # bounded wait for a build stage to publish dynamic-filter
+        # summaries before the probe stage launches; expiry degrades to
+        # proceed-without-filter (reference: dynamic filtering's
+        # collection timeout). 0 disables cross-task shipping.
+        self.dynfilter_wait = float(env("PRESTO_TPU_DYNFILTER_WAIT_S", "10"))
         self.stats = SchedulerStats()
         self._lock = threading.Lock()
 
@@ -325,7 +336,9 @@ class HttpScheduler:
         try:
             fragment, specs = self._cut(root)
             sources = self._resolve_sources(
-                specs, False, workers, all_tasks, query_id
+                specs, False, workers, all_tasks, query_id,
+                dyn_links=self._dyn_links(fragment, specs),
+                dyn_values={},
             )
             ex = FragmentExecutor(self.catalog, {}, sources)
             return ex.run(fragment)
@@ -385,19 +398,138 @@ class HttpScheduler:
             return True
         return any(HttpScheduler._has_scan(c) for c in node.children)
 
+    # -- cross-task dynamic filters (exec/dynfilter.py) --
+
+    @staticmethod
+    def _dyn_links(fragment: N.PlanNode, specs: Dict[str, Exchange]):
+        """(produce, consume) stage links for dynamic filters crossing
+        task boundaries. produce: source_id -> [(filter_id, channel)] for
+        joins in `fragment` whose BUILD side is directly a RemoteSource —
+        that producer stage's output IS the build rows, so its tasks can
+        summarize the key channel. consume: source_id -> {filter_id} for
+        producer subtrees containing annotated probe scans."""
+        from ..expr import ir
+
+        produce: Dict[str, list] = {}
+
+        def walk(n):
+            if isinstance(n, (N.Join, N.SemiJoin)) and getattr(
+                n, "dynamic_filters", ()
+            ):
+                build = n.children[1]
+                keys = (
+                    n.right_keys
+                    if isinstance(n, N.Join)
+                    else n.source_keys
+                )
+                if isinstance(build, RemoteSource):
+                    fields = {f for f, _ in build.fields}
+                    for fid, i, _c in n.dynamic_filters:
+                        k = keys[i]
+                        if isinstance(k, ir.ColumnRef) and k.name in fields:
+                            produce.setdefault(build.source_id, []).append(
+                                (fid, k.name)
+                            )
+            for c in n.children:
+                walk(c)
+
+        walk(fragment)
+
+        consume: Dict[str, set] = {}
+
+        def scan_fids(n, acc: set):
+            if isinstance(n, N.TableScan):
+                for fid, *_rest in n.dynamic_filters:
+                    acc.add(fid)
+            for c in n.children:
+                scan_fids(c, acc)
+
+        for sid, ex in specs.items():
+            acc: set = set()
+            scan_fids(ex.child, acc)
+            if acc:
+                consume[sid] = acc
+        return produce, consume
+
+    def _await_dyn_filters(self, handles, entries, dyn_values: dict) -> None:
+        """Bounded wait for a build stage's tasks to FINISH, then merge
+        their per-task summaries into `dyn_values`. Expiry or a failed
+        task drops the filter (proceed-without-filter) — dynamic filters
+        are an optimization, never a correctness dependency."""
+        from ..exec.dynfilter import merge_summaries
+
+        deadline = time.time() + self.dynfilter_wait
+        t0 = time.perf_counter()
+        per_task: List[Optional[dict]] = []
+        timed_out = False
+        for uri, task in handles:
+            status = None
+            while time.time() < deadline:
+                try:
+                    status = self._task_status(uri, task)
+                except TaskFailure:
+                    status = None
+                    break
+                if status.get("state") in ("FINISHED", "FAILED"):
+                    break
+                time.sleep(0.05)
+            else:
+                timed_out = True
+            if status is None or status.get("state") != "FINISHED":
+                per_task.append(None)
+            else:
+                per_task.append(status.get("dynFilters") or {})
+        with self._lock:
+            self.stats.dynfilter_wait_s += time.perf_counter() - t0
+            if timed_out:
+                self.stats.dynfilter_timeouts += 1
+        if any(p is None for p in per_task):
+            return  # a task failed/timed out: filter untrusted
+        for fid, _channel in entries:
+            merged = merge_summaries([p.get(fid) for p in per_task])
+            if merged is not None:
+                dyn_values[fid] = merged
+                with self._lock:
+                    self.stats.dynfilters_shipped += 1
+
     # -- stage execution --
 
     def _resolve_sources(self, specs, sharded_consumer: bool,
                          workers: List[str], all_tasks,
-                         query_id: Optional[str] = None):
+                         query_id: Optional[str] = None,
+                         dyn_links=None, dyn_values: Optional[dict] = None):
         """Run producer stages for each exchange; returns either
         {sid: (kind, handles)} (sharded consumer) or {sid: [pages]}
-        (coordinator consumer)."""
+        (coordinator consumer).
+
+        Dynamic-filter link scheduling: a stage producing a filter some
+        sibling stage's scans consume launches FIRST; the coordinator then
+        waits (bounded) for its summaries and ships the merged filter in
+        the later stages' task specs — the cross-task half of dynamic
+        filtering (exec/dynfilter.py)."""
+        produce, consume = dyn_links if dyn_links else ({}, {})
+        if dyn_values is None:
+            dyn_values = {}
+        wanted: set = set()
+        for fids in consume.values():
+            wanted |= fids
+        if self.dynfilter_wait <= 0:
+            produce, consume, wanted = {}, {}, set()
+
+        def is_producer(sid):
+            return any(f in wanted for f, _ in produce.get(sid, ()))
+
+        order = sorted(specs, key=lambda sid: (not is_producer(sid),))
         resolved = {}
-        for sid, ex in specs.items():
+        for sid in order:
+            ex = specs[sid]
+            entries = [
+                (f, ch) for f, ch in produce.get(sid, ()) if f in wanted
+            ]
             if ex.kind == "repartition" and sharded_consumer:
                 handles = self._run_sharded_stage(
-                    ex.child, ("hash", ex.keys), workers, all_tasks, query_id
+                    ex.child, ("hash", ex.keys), workers, all_tasks,
+                    query_id, dyn_produce=entries, dyn_values=dyn_values,
                 )
                 resolved[sid] = ("repartition", handles)
             else:
@@ -411,8 +543,15 @@ class HttpScheduler:
                     unbounded_output=(
                         sharded_consumer and ex.kind == "replicate"
                     ),
+                    dyn_produce=entries, dyn_values=dyn_values,
                 )
                 resolved[sid] = ("gather", handles)
+            if entries and any(
+                other != sid
+                and (consume.get(other, set()) & {f for f, _ in entries})
+                for other in specs
+            ):
+                self._await_dyn_filters(handles, entries, dyn_values)
         if sharded_consumer:
             return resolved
         # coordinator-side: materialize every source into Pages now
@@ -438,7 +577,9 @@ class HttpScheduler:
     def _run_sharded_stage(self, node: N.PlanNode, output,
                            all_workers: List[str], all_tasks,
                            query_id: Optional[str] = None,
-                           unbounded_output: bool = False) -> List[Tuple[str, str]]:
+                           unbounded_output: bool = False,
+                           dyn_produce=None,
+                           dyn_values: Optional[dict] = None) -> List[Tuple[str, str]]:
         """One task per worker for sharded stages (splits/repartition
         inputs); scan-less single-distribution stages run as ONE task so
         rows are never duplicated. Returns [(worker_uri, task_id)]."""
@@ -449,7 +590,9 @@ class HttpScheduler:
         )
         workers = all_workers if sharded else all_workers[:1]
         child_resolved = self._resolve_sources(
-            specs, True, all_workers, all_tasks, query_id
+            specs, True, all_workers, all_tasks, query_id,
+            dyn_links=self._dyn_links(fragment, specs),
+            dyn_values=dyn_values,
         )
 
         # row-range splits per scanned table
@@ -493,6 +636,12 @@ class HttpScheduler:
                 "num_partitions": nparts,
                 "query_id": query_id,
                 "buffer_unbounded": unbounded_output,
+                # cross-task dynamic filters: summaries this stage must
+                # PRODUCE over its output, and resolved filter values its
+                # scans may CONSUME (a snapshot — stages launched before a
+                # build stage finished simply run unfiltered)
+                "dyn_filter_produce": list(dyn_produce or ()) or None,
+                "dyn_filters": dict(dyn_values) if dyn_values else None,
             }
             launched.append(
                 self._post_with_retry(uri, spec, all_workers, all_tasks)

@@ -241,6 +241,10 @@ class TaskState:
         self.done = threading.Event()
         self.query_id = query_id
         self.abort = threading.Event()  # set by the low-memory killer
+        # dynamic-filter summaries accumulated over this task's output
+        # (spec dyn_filter_produce; exec/dynfilter.HostFilterAccumulator),
+        # exposed to the coordinator through the status endpoint
+        self.dyn_filters: dict = {}
 
 
 # message fragments marking failures that would recur identically on any
@@ -337,6 +341,12 @@ class StreamingFragmentExecutor(StreamingExecutor):
         if rng is None:
             yield from super()._stream_scan(node, predicate)
             return
+        if node.dynamic_filters:
+            # dynamic-filter SPI hints (coordinator-shipped or published
+            # by an in-fragment join) prune connector units before decode
+            dyn = self._dyn_scan_hints(node)
+            if dyn:
+                predicate = list(predicate or []) + dyn
         start, stop = rng
         B = self.batch_rows
         pos = start
@@ -349,7 +359,7 @@ class StreamingFragmentExecutor(StreamingExecutor):
                 columns=[c for _, c, _ in node.columns],
                 predicate=predicate,
             )
-            yield self._rename_scan(node, src)
+            yield self._scan_out(node, self._rename_scan(node, src))
             first = False
             pos += B
 
@@ -452,6 +462,7 @@ class WorkerServer:
                     self._send(200, {
                         "state": t.state, "error": t.error,
                         "errorInfo": t.error_info,
+                        "dynFilters": t.dyn_filters or None,
                     })
                     return
                 if (
@@ -584,6 +595,27 @@ class WorkerServer:
                 for sid, src in (spec.get("sources") or {}).items()
             }
             ex = StreamingFragmentExecutor(self.catalog, splits, streams)
+            # cross-task dynamic filters shipped by the coordinator: seed
+            # the executor registry so annotated scans in this fragment
+            # prune (exec/dynfilter.py). Missing/late filters simply stay
+            # unpublished — the scan runs unfiltered (proceed-without).
+            for fid, summary in (spec.get("dyn_filters") or {}).items():
+                try:
+                    from ..exec.dynfilter import filter_from_summary
+
+                    df = filter_from_summary(summary, None)
+                    if df is not None:
+                        ex.dyn_ctx.publish(fid, df)
+                except Exception:  # noqa: BLE001 — filters are best-effort
+                    pass
+            # summaries to accumulate over THIS task's output pages
+            # (the build side of some downstream dynamic-filter join)
+            from ..exec.dynfilter import HostFilterAccumulator
+
+            dyn_accs = {
+                fid: HostFilterAccumulator(channel)
+                for fid, channel in (spec.get("dyn_filter_produce") or [])
+            }
             part_keys = spec.get("partition_keys")
             nparts = int(spec.get("num_partitions", 1))
             keys = (
@@ -620,6 +652,11 @@ class WorkerServer:
                     page = next(stream_iter, None)
                 if page is None:
                     break
+                for acc in dyn_accs.values():
+                    try:
+                        acc.add_page(page)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        acc.unsupported = True
                 for piece in _split_to_bound(page, bound):
                     if keys is not None:
                         parts = _hash_partition(piece, keys, nparts)
@@ -628,6 +665,12 @@ class WorkerServer:
                                 buffers.put(p, d)
                     else:
                         buffers.put(0, serialize_page(piece))
+            if dyn_accs:
+                state.dyn_filters = {
+                    fid: s
+                    for fid, acc in dyn_accs.items()
+                    if (s := acc.summary()) is not None
+                }
             state.state = "FINISHED"
         except BaseException as exc:  # noqa: BLE001 - kernel faults
             # (XLA/Mosaic aborts surface as various exception types)

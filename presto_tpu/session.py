@@ -64,6 +64,7 @@ SESSION_PROPERTIES = {
     "query_priority": int,        # resource-group query_priority policy
     "pallas_groupby": _parse_bool,  # small-G aggregation via the Pallas kernel
     "matmul_groupby": _parse_bool,  # dense-key aggregation via MXU matmuls
+    "dynamic_filtering": _parse_bool,  # build-side runtime filters on probes
 }
 
 
@@ -105,6 +106,7 @@ class Session:
         pallas_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
         matmul_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
         exchange_budget=None,  # per-shard bytes for exchanged joins
+        dynamic_filtering: bool = True,  # build-side runtime join filters
     ):
         self.access_control = access_control
         self.user = user
@@ -130,11 +132,14 @@ class Session:
         self.memory_budget = memory_budget
         self.pallas_groupby = pallas_groupby
         self.matmul_groupby = matmul_groupby
+        self.dynamic_filtering = dynamic_filtering
         local = getattr(self.executor, "local", self.executor)
         if pallas_groupby is not None and hasattr(local, "pallas_groupby"):
             local.pallas_groupby = pallas_groupby
         if matmul_groupby is not None and hasattr(local, "matmul_groupby"):
             local.matmul_groupby = matmul_groupby
+        if hasattr(local, "dynamic_filtering"):
+            local.dynamic_filtering = dynamic_filtering
         # statement-layer state (shared BY REFERENCE with derived
         # property-override sessions, see with_properties)
         self.views: dict = {}  # name -> view query SQL
@@ -189,6 +194,9 @@ class Session:
                 ),
                 matmul_groupby=engine.get(
                     "matmul_groupby", self.matmul_groupby
+                ),
+                dynamic_filtering=engine.get(
+                    "dynamic_filtering", self.dynamic_filtering
                 ),
             )
             # statement-layer state is session-wide, not per-override
@@ -1030,6 +1038,8 @@ class Session:
             local.pallas_groupby = self.pallas_groupby
         if self.matmul_groupby is not None and hasattr(local, "matmul_groupby"):
             local.matmul_groupby = self.matmul_groupby
+        if hasattr(local, "dynamic_filtering"):
+            local.dynamic_filtering = self.dynamic_filtering
         ex.run(node)
         # fold parked device row-count scalars in one batch (the lazy
         # collector avoids a blocking host sync per plan node)
@@ -1041,10 +1051,26 @@ class Session:
 
         breakers = kernel_breaker_lines()
         breaker_txt = "".join(f"\n-- {line}" for line in breakers)
+        dyn_txt = ""
+        dyn_ctx = getattr(
+            ex, "dyn_ctx", getattr(getattr(ex, "local", None), "dyn_ctx", None)
+        )
+        if dyn_ctx is not None and dyn_ctx.snapshot()["filters"]:
+            snap = dyn_ctx.snapshot()
+            filters = ", ".join(
+                f"{fid}={d}" for fid, d in sorted(snap["filters"].items())
+            )
+            scan_p = sum(snap["scan_pruned"].values())
+            pre_p = sum(snap["preprobe_pruned"].values())
+            dyn_txt = (
+                f"\n-- dynamic filters: {filters}; rows_pruned="
+                f"{scan_p + pre_p:,} (scan {scan_p:,}, pre-probe {pre_p:,})"
+            )
+            if snap["wait_s"]:
+                dyn_txt += f", wait {snap['wait_s']:.2f}s"
         return (
-            f"{tree}\n"
+            f"{tree}{dyn_txt}{breaker_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
-            f"{breaker_txt}"
         )
 
     def explain_analyze(self, sql: str) -> str:

@@ -42,13 +42,14 @@ def _exchange_harness(page, key_exprs, part_capacity):
     schema = page_schema(page)
     leaves = page_to_arrays(page)
 
+    from presto_tpu.exec.dist import _shard_map
+
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(tuple(P("workers") for _ in leaves), P("workers")),
         out_specs=(tuple(P("workers") for _ in leaves), P("workers"), P("workers")),
-        check_vma=False,
     )
     def step(shard_leaves, counts):
         local = page_from_arrays(shard_leaves, schema, counts[0])
@@ -102,13 +103,14 @@ def test_all_gather_page_replicates():
     schema = page_schema(page)
     leaves = page_to_arrays(page)
 
+    from presto_tpu.exec.dist import _shard_map
+
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(tuple(P("workers") for _ in leaves), P("workers")),
         out_specs=(tuple(P("workers") for _ in leaves), P("workers")),
-        check_vma=False,
     )
     def step(shard_leaves, counts):
         local = page_from_arrays(shard_leaves, schema, counts[0])

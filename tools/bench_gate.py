@@ -25,7 +25,13 @@ import json
 import os
 import sys
 
-GATED = ("sort_2key", "top_n_100", "distinct_2key", "window_rank_runsum")
+GATED = (
+    "sort_2key", "top_n_100", "distinct_2key", "window_rank_runsum",
+    # dynamic-filter probe path (PR 3): the filtered probe must stay
+    # ahead of the legacy unfiltered join_probe_n1 floor, and the bloom
+    # build+query kernel must not regress
+    "join_probe_filtered", "bloom_build_query",
+)
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
 
