@@ -880,6 +880,174 @@ def run_serde_bench(sf: float, runs: int = RUNS) -> Dict:
     }
 
 
+def run_serde_encoded_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Wire v2 light-weight encodings end to end (server/serde.py):
+    serialize+deserialize a page whose columns exercise dict/delta/off/
+    bits paths, reporting throughput AND the achieved wire ratio. The
+    companion serde_lz4 row measures the engine-default path on the Q6
+    page; this row keeps the encoding win visible even if defaults
+    change."""
+    from ..server.serde import deserialize_page, serialize_page
+    from .handcoded import _table_page
+
+    page = _table_page(
+        "lineitem", sf,
+        ("l_quantity", "l_discount", "l_shipdate", "l_returnflag",
+         "l_linestatus", "l_orderkey"),
+    )
+    page.block("l_quantity").data.block_until_ready()
+    caps = {"version": 2, "codecs": ["zstd", "lz4", "zlib", "raw"]}
+    wire = serialize_page(page, caps=caps)
+    deserialize_page(wire)  # warm
+    t_ser = t_des = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        wire = serialize_page(page, caps=caps)
+        t_ser = min(t_ser, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        deserialize_page(wire)
+        t_des = min(t_des, time.perf_counter() - t0)
+    raw_bytes = sum(np.asarray(b.data).nbytes for b in page.blocks)
+    n = int(page.count)
+    return {
+        "name": "serde_encoded",
+        "rows": n,
+        "rows_per_s": round(n / (t_ser + t_des)),
+        "ms": round((t_ser + t_des) * 1e3, 3),
+        "serialize_MBps": round(raw_bytes / t_ser / 1e6, 1),
+        "deserialize_MBps": round(raw_bytes / t_des / 1e6, 1),
+        "wire_bytes": len(wire),
+        "raw_bytes": raw_bytes,
+        "note": f"ratio {round(raw_bytes / len(wire), 2)}x "
+                "(dict/delta/off/bits + stripes)",
+    }
+
+
+def run_serde_stripes_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Striped parallel compression on a codec-bound payload (tiled
+    random int64 defeats the encodings; the 8KB repeat period keeps LZ4
+    effective inside each stripe), so this row isolates what the stripe
+    pool buys over one sequential codec pass."""
+    from ..server import serde
+    from ..server.serde import deserialize_page, serialize_page
+    from ..page import Page
+
+    rng = np.random.default_rng(5)
+    rows = max(int(2_000_000 * sf * 10), 1 << 16)
+    piece = rng.integers(0, 2**62, 1024, dtype=np.int64)
+    page = Page.from_dict({"a": np.tile(piece, rows // 1024 + 1)[:rows]})
+    caps = {"version": 2, "codecs": ["zstd", "lz4", "zlib", "raw"]}
+    wire = serialize_page(page, caps=caps)
+    nstripes = int.from_bytes(wire[5:9], "little")
+    deserialize_page(wire)  # warm
+    t_ser = t_des = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        wire = serialize_page(page, caps=caps)
+        t_ser = min(t_ser, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        deserialize_page(wire)
+        t_des = min(t_des, time.perf_counter() - t0)
+    raw_bytes = rows * 8
+    return {
+        "name": "serde_parallel_stripes",
+        "rows": rows,
+        "rows_per_s": round(rows / (t_ser + t_des)),
+        "ms": round((t_ser + t_des) * 1e3, 3),
+        "serialize_MBps": round(raw_bytes / t_ser / 1e6, 1),
+        "deserialize_MBps": round(raw_bytes / t_des / 1e6, 1),
+        "wire_bytes": len(wire),
+        "raw_bytes": raw_bytes,
+        "note": f"{nstripes} stripes x {serde._STRIPE_BYTES >> 10}KB, "
+                f"pool={serde._stripe_pool() is not None}",
+    }
+
+
+def run_exchange_pull_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Pipelined concurrent shuffle client vs the sequential drain
+    (server/exchange.ExchangeClient vs worker._pull_buffer): two
+    in-process workers hold identical pre-serialized buffers; rows/s
+    counts rows landed at the consumer, note reports the speedup."""
+    import threading
+
+    from ..connectors.tpch import TpchCatalog
+    from ..server.serde import deserialize_page, serialize_page
+    from ..server.exchange import ExchangeClient, ExchangeStats
+    from ..server.worker import (
+        OutputBuffers,
+        TaskState,
+        WorkerServer,
+        _pull_buffer,
+    )
+    from .handcoded import lineitem_q6_page
+
+    page = lineitem_q6_page(min(sf, 0.02))
+    page.block("l_quantity").data.block_until_ready()
+    data = serialize_page(page)
+    n_pages = 8
+    workers = []
+    for _ in range(2):
+        w = WorkerServer(TpchCatalog(sf=0.001))
+        t = TaskState(query_id="qb")
+        t.buffers = OutputBuffers(w.pool, "qb", threading.Event(), bound=None)
+        for _i in range(n_pages):
+            t.buffers.put(0, data)
+        t.buffers.finish()
+        t.state = "FINISHED"
+        t.done.set()
+        w.tasks["tb"] = t
+        workers.append(w.start())
+    try:
+        locs = [(w.uri, "tb", 0) for w in workers]
+        rows = int(page.count) * n_pages * 2
+
+        def pull_pipelined():
+            stats = ExchangeStats()
+            client = ExchangeClient(locs, ack=False, stats=stats)
+            got = sum(1 for _ in client.pages())
+            assert got == n_pages * 2
+            return stats
+
+        def pull_sequential():
+            got = 0
+            for uri, task, buf in locs:
+                for d in _pull_buffer(uri, task, buf, ack=False):
+                    deserialize_page(d)
+                    got += 1
+            assert got == n_pages * 2
+
+        pull_pipelined()  # warm
+        t_pipe = t_seq = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            stats = pull_pipelined()
+            t_pipe = min(t_pipe, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            pull_sequential()
+            t_seq = min(t_seq, time.perf_counter() - t0)
+        return {
+            "name": "exchange_pull_pipelined",
+            "rows": rows,
+            "rows_per_s": round(rows / t_pipe),
+            "ms": round(t_pipe * 1e3, 3),
+            "wire_bytes": stats.snapshot()["wire_bytes"],
+            "note": f"{round(t_seq / t_pipe, 2)}x vs sequential "
+                    f"({round(rows / t_seq):,} rows/s), "
+                    f"peak {stats.snapshot()['peak_concurrent']} pullers",
+        }
+    finally:
+        for w in workers:
+            w.stop()
+
+
+HOST_BENCHES = {
+    "serde_lz4": run_serde_bench,
+    "serde_encoded": run_serde_encoded_bench,
+    "serde_parallel_stripes": run_serde_stripes_bench,
+    "exchange_pull_pipelined": run_exchange_pull_bench,
+}
+
+
 def run_exchange_bench(sf: float, runs: int = RUNS) -> Optional[Dict]:
     """Hash-repartition all_to_all over the device mesh (ref:
     BenchmarkPartitionedOutputOperator + ExchangeOperator; the ICI data
@@ -1003,11 +1171,13 @@ def run_suite(
             results.append(r)
         except Exception as e:  # noqa: BLE001 - suite entries are independent
             errors[name] = repr(e)[:300]
-    if not only or "serde_lz4" in only:
+    for hname, hctor in HOST_BENCHES.items():
+        if only and hname not in only:
+            continue
         try:
-            results.append(run_serde_bench(sf, runs))
+            results.append(hctor(sf, runs))
         except Exception as e:  # noqa: BLE001
-            errors["serde_lz4"] = repr(e)[:300]
+            errors[hname] = repr(e)[:300]
     if not only or "exchange_all_to_all" in only:
         try:
             r = run_exchange_bench(sf, runs)

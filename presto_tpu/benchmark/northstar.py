@@ -49,16 +49,25 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
         "runs": runs,
         "results": [],
     }
+    from ..server.serde import GLOBAL_WIRE_STATS
+
     for name in queries or QUERIES:
         sql = QUERIES[name]
         try:
             for _ in range(prewarm):
                 rows = sess.query(sql).rows()  # compile + caches
             samples = []
+            wire0 = GLOBAL_WIRE_STATS.snapshot()
             for _ in range(runs):
                 t0 = time.perf_counter()
                 rows = sess.query(sql).rows()
                 samples.append((time.perf_counter() - t0) * 1e3)
+            # per-query wire traffic (serde.GLOBAL_WIRE_STATS delta):
+            # zero on the single-process ICI path, the real exchange
+            # bytes + compression ratio when the query crossed workers
+            wire1 = GLOBAL_WIRE_STATS.snapshot()
+            wire_bytes = (wire1["wire_bytes"] - wire0["wire_bytes"]) // runs
+            raw_b = (wire1["raw_bytes"] - wire0["raw_bytes"]) // runs
             best = min(samples)
             # dynamic-filter pruning observability (exec/dynfilter.py):
             # rows the runtime filters dropped before probe kernels, per
@@ -77,6 +86,10 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
                         + sum(snap.get("preprobe_pruned", {}).values())
                     ),
                     "dyn_filters": snap.get("filters") or {},
+                    "wire_bytes": wire_bytes,
+                    "wire_ratio": (
+                        round(raw_b / wire_bytes, 2) if wire_bytes else None
+                    ),
                 }
             )
         except Exception as e:  # noqa: BLE001 — record, keep going

@@ -440,12 +440,19 @@ replicated subtrees delegate to the single-node Executor."""
     def _d_sample(self, node):
         from ..ops.filter import sample_page
 
-        return self._unary(
-            node,
-            ("sample", node),
-            lambda p: sample_page(p, node.fraction, node.seed),
-            shrink=True,
-        )
+        axis = self.axis
+
+        def fn(p):
+            # per-shard component of the global row position: shard i's
+            # rows occupy [i*capacity, i*capacity + count) — without it
+            # every shard would reuse the identical positional mask
+            # (systematic, not Bernoulli sampling)
+            off = jax.lax.axis_index(axis).astype(jnp.uint64) * jnp.uint64(
+                p.capacity
+            )
+            return sample_page(p, node.fraction, node.seed, off)
+
+        return self._unary(node, ("sample", node), fn, shrink=True)
 
     def _d_filter(self, node: N.Filter):
         return self._unary(

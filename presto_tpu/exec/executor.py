@@ -73,6 +73,12 @@ class Executor:
         # session override (the `dynamic_filtering` session property);
         # PRESTO_TPU_DYNFILTER=0 disables engine-wide
         self.dynamic_filtering = True
+        # TABLESAMPLE determinism: per-Sample-node running row offset
+        # (streaming batches) + a per-worker/per-split salt set by the
+        # fragment executors, mixed into the sample hash so positional
+        # masks never repeat across batches/workers (ops/filter.py)
+        self._sample_pos: Dict[int, int] = {}
+        self.sample_salt = 0
 
     def _kernel(self, key, make_fn):
         """Compile-once cache for per-node kernels. jax.jit retraces per
@@ -619,11 +625,24 @@ class Executor:
     def _exec_sample(self, node: N.Sample, page: Page) -> Page:
         from ..ops.filter import sample_page
 
+        # global row position of this batch: per-node running offset
+        # (advanced by CAPACITY, not count, so it needs no host sync) +
+        # the per-worker/per-split salt — the same positional mask must
+        # never repeat across batches or workers (Bernoulli, not
+        # systematic sampling). Offset is a traced argument, so the
+        # compiled kernel is shared across batches.
+        pos = self._sample_pos.get(id(node), 0)
+        self._sample_pos[id(node)] = pos + page.capacity
+        offset = jnp.asarray(
+            (self.sample_salt + pos) & 0xFFFFFFFFFFFFFFFF, jnp.uint64
+        )
         fn = self._kernel(
             node,
-            lambda: lambda p: sample_page(p, node.fraction, node.seed),
+            lambda: lambda p, off: sample_page(
+                p, node.fraction, node.seed, off
+            ),
         )
-        return self._shrink(fn(page), node)
+        return self._shrink(fn(page, offset), node)
 
     def _exec_filter(self, node: N.Filter, page: Page) -> Page:
         if node.dynamic_filters and any(

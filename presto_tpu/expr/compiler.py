@@ -597,18 +597,18 @@ def _eval_map_zip_with(expr: Call, page: Page) -> Val:
     w2 = m2.data.shape[1]
     W = w1 + w2
     inb1, inb2 = _in_bounds(m1), _in_bounds(m2)
-    big = (
-        jnp.iinfo(kd1.dtype).max
-        if jnp.issubdtype(kd1.dtype, jnp.integer)
-        else jnp.asarray(jnp.inf, kd1.dtype)
-    )
-    allk = jnp.concatenate(
-        [jnp.where(inb1, kd1, big), jnp.where(inb2, kd2, big)], axis=1
-    )
+    allk = jnp.concatenate([kd1, kd2], axis=1)
     inb = jnp.concatenate([inb1, inb2], axis=1)
-    order = jnp.argsort(allk, axis=1, stable=True)
-    sk = jnp.take_along_axis(allk, order, axis=1)
-    sinb = jnp.take_along_axis(inb, order, axis=1)
+    # sort on the explicit (out_of_bounds, key) composite — the dead-flag
+    # approach of ops/sort.py — instead of overloading dtype-max/+inf as
+    # padding: a REAL key equal to the sentinel would otherwise be
+    # indistinguishable from padding and silently dropped/mis-joined.
+    # Out-of-bounds lanes sort last; in-bounds duplicates stay adjacent.
+    oob = (~inb).astype(jnp.int8)
+    sort_oob, sk = jax.lax.sort(
+        (oob, allk), dimension=1, num_keys=2, is_stable=True
+    )
+    sinb = sort_oob == 0
     first = jnp.concatenate(
         [jnp.ones((cap, 1), jnp.bool_), sk[:, 1:] != sk[:, :-1]], axis=1
     )

@@ -61,15 +61,23 @@ def filter_project_page(page: Page, predicate, exprs, names) -> Page:
     return compact(projected, keep)
 
 
-def sample_page(page: Page, fraction: float, seed: int) -> Page:
+def sample_page(page: Page, fraction: float, seed: int, offset=0) -> Page:
     """TABLESAMPLE BERNOULLI(p): keep each live row independently with
-    probability `fraction`, decided by a splitmix64 hash of (row
+    probability `fraction`, decided by a splitmix64 hash of (global row
     position, seed) — deterministic within one plan (the seed is drawn
     at plan time), stateless across batches (reference SampleNode +
-    bernoulli_sample filter rewrite)."""
-    import numpy as np
+    bernoulli_sample filter rewrite).
 
-    idx = jnp.arange(page.capacity, dtype=jnp.uint64)
+    `offset` is the GLOBAL position of this page's row 0 — a running
+    row offset plus a per-worker/per-shard salt threaded by the
+    executors. Without it the same positional mask would repeat across
+    every batch and worker (systematic sampling, not Bernoulli —
+    variance inflated and results biased whenever row order correlates
+    with values; ADVICE round-5). Traced, so one compiled kernel serves
+    every batch."""
+    idx = jnp.arange(page.capacity, dtype=jnp.uint64) + jnp.asarray(
+        offset
+    ).astype(jnp.uint64)
     z = (idx + jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF)) * jnp.uint64(
         0x9E3779B97F4A7C15
     )

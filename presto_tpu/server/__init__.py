@@ -9,7 +9,15 @@ Client:                 Client(coordinator_uri).execute(sql).
 from .client import Client, QueryError
 from .cluster import HttpClusterSession, HttpScheduler, NodeManager, TaskFailure
 from .coordinator import CoordinatorServer
-from .serde import DictionaryCache, deserialize_page, serialize_page
+from .exchange import ExchangeClient, ExchangeError, ExchangeStats
+from .serde import (
+    DictionaryCache,
+    WireStats,
+    deserialize_page,
+    local_capabilities,
+    negotiate,
+    serialize_page,
+)
 from .worker import WorkerServer
 
 __all__ = [
@@ -21,7 +29,13 @@ __all__ = [
     "HttpScheduler",
     "HttpClusterSession",
     "TaskFailure",
+    "ExchangeClient",
+    "ExchangeError",
+    "ExchangeStats",
     "serialize_page",
     "deserialize_page",
+    "local_capabilities",
+    "negotiate",
+    "WireStats",
     "DictionaryCache",
 ]

@@ -48,13 +48,13 @@ from typing import Dict, List, Optional, Tuple
 
 from ..plan import nodes as N
 from ..plan.fragment import Exchange
+from .exchange import ExchangeClient, ExchangeError, ExchangeStats
+from .serde import WireStats, negotiate
 from .worker import (
     _FATAL_MARKERS,
     FragmentExecutor,
     RemoteSource,
-    _pull_buffer,
 )
-from .serde import deserialize_page
 
 
 def _retryable_message(msg: str) -> bool:
@@ -90,7 +90,7 @@ class NodeManager:
                  event_bus=None):
         self.workers = {
             u: {"state": "ACTIVE", "failures": 0, "task_failures": 0,
-                "blacklisted_at": None}
+                "blacklisted_at": None, "wire": None}
             for u in worker_uris
         }
         self.interval = interval
@@ -162,11 +162,86 @@ class NodeManager:
             if st is not None:
                 st["task_failures"] = 0
 
+    def wire_caps(self, uri: str) -> Optional[dict]:
+        """Cached wire capabilities a worker advertised through its
+        status handshake; fetched once on demand when the heartbeat loop
+        has not probed yet. None = unknown (negotiation degrades to the
+        baseline wire format for the whole fleet). A failed probe is
+        negatively cached for one heartbeat interval so an unreachable
+        worker costs ONE query a 2s stall, not every query."""
+        with self._lock:
+            st = self.workers.get(uri)
+            if st is None:
+                return None
+            cached = st.get("wire")
+            failed_at = st.get("wire_probe_failed_at")
+        if cached is not None:
+            return cached
+        if failed_at is not None and time.time() - failed_at < self.interval:
+            return None
+        caps = None
+        try:
+            with urllib.request.urlopen(f"{uri}/v1/status", timeout=2) as r:
+                caps = json.loads(r.read()).get("wire")
+        except Exception:  # noqa: BLE001 - unknown peer stays baseline
+            caps = None
+        with self._lock:
+            st = self.workers.get(uri)
+            if st is not None:
+                if isinstance(caps, dict):
+                    st["wire"] = caps
+                    st.pop("wire_probe_failed_at", None)
+                else:
+                    st["wire_probe_failed_at"] = time.time()
+        return caps if isinstance(caps, dict) else None
+
+    def wire_caps_all(self, uris: List[str]) -> List[Optional[dict]]:
+        """wire_caps for a worker snapshot, fetching the uncached ones
+        CONCURRENTLY — query submit must not pay a serial 2s-per-worker
+        stall while the heartbeat cache warms up. A probe that misses
+        the join window reports None (baseline degradation) instead of
+        being re-issued serially; the daemon thread still warms the
+        cache for the next query."""
+        results: Dict[str, Optional[dict]] = {}
+        with self._lock:
+            for u in uris:
+                st = self.workers.get(u)
+                if st is not None and st.get("wire") is not None:
+                    results[u] = st["wire"]
+        missing = [u for u in uris if u not in results]
+        if len(missing) == 1:
+            results[missing[0]] = self.wire_caps(missing[0])
+        elif missing:
+            def probe(u):
+                results[u] = self.wire_caps(u)
+
+            threads = [
+                threading.Thread(target=probe, args=(u,), daemon=True)
+                for u in missing
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=3)
+        return [results.get(u) for u in uris]
+
     def probe_all(self):
         for uri in self.all_workers():
             try:
                 with urllib.request.urlopen(f"{uri}/v1/status", timeout=2) as r:
-                    ok = json.loads(r.read()).get("state") == "ACTIVE"
+                    payload = json.loads(r.read())
+                    ok = payload.get("state") == "ACTIVE"
+                    # cache what the worker advertises NOW — including
+                    # clearing a stale entry when a rolled-back build at
+                    # the same URI stops advertising caps (else peers
+                    # would keep sending it undecodable v2 pages)
+                    caps = payload.get("wire")
+                    with self._lock:
+                        st = self.workers.get(uri)
+                        if st is not None:
+                            st["wire"] = (
+                                caps if isinstance(caps, dict) else None
+                            )
             except Exception:  # noqa: BLE001 - network failure IS the signal
                 ok = False
             with self._lock:
@@ -237,6 +312,12 @@ class SchedulerStats:
     dynfilters_shipped: int = 0
     dynfilter_wait_s: float = 0.0
     dynfilter_timeouts: int = 0
+    # pipelined exchange observability (server/exchange.py): per-source
+    # pull stats of the LAST query attempt (coordinator-side gathers) +
+    # best-effort producer-side encode stats polled from task statuses,
+    # and the wire capability set the attempt negotiated
+    exchange: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    wire_caps: Optional[dict] = None
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -332,6 +413,14 @@ class HttpScheduler:
         workers = self.nodes.active_workers()
         if not workers:
             raise TaskFailure("no active workers", retryable=False)
+        # wire-format handshake: intersect the snapshot's advertised
+        # capabilities (+ the coordinator's own) once per attempt and
+        # ship the result in every task spec — a mixed fleet agrees on
+        # codecs/encodings instead of failing on deserialize
+        wire_caps = negotiate(self.nodes.wire_caps_all(workers))
+        with self._lock:
+            self.stats.wire_caps = wire_caps
+            self.stats.exchange = {}
         all_tasks: List[Tuple[str, str]] = []
         try:
             fragment, specs = self._cut(root)
@@ -339,6 +428,7 @@ class HttpScheduler:
                 specs, False, workers, all_tasks, query_id,
                 dyn_links=self._dyn_links(fragment, specs),
                 dyn_values={},
+                wire_caps=wire_caps,
             )
             ex = FragmentExecutor(self.catalog, {}, sources)
             return ex.run(fragment)
@@ -497,7 +587,8 @@ class HttpScheduler:
     def _resolve_sources(self, specs, sharded_consumer: bool,
                          workers: List[str], all_tasks,
                          query_id: Optional[str] = None,
-                         dyn_links=None, dyn_values: Optional[dict] = None):
+                         dyn_links=None, dyn_values: Optional[dict] = None,
+                         wire_caps: Optional[dict] = None):
         """Run producer stages for each exchange; returns either
         {sid: (kind, handles)} (sharded consumer) or {sid: [pages]}
         (coordinator consumer).
@@ -530,6 +621,7 @@ class HttpScheduler:
                 handles = self._run_sharded_stage(
                     ex.child, ("hash", ex.keys), workers, all_tasks,
                     query_id, dyn_produce=entries, dyn_values=dyn_values,
+                    wire_caps=wire_caps,
                 )
                 resolved[sid] = ("repartition", handles)
             else:
@@ -544,6 +636,7 @@ class HttpScheduler:
                         sharded_consumer and ex.kind == "replicate"
                     ),
                     dyn_produce=entries, dyn_values=dyn_values,
+                    wire_caps=wire_caps,
                 )
                 resolved[sid] = ("gather", handles)
             if entries and any(
@@ -554,32 +647,63 @@ class HttpScheduler:
                 self._await_dyn_filters(handles, entries, dyn_values)
         if sharded_consumer:
             return resolved
-        # coordinator-side: materialize every source into Pages now
+        # coordinator-side: materialize every source into Pages through
+        # the PIPELINED exchange client — one puller per producer task,
+        # multi-page responses, deserialization overlapped with in-flight
+        # pulls (replaces the round-5 sequential one-thread drain)
         out = {}
         for sid, (kind, handles) in resolved.items():
+            ex_stats = ExchangeStats()
+            client = ExchangeClient(
+                [(uri, task, 0) for uri, task in handles],
+                ack=True,
+                deadline=self.task_deadline,
+                stats=ex_stats,
+            )
             pages = []
-            for uri, task in handles:
-                try:
-                    for data in _pull_buffer(
-                        uri, task, 0, deadline=self.task_deadline
-                    ):
-                        pages.append(deserialize_page(data))
-                except RuntimeError as e:
-                    # attribute the mid-stream failure to its worker so
-                    # query-level retry can feed the blacklist
-                    raise TaskFailure(
-                        str(e), uri=uri, task_id=task,
-                        retryable=_retryable_message(str(e)),
-                    ) from None
+            try:
+                for page in client.pages():
+                    pages.append(page)
+            except ExchangeError as e:
+                # attribute the mid-stream failure to its worker so
+                # query-level retry can feed the blacklist. Pull stats
+                # only — polling still-RUNNING producers' statuses here
+                # would add ~0.5s of server-side wait per producer to
+                # every retry attempt
+                self._record_exchange(sid, ex_stats, ())
+                raise TaskFailure(
+                    str(e), uri=e.uri, task_id=e.task_id,
+                    retryable=_retryable_message(str(e)),
+                ) from None
+            self._record_exchange(sid, ex_stats, handles)
             out[sid] = pages
         return out
+
+    def _record_exchange(self, sid: str, ex_stats: "ExchangeStats",
+                         handles) -> None:
+        """Fold one gather's pull stats + best-effort producer encode
+        stats (task status exchangeStats — the producers are FINISHED
+        here, so each poll answers immediately; still queryable until
+        query cleanup) into the scheduler's observable accounting."""
+        entry = ex_stats.snapshot()
+        encode = WireStats()
+        for uri, task in handles:
+            try:
+                st = self._task_status(uri, task)
+            except Exception:  # noqa: BLE001 — observability, best effort
+                continue
+            encode.merge_snapshot(st.get("exchangeStats") or {})
+        entry["producer"] = encode.snapshot()
+        with self._lock:
+            self.stats.exchange[sid] = entry
 
     def _run_sharded_stage(self, node: N.PlanNode, output,
                            all_workers: List[str], all_tasks,
                            query_id: Optional[str] = None,
                            unbounded_output: bool = False,
                            dyn_produce=None,
-                           dyn_values: Optional[dict] = None) -> List[Tuple[str, str]]:
+                           dyn_values: Optional[dict] = None,
+                           wire_caps: Optional[dict] = None) -> List[Tuple[str, str]]:
         """One task per worker for sharded stages (splits/repartition
         inputs); scan-less single-distribution stages run as ONE task so
         rows are never duplicated. Returns [(worker_uri, task_id)]."""
@@ -593,6 +717,7 @@ class HttpScheduler:
             specs, True, all_workers, all_tasks, query_id,
             dyn_links=self._dyn_links(fragment, specs),
             dyn_values=dyn_values,
+            wire_caps=wire_caps,
         )
 
         # row-range splits per scanned table
@@ -642,6 +767,9 @@ class HttpScheduler:
                 # build stage finished simply run unfiltered)
                 "dyn_filter_produce": list(dyn_produce or ()) or None,
                 "dyn_filters": dict(dyn_values) if dyn_values else None,
+                # fleet-negotiated wire capabilities: this task's output
+                # serializer must stay within them
+                "wire": wire_caps,
             }
             launched.append(
                 self._post_with_retry(uri, spec, all_workers, all_tasks)
@@ -970,15 +1098,57 @@ class HttpClusterSession:
             ClusterMemoryManager(nodes).start() if memory_manager else None
         )
 
-    def query(self, sql: str):
+    def _run_fragmented(self, sql: str):
+        """The one plan -> fragment -> schedule pipeline both query()
+        and explain_analyze() go through; returns (fragmented node,
+        result page)."""
         from ..plan.fragment import fragment_plan
-        from ..session import QueryResult
 
         node = self._planner.plan(sql)
         node = fragment_plan(node, self.catalog, self.broadcast_threshold,
                              num_workers=max(len(self.scheduler.nodes.active_workers()), 2))
         page = self.scheduler.run(node, query_id=f"q_{next(self._query_ids)}")
+        return node, page
+
+    def query(self, sql: str):
+        from ..session import QueryResult
+
+        node, page = self._run_fragmented(sql)
         return QueryResult(page, node.titles)
+
+    def explain_analyze(self, sql: str) -> str:
+        """Run the query over the cluster and render the fragmented plan
+        with per-exchange WIRE stats: pages, wire vs raw bytes and the
+        compression ratio, encode/decode wall, and pull concurrency —
+        the distributed half of EXPLAIN ANALYZE (the single-process half
+        lives in Session.explain_analyze_plan)."""
+        node, _page = self._run_fragmented(sql)
+        tree = N.plan_tree_str(node)
+        lines = [tree]
+        st = self.scheduler.stats
+        if st.wire_caps:
+            lines.append(
+                "-- wire: v%s, codecs %s"
+                % (st.wire_caps.get("version"),
+                   "/".join(st.wire_caps.get("codecs") or ()))
+            )
+        for sid, ex in sorted(st.exchange.items()):
+            prod = ex.get("producer") or {}
+            ratio = prod.get("compression_ratio")
+            lines.append(
+                f"-- exchange {sid}: {ex['pages']} pages from "
+                f"{ex['sources']} producers, wire "
+                f"{ex['wire_bytes']:,}B"
+                + (
+                    f" (raw {prod['raw_bytes']:,}B, {ratio}x)"
+                    if prod.get("raw_bytes") and ratio
+                    else ""
+                )
+                + f", encode {prod.get('encode_ms', 0)}ms, decode "
+                f"{ex['decode_ms']}ms, pull peak {ex['peak_concurrent']} "
+                f"concurrent"
+            )
+        return "\n".join(lines)
 
     def close(self):
         if self.memory_manager is not None:

@@ -1,0 +1,450 @@
+"""Pipelined concurrent exchange client for the pull-based HTTP shuffle.
+
+Re-designed equivalent of the reference's ExchangeClient +
+HttpPageBufferClient (presto-main/.../operator/ExchangeClient.java:55 —
+one concurrent HTTP client per upstream task, responses bounded by
+`exchange.max-response-size`, pages landing in a bounded buffer the
+operator drains). The previous implementation pulled producer buffers
+SEQUENTIALLY on the consumer thread (server/cluster.py round-5 review):
+with N producers the wire was idle N-1/N of the time and deserialization
+serialized behind the network.
+
+Shape here:
+
+* one **puller thread per producer location**, each long-polling
+  `GET /v1/task/{id}/results/{buffer}/{token}?max_bytes=B` — the worker
+  packs as many already-produced pages as fit the `max_response_bytes`
+  budget into one response (the `exchange.max-response-size` analog);
+* a **bounded staging deque** (bytes-bounded) between pullers and the
+  consumer: pullers block when staging is full, which stops their pulls,
+  which backpressures the producer's bounded output buffer — end-to-end
+  flow control with no unbounded queue anywhere;
+* pages are **acknowledged as they are staged** (DELETE up to token),
+  freeing producer budget while the consumer is still decoding earlier
+  pages — the ack IS the backpressure release;
+* **deserialization overlaps the network**: the consumer thread decodes
+  while every puller has the next response in flight.
+
+Failure semantics match `_pull_buffer`: upstream failures surface as
+RuntimeError with the upstream cause in the message (the coordinator's
+retry classifier matches on it), annotated with the failing location.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .serde import WireStats, deserialize_page
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+DEFAULT_MAX_RESPONSE_BYTES = _env_int(
+    "PRESTO_TPU_MAX_RESPONSE_BYTES", 8 << 20
+)
+DEFAULT_STAGING_BYTES = _env_int(
+    "PRESTO_TPU_EXCHANGE_STAGING_BYTES", 64 << 20
+)
+DEFAULT_CONCURRENCY = _env_int("PRESTO_TPU_EXCHANGE_CONCURRENCY", 16)
+
+
+class ExchangeError(RuntimeError):
+    """A pull failed. Carries the failing location so the scheduler can
+    attribute the failure (blacklist streaks, query retry)."""
+
+    def __init__(self, message: str, uri: str = "", task_id: str = ""):
+        super().__init__(message)
+        self.uri = uri
+        self.task_id = task_id
+
+
+class ExchangeStats:
+    """Observable pull-side accounting (acceptance: concurrency must be
+    visible, not inferred from timing). `peak_concurrent` is the high
+    water of simultaneously ALIVE pullers; `peak_inflight` counts
+    overlapping HTTP requests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pages = 0
+        self.wire_bytes = 0
+        self.responses = 0
+        self.pull_s = 0.0
+        self.decode_s = 0.0
+        self.sources = 0
+        self.active = 0
+        self.peak_concurrent = 0
+        self.inflight = 0
+        self.peak_inflight = 0
+        self.by_source: dict = {}
+
+    def puller_started(self) -> None:
+        with self._lock:
+            self.active += 1
+            self.peak_concurrent = max(self.peak_concurrent, self.active)
+
+    def puller_finished(self) -> None:
+        with self._lock:
+            self.active -= 1
+
+    def request_started(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.peak_inflight = max(self.peak_inflight, self.inflight)
+
+    def request_finished(self, seconds: float) -> None:
+        with self._lock:
+            self.inflight -= 1
+            self.responses += 1
+            self.pull_s += seconds
+
+    def pages_staged(self, source: str, count: int, nbytes: int) -> None:
+        with self._lock:
+            self.pages += count
+            self.wire_bytes += nbytes
+            self.by_source[source] = self.by_source.get(source, 0) + count
+
+    def page_decoded(self, seconds: float) -> None:
+        with self._lock:
+            self.decode_s += seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "pages": self.pages,
+                "wire_bytes": self.wire_bytes,
+                "responses": self.responses,
+                "sources": self.sources,
+                "peak_concurrent": self.peak_concurrent,
+                "peak_inflight": self.peak_inflight,
+                "pull_ms": round(self.pull_s * 1e3, 2),
+                "decode_ms": round(self.decode_s * 1e3, 2),
+                "by_source": dict(self.by_source),
+            }
+
+
+def fetch_pages(
+    uri: str,
+    task_id: str,
+    buffer_id: int,
+    token: int,
+    max_bytes: Optional[int] = None,
+    timeout: float = 300.0,
+) -> Tuple[List[bytes], bool, bool]:
+    """One results request: (pages, complete, ready). ready=False means
+    the producer has nothing at `token` yet (HTTP 503 long-poll miss).
+    Raises RuntimeError with the upstream cause on failure — the message
+    shapes the coordinator's retryable/fatal classification."""
+    url = f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}"
+    if max_bytes:
+        url += f"?max_bytes={int(max_bytes)}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            payload = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 503:
+            return [], False, False
+        # surface the UPSTREAM failure cause (e.g. a low-memory kill),
+        # not a bare HTTP 500 — the coordinator matches on the message
+        # (reference: HttpPageBufferClient propagates the task error)
+        try:
+            detail = json.loads(e.read()).get("error") or str(e)
+        except Exception:  # noqa: BLE001
+            detail = str(e)
+        raise ExchangeError(
+            f"upstream task {task_id} on {uri} results fetch "
+            f"failed: {detail}",
+            uri=uri, task_id=task_id,
+        ) from None
+    except (urllib.error.URLError, ConnectionError, OSError) as e:
+        # a worker dying mid-stream must surface as a RETRYABLE
+        # RuntimeError (the query-level retry contract), never as a
+        # raw URLError that escapes the scheduler's retry handler
+        raise ExchangeError(
+            f"upstream task {task_id} on {uri} connection lost "
+            f"mid-stream: {e}",
+            uri=uri, task_id=task_id,
+        ) from None
+    if payload.get("pages") is not None:
+        pages = [base64.b64decode(p) for p in payload["pages"]]
+    elif payload.get("page"):
+        pages = [base64.b64decode(payload["page"])]
+    else:
+        pages = []
+    # an old worker answers without "pages"; an empty single-page answer
+    # with complete unset means long-poll timed out server-side
+    ready = bool(pages) or bool(payload.get("complete", not pages))
+    return pages, bool(payload.get("complete", not pages)), ready
+
+
+def ack_pages(uri: str, task_id: str, buffer_id: int, upto_token: int) -> None:
+    """Acknowledge pages [0, upto_token) — frees the producer's bounded
+    buffer budget. Advisory: a lost ack only delays the free."""
+    try:
+        req = urllib.request.Request(
+            f"{uri}/v1/task/{task_id}/results/{buffer_id}/{upto_token}",
+            method="DELETE",
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+    except Exception:  # noqa: BLE001 - ack is advisory
+        pass
+
+
+def _page_nbytes(page) -> int:
+    """Decoded footprint of a Page: every array a Block carries."""
+    total = 0
+    stack = list(getattr(page, "blocks", ()))
+    while stack:
+        b = stack.pop()
+        for arr in (b.data, b.valid, b.lengths, b.elem_valid):
+            if arr is not None:
+                total += arr.size * arr.dtype.itemsize
+        if b.key_block is not None:
+            stack.append(b.key_block)
+    return total
+
+
+class ExchangeClient:
+    """Concurrent pull over a set of producer buffer locations.
+
+    `locations` is a sequence of (uri, task_id, buffer_id). `pages()`
+    yields deserialized Pages in ARRIVAL order — per-location token order
+    is preserved, interleaving across locations is whatever the network
+    delivers (exchange consumers are order-insensitive across producers,
+    exactly like the reference's ExchangeOperator)."""
+
+    def __init__(
+        self,
+        locations: Sequence[Tuple[str, str, int]],
+        ack: bool = True,
+        max_response_bytes: Optional[int] = None,
+        staging_bytes: Optional[int] = None,
+        deadline: Optional[float] = None,
+        concurrency: Optional[int] = None,
+        stats: Optional[ExchangeStats] = None,
+        wire_stats: Optional[WireStats] = None,
+        decode: Optional[Callable] = None,
+        decode_in_pullers: bool = True,
+    ):
+        self.locations = list(locations)
+        self.ack = ack
+        self.max_response_bytes = (
+            DEFAULT_MAX_RESPONSE_BYTES
+            if max_response_bytes is None
+            else max_response_bytes
+        )
+        self.staging_bytes = (
+            DEFAULT_STAGING_BYTES if staging_bytes is None else staging_bytes
+        )
+        if deadline is None:
+            deadline = float(
+                os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600")
+            )
+        self.deadline = deadline
+        self.concurrency = max(
+            1, DEFAULT_CONCURRENCY if concurrency is None else concurrency
+        )
+        self.stats = stats or ExchangeStats()
+        self.stats.sources += len(self.locations)  # additive: one stats
+        # object may span several clients (a task with many sources)
+        # decode on the puller threads: deserialization parallelizes
+        # across producers AND overlaps the consumer (numpy/stripe
+        # decompression release the GIL). Off = stage raw bytes and
+        # decode lazily on the consumer thread.
+        self.decode_in_pullers = decode_in_pullers
+        self.wire_stats = wire_stats
+        self._decode = decode or deserialize_page
+        self._cond = threading.Condition()
+        self._staged: deque = deque()  # (loc_index, bytes)
+        self._staged_bytes = 0
+        self._done = 0
+        self._error: Optional[ExchangeError] = None
+        self._stop = threading.Event()
+        self._sem = threading.Semaphore(self.concurrency)
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    # -- pull side --
+
+    def _stage(self, idx: int, pages: List[bytes]) -> None:
+        # page-at-a-time: decode (outside the lock), then admit against
+        # the staging budget accounted at DECODED size — the light-weight
+        # encodings routinely achieve 5-50x ratios, so bounding by wire
+        # bytes while holding decoded Pages would amplify the bound by
+        # the compression ratio. A blocked puller holds at most the one
+        # page it just decoded.
+        for p in pages:
+            dec = None
+            nbytes = len(p)
+            if self.decode_in_pullers:
+                t0 = time.perf_counter()
+                dec = self._decode(p)
+                dt = time.perf_counter() - t0
+                self.stats.page_decoded(dt)
+                if self.wire_stats is not None:
+                    self.wire_stats.record_decode(len(p), dt)
+                nbytes = max(nbytes, _page_nbytes(dec))
+            with self._cond:
+                # bounded staging: block while full (unless empty — one
+                # page must always be admissible or nothing moves)
+                while (
+                    self._staged_bytes + nbytes > self.staging_bytes
+                    and self._staged
+                    and not self._stop.is_set()
+                ):
+                    self._cond.wait(timeout=0.1)
+                if self._stop.is_set():
+                    return
+                self._staged.append((idx, p, dec, nbytes))
+                self._staged_bytes += nbytes
+                self._cond.notify_all()
+        uri, task, _buf = self.locations[idx]
+        self.stats.pages_staged(
+            f"{uri}/{task}", len(pages), sum(len(p) for p in pages)
+        )
+
+    def _pull_one(self, idx: int) -> None:
+        uri, task, buf = self.locations[idx]
+        token = 0
+        give_up = time.time() + self.deadline
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            self.stats.request_started()
+            try:
+                pages, complete, ready = fetch_pages(
+                    uri, task, buf, token,
+                    max_bytes=self.max_response_bytes,
+                )
+            finally:
+                self.stats.request_finished(time.perf_counter() - t0)
+            if pages:
+                token += len(pages)
+                self._stage(idx, pages)
+                # ack AFTER staging admitted the bytes: the bounded
+                # staging deque is the consumer-side half of the
+                # backpressure loop, the ack releases the producer half
+                if self.ack:
+                    ack_pages(uri, task, buf, token)
+                give_up = time.time() + self.deadline  # progress
+            if complete:
+                return
+            if not ready and not pages:
+                # `deadline` caps the wall time between PAGES (a progress
+                # deadline): a wedged producer (RUNNING forever,
+                # producing nothing) must fail the pull — retryably —
+                # instead of hanging its consumer forever
+                if time.time() >= give_up:
+                    raise ExchangeError(
+                        f"upstream task {task} on {uri} produced no page "
+                        f"within the {self.deadline:.0f}s task deadline "
+                        "(wedged worker?)",
+                        uri=uri, task_id=task,
+                    )
+
+    def _run_puller(self, idx: int) -> None:
+        with self._sem:  # bound total concurrent pullers
+            self.stats.puller_started()
+            try:
+                self._pull_one(idx)
+            except ExchangeError as e:
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+                    self._cond.notify_all()
+            except Exception as e:  # noqa: BLE001 - never die silently
+                uri, task, _b = self.locations[idx]
+                with self._cond:
+                    if self._error is None:
+                        self._error = ExchangeError(
+                            f"upstream task {task} on {uri} pull failed: "
+                            f"{e!r}",
+                            uri=uri, task_id=task,
+                        )
+                    self._cond.notify_all()
+            finally:
+                self.stats.puller_finished()
+                with self._cond:
+                    self._done += 1
+                    self._cond.notify_all()
+
+    def start(self) -> "ExchangeClient":
+        if self._started:
+            return self
+        self._started = True
+        for i in range(len(self.locations)):
+            t = threading.Thread(
+                target=self._run_puller, args=(i,), daemon=True,
+                name=f"ptpu-pull-{i}",
+            )
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- consume side --
+
+    def _drain(self):
+        """Yield staged (location_index, bytes, decoded-or-None) in
+        arrival order. Raises the first puller failure once staged pages
+        drain — pages already pulled are still delivered (acked)."""
+        self.start()
+        try:
+            while True:
+                with self._cond:
+                    while (
+                        not self._staged
+                        and self._error is None
+                        and self._done < len(self.locations)
+                    ):
+                        self._cond.wait(timeout=0.5)
+                    if self._staged:
+                        idx, data, dec, nbytes = self._staged.popleft()
+                        self._staged_bytes -= nbytes
+                        self._cond.notify_all()
+                    elif self._error is not None:
+                        raise self._error
+                    else:
+                        return
+                yield idx, data, dec
+        finally:
+            self.close()
+
+    def raw_pages(self):
+        """Yield (location_index, serialized_page_bytes) in arrival
+        order."""
+        for idx, data, _dec in self._drain():
+            yield idx, data
+
+    def pages(self):
+        """Yield deserialized Pages. With decode_in_pullers (default)
+        pages arrive pre-decoded — deserialization ran concurrently on
+        the puller threads, overlapped with in-flight pulls; otherwise
+        the consumer decodes while pullers fetch ahead."""
+        for _idx, data, dec in self._drain():
+            if dec is not None:
+                yield dec
+                continue
+            t0 = time.perf_counter()
+            page = self._decode(data)
+            dt = time.perf_counter() - t0
+            self.stats.page_decoded(dt)
+            if self.wire_stats is not None:
+                self.wire_stats.record_decode(len(data), dt)
+            yield page

@@ -4,10 +4,30 @@ Re-designed equivalent of the reference's SerializedPage + PagesSerde
 (presto-main/.../execution/buffer/PagesSerde.java:39 — block-encoded
 binary pages with optional LZ4). TPU-first differences: blocks are
 fixed-width numpy arrays, so the encoding is a small JSON header (schema,
-types, dictionary payloads) + raw little-endian column buffers,
-compressed with the native C++ LZ4 block codec (presto_tpu/native/ —
-the same codec role as airlift's aircompressor LZ4), falling back to
-stdlib zlib where no toolchain exists, or raw for incompressible pages.
+types, dictionary payloads) + column buffers, compressed with the native
+C++ LZ4 block codec (presto_tpu/native/ — the same codec role as
+airlift's aircompressor LZ4), falling back to stdlib zlib where no
+toolchain exists, or raw for incompressible pages.
+
+Wire format v2 (magic ``PTP2``) adds two layers the reference keeps in
+its block encodings + PagesSerde framing:
+
+* **Light-weight columnar encodings** chosen per buffer from cheap
+  vectorized stats BEFORE the general codec (the analog of the
+  reference's RunLengthEncodedBlock / DictionaryBlock / int packing):
+  constant blocks, run-length encoding, dictionary encoding for low-NDV
+  integer buffers, zigzag delta + byte-width packing for integer/date
+  buffers, offset + byte-width packing, and bit-packed null bitmaps
+  (``np.packbits``). Each shrinks the bytes LZ4/zstd has to chew, which
+  is where the serialize wall time goes.
+* **Striped parallel compression**: the raw body is split into fixed
+  stripes compressed concurrently on a shared thread pool (the native
+  LZ4 codec, zlib and zstd all release the GIL), with a framed stripe
+  header so the receiving side decompresses concurrently too.
+
+v1 frames (magic ``PTP1``) are still produced when a peer negotiates
+down (see `negotiate`) and always decodable, so mixed fleets keep
+working mid-upgrade.
 
 Pages on the pull-based exchange path are SELF-CONTAINED: dictionaries
 ship with every page (buffers are produced before their consumers are
@@ -21,8 +41,11 @@ from __future__ import annotations
 
 import io
 import json
+import os
+import threading
+import time
 import zlib
-from typing import Dict, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -30,11 +53,29 @@ from .. import types as T
 from ..page import Block, Page, dictionary_by_id, intern_dictionary
 
 _MAGIC = b"PTP1"
+_MAGIC2 = b"PTP2"
+WIRE_VERSION = 2
 
 # absolute cap on one deserialized wire page (untrusted input bound; the
 # exchange sends pages far smaller than this — it exists so a corrupt or
 # malicious header/stream cannot demand unbounded memory)
 MAX_PAGE_BYTES = 1 << 30
+# stripe-count bound: a corrupt v2 frame cannot demand an absurd header
+MAX_STRIPES = 1 << 16
+
+# knobs (docs/tuning.md "Exchange and wire format")
+_STRIPE_BYTES = max(
+    int(os.environ.get("PRESTO_TPU_STRIPE_BYTES", str(1 << 20))), 64 << 10
+)
+_ENCODINGS_ON = os.environ.get("PRESTO_TPU_ENCODINGS", "1") != "0"
+_FORCE_V1 = os.environ.get("PRESTO_TPU_WIRE_V1", "0") == "1"
+# skip the general codec when encodings already shrank the body below
+# this fraction of the logical bytes (compress-once: delta/dict packed
+# buffers are near-incompressible, so the codec pass would cost wall
+# time for single-digit-% wins)
+_SKIP_CODEC_RATIO = float(
+    os.environ.get("PRESTO_TPU_ENCODED_SKIP_CODEC", "0.55")
+)
 
 # zstd (codec 3) is optional: gate on import so the serde stays
 # dependency-free where the wheel is absent. (De)compressor objects are
@@ -67,6 +108,612 @@ except Exception:  # noqa: BLE001
     _zstd_c = _zstd_d = None
 
 
+# ---------------------------------------------------------------------------
+# capability negotiation (the exchange.max-response-size era's analog of
+# the Accept header: mixed fleets must agree on a wire format instead of
+# failing at deserialize — ADVICE round-5)
+# ---------------------------------------------------------------------------
+
+# the codec set ANY peer can decode without optional wheels or a
+# toolchain: codec-2 LZ4 has a pure-python decode fallback, zlib and raw
+# are stdlib. Used when a peer advertises nothing (old build).
+_BASELINE_CODECS = ("lz4", "zlib", "raw")
+_CODEC_PREFERENCE = ("zstd", "lz4", "zlib", "raw")
+
+
+def local_capabilities() -> dict:
+    """Codecs + wire version THIS process can decode, advertised through
+    the worker /v1/status handshake."""
+    codecs = (["zstd"] if _zstd_d is not None else []) + list(_BASELINE_CODECS)
+    return {
+        "version": 1 if _FORCE_V1 else WIRE_VERSION,
+        "codecs": codecs,
+    }
+
+
+def baseline_capabilities() -> dict:
+    """The wire format EVERY build (past or present) can decode: v1
+    frames + the stdlib/pure-python codec floor. The right assumption
+    for a consumer that did not negotiate (e.g. a task spec posted by an
+    old coordinator without a \"wire\" field)."""
+    return {"version": 1, "codecs": list(_BASELINE_CODECS)}
+
+
+def negotiate(peer_caps: Sequence[Optional[dict]]) -> dict:
+    """Intersect this process's capabilities with every peer's advertised
+    set. A peer that advertises nothing (None — an old build, or a status
+    probe that failed) degrades the fleet to wire v1 + baseline codecs,
+    so the exchange keeps flowing instead of failing on deserialize."""
+    caps = local_capabilities()
+    version = caps["version"]
+    codecs = set(caps["codecs"])
+    for pc in peer_caps:
+        if not isinstance(pc, dict):
+            version = 1
+            codecs &= set(_BASELINE_CODECS)
+            continue
+        version = min(version, int(pc.get("version", 1)))
+        codecs &= set(pc.get("codecs", _BASELINE_CODECS))
+    codecs.add("raw")  # raw is the universal floor
+    return {
+        "version": max(version, 1),
+        "codecs": [c for c in _CODEC_PREFERENCE if c in codecs],
+    }
+
+
+# ---------------------------------------------------------------------------
+# wire stats (EXPLAIN ANALYZE / scheduler observability)
+# ---------------------------------------------------------------------------
+
+
+class WireStats:
+    """Thread-safe encode/decode accounting for one exchange endpoint
+    (a task's output serializer, a pull client's decoder). `raw_bytes`
+    is the logical (pre-encoding) buffer size, so wire_bytes/raw_bytes
+    is the end-to-end compression ratio the wire achieved."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pages = 0
+        self.raw_bytes = 0
+        self.wire_bytes = 0  # ENCODE-side bytes put on the wire
+        self.decoded_pages = 0
+        self.decoded_bytes = 0  # DECODE-side bytes read off the wire —
+        # kept separate so a process that both serializes and
+        # deserializes (every worker) never double-counts wire traffic
+        # or halves its compression ratio
+        self.encode_s = 0.0
+        self.decode_s = 0.0
+        self.encodings: Dict[str, int] = {}
+
+    def record_encode(self, raw: int, wire: int, seconds: float,
+                      encodings: Optional[Sequence[str]] = None) -> None:
+        with self._lock:
+            self.pages += 1
+            self.raw_bytes += raw
+            self.wire_bytes += wire
+            self.encode_s += seconds
+            for e in encodings or ():
+                self.encodings[e] = self.encodings.get(e, 0) + 1
+
+    def record_decode(self, wire: int, seconds: float) -> None:
+        with self._lock:
+            self.decoded_pages += 1
+            self.decoded_bytes += wire
+            self.decode_s += seconds
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold a peer's snapshot() (e.g. a worker's status-reported
+        encode stats) into this accumulator."""
+        with self._lock:
+            self.pages += snap.get("pages") or 0
+            self.raw_bytes += snap.get("raw_bytes") or 0
+            self.wire_bytes += snap.get("wire_bytes") or 0
+            self.decoded_pages += snap.get("decoded_pages") or 0
+            self.decoded_bytes += snap.get("decoded_bytes") or 0
+            self.encode_s += (snap.get("encode_ms") or 0) / 1e3
+            self.decode_s += (snap.get("decode_ms") or 0) / 1e3
+            for k, v in (snap.get("encodings") or {}).items():
+                self.encodings[k] = self.encodings.get(k, 0) + v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            ratio = (
+                round(self.raw_bytes / self.wire_bytes, 2)
+                if self.wire_bytes and self.raw_bytes
+                else None
+            )
+            return {
+                "pages": self.pages,
+                "raw_bytes": self.raw_bytes,
+                "wire_bytes": self.wire_bytes,
+                "decoded_pages": self.decoded_pages,
+                "decoded_bytes": self.decoded_bytes,
+                "encode_ms": round(self.encode_s * 1e3, 2),
+                "decode_ms": round(self.decode_s * 1e3, 2),
+                "compression_ratio": ratio,
+                "encodings": dict(self.encodings),
+            }
+
+
+# process-wide accumulator (benchmark drivers snapshot deltas around a
+# query to report per-query wire traffic; zero on paths that never
+# serialize, e.g. single-process ICI execution)
+GLOBAL_WIRE_STATS = WireStats()
+
+
+# ---------------------------------------------------------------------------
+# striped parallel compression
+# ---------------------------------------------------------------------------
+
+_pool_lock = threading.Lock()
+_pool = None
+_pool_unavailable = False
+
+
+def _stripe_pool():
+    """Shared worker pool for stripe (de)compression. The native LZ4
+    ctypes calls, zlib and zstd all release the GIL, so stripes genuinely
+    overlap. None on single-core boxes (striping still frames, the work
+    just runs inline)."""
+    global _pool, _pool_unavailable
+    if _pool is not None or _pool_unavailable:
+        return _pool
+    with _pool_lock:
+        if _pool is None and not _pool_unavailable:
+            workers = min(os.cpu_count() or 1, 8)
+            if workers < 2:
+                _pool_unavailable = True
+                return None
+            from concurrent.futures import ThreadPoolExecutor
+
+            _pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="ptpu-stripe"
+            )
+    return _pool
+
+
+_CODEC_IDS = {"raw": 0, "zlib": 1, "lz4": 2, "zstd": 3}
+
+
+def _pick_codec(caps: Optional[dict]) -> str:
+    """First codec this process can ENCODE that every negotiated peer can
+    decode. (Decode support is wider than encode support: lz4 decode has
+    a pure-python fallback, but compression needs the native library.)"""
+    allowed = (caps or local_capabilities()).get("codecs") or _BASELINE_CODECS
+    from .. import native
+
+    for c in _CODEC_PREFERENCE:
+        if c not in allowed:
+            continue
+        if c == "zstd" and _zstd_c is None:
+            continue
+        if c == "lz4" and not native.available():
+            continue
+        return c
+    return "raw"
+
+
+def _compress_one(codec: str, data: bytes) -> bytes:
+    if codec == "zstd":
+        return _zstd_compress(data)
+    if codec == "lz4":
+        from .. import native
+
+        return native.lz4_compress(data)
+    if codec == "zlib":
+        return zlib.compress(data, 1)
+    return data
+
+
+def _decompress_one(codec: str, blob: bytes, orig: int) -> bytes:
+    if codec == "zstd":
+        if _zstd_d is None:
+            raise ValueError("zstd page received but zstandard missing")
+        return _zstd_decompress(blob, orig)
+    if codec == "lz4":
+        from .. import native
+
+        return native.lz4_decompress(blob, orig)
+    if codec == "zlib":
+        d = zlib.decompressobj()
+        out = d.decompress(blob, orig)
+        if d.unconsumed_tail or len(out) != orig:
+            raise ValueError("zlib stripe inflated to an unexpected size")
+        return out
+    return blob
+
+
+def _frame_v2(raw: bytes, codec: str) -> bytes:
+    """PTP2 | codec u8 | nstripes u32 | (orig u32, comp u32)* | blobs.
+
+    Stripes compress concurrently on the shared pool; if the compressed
+    total is not smaller than the input the frame degrades to one raw
+    stripe (incompressible page)."""
+    n = len(raw)
+    if codec != "raw" and n > 0:
+        view = memoryview(raw)
+        stripes = [
+            bytes(view[i : i + _STRIPE_BYTES])
+            for i in range(0, n, _STRIPE_BYTES)
+        ]
+        pool = _stripe_pool()
+        if pool is not None and len(stripes) > 1:
+            blobs = list(pool.map(lambda s: _compress_one(codec, s), stripes))
+        else:
+            blobs = [_compress_one(codec, s) for s in stripes]
+        if sum(len(b) for b in blobs) < n:
+            out = io.BytesIO()
+            out.write(_MAGIC2)
+            out.write(bytes([_CODEC_IDS[codec]]))
+            out.write(len(stripes).to_bytes(4, "little"))
+            for s, b in zip(stripes, blobs):
+                out.write(len(s).to_bytes(4, "little"))
+                out.write(len(b).to_bytes(4, "little"))
+            for b in blobs:
+                out.write(b)
+            return out.getvalue()
+    return (
+        _MAGIC2
+        + b"\x00"
+        + (1).to_bytes(4, "little")
+        + len(raw).to_bytes(4, "little")
+        + len(raw).to_bytes(4, "little")
+        + raw
+    )
+
+
+def _deframe_v2(data: bytes) -> bytes:
+    """Parse + validate a PTP2 stripe frame, decompressing stripes
+    concurrently. Every field is untrusted wire input: stripe counts and
+    sizes are bounded BEFORE any allocation."""
+    codec_id = data[4]
+    codec = {v: k for k, v in _CODEC_IDS.items()}.get(codec_id)
+    if codec is None:
+        raise ValueError(f"unknown page codec {codec_id}")
+    nstripes = int.from_bytes(data[5:9], "little")
+    if not 1 <= nstripes <= MAX_STRIPES:
+        raise ValueError(f"implausible stripe count {nstripes}")
+    head_end = 9 + 8 * nstripes
+    if len(data) < head_end:
+        raise ValueError("truncated stripe header")
+    origs: List[int] = []
+    comps: List[int] = []
+    total_orig = 0
+    for i in range(nstripes):
+        o = int.from_bytes(data[9 + 8 * i : 13 + 8 * i], "little")
+        c = int.from_bytes(data[13 + 8 * i : 17 + 8 * i], "little")
+        total_orig += o
+        if total_orig > MAX_PAGE_BYTES:
+            raise ValueError(
+                f"stripe header declares more than the {MAX_PAGE_BYTES}-byte "
+                "page cap"
+            )
+        # LZ4/zlib/zstd block expansion is far below 256x; a corrupt
+        # header cannot demand an implausible inflation
+        if codec != "raw" and o > max(256 * max(c, 1), 1 << 12):
+            raise ValueError(
+                f"stripe {i} declares implausible size {o} for {c} "
+                "compressed bytes"
+            )
+        origs.append(o)
+        comps.append(c)
+    if len(data) - head_end != sum(comps):
+        raise ValueError("stripe payload length mismatch")
+    view = memoryview(data)
+    blobs = []
+    off = head_end
+    for c in comps:
+        blobs.append(bytes(view[off : off + c]))
+        off += c
+    pool = _stripe_pool()
+    if codec == "raw":
+        parts = blobs
+    elif pool is not None and nstripes > 1:
+        parts = list(
+            pool.map(lambda t: _decompress_one(codec, t[0], t[1]),
+                     zip(blobs, origs))
+        )
+    else:
+        parts = [_decompress_one(codec, b, o) for b, o in zip(blobs, origs)]
+    for p, o in zip(parts, origs):
+        if len(p) != o:
+            raise ValueError("stripe inflated to an unexpected size")
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# light-weight columnar encodings
+# ---------------------------------------------------------------------------
+
+
+def _width_dtype(maxval: int) -> np.dtype:
+    if maxval < (1 << 8):
+        return np.dtype("<u1")
+    if maxval < (1 << 16):
+        return np.dtype("<u2")
+    if maxval < (1 << 32):
+        return np.dtype("<u4")
+    return np.dtype("<u8")
+
+
+def _to_u64(flat: np.ndarray) -> np.ndarray:
+    """View/convert any integer array into the modular uint64 domain
+    (sign-extended), where offset/delta arithmetic is exact for every
+    input — including full-range int64."""
+    if flat.dtype == np.uint64:
+        return flat
+    if flat.dtype == np.int64:
+        return flat.view(np.uint64)
+    return flat.astype(np.int64).view(np.uint64)
+
+
+def _from_u64(u: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    """Inverse of `_to_u64`: modular cast back to the original dtype
+    (two's-complement truncation — exact because the encoded values fit)."""
+    if dtype == np.uint64:
+        return u
+    if np.dtype(dtype).kind == "i":
+        return u.astype(np.uint64).view(np.int64).astype(dtype)
+    return u.astype(dtype)
+
+
+_DICT_RANGE_MAX = 1 << 16  # bincount-based NDV probe stays O(n) + small
+
+
+def _signed_width(lo: int, hi: int) -> int:
+    """Smallest byte width whose SIGNED range holds [lo, hi]."""
+    for w in (1, 2, 4):
+        if -(1 << (8 * w - 1)) <= lo and hi < (1 << (8 * w - 1)):
+            return w
+    return 8
+
+
+def _encode_array(arr: np.ndarray):
+    """(encoding descriptor | None, payload ndarray-or-bytes). None means
+    raw C-order bytes.
+
+    Encodings are chosen by exact byte cost from one cheap vectorized
+    stats pass (min/max, modular deltas, bincount NDV for small ranges) —
+    the per-column analog of the reference's block encodings
+    (RunLengthEncodedBlock, DictionaryBlock, int packing), applied on the
+    wire where this engine's device pages are plain fixed-width arrays.
+    All integer arithmetic runs modulo 2^64 on both ends, so truncation
+    is exact for every input including full-range int64. The hot path is
+    deliberately few-pass: one reduction pair for min/max, one diff, one
+    truncating store — serialize wall time IS this function."""
+    a = np.ascontiguousarray(arr)
+    n = a.size
+    if n < 64 or not _ENCODINGS_ON:
+        return None, a
+    if a.dtype == np.bool_:
+        # bit-packed bitmap: 8x smaller before the codec ever runs
+        return {"k": "bits"}, np.packbits(a.reshape(-1))
+    kind = a.dtype.kind
+    if kind == "f":
+        # floats: constant detection only (bitwise, NaN-safe)
+        bits = a.view(_width_dtype((1 << (8 * a.dtype.itemsize)) - 1)).reshape(-1)
+        if bits.min() == bits.max():
+            return {"k": "const"}, a.reshape(-1)[:1]
+        return None, a
+    if kind not in "iu":
+        return None, a
+
+    # integer lanes: multi-dim arrays (decimal limbs, collection widths)
+    # encode lane-contiguous (Fortran flatten) so deltas run down a lane
+    fortran = a.ndim > 1
+    flat = np.ascontiguousarray(a.T).reshape(-1) if fortran else a.reshape(-1)
+    mn_s, mx_s = int(flat.min()), int(flat.max())
+    base = {"F": 1} if fortran else {}
+    if mn_s == mx_s:
+        return {"k": "const", **base}, flat[:1]
+    u = _to_u64(flat)
+    off_u = np.uint64(mn_s & 0xFFFFFFFFFFFFFFFF)
+    rng = mx_s - mn_s  # exact python int — never overflows
+    off_dt = _width_dtype(rng)
+    itemsize = a.dtype.itemsize
+    best_kind = None
+    best_cost = n * itemsize  # raw
+    if off_dt.itemsize < itemsize:
+        best_kind, best_cost = "off", n * off_dt.itemsize
+
+    # probe delta/RLE/NDV stats on contiguous sample chunks first: the
+    # full-array diff/bincount temporaries are the expensive part of a
+    # serialize (multi-MB allocations), so they only run when the sample
+    # says the encoding can plausibly win. The probe only GATES — every
+    # chosen encoding is verified on exact full-array stats below.
+    if n > 65536:
+        step = n // 8
+        chunks = [u[i * step : i * step + 512] for i in range(8)]
+        dsamp = np.concatenate([np.diff(c) for c in chunks])
+        ssamp = np.concatenate(chunks)
+    else:
+        dsamp = np.diff(u)
+        ssamp = u
+    dss = dsamp.view(np.int64)
+    dw_est = _signed_width(int(dss.min()), int(dss.max())) if dss.size else 1
+    run_frac = (
+        np.count_nonzero(dsamp) / dsamp.size if dsamp.size else 1.0
+    )
+
+    # modular delta, stored sign-truncated: sorted/clustered ints (keys,
+    # dates, row ids) shrink to their STEP width
+    d = dw = None
+    nruns = None
+    probe_delta = itemsize + n * dw_est < best_cost
+    probe_rle = run_frac < 0.25
+    if (probe_delta or probe_rle) and n > 1:
+        d = np.diff(u)
+        ds = d.view(np.int64)
+        dw = _signed_width(int(ds.min()), int(ds.max()))
+        delta_cost = itemsize + ds.size * dw
+        if delta_cost < best_cost:
+            best_kind, best_cost = "delta", delta_cost
+        # run-length: few runs of repeated values (sorted keys, flags)
+        nruns = int(np.count_nonzero(d)) + 1
+        if nruns * 4 < n:
+            run_dt = _width_dtype(n)
+            rle_cost = nruns * (off_dt.itemsize + run_dt.itemsize)
+            if rle_cost < best_cost:
+                best_kind, best_cost = "rle", rle_cost
+
+    # dictionary: low NDV over a bounded range (bincount keeps the NDV
+    # probe O(n) — wide-range low-NDV columns fall through to delta/raw).
+    # Gate on the sampled NDV so high-NDV columns skip the code build.
+    counts = vals = None
+    if (
+        rng <= _DICT_RANGE_MAX
+        and off_dt.itemsize > 1
+        and np.unique(ssamp).size <= 512
+    ):
+        vals = np.subtract(u, off_u, dtype=np.int64, casting="unsafe")
+        counts = np.bincount(vals, minlength=rng + 1)
+        nu = int(np.count_nonzero(counts))
+        code_dt = _width_dtype(max(nu - 1, 0))
+        dict_cost = nu * off_dt.itemsize + n * code_dt.itemsize
+        if dict_cost < best_cost:
+            best_kind, best_cost = "dict", dict_cost
+
+    if best_kind is None:
+        return None, a  # raw keeps C order (the no-descriptor contract)
+    if best_kind == "off":
+        # modular homomorphism: truncate-then-subtract == subtract-then-
+        # truncate, so the whole encode is ONE casting ufunc pass
+        vals = np.subtract(u, off_u, dtype=off_dt, casting="unsafe")
+        return {"k": "off", "o": mn_s, "w": off_dt.itemsize, **base}, vals
+    if best_kind == "delta":
+        return (
+            {"k": "delta", "f": int(flat[0]), "w": dw, **base},
+            d.astype(_u_dt(dw)),  # modular truncate; exact by width check
+        )
+    if best_kind == "rle":
+        run_dt = _width_dtype(n)
+        starts = np.concatenate([np.zeros(1, np.int64), np.flatnonzero(d) + 1])
+        lengths = np.diff(np.append(starts, n)).astype(run_dt)
+        rvals = (u[starts] - off_u).astype(off_dt)
+        return (
+            {"k": "rle", "o": mn_s, "w": off_dt.itemsize,
+             "rw": run_dt.itemsize, "nr": nruns, **base},
+            rvals.tobytes() + lengths.tobytes(),
+        )
+    # dict
+    code_map = np.cumsum(counts > 0) - 1
+    nu = int(np.count_nonzero(counts))
+    code_dt = _width_dtype(max(nu - 1, 0))
+    codes = code_map[vals].astype(code_dt)
+    uniq = np.flatnonzero(counts).astype(off_dt)
+    return (
+        {"k": "dict", "o": mn_s, "w": off_dt.itemsize,
+         "cw": code_dt.itemsize, "nu": nu, **base},
+        uniq.tobytes() + codes.tobytes(),
+    )
+
+
+def _u_dt(width: int) -> np.dtype:
+    return {1: np.dtype("<u1"), 2: np.dtype("<u2"),
+            4: np.dtype("<u4"), 8: np.dtype("<u8")}[int(width)]
+
+
+def _decode_array(desc: Optional[dict], buf, dtype: np.dtype,
+                  shape: Sequence[int],
+                  budget: Optional[dict] = None) -> np.ndarray:
+    """Inverse of `_encode_array`. `buf` and the header-declared shape
+    are untrusted wire input: frombuffer raises on short payloads, and
+    the MATERIALIZED size is bounded — const/rle/dict expand beyond the
+    (stripe-bounded) wire bytes, so a corrupt header must not be able to
+    demand a huge allocation. `budget` ({"left": bytes}) caps the whole
+    page cumulatively across its columns."""
+    dtype = np.dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    n = 1
+    for s in shape:
+        n *= s
+    nbytes = n * dtype.itemsize
+    if n < 0 or nbytes > MAX_PAGE_BYTES:
+        raise ValueError(
+            f"column declares {n} elements ({nbytes} bytes), "
+            f"past the {MAX_PAGE_BYTES}-byte page cap"
+        )
+    if budget is not None:
+        budget["left"] -= nbytes
+        if budget["left"] < 0:
+            raise ValueError(
+                f"page columns declare more than the {MAX_PAGE_BYTES}-byte "
+                "page cap in total"
+            )
+    if desc is None:
+        arr = np.frombuffer(buf, dtype=dtype, count=n)
+        return arr.reshape(shape)
+    k = desc.get("k")
+    fortran = bool(desc.get("F"))
+
+    def out_shape(flat):
+        if fortran:
+            return flat.reshape(tuple(reversed(shape))).T
+        return flat.reshape(shape)
+
+    if k == "bits":
+        flat = np.unpackbits(
+            np.frombuffer(buf, dtype=np.uint8), count=n
+        ).astype(np.bool_)
+        return flat.reshape(shape)
+    if k == "const":
+        v = np.frombuffer(buf, dtype=dtype, count=1)
+        return out_shape(np.broadcast_to(v, (n,)).copy())
+    if k == "off":
+        vals = np.frombuffer(buf, dtype=_u_dt(desc["w"]), count=n)
+        u = vals.astype(np.uint64) + np.uint64(int(desc["o"]) & 0xFFFFFFFFFFFFFFFF)
+        return out_shape(_from_u64(u, dtype))
+    if k == "delta":
+        if n == 0:
+            return out_shape(np.zeros(0, dtype))
+        w = int(desc["w"])
+        st = np.frombuffer(buf, dtype=_u_dt(w), count=max(n - 1, 0))
+        sdt = {1: np.int8, 2: np.int16, 4: np.int32, 8: np.int64}[w]
+        # sign-extend the truncated modular deltas, then rebuild the
+        # absolutes by modular cumsum from the first value
+        ds = st.view(sdt).astype(np.int64).view(np.uint64)
+        u = np.empty(n, np.uint64)
+        u[0] = np.uint64(int(desc["f"]) & 0xFFFFFFFFFFFFFFFF)
+        if n > 1:
+            np.cumsum(ds, out=u[1:])
+            u[1:] += u[0]
+        return out_shape(_from_u64(u, dtype))
+    if k == "rle":
+        nr = int(desc["nr"])
+        vw, rw = int(desc["w"]), int(desc["rw"])
+        if nr < 0 or nr > n:
+            raise ValueError(f"implausible run count {nr}")
+        rvals = np.frombuffer(buf, dtype=_u_dt(vw), count=nr, offset=0)
+        lengths = np.frombuffer(
+            buf, dtype=_u_dt(rw), count=nr, offset=nr * vw
+        ).astype(np.int64)
+        if int(lengths.sum()) != n:
+            raise ValueError("run lengths do not cover the buffer")
+        u = np.repeat(
+            rvals.astype(np.uint64)
+            + np.uint64(int(desc["o"]) & 0xFFFFFFFFFFFFFFFF),
+            lengths,
+        )
+        return out_shape(_from_u64(u, dtype))
+    if k == "dict":
+        nu = int(desc["nu"])
+        vw, cw = int(desc["w"]), int(desc["cw"])
+        if nu <= 0 or nu > n:
+            raise ValueError(f"implausible dictionary size {nu}")
+        uniq = np.frombuffer(buf, dtype=_u_dt(vw), count=nu, offset=0)
+        codes = np.frombuffer(
+            buf, dtype=_u_dt(cw), count=n, offset=nu * vw
+        ).astype(np.int64)
+        if codes.size and int(codes.max()) >= nu:
+            raise ValueError("dictionary code out of range")
+        u = uniq.astype(np.uint64)[codes] + np.uint64(
+            int(desc["o"]) & 0xFFFFFFFFFFFFFFFF
+        )
+        return out_shape(_from_u64(u, dtype))
+    raise ValueError(f"unknown buffer encoding {k!r}")
+
+
 def _type_to_wire(t: T.Type) -> str:
     return t.display()
 
@@ -84,20 +731,46 @@ class DictionaryCache:
         self.remote_to_local: Dict[int, int] = {}
 
 
+# ---------------------------------------------------------------------------
+# serialize
+# ---------------------------------------------------------------------------
+
+
 def serialize_page(
-    page: Page, cache: Optional[DictionaryCache] = None, compress: bool = True
+    page: Page,
+    cache: Optional[DictionaryCache] = None,
+    compress: bool = True,
+    caps: Optional[dict] = None,
+    stats: Optional[WireStats] = None,
 ) -> bytes:
-    """Page -> bytes. Live rows only (the wire never carries dead slots)."""
+    """Page -> bytes. Live rows only (the wire never carries dead slots).
+
+    `caps` is the NEGOTIATED capability set for the receiving fleet (see
+    `negotiate`); None means "assume a peer like this process". Version-1
+    peers get the legacy PTP1 frame; v2 peers get per-buffer light-weight
+    encodings + the striped frame."""
+    t0 = time.perf_counter()
+    if caps is None:
+        caps = local_capabilities()
+    v2 = int(caps.get("version", 1)) >= 2 and not _FORCE_V1
     n = int(page.count)
     cols = []
-    buffers = []
+    arrays: List[np.ndarray] = []  # buffers in wire order, pre-encoding
+    fixups = []  # (entry, [array indices]) to fill enc descriptors
     dict_payloads = {}
+    raw_logical = 0
+
+    def push_buffer(arr: np.ndarray) -> int:
+        nonlocal raw_logical
+        raw_logical += arr.nbytes
+        arrays.append(arr)
+        return len(arrays) - 1
 
     def encode_block(name, b):
-        data = np.asarray(b.data[:n])
-        valid = None if b.valid is None else np.asarray(b.valid[:n])
-        lengths = None if b.lengths is None else np.asarray(b.lengths[:n])
-        ev = None if b.elem_valid is None else np.asarray(b.elem_valid[:n])
+        data = np.asarray(b.data)[:n]
+        valid = None if b.valid is None else np.asarray(b.valid)[:n]
+        lengths = None if b.lengths is None else np.asarray(b.lengths)[:n]
+        ev = None if b.elem_valid is None else np.asarray(b.elem_valid)[:n]
         entry = {
             "name": name,
             "type": _type_to_wire(b.type),
@@ -115,64 +788,136 @@ def serialize_page(
                 dict_payloads[str(b.dict_id)] = list(d)
                 if cache is not None:
                     cache.sent.add(b.dict_id)
-        buffers.append(data.tobytes())
+        idxs = [push_buffer(data)]
         if valid is not None:
-            buffers.append(valid.tobytes())
+            idxs.append(push_buffer(valid))
         if lengths is not None:
-            buffers.append(lengths.astype(np.int32).tobytes())
+            idxs.append(push_buffer(lengths.astype(np.int32)))
         if ev is not None:
-            buffers.append(ev.tobytes())
+            idxs.append(push_buffer(ev))
+        fixups.append((entry, idxs))
         if b.key_block is not None:
             entry["key"] = encode_block(f"{name}$keys", b.key_block)
         return entry
 
     for name, b in zip(page.names, page.blocks):
         cols.append(encode_block(name, b))
+
+    encs: List[str] = []
+    if v2:
+        # per-column light-weight encodings, fanned out on the stripe
+        # pool — numpy reductions/casts release the GIL, so columns of
+        # one page encode concurrently like stripes compress
+        pool = _stripe_pool()
+        big = sum(a.nbytes for a in arrays) >= (1 << 20)
+        if pool is not None and big and len(arrays) > 1:
+            encoded = list(pool.map(_encode_array, arrays))
+        else:
+            encoded = [_encode_array(a) for a in arrays]
+        payloads = []
+        descs_by_idx: List[Optional[dict]] = []
+        for desc, payload in encoded:
+            descs_by_idx.append(desc)
+            payloads.append(payload)
+            if desc is not None:
+                encs.append(desc["k"])
+        for entry, idxs in fixups:
+            descs = [descs_by_idx[i] for i in idxs]
+            if any(d is not None for d in descs):
+                entry["enc"] = descs
+    else:
+        payloads = [np.ascontiguousarray(a) for a in arrays]
+
     header = json.dumps(
         {"count": n, "columns": cols, "dictionaries": dict_payloads}
     ).encode()
-    body = io.BytesIO()
-    body.write(len(header).to_bytes(4, "little"))
-    body.write(header)
-    for buf in buffers:
-        body.write(len(buf).to_bytes(8, "little"))
-        body.write(buf)
-    raw = body.getvalue()
-    if not compress:
-        return _MAGIC + b"\x00" + raw
-    # codec preference: zstd level 1 (fastest wire codec available in
-    # this image — ~4x the from-scratch LZ4's throughput on the serde
-    # micro) > native LZ4 (native/lz4.cpp, the aircompressor-analog) >
-    # zlib > raw-if-incompressible. The codec byte keeps old readers'
-    # frames decodable either way.
-    if _zstd_c is not None:
+    parts: List[bytes] = [len(header).to_bytes(4, "little"), header]
+    for buf in payloads:
+        nbytes = buf.nbytes if isinstance(buf, np.ndarray) else len(buf)
+        parts.append(nbytes.to_bytes(8, "little"))
+        parts.append(buf.data if isinstance(buf, np.ndarray) else buf)
+    raw = b"".join(parts)
+    raw_logical += len(header)
+
+    if v2:
+        # compress-once policy: when the encodings already shrank the
+        # body well below the logical bytes, the general codec has little
+        # left to chew — skip it and save its wall time
+        already_compact = len(raw) < raw_logical * _SKIP_CODEC_RATIO
+        codec = (
+            "raw"
+            if not compress or already_compact
+            else _pick_codec(caps)
+        )
+        out = _frame_v2(raw, codec)
+        for s in (stats, GLOBAL_WIRE_STATS):
+            if s is not None:
+                s.record_encode(
+                    raw_logical, len(out), time.perf_counter() - t0, encs
+                )
+        return out
+
+    out = _serialize_v1_tail(raw, caps if compress else {"codecs": ["raw"]})
+    for s in (stats, GLOBAL_WIRE_STATS):
+        if s is not None:
+            s.record_encode(raw_logical, len(out), time.perf_counter() - t0)
+    return out
+
+
+def _serialize_v1_tail(raw: bytes, caps: Optional[dict]) -> bytes:
+    """Legacy PTP1 codec selection over an unencoded body, now bounded by
+    the negotiated codec set (a v1 peer without the zstd wheel must not
+    receive codec 3). The codec byte keeps old readers' frames decodable."""
+    codec = _pick_codec(caps)
+    if codec == "zstd":
         packed = _zstd_compress(raw)
         if len(packed) < len(raw):
             return _MAGIC + b"\x03" + packed
         return _MAGIC + b"\x00" + raw
-    from .. import native
+    if codec == "lz4":
+        from .. import native
 
-    if native.available():
         packed = native.lz4_compress(raw)
         if len(packed) + 8 < len(raw):
-            return (
-                _MAGIC + b"\x02" + len(raw).to_bytes(8, "little") + packed
-            )
+            return _MAGIC + b"\x02" + len(raw).to_bytes(8, "little") + packed
         return _MAGIC + b"\x00" + raw
-    payload = zlib.compress(raw, 1)
-    if len(payload) < len(raw):
-        return _MAGIC + b"\x01" + payload
+    if codec == "zlib":
+        payload = zlib.compress(raw, 1)
+        if len(payload) < len(raw):
+            return _MAGIC + b"\x01" + payload
+        return _MAGIC + b"\x00" + raw
     return _MAGIC + b"\x00" + raw
 
 
+# ---------------------------------------------------------------------------
+# deserialize
+# ---------------------------------------------------------------------------
+
+
 def deserialize_page(
-    data: bytes, cache: Optional[DictionaryCache] = None
+    data: bytes, cache: Optional[DictionaryCache] = None,
+    stats: Optional[WireStats] = None,
 ) -> Page:
-    assert data[:4] == _MAGIC, "bad page magic"
+    t0 = time.perf_counter()
+    magic = data[:4]
+    if magic == _MAGIC2:
+        raw = _deframe_v2(data)
+    elif magic == _MAGIC:
+        raw = _deframe_v1(data)
+    else:
+        raise AssertionError("bad page magic")
+    page = _decode_body(raw, cache)
+    for s in (stats, GLOBAL_WIRE_STATS):
+        if s is not None:
+            s.record_decode(len(data), time.perf_counter() - t0)
+    return page
+
+
+def _deframe_v1(data: bytes) -> bytes:
     codec = data[4]
     if codec == 0:
-        raw = data[5:]
-    elif codec == 1:
+        return data[5:]
+    if codec == 1:
         # untrusted wire input: bound the inflated size (a zlib bomb can
         # expand ~1000x, so a ratio bound would reject legitimately
         # compressible pages — use the absolute page cap instead)
@@ -182,7 +927,8 @@ def deserialize_page(
             raise ValueError(
                 f"zlib page exceeds the {MAX_PAGE_BYTES}-byte page cap"
             )
-    elif codec == 2:
+        return raw
+    if codec == 2:
         from .. import native
 
         orig = int.from_bytes(data[5:13], "little")
@@ -194,14 +940,16 @@ def deserialize_page(
                 f"lz4 page declares implausible size {orig} "
                 f"for {len(data) - 13} compressed bytes"
             )
-        raw = native.lz4_decompress(data[13:], orig)
-    elif codec == 3:
+        return native.lz4_decompress(data[13:], orig)
+    if codec == 3:
         if _zstd_d is None:
             raise ValueError("zstd page received but zstandard missing")
         # untrusted wire input: stream-bound the inflated size like zlib
-        raw = _zstd_decompress(data[5:], MAX_PAGE_BYTES)
-    else:
-        raise ValueError(f"unknown page codec {codec}")
+        return _zstd_decompress(data[5:], MAX_PAGE_BYTES)
+    raise ValueError(f"unknown page codec {codec}")
+
+
+def _decode_body(raw: bytes, cache: Optional[DictionaryCache]) -> Page:
     view = memoryview(raw)
     hlen = int.from_bytes(view[:4], "little")
     header = json.loads(bytes(view[4 : 4 + hlen]))
@@ -218,22 +966,36 @@ def deserialize_page(
     n = header["count"]
     blocks = []
     names = []
+    # cumulative materialization cap across ALL of the page's buffers
+    # (per-column checks alone would let a many-column corrupt header
+    # amplify const/rle payload bytes into N separate huge allocations)
+    budget = {"left": MAX_PAGE_BYTES}
     import jax.numpy as jnp
 
     def decode_block(col):
         typ = _type_from_wire(col["type"])
-        arr = np.frombuffer(read_buf(), dtype=np.dtype(col["dtype"]))
-        arr = arr.reshape(col["shape"])
+        encs = col.get("enc") or [None] * 4
+        ei = iter(encs)
+        dtype = np.dtype(col["dtype"])
+        shape = col["shape"]
+        arr = _decode_array(next(ei, None), read_buf(), dtype, shape, budget)
         valid = None
         if col["valid"]:
-            valid = np.frombuffer(read_buf(), dtype=np.bool_)
+            valid = _decode_array(
+                next(ei, None), read_buf(), np.dtype(np.bool_), (shape[0],),
+                budget,
+            )
         lengths = None
         if col.get("lengths"):
-            lengths = np.frombuffer(read_buf(), dtype=np.int32)
+            lengths = _decode_array(
+                next(ei, None), read_buf(), np.dtype(np.int32), (shape[0],),
+                budget,
+            )
         ev = None
         if col.get("elem_valid"):
-            ev = np.frombuffer(read_buf(), dtype=np.bool_).reshape(
-                col["shape"][:2]
+            ev = _decode_array(
+                next(ei, None), read_buf(), np.dtype(np.bool_), shape[:2],
+                budget,
             )
         dict_id = col["dict_id"]
         local_dict = None

@@ -33,7 +33,7 @@ from ..exec.stream import StreamingExecutor
 from ..ops.union import concat_pages
 from ..page import Block, Page
 from ..plan import nodes as N
-from .serde import deserialize_page, serialize_page
+from .serde import serialize_page
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,6 +196,35 @@ class OutputBuffers:
                     return None, False, False
                 self._cond.wait(timeout=0.1)
 
+    def get_many(self, buffer_id: int, token: int, max_bytes: int,
+                 timeout: float = 60.0):
+        """([serialized pages], complete, ready): as many consecutive
+        already-produced pages from `token` as fit the `max_bytes`
+        response budget (the reference's `exchange.max-response-size`
+        batching, TaskResource.java:239). At least one page is always
+        returned when one exists; `complete` is True when the returned
+        batch drains a finished buffer, saving the final round trip."""
+        first, complete, ready = self.get(buffer_id, token, timeout=timeout)
+        if not ready or first is None:
+            return [], complete, ready
+        out = [first]
+        total = len(first)
+        with self._cond:
+            pages = self._pages.get(buffer_id, [])
+            t = token + 1
+            while t < len(pages) and total < max_bytes:
+                p = pages[t]
+                if p is None:
+                    raise RuntimeError(
+                        f"buffer {buffer_id} token {t} was already "
+                        "acknowledged (exchange protocol violation)"
+                    )
+                out.append(p)
+                total += len(p)
+                t += 1
+            complete = self._finished and t >= len(pages)
+        return out, complete, True
+
     def ack(self, buffer_id: int, upto_token: int) -> None:
         """Acknowledge pages [0, upto_token): their bytes free the bound
         and the worker pool (reference: acknowledge + delete results)."""
@@ -245,6 +274,14 @@ class TaskState:
         # (spec dyn_filter_produce; exec/dynfilter.HostFilterAccumulator),
         # exposed to the coordinator through the status endpoint
         self.dyn_filters: dict = {}
+        # wire observability: encode stats for this task's serialized
+        # output + pull stats for its upstream exchange clients, exposed
+        # through the status endpoint as "exchangeStats" (the substrate
+        # of EXPLAIN ANALYZE's per-exchange wire numbers)
+        from .serde import WireStats
+
+        self.wire_stats = WireStats()
+        self.pull_stats = None  # ExchangeStats, set when sources exist
 
 
 # message fragments marking failures that would recur identically on any
@@ -290,6 +327,7 @@ class FragmentExecutor(Executor):
         super().__init__(catalog)
         self.splits = splits or {}
         self.sources = sources or {}
+        self.sample_salt = _split_salt(self.splits)
 
     def _exec_tablescan(self, node: N.TableScan) -> Page:
         rng = self.splits.get(node.table)
@@ -329,6 +367,10 @@ class StreamingFragmentExecutor(StreamingExecutor):
         )
         self.splits = splits or {}
         self.source_streams = source_streams or {}
+        # TABLESAMPLE: distinct per-worker hash salt derived from this
+        # task's split assignment, so workers sampling disjoint row
+        # ranges never reuse one positional mask (ops/filter.sample_page)
+        self.local.sample_salt = _split_salt(self.splits)
 
     def stream(self, node: N.PlanNode):
         if isinstance(node, RemoteSource):
@@ -374,10 +416,14 @@ class WorkerServer:
                  buffer_bound: Optional[int] = 32 << 20,
                  task_concurrency: int = 2,
                  fault_rate: float = 0.0,
-                 task_timeout: Optional[float] = None):
+                 task_timeout: Optional[float] = None,
+                 wire_caps: Optional[dict] = None):
         from ..exec.taskqueue import MultilevelScheduler
 
         self.catalog = catalog
+        # capability-advertisement override (tests: simulate an old node
+        # or one without the zstandard wheel in an in-process fleet)
+        self.wire_caps = wire_caps
         # fault injection knob: probability a task fails at start
         self.fault_rate = float(fault_rate)
         # wall-clock ceiling per task, checked between batches: a wedged
@@ -442,9 +488,20 @@ class WorkerServer:
                     )
 
             def _do_get(self):
-                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                path, _, query = self.path.partition("?")
+                parts = [p for p in path.split("/") if p]
                 if parts == ["v1", "status"]:
-                    self._send(200, {"state": "ACTIVE"})
+                    # capability handshake: the coordinator intersects
+                    # every member's advertised wire caps and ships the
+                    # result in task specs, so a mixed fleet (one node
+                    # without the zstandard wheel, or still on wire v1)
+                    # agrees on a format instead of failing deserialize
+                    from .serde import local_capabilities
+
+                    self._send(200, {
+                        "state": "ACTIVE",
+                        "wire": outer.wire_caps or local_capabilities(),
+                    })
                     return
                 if parts == ["v1", "memory"]:
                     # reference MemoryResource polled by the coordinator's
@@ -459,10 +516,14 @@ class WorkerServer:
                     t.done.wait(timeout=0.5)  # short-poll: consumers
                     # pipeline against RUNNING producers; failures also
                     # surface as 500s on the results pull
+                    ex_stats = t.wire_stats.snapshot()
+                    if t.pull_stats is not None:
+                        ex_stats["pull"] = t.pull_stats.snapshot()
                     self._send(200, {
                         "state": t.state, "error": t.error,
                         "errorInfo": t.error_info,
                         "dynFilters": t.dyn_filters or None,
+                        "exchangeStats": ex_stats,
                     })
                     return
                 if (
@@ -482,9 +543,26 @@ class WorkerServer:
                     if t.buffers is None:  # task thread not started yet
                         self._send(503, {"retry": True, "state": t.state})
                         return
-                    data, complete, ready = t.buffers.get(
-                        buffer_id, token, timeout=50
-                    )
+                    max_bytes = 0
+                    for kv in query.split("&"):
+                        if kv.startswith("max_bytes="):
+                            try:
+                                max_bytes = int(kv.split("=", 1)[1])
+                            except ValueError:
+                                pass
+                    if max_bytes > 0:
+                        # multi-page response bounded by the client's
+                        # max_response_bytes budget (the
+                        # exchange.max-response-size analog); "page"
+                        # stays populated so old pullers interoperate
+                        datas, complete, ready = t.buffers.get_many(
+                            buffer_id, token, max_bytes, timeout=50
+                        )
+                    else:
+                        data, complete, ready = t.buffers.get(
+                            buffer_id, token, timeout=50
+                        )
+                        datas = [] if data is None else [data]
                     if t.state == "FAILED":
                         # finish() fires in the task's finally, so a failed
                         # producer must never look like a complete stream
@@ -494,11 +572,14 @@ class WorkerServer:
                     if not ready:
                         self._send(503, {"retry": True, "state": t.state})
                         return
+                    encoded = [
+                        base64.b64encode(d).decode() for d in datas
+                    ]
                     self._send(
                         200,
                         {
-                            "page": None if data is None
-                            else base64.b64encode(data).decode(),
+                            "page": encoded[0] if encoded else None,
+                            "pages": encoded,
                             "complete": complete,
                         },
                     )
@@ -575,17 +656,37 @@ class WorkerServer:
             splits = {
                 t: tuple(rng) for t, rng in (spec.get("splits") or {}).items()
             }
+            # fleet-negotiated wire capabilities (coordinator handshake):
+            # this task's output must only use codecs/encodings every
+            # consumer can decode. A spec WITHOUT the field came from a
+            # coordinator that does not negotiate (an old build) — its
+            # decoder is unknown, so degrade to the universal baseline
+            # rather than assuming this process's own capabilities.
+            from .serde import baseline_capabilities
+
+            wire_caps = spec.get("wire") or baseline_capabilities()
+            if spec.get("sources"):
+                from .exchange import ExchangeStats
+
+                state.pull_stats = ExchangeStats()
 
             def make_stream(locations, exclusive):
                 def gen():
-                    for uri, utask, buf in locations:
-                        # acks free producer pages — only safe when this
-                        # task is the buffer's sole consumer (replicated
-                        # buffers are pulled by every consumer and are
-                        # freed on task DELETE instead)
-                        for data in _pull_buffer(uri, utask, buf,
-                                                 ack=exclusive):
-                            yield _min_capacity(deserialize_page(data))
+                    # pipelined concurrent pull: one puller per producer
+                    # task, multi-page responses, deserialize overlapped
+                    # with in-flight requests (server/exchange.py). Acks
+                    # free producer pages — only safe when this task is
+                    # the buffer's sole consumer (replicated buffers are
+                    # pulled by every consumer and freed on task DELETE)
+                    from .exchange import ExchangeClient
+
+                    client = ExchangeClient(
+                        [(u, t, b) for u, t, b in locations],
+                        ack=exclusive,
+                        stats=state.pull_stats,
+                    )
+                    for page in client.pages():
+                        yield _min_capacity(page)
                 return gen
 
             streams = {
@@ -659,12 +760,17 @@ class WorkerServer:
                         acc.unsupported = True
                 for piece in _split_to_bound(page, bound):
                     if keys is not None:
-                        parts = _hash_partition(piece, keys, nparts)
+                        parts = _hash_partition(
+                            piece, keys, nparts, caps=wire_caps,
+                            stats=state.wire_stats,
+                        )
                         for p, data in parts.items():
                             for d in data:
                                 buffers.put(p, d)
                     else:
-                        buffers.put(0, serialize_page(piece))
+                        buffers.put(0, serialize_page(
+                            piece, caps=wire_caps, stats=state.wire_stats,
+                        ))
             if dyn_accs:
                 state.dyn_filters = {
                     fid: s
@@ -701,6 +807,14 @@ class WorkerServer:
     @property
     def uri(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+
+def _split_salt(splits: Dict[str, Tuple[int, int]]) -> int:
+    """Deterministic per-task sample salt from the split assignment: the
+    summed range starts are distinct across workers of one stage (their
+    row ranges are disjoint), so TABLESAMPLE's positional hash never
+    reuses a mask across workers."""
+    return sum(int(start) for start, _stop in splits.values())
 
 
 def _split_to_bound(page: Page, bound: Optional[int]):
@@ -744,7 +858,9 @@ def _min_capacity(page: Page, minimum: int = 16) -> Page:
     )
 
 
-def _hash_partition(page: Page, key_exprs, nparts: int) -> Dict[int, List[bytes]]:
+def _hash_partition(page: Page, key_exprs, nparts: int,
+                    caps: Optional[dict] = None,
+                    stats=None) -> Dict[int, List[bytes]]:
     """Partition live rows by key hash -> serialized per-partition pages
     (reference PartitionedOutputOperator.partitionPage + PagesSerde)."""
     import jax.numpy as jnp
@@ -759,27 +875,27 @@ def _hash_partition(page: Page, key_exprs, nparts: int) -> Dict[int, List[bytes]
     out: Dict[int, List[bytes]] = {}
     for p in range(nparts):
         sub = compact(page, part == p)
-        out[p] = [serialize_page(sub)]
+        out[p] = [serialize_page(sub, caps=caps, stats=stats)]
     return out
 
 
 def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True,
-                 deadline: Optional[float] = None):
-    """Generator of serialized pages from an upstream buffer, one page per
-    long-poll, acknowledging each consumed page so the bounded producer
-    buffer frees its bytes (reference ExchangeClient.java:55,201 +
-    HttpPageBufferClient pull/ack/delete loop).
+                 deadline: Optional[float] = None,
+                 max_bytes: Optional[int] = None):
+    """Generator of serialized pages from ONE upstream buffer, batched
+    long-polls + acks (reference HttpPageBufferClient pull/ack/delete
+    loop). The multi-producer pipelined path is server/exchange.py's
+    ExchangeClient; this sequential form remains for single-location
+    pulls and as the oracle the concurrent client is tested against.
 
     `deadline` caps the wall time between PAGES (a progress deadline): a
     wedged producer (RUNNING forever, producing nothing) must fail the
     pull — retryably — instead of hanging its consumer forever (the
     round-5 relay stall). None reads PRESTO_TPU_TASK_DEADLINE_S
     (default 600)."""
-    import base64 as b64
-    import json as js
     import os
-    import urllib.error
-    import urllib.request
+
+    from .exchange import ack_pages, fetch_pages
 
     if deadline is None:
         deadline = float(os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600"))
@@ -787,53 +903,24 @@ def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True,
 
     token = 0
     while True:
-        url = f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}"
-        try:
-            with urllib.request.urlopen(url, timeout=300) as resp:
-                payload = js.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 503:  # producer still running: long-poll again
-                if time.time() >= give_up:
-                    raise RuntimeError(
-                        f"upstream task {task_id} on {uri} produced no "
-                        f"page within the {deadline:.0f}s task deadline "
-                        "(wedged worker?)"
-                    ) from None
-                continue
-            # surface the UPSTREAM failure cause (e.g. a low-memory kill),
-            # not a bare HTTP 500 — the coordinator matches on the message
-            # (reference: HttpPageBufferClient propagates the task error)
-            try:
-                detail = js.loads(e.read()).get("error") or str(e)
-            except Exception:  # noqa: BLE001
-                detail = str(e)
-            raise RuntimeError(
-                f"upstream task {task_id} on {uri} results fetch "
-                f"failed: {detail}"
-            ) from None
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            # a worker dying mid-stream must surface as a RETRYABLE
-            # RuntimeError (the query-level retry contract), never as a
-            # raw URLError that escapes the scheduler's retry handler
-            raise RuntimeError(
-                f"upstream task {task_id} on {uri} connection lost "
-                f"mid-stream: {e}"
-            ) from None
-        if payload.get("page"):
-            yield b64.b64decode(payload["page"])
+        pages, complete, ready = fetch_pages(
+            uri, task_id, buffer_id, token, max_bytes=max_bytes
+        )
+        if pages:
+            token += len(pages)
+            for data in pages:
+                yield data
             give_up = time.time() + deadline  # progress resets the clock
-            token += 1
             if ack:
-                try:
-                    req = urllib.request.Request(
-                        f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}",
-                        method="DELETE",
-                    )
-                    urllib.request.urlopen(req, timeout=5).read()
-                except Exception:  # noqa: BLE001 - ack is advisory
-                    pass
-            if payload.get("complete", True):
+                ack_pages(uri, task_id, buffer_id, token)
+            if complete:
                 return
             continue
-        if payload.get("complete", True):
+        if complete:
             return
+        if not ready and time.time() >= give_up:
+            raise RuntimeError(
+                f"upstream task {task_id} on {uri} produced no "
+                f"page within the {deadline:.0f}s task deadline "
+                "(wedged worker?)"
+            ) from None

@@ -633,7 +633,14 @@ class Planner:
             import random as _random
 
             inner = self.plan_relation(rel.relation, outer, ctes)
-            frac = max(0.0, min(rel.percentage / 100.0, 1.0))
+            if not 0.0 <= rel.percentage <= 100.0:
+                # reference semantics: SAMPLE_PERCENTAGE_OUT_OF_RANGE
+                # fails the query — clamping would silently change results
+                raise PlanningError(
+                    f"TABLESAMPLE percentage must be in [0, 100], got "
+                    f"{rel.percentage!r}"
+                )
+            frac = rel.percentage / 100.0
             # plan-time seed: each query samples a fresh subset while the
             # compiled kernel stays deterministic (reference SampleNode)
             node = N.Sample(
@@ -786,7 +793,7 @@ class Planner:
                 group_names.append(ch)
                 group_map[ast_g] = (ch, e.type)
 
-            aggs, agg_map = self._plan_aggregates(agg_calls, sctx)
+            aggs, agg_map, agg_order = self._plan_aggregates(agg_calls, sctx)
             if not aggs and not group_exprs:
                 # GROUP BY (): exactly one output row regardless of input
                 # (the empty grouping set of a ROLLUP). A hidden count(*)
@@ -794,10 +801,8 @@ class Planner:
                 aggs = [
                     AggSpec("count_star", None, self.channel("gcount"), T.BIGINT)
                 ]
-            agg_order = getattr(self, "_pending_agg_order", None)
             if agg_order is not None:
                 holder.plan = N.Sort(holder.plan, agg_order)
-                self._pending_agg_order = None
             holder.plan, distinct_rewritten = self._build_aggregate(
                 holder.plan, group_exprs, group_names, aggs
             )
@@ -1054,10 +1059,18 @@ class Planner:
             holder.plan = N.Window(holder.plan, part, order, tuple(funcs))
         return win_map
 
-    def _plan_aggregates(self, agg_calls, sctx) -> Tuple[List[AggSpec], Dict]:
+    def _plan_aggregates(
+        self, agg_calls, sctx
+    ) -> Tuple[List[AggSpec], Dict, Optional[tuple]]:
+        """Returns (specs, call->channel map, agg-internal ORDER BY keys).
+        The ordering is RETURNED, not stashed on the planner: mutable
+        planner-wide state would leak stale sort keys into the next
+        aggregation whenever a PlanningError fired between set and
+        consume (ADVICE round-5)."""
         aggs: List[AggSpec] = []
         agg_map: Dict[t.Node, Tuple[str, T.Type]] = {}
         seen: Dict[t.Node, int] = {}
+        agg_order: Optional[tuple] = None
         for call in agg_calls:
             if call in agg_map:
                 continue
@@ -1074,13 +1087,12 @@ class Planner:
                     )
                     for si in call.order_by
                 )
-                pend = getattr(self, "_pending_agg_order", None)
-                if pend is not None and pend != keys:
+                if agg_order is not None and agg_order != keys:
                     raise PlanningError(
                         "aggregates with DIFFERENT ORDER BY orderings in "
                         "one aggregation are not supported"
                     )
-                self._pending_agg_order = keys
+                agg_order = keys
             if fname == "approx_distinct":
                 # real HyperLogLog estimate (reference
                 # ApproximateCountDistinctAggregations + airlift HLL) with
@@ -1371,7 +1383,7 @@ class Planner:
                     spec = dataclasses.replace(spec, func=f"distinct_{func}")
             aggs.append(spec)
             agg_map[orig_call] = (spec.name, spec.output_type)
-        return aggs, agg_map
+        return aggs, agg_map, agg_order
 
     def _rewrite_aggregate(self, call, sctx, aggs) -> ir.RowExpression:
         """Plan a derived aggregate as core aggregates + a post-formula
@@ -2481,19 +2493,9 @@ class SelectContext:
         if isinstance(ast, t.DateLiteral):
             return ir.Literal(ast.value, T.DATE)
         if isinstance(ast, t.TimestampLiteral):
-            import datetime as _dt
-
-            txt = ast.value.strip()
-            fmt = (
-                "%Y-%m-%d %H:%M:%S.%f" if "." in txt
-                else ("%Y-%m-%d %H:%M:%S" if ":" in txt else "%Y-%m-%d")
+            return ir.Literal(
+                _parse_timestamp_literal(ast.value), T.TIMESTAMP
             )
-            epoch = _dt.datetime(1970, 1, 1)
-            us = int(
-                (_dt.datetime.strptime(txt, fmt) - epoch).total_seconds()
-                * 1_000_000
-            )
-            return ir.Literal(us, T.TIMESTAMP)
         if isinstance(ast, t.IntervalLiteral):
             n = int(ast.value) * (-1 if ast.negative else 1)
             if ast.unit in ("year", "month"):
@@ -2973,6 +2975,48 @@ class SelectContext:
 
     def translate_conjunct_or_apply(self, conj) -> Optional[ir.RowExpression]:
         return self.translate(conj)
+
+
+_TS_FORMATS = (
+    "%Y-%m-%d %H:%M:%S.%f",
+    "%Y-%m-%d %H:%M:%S",
+    "%Y-%m-%d %H:%M",  # Presto-legal no-seconds shape
+    "%Y-%m-%d",
+)
+
+
+def _parse_timestamp_literal(value: str) -> int:
+    """TIMESTAMP 'literal' -> epoch micros. Accepts every Presto-legal
+    datetime shape (with/without seconds or fraction, date-only) plus a
+    trailing numeric zone offset (+HH:MM / -HHMM / Z / UTC — normalized
+    to UTC micros). Exhaustion raises a PlanningError instead of leaking
+    a raw strptime ValueError; named zones are rejected explicitly."""
+    import datetime as _dt
+    import re as _re
+
+    txt = value.strip()
+    off_us = 0
+    m = _re.search(r"\s*(Z|UTC|[+-]\d{2}:?\d{2})$", txt)
+    if m:
+        z = m.group(1)
+        if z not in ("Z", "UTC"):
+            sign = 1 if z[0] == "+" else -1
+            hh, mm = int(z[1:3]), int(z[-2:])
+            off_us = sign * (hh * 3600 + mm * 60) * 1_000_000
+        txt = txt[: m.start()].strip()
+    elif _re.search(r"[ ]\d{1,2}:\d{2}.*[ ][A-Za-z][\w/+_-]*$", txt):
+        raise PlanningError(
+            f"invalid timestamp literal {value!r}: named time zones are "
+            "not supported (use a numeric offset like +05:30)"
+        )
+    epoch = _dt.datetime(1970, 1, 1)
+    for fmt in _TS_FORMATS:
+        try:
+            dt = _dt.datetime.strptime(txt, fmt)
+        except ValueError:
+            continue
+        return int((dt - epoch).total_seconds() * 1_000_000) - off_us
+    raise PlanningError(f"invalid timestamp literal {value!r}")
 
 
 def _number_literal(text: str) -> ir.Literal:

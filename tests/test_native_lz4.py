@@ -124,19 +124,25 @@ def test_fuzz_roundtrip_against_python_decoder():
         assert native.lz4_decompress(packed, len(data)) == data
 
 
-def test_serde_uses_lz4_and_roundtrips():
+def _codec_resistant_page():
+    """A page the light-weight encodings cannot shrink (tiled random
+    int64 — huge range, random deltas, high NDV) but the general codec
+    can (the tile repeats), so serialize must reach for zstd/LZ4."""
     from presto_tpu.page import Page
+
+    rng = np.random.default_rng(11)
+    half = rng.integers(0, 2**62, 4096, dtype=np.int64)
+    return Page.from_dict({"a": np.tile(half, 2)})
+
+
+def test_serde_uses_lz4_and_roundtrips():
     from presto_tpu.server.serde import deserialize_page, serialize_page
 
-    pg = Page.from_dict(
-        {
-            "a": np.arange(5000, dtype=np.int64) % 17,
-            "s": ["alpha", "beta", "alpha", None, "gamma"] * 1000,
-        }
-    )
+    pg = _codec_resistant_page()
     wire = serialize_page(pg)
     # codec negotiation: zstd (3) preferred when the wheel is present,
-    # the native LZ4 (2) otherwise
+    # the native LZ4 (2) otherwise. (Pages the light-weight encodings
+    # already shrink skip the codec entirely — compress-once.)
     from presto_tpu.server import serde as _s
 
     assert wire[4] == (3 if _s._zstd_c is not None else 2)
@@ -144,15 +150,28 @@ def test_serde_uses_lz4_and_roundtrips():
     assert back.to_pylist() == pg.to_pylist()
 
 
-def test_serde_lz4_roundtrips_without_zstd(monkeypatch):
+def test_serde_encoded_page_skips_codec():
+    """Encoding-compacted bodies skip the general codec (raw frame):
+    delta/dict-packed buffers are near-incompressible, so the codec pass
+    would cost serialize wall time for single-digit-% wins."""
     from presto_tpu.page import Page
+    from presto_tpu.server.serde import deserialize_page, serialize_page
+
+    pg = Page.from_dict(
+        {"a": np.arange(5000, dtype=np.int64) % 17}
+    )
+    wire = serialize_page(pg)
+    assert wire[:4] == b"PTP2" and wire[4] == 0
+    back = deserialize_page(wire)
+    assert back.to_pylist() == pg.to_pylist()
+
+
+def test_serde_lz4_roundtrips_without_zstd(monkeypatch):
     from presto_tpu.server import serde as _s
     from presto_tpu.server.serde import deserialize_page, serialize_page
 
     monkeypatch.setattr(_s, "_zstd_c", None)
-    pg = Page.from_dict(
-        {"a": np.arange(5000, dtype=np.int64) % 17}
-    )
+    pg = _codec_resistant_page()
     wire = serialize_page(pg)
     assert wire[4] == 2  # native lz4 fallback
     back = deserialize_page(wire)

@@ -31,6 +31,12 @@ GATED = (
     # ahead of the legacy unfiltered join_probe_n1 floor, and the bloom
     # build+query kernel must not regress
     "join_probe_filtered", "bloom_build_query",
+    # vectorized exchange (PR 4): light-weight encodings + striped
+    # parallel compression + the pipelined pull client. serde_lz4 also
+    # carries a serialize_MBps floor (acceptance: >= 2x the BENCH_r05
+    # 208 MB/s) checked via the mbps_floors table below
+    "serde_lz4", "serde_encoded", "serde_parallel_stripes",
+    "exchange_pull_pipelined",
 )
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
@@ -82,6 +88,15 @@ def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
         print(line)
         if ratio < 1.0 - tolerance:
             failures.append(line)
+        mbps_floor = (gate.get("mbps_floors") or {}).get(name)
+        if mbps_floor and r.get("serialize_MBps"):
+            mline = (
+                f"{name}: serialize {r['serialize_MBps']} MB/s vs floor "
+                f"{mbps_floor} MB/s"
+            )
+            print(mline)
+            if r["serialize_MBps"] < mbps_floor * (1.0 - tolerance):
+                failures.append(mline)
     if failures:
         print(f"\nbench_gate: FAIL — {len(failures)} kernel(s) regressed "
               f">{tolerance:.0%} vs {os.path.basename(baseline_path)}:")
