@@ -1040,11 +1040,122 @@ def run_exchange_pull_bench(sf: float, runs: int = RUNS) -> Dict:
             w.stop()
 
 
+def run_hybrid_join_spill_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Partitioned hybrid hash join with the build side forced through
+    the offload + disk-spill tier (exec/stream._hybrid_hash_join under a
+    budget ~1/8 of the build bytes, host-RAM ceiling 0 so every spilled
+    byte hits the CRC-checked disk files). Gates the whole degradation
+    ladder: a regression here means overload queries got slower even if
+    the in-memory path stayed fast."""
+    import os
+
+    from ..connectors.memory import MemoryCatalog
+    from ..page import Page
+    from ..session import Session
+
+    n_build = max(int(600_000 * sf), 8_000)
+    n_probe = 4 * n_build
+    rng = np.random.default_rng(11)
+    build_page = Page.from_dict(
+        {
+            "bk": np.arange(n_build, dtype=np.int64),
+            "bv": rng.integers(0, 1000, n_build).astype(np.int64),
+        }
+    )
+    probe_page = Page.from_dict(
+        {
+            "pk": rng.integers(0, n_build, n_probe).astype(np.int64),
+            "pv": rng.integers(0, 1000, n_probe).astype(np.int64),
+        }
+    )
+    cat = MemoryCatalog({"b": build_page, "p": probe_page})
+    build_bytes = 16 * n_build
+    sql = "select count(*) c, sum(bv + pv) s from p join b on pk = bk"
+    prev = os.environ.get("PRESTO_TPU_HOST_SPILL_BYTES")
+    os.environ["PRESTO_TPU_HOST_SPILL_BYTES"] = "0"
+    try:
+        sess = Session(
+            cat, streaming=True, batch_rows=1 << 16,
+            memory_budget=max(build_bytes // 8, 96 << 10),
+        )
+        sess.query(sql).rows()  # warm (compile)
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            sess.query(sql).rows()
+            best = min(best, time.perf_counter() - t0)
+        ev = set(sess.executor.spill_events)
+        note = "hybrid" if "hybrid_hash_join" in ev else "no-spill?"
+    finally:
+        if prev is None:
+            os.environ.pop("PRESTO_TPU_HOST_SPILL_BYTES", None)
+        else:
+            os.environ["PRESTO_TPU_HOST_SPILL_BYTES"] = prev
+    return {
+        "name": "hybrid_join_spill",
+        "rows": n_probe,
+        "rows_per_s": round(n_probe / best),
+        "ms": round(best * 1e3, 3),
+        "note": note,
+    }
+
+
+def run_external_sort_disk_bench(sf: float, runs: int = RUNS) -> Dict:
+    """External sort through the disk spill tier: the input offloads to
+    CRC-checked spill files (host ceiling 0) and range-partitioned
+    device sorting reads it back chunk-by-chunk."""
+    import os
+
+    from ..connectors.memory import MemoryCatalog
+    from ..page import Page
+    from ..session import Session
+
+    n = max(int(2_000_000 * sf), 30_000)
+    rng = np.random.default_rng(7)
+    page = Page.from_dict(
+        {
+            "a": rng.random(n),
+            "b": rng.integers(0, 1 << 40, n).astype(np.int64),
+        }
+    )
+    cat = MemoryCatalog({"t": page})
+    sql = "select a, b from t order by a, b"
+    prev = os.environ.get("PRESTO_TPU_HOST_SPILL_BYTES")
+    os.environ["PRESTO_TPU_HOST_SPILL_BYTES"] = "0"
+    try:
+        sess = Session(
+            cat, streaming=True, batch_rows=1 << 16,
+            memory_budget=max(16 * n // 8, 128 << 10),
+        )
+        sess.query(sql).rows()  # warm
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            sess.query(sql).rows()
+            best = min(best, time.perf_counter() - t0)
+        ev = set(sess.executor.spill_events)
+        note = "disk" if "sort" in ev else "no-spill?"
+    finally:
+        if prev is None:
+            os.environ.pop("PRESTO_TPU_HOST_SPILL_BYTES", None)
+        else:
+            os.environ["PRESTO_TPU_HOST_SPILL_BYTES"] = prev
+    return {
+        "name": "external_sort_disk",
+        "rows": n,
+        "rows_per_s": round(n / best),
+        "ms": round(best * 1e3, 3),
+        "note": note,
+    }
+
+
 HOST_BENCHES = {
     "serde_lz4": run_serde_bench,
     "serde_encoded": run_serde_encoded_bench,
     "serde_parallel_stripes": run_serde_stripes_bench,
     "exchange_pull_pipelined": run_exchange_pull_bench,
+    "hybrid_join_spill": run_hybrid_join_spill_bench,
+    "external_sort_disk": run_external_sort_disk_bench,
 }
 
 

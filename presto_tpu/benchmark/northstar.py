@@ -49,6 +49,7 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
         "runs": runs,
         "results": [],
     }
+    from ..exec import spillspace
     from ..server.serde import GLOBAL_WIRE_STATS
 
     for name in queries or QUERIES:
@@ -58,6 +59,10 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
                 rows = sess.query(sql).rows()  # compile + caches
             samples = []
             wire0 = GLOBAL_WIRE_STATS.snapshot()
+            spilled0 = spillspace.total_written()
+            rev0 = getattr(
+                getattr(sess.executor, "pool", None), "revocations", 0
+            )
             for _ in range(runs):
                 t0 = time.perf_counter()
                 rows = sess.query(sql).rows()
@@ -90,6 +95,18 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
                     "wire_ratio": (
                         round(raw_b / wire_bytes, 2) if wire_bytes else None
                     ),
+                    # degradation-path observability (exec/spillspace.py +
+                    # exec/memory.py): disk bytes the query spilled and
+                    # revocation cycles it absorbed — a regression here
+                    # (suddenly spilling, or revoking every run) is a perf
+                    # bug even when wall-clock still looks fine
+                    "spilled_bytes": (
+                        (spillspace.total_written() - spilled0) // runs
+                    ),
+                    "revocations": getattr(
+                        getattr(sess.executor, "pool", None),
+                        "revocations", 0,
+                    ) - rev0,
                 }
             )
         except Exception as e:  # noqa: BLE001 — record, keep going

@@ -5,14 +5,37 @@ Re-designed equivalent of the reference's node memory management
 presto-memory-context/ hierarchical contexts). TPU-first reduction: one
 pool per query tracking HBM-resident page bytes; "revocable" memory is the
 streaming driver's build/accumulator state, which it can offload to host
-RAM (exec/stream.py) — the disk-spill analog from SURVEY §5.
+RAM and from there to the disk spill tier (exec/stream.py + exec/spill.py
++ exec/spillspace.py).
 
 Enforcement is cooperative: kernels are static-shape, so the driver checks
 the budget BEFORE materializing (reserve raises MemoryExceededError and the
 caller switches to a bounded strategy — smaller batches or chunked build
-execution), instead of the reference's blocking futures."""
+execution), instead of the reference's blocking futures.
+
+Two additions over the original design:
+
+* Parent mirroring: a pool created with `parent=` (the worker's
+  WorkerMemoryPool) mirrors every reserve/free into the worker's
+  execution ledger, so `/v1/memory` reflects executor-held bytes (build
+  tables, accumulator state) alongside output buffers and the cluster
+  memory manager kills based on REAL usage.
+* Cooperative revocation (the MemoryRevokingScheduler analog,
+  execution/MemoryRevokingScheduler.java:46): `request_revoke()` flips
+  the pool into a state where `can_accumulate` answers False, so the
+  driver's accumulators take their offload path at the next batch
+  boundary and then call `note_revoked`. Revocation is the rung between
+  "blocked" and "killed" on the degradation ladder.
+
+Over-frees (freeing more than is reserved) are COUNTED, not silently
+clamped away: a nonzero `over_frees` means a double-free accounting bug,
+and the test suite fails on it (tests/conftest.py memory guard).
+"""
 
 from __future__ import annotations
+
+import time
+from typing import Optional
 
 
 class MemoryExceededError(RuntimeError):
@@ -20,15 +43,42 @@ class MemoryExceededError(RuntimeError):
     ExceededMemoryLimitException)."""
 
 
+# process-wide over-free aggregate: the suite-level guard asserts its
+# delta is zero after every test (a double-free anywhere is a bug even if
+# the owning pool was short-lived)
+GLOBAL_ACCOUNTING = {"over_frees": 0, "over_freed_bytes": 0}
+
+
 class MemoryPool:
-    def __init__(self, max_bytes: int | None = None, name: str = "query"):
+    def __init__(self, max_bytes: int | None = None, name: str = "query",
+                 parent=None, query_id: str = ""):
         self.max_bytes = max_bytes
         self.name = name
+        self.parent = parent  # server.worker.WorkerMemoryPool (or None)
+        self.query_id = query_id or name
         self.reserved = 0
         self.peak = 0
+        # double-free observability (never silently clamp)
+        self.over_frees = 0
+        self.over_freed_bytes = 0
+        # cooperative revocation state
+        self.revocations = 0  # completed revoke cycles
+        self.accumulated = 0  # driver-held device bytes not yet reserved
+        self._revoke_requested_at: Optional[float] = None
+        self.revoke_grace_s = 5.0
+
+    # -- reservation --
 
     def can_reserve(self, nbytes: int) -> bool:
         return self.max_bytes is None or self.reserved + nbytes <= self.max_bytes
+
+    def can_accumulate(self, nbytes: int) -> bool:
+        """May the driver keep accumulating device state? False while a
+        revoke is pending — the accumulator then takes its offload path
+        (host RAM -> disk) exactly as if the budget ran out."""
+        if self.revoke_pending:
+            return False
+        return self.can_reserve(nbytes)
 
     def reserve(self, nbytes: int, what: str = "") -> int:
         if not self.can_reserve(nbytes):
@@ -39,7 +89,69 @@ class MemoryPool:
             )
         self.reserved += nbytes
         self.peak = max(self.peak, self.reserved)
+        if self.parent is not None:
+            self.parent.reserve_execution(self.query_id, nbytes)
         return nbytes
 
     def free(self, nbytes: int) -> None:
-        self.reserved = max(0, self.reserved - nbytes)
+        if nbytes > self.reserved:
+            # a double-free: count it loudly instead of clamping silently
+            self.over_frees += 1
+            over = nbytes - self.reserved
+            self.over_freed_bytes += over
+            GLOBAL_ACCOUNTING["over_frees"] += 1
+            GLOBAL_ACCOUNTING["over_freed_bytes"] += over
+            nbytes = self.reserved
+        self.reserved -= nbytes
+        if self.parent is not None and nbytes:
+            self.parent.free_execution(self.query_id, nbytes)
+
+    # -- revocation (cooperative; see exec/stream.py accumulators) --
+
+    @property
+    def revoke_pending(self) -> bool:
+        t = self._revoke_requested_at
+        if t is None:
+            return False
+        if time.monotonic() - t > self.revoke_grace_s:
+            # the driver never reached a revocation point (e.g. blocked
+            # in a kernel): expire the request so an eventually-healthy
+            # query is not forced to spill forever
+            self._revoke_requested_at = None
+            return False
+        return True
+
+    def request_revoke(self) -> bool:
+        """Ask the driver to offload revocable state at its next batch
+        boundary. Returns True when a new request was armed."""
+        if self._revoke_requested_at is not None:
+            return False
+        self._revoke_requested_at = time.monotonic()
+        return True
+
+    def note_revoked(self, nbytes: int) -> None:
+        """The driver offloaded `nbytes` of device state. Completes a
+        pending revoke request (no-op when none is pending — the normal
+        budget-exhaustion offload calls this too)."""
+        if self._revoke_requested_at is not None:
+            self._revoke_requested_at = None
+            self.revocations += 1
+
+    def revocable_bytes(self) -> int:
+        """Estimate of bytes a revoke could free: accumulator-held pages
+        plus reserved operator state (largest-revocable-first ordering in
+        the worker's revoking scheduler)."""
+        return self.accumulated + self.reserved
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name,
+            "max_bytes": self.max_bytes,
+            "reserved": self.reserved,
+            "peak": self.peak,
+            "accumulated": self.accumulated,
+            "over_frees": self.over_frees,
+            "over_freed_bytes": self.over_freed_bytes,
+            "revocations": self.revocations,
+            "revoke_pending": self.revoke_pending,
+        }

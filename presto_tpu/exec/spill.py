@@ -89,60 +89,212 @@ def host_concat_pages(pages: Sequence[Page]) -> Page:
     return Page(tuple(blocks), first.names, total)
 
 
-class SpilledRows:
-    """Append-only host store of offloaded pages (the spill-file analog)."""
+def _default_host_spill_bytes() -> int:
+    """Host-RAM bytes one offloaded store may hold before migrating to
+    the disk spill tier (exec/spillspace.py). 0 forces everything to
+    disk (tests / hosts with no RAM headroom)."""
+    import os
 
-    def __init__(self, host=None):
+    return int(os.environ.get("PRESTO_TPU_HOST_SPILL_BYTES", str(64 << 20)))
+
+
+def _host_table_concat(tables):
+    """Concatenate HostTable-shaped chunks on the host, unifying
+    per-chunk string dictionaries (the numpy mirror of
+    HostTable.append_page)."""
+    from .stream import HostTable
+
+    first = tables[0]
+    out = HostTable(
+        first.names,
+        first.types,
+        first.dict_ids,
+        [c.copy() for c in first.columns],
+        [None if v is None else v.copy() for v in first.valids],
+    )
+    for t in tables[1:]:
+        out.append_host(t)
+    return out
+
+
+class SpilledRows:
+    """Offloaded rows: host-RAM store (HostTable) that migrates to the
+    CRC-checked disk tier (spillspace.DiskRows) once it outgrows
+    PRESTO_TPU_HOST_SPILL_BYTES — the spill-file analog, now with an
+    actual spill file underneath."""
+
+    def __init__(self, host=None, space=None, tag: str = "spill"):
         self._host = host  # exec.stream.HostTable
+        self._space = space  # spillspace.QuerySpillSpace (None = host-only)
+        self._tag = tag
+        self._disk = None  # spillspace.DiskRows once migrated
+        self._host_limit = _default_host_spill_bytes()
+        if host is not None:
+            self._maybe_migrate()
 
     def append(self, page: Page) -> None:
         from .stream import HostTable
 
+        chunk = HostTable.from_pages([page])
+        if self._disk is not None:
+            self._disk.append_chunk(
+                chunk.columns, chunk.valids, chunk.dict_ids, chunk.num_rows
+            )
+            return
         if self._host is None:
-            self._host = HostTable.from_pages([page])
+            self._host = chunk
         else:
-            self._host.append_page(page)
+            self._host.append_host(chunk)
+        self._maybe_migrate()
+
+    def _maybe_migrate(self) -> None:
+        """Host store crossed its RAM ceiling: stream it into a disk
+        record store and drop the RAM copy (the second rung of the
+        degradation ladder: device -> host -> disk)."""
+        if self._space is None or self._host is None:
+            return
+        h = self._host
+        if h.num_rows * max(h.row_bytes, 1) <= self._host_limit:
+            return
+        from .spillspace import DiskRows
+
+        self._disk = DiskRows(self._space, self._tag, h.names, h.types)
+        self._disk.append_chunk(h.columns, h.valids, h.dict_ids, h.num_rows)
+        self._host = None
 
     @property
     def host(self):
         return self._host
 
     @property
+    def on_disk(self) -> bool:
+        return self._disk is not None
+
+    @property
     def num_rows(self) -> int:
+        if self._disk is not None:
+            return self._disk.num_rows
         return 0 if self._host is None else self._host.num_rows
 
     @property
     def row_bytes(self) -> int:
+        if self._disk is not None:
+            return max(self._disk.row_bytes, 1)
         return 0 if self._host is None else max(self._host.row_bytes, 1)
 
-    def subset(self, indices: np.ndarray) -> "SpilledRows":
+    def iter_host_chunks(self):
+        """HostTable chunks of the whole store (one for the RAM tier, one
+        per CRC-verified record for the disk tier)."""
         from .stream import HostTable
 
-        h = self._host
-        return SpilledRows(
-            HostTable(
+        if self._disk is not None:
+            for cols, vals, dict_ids, _rows in self._disk.iter_chunks():
+                yield HostTable(
+                    self._disk.names, self._disk.types, dict_ids,
+                    list(cols), list(vals),
+                )
+        elif self._host is not None:
+            yield self._host
+
+    def _gather_host(self, indices: np.ndarray):
+        """HostTable of the rows at `indices` (in `indices` order)."""
+        if self._disk is None:
+            h = self._host
+            from .stream import HostTable
+
+            return HostTable(
                 h.names,
                 h.types,
                 h.dict_ids,
                 [c[indices] for c in h.columns],
                 [None if v is None else v[indices] for v in h.valids],
             )
+        # disk tier: one sequential pass, gathering each record's share
+        # in ascending order, then restore the caller's order
+        order = np.argsort(indices, kind="stable")
+        sorted_idx = np.asarray(indices)[order]
+        chunks = []
+        off = 0
+        pos = 0
+        from .stream import HostTable
+
+        for cols, vals, dict_ids, rows in self._disk.iter_chunks():
+            hi = np.searchsorted(sorted_idx, off + rows, side="left")
+            if hi > pos:
+                local = sorted_idx[pos:hi] - off
+                chunks.append(
+                    HostTable(
+                        self._disk.names, self._disk.types, dict_ids,
+                        [c[local] for c in cols],
+                        [None if v is None else v[local] for v in vals],
+                    )
+                )
+                pos = hi
+            off += rows
+            if pos == len(sorted_idx):
+                break
+        if not chunks:
+            # empty selection: 0-row gather of the first record keeps the
+            # true dtypes/dictionaries (a schema-correct empty table)
+            cols, vals, dict_ids, _rows = self._disk.read_chunk(0)
+            return HostTable(
+                self._disk.names, self._disk.types, dict_ids,
+                [c[:0] for c in cols],
+                [None if v is None else v[:0] for v in vals],
+            )
+        ht = _host_table_concat(chunks)
+        inverse = np.empty(len(order), np.int64)
+        inverse[order] = np.arange(len(order))
+        ht.columns = [c[inverse] for c in ht.columns]
+        ht.valids = [None if v is None else v[inverse] for v in ht.valids]
+        return ht
+
+    def subset(self, indices: np.ndarray) -> "SpilledRows":
+        if self._disk is None:
+            return SpilledRows(
+                self._gather_host(indices), space=self._space, tag=self._tag
+            )
+        # disk tier: stream the selection into a NEW record store so a
+        # large subset never re-materializes in host RAM
+        from .spillspace import DiskRows
+
+        sorted_idx = np.sort(np.asarray(indices))
+        sub = SpilledRows(space=self._space, tag=self._tag)
+        sub._disk = DiskRows(
+            self._space, self._tag, self._disk.names, self._disk.types
         )
+        off = 0
+        pos = 0
+        for cols, vals, dict_ids, rows in self._disk.iter_chunks():
+            hi = np.searchsorted(sorted_idx, off + rows, side="left")
+            if hi > pos:
+                local = sorted_idx[pos:hi] - off
+                sub._disk.append_chunk(
+                    [c[local] for c in cols],
+                    [None if v is None else v[local] for v in vals],
+                    dict_ids,
+                    len(local),
+                )
+                pos = hi
+            off += rows
+            if pos == len(sorted_idx):
+                break
+        return sub
 
     def take_page(self, indices: np.ndarray) -> Page:
-        """Gather host rows by position into a device-uploadable Page."""
-        h = self._host
+        """Gather rows by position into a device-uploadable Page."""
+        h = self._gather_host(np.asarray(indices))
         n = len(indices)
         cap = round_capacity(max(n, 1))
         blocks = []
         for c, v, typ, did in zip(h.columns, h.valids, h.types, h.dict_ids):
-            data = c[indices]
+            data = c
             if cap > n:
                 pad = (cap - n,) + data.shape[1:]
                 data = np.concatenate([data, np.zeros(pad, data.dtype)])
             valid = None
             if v is not None:
-                valid = v[indices]
+                valid = v
                 if cap > n:
                     valid = np.concatenate(
                         [valid, np.zeros(cap - n, np.bool_)]
@@ -160,10 +312,24 @@ class SpilledRows:
     def column_eval(
         self, eval_fn: Callable[[Page], jnp.ndarray], chunk_rows: int
     ) -> np.ndarray:
-        """Evaluate a device function over the host rows chunk-by-chunk,
+        """Evaluate a device function over the stored rows chunk-by-chunk,
         returning the concatenated host result (sort-key normalization,
         partition hashing)."""
         outs = []
+        if self._disk is not None:
+            from .stream import HostTable
+
+            for cols, vals, dict_ids, rows in self._disk.iter_chunks():
+                ht = HostTable(
+                    self._disk.names, self._disk.types, dict_ids,
+                    list(cols), list(vals),
+                )
+                # pad to the quantized capacity: records carry arbitrary
+                # row counts, and one compiled kernel per distinct shape
+                # would turn every pass into a compile storm
+                page = ht.slice_page(0, rows, pad_to=round_capacity(rows))
+                outs.append(np.asarray(eval_fn(page))[:rows])
+            return np.concatenate(outs) if outs else np.empty((0,))
         n = self.num_rows
         step = max(chunk_rows, 1)
         for start in range(0, n, step):

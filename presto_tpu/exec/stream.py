@@ -125,9 +125,13 @@ class HostTable:
         )
 
     def append_page(self, page: Page) -> None:
+        self.append_host(HostTable.from_pages([page]))
+
+    def append_host(self, other: "HostTable") -> None:
+        """Concatenate another host table's rows onto this one, unifying
+        per-table string dictionaries."""
         from ..page import dictionary_by_id, intern_dictionary
 
-        other = HostTable.from_pages([page])
         dict_ids = list(self.dict_ids)
         for i in range(len(self.columns)):
             a_id, b_id = dict_ids[i], other.dict_ids[i]
@@ -277,10 +281,19 @@ class StreamingExecutor:
         batch_rows: int = 1 << 20,
         memory_budget: Optional[int] = None,
         collector=None,
+        query_id: str = "",
+        worker_pool=None,
+        spill_space=None,
     ):
         self.catalog = catalog
         self.batch_rows = batch_rows
-        self.pool = MemoryPool(memory_budget)
+        self.query_id = query_id or f"local-{id(self):x}"
+        # parent mirroring: on a worker, executor-held bytes show up in
+        # the WorkerMemoryPool's execution ledger (/v1/memory)
+        self.pool = MemoryPool(
+            memory_budget, name=self.query_id, parent=worker_pool,
+            query_id=self.query_id,
+        )
         self.local = Executor(catalog, collector=collector)
         self.collector = collector
         # dynamic filters are shared with the delegate executor: joins
@@ -291,6 +304,28 @@ class StreamingExecutor:
         # the spill path actually fired; reference: OperatorStats spill
         # counters)
         self.spill_events: List[str] = []
+        # degradation-ladder observability (EXPLAIN ANALYZE memory line):
+        # disk bytes written, hybrid-join partition count / recursion
+        # depth, and chunk-loop fallbacks (all-ties / depth exhausted)
+        self.spill_stats: Dict[str, int] = {
+            "disk_bytes": 0,
+            "hybrid_parts": 0,
+            "hybrid_depth": 0,
+            "chunk_fallbacks": 0,
+        }
+        self._spill_space = spill_space
+        self._owns_spill = spill_space is None
+
+    def _spill(self):
+        """Lazily opened spill space (exec/spillspace.py): disk-tier
+        quota accounting + guaranteed file cleanup at run() end (owned
+        spaces) or task end (worker-provided spaces)."""
+        if self._spill_space is None:
+            from .spillspace import SPILL_MANAGER
+
+            self._spill_space = SPILL_MANAGER.open(self.query_id)
+            self._owns_spill = True
+        return self._spill_space
 
     def _spill_share(self) -> int:
         """Device bytes one offloaded operator may hold at a time: half the
@@ -300,9 +335,9 @@ class StreamingExecutor:
 
     def _collect_or_spill(self, child: N.PlanNode, tag: str):
         """Accumulate a child stream on device while the budget allows;
-        past it, migrate everything to a host SpilledRows store (the
-        revoke-to-spill moment). Returns (first_batch, device_batches,
-        held_bytes, spilled_or_None)."""
+        past it — or when a revoke is pending — migrate everything to a
+        SpilledRows store (host RAM, then the disk tier). Returns
+        (first_batch, device_batches, held_bytes, spilled_or_None)."""
         from .spill import SpilledRows
 
         batches: List[Page] = []
@@ -315,26 +350,43 @@ class StreamingExecutor:
             if int(b.count) == 0:
                 continue
             nb = page_device_bytes(b)
-            if spilled is None and self.pool.can_reserve(held + nb):
+            if spilled is None and self.pool.can_accumulate(held + nb):
                 batches.append(b)
                 held += nb
+                self.pool.accumulated = held
                 continue
             if spilled is None:
                 self.spill_events.append(tag)
-                spilled = SpilledRows()
+                spilled = SpilledRows(space=self._spill(), tag=tag)
                 for p in batches:
                     spilled.append(p)
                 batches = []
+                self.pool.note_revoked(held)
                 held = 0
+                self.pool.accumulated = 0
             spilled.append(b)
+        self.pool.accumulated = 0
         return first, batches, held, spilled
 
     # -- public --
 
     def run(self, node: N.PlanNode) -> Page:
         self.dyn_ctx.reset()  # filters are per-query state
-        out = self._run(node)
-        return out
+        try:
+            return self._run(node)
+        finally:
+            self.release_spill()
+
+    def release_spill(self) -> None:
+        """Guaranteed spill cleanup: fold disk-tier counters into the
+        stats and unlink this query's spill files. Worker-provided spaces
+        are released by the task's own finally (server/worker.py)."""
+        if self._spill_space is not None:
+            self.spill_stats["disk_bytes"] += self._spill_space.written
+            self._spill_space.written = 0
+            if self._owns_spill:
+                self._spill_space.release()
+                self._spill_space = None
 
     def rows(self, node: N.PlanNode) -> List[tuple]:
         return self.run(node).to_pylist()
@@ -525,31 +577,44 @@ class StreamingExecutor:
     # -- joins ----------------------------------------------------------------
 
     def _collect_side(self, node: N.PlanNode):
-        """Materialize a build side on device within budget; offload to host
-        when the budget runs out (HashBuilderOperator's revoke-to-spill)."""
+        """Materialize a build side on device within budget; offload to a
+        SpilledRows store (host RAM -> disk tier) when the budget runs
+        out or a revoke is pending (HashBuilderOperator's
+        revoke-to-spill)."""
+        from .spill import SpilledRows
+
         batches: List[Page] = []
         held = 0
-        host: Optional[HostTable] = None
+        spilled: Optional[SpilledRows] = None
+        first: Optional[Page] = None
         for b in self.stream(node):
+            if first is None:
+                first = b
             if int(b.count) == 0:
-                if not batches and host is None:
-                    batches.append(b)  # keep schema carrier
                 continue
             nb = page_device_bytes(b)
-            if host is None and self.pool.can_reserve(nb + held):
+            if spilled is None and self.pool.can_accumulate(nb + held):
                 batches.append(b)
                 held += nb
+                self.pool.accumulated = held
             else:
-                if host is None:
-                    host = HostTable.from_pages(batches) if batches else None
+                if spilled is None:
+                    self.spill_events.append("join_build")
+                    spilled = SpilledRows(
+                        space=self._spill(), tag="join_build"
+                    )
+                    for p in batches:
+                        spilled.append(p)
                     batches = []
+                    self.pool.note_revoked(held)
                     held = 0
-                if host is None:
-                    host = HostTable.from_pages([b])
-                else:
-                    host.append_page(b)
-        if host is not None:
-            return "host", host
+                    self.pool.accumulated = 0
+                spilled.append(b)
+        self.pool.accumulated = 0
+        if spilled is not None:
+            return "spilled", spilled
+        if not batches and first is not None:
+            batches.append(first)  # keep schema carrier
         self.pool.reserve(held, "join build side")
         page = batches[0] if len(batches) == 1 else concat_pages(batches)
         return "device", (page, held)
@@ -745,24 +810,78 @@ class StreamingExecutor:
             finally:
                 self.pool.free(held)
             return
-        # host-offloaded build: chunked (grouped) execution — INNER only
+        # offloaded build: partitioned hybrid hash join — INNER only
         if node.kind != "inner":
             raise MemoryExceededError(
                 "outer join build side exceeds the device budget "
                 "(chunked execution covers inner joins)"
             )
-        host: HostTable = side
+        spilled = side
         if getattr(node, "dynamic_filters", ()):
-            self._publish_host_filters(node, host)
-        budget = self.pool.max_bytes or (1 << 62)
-        # size chunks from the budget REMAINING after state already held
-        # (aggregation state, other build sides), not the full budget
-        remaining = max(budget - self.pool.reserved, 1)
-        share = max(remaining // 2, 1)
-        rows_per_chunk = max(int(share // max(host.row_bytes, 1)), 1)
-        for start in range(0, max(host.num_rows, 1), rows_per_chunk):
-            stop = min(start + rows_per_chunk, host.num_rows)
-            chunk = host.slice_page(start, stop)
+            self._publish_host_filters(node, spilled)
+        from .breaker import BREAKERS
+
+        if BREAKERS.allow("hybrid_join") and not self._hybrid_unsafe_keys(
+            node
+        ):
+            try:
+                # partitioning + resident-build SETUP runs before the
+                # probe stream is touched: a fault here falls back
+                # CLEANLY to the chunked path (no probe page consumed or
+                # acked, no row emitted). Spill-tier errors stay fatal —
+                # retrying cannot outrun a quota or a corrupt file.
+                setup = self._hybrid_setup(node, spilled)
+            except MemoryExceededError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - degrade, don't fail
+                from .spillspace import SpillError
+
+                if isinstance(exc, SpillError):
+                    raise
+                BREAKERS.record_failure("hybrid_join", repr(exc))
+            else:
+                # once the probe pass starts its pages may be consumed
+                # (and exchange-acked): no silent fallback — a fault
+                # propagates, the breaker records it, and the NEXT
+                # attempt takes the chunked path
+                try:
+                    yield from self._hybrid_hash_join(
+                        node, spilled, right_names, setup
+                    )
+                except (MemoryExceededError, GeneratorExit):
+                    raise
+                except Exception as exc:  # noqa: BLE001
+                    from .spillspace import SpillError
+
+                    if not isinstance(exc, SpillError):
+                        BREAKERS.record_failure("hybrid_join", repr(exc))
+                    raise
+                BREAKERS.record_success("hybrid_join")
+                return
+        self.spill_stats["chunk_fallbacks"] += 1
+        yield from self._chunked_host_join(node, spilled, right_names)
+
+    def _hybrid_unsafe_keys(self, node: N.Join) -> bool:
+        """Hash partitioning requires build/probe key hashes to agree for
+        equal VALUES; dictionary-encoded varchar columns hash their codes
+        (dictionaries can differ across sides), so those joins keep the
+        chunked path."""
+        for k in tuple(node.left_keys) + tuple(node.right_keys):
+            if isinstance(getattr(k, "type", None), T.VarcharType):
+                return True
+        return False
+
+    def _chunked_host_join(self, node: N.Join, spilled, right_names):
+        """Legacy offloaded-build execution (the hybrid join's circuit-
+        breaker fallback): upload budget-sized build chunks, re-stream the
+        whole probe against each (inner joins distribute over build
+        chunks)."""
+        share = self._spill_share()
+        rows_per_chunk = max(int(share // max(spilled.row_bytes, 1)), 1)
+        n = spilled.num_rows
+        for start in range(0, max(n, 1), rows_per_chunk):
+            stop = min(start + rows_per_chunk, n)
+            chunk = spilled.take_page(np.arange(start, max(stop, start)))
             nb = page_device_bytes(chunk)
             self.pool.reserve(nb, "join build chunk")
             try:
@@ -770,9 +889,245 @@ class StreamingExecutor:
             finally:
                 self.pool.free(nb)
 
-    def _publish_host_filters(self, node: N.Join, host: HostTable) -> None:
-        """Derive filters from a host-offloaded build side (numpy columns;
-        the spilled-build analog of _publish_dynamic_filters)."""
+    def _hybrid_partition_count(self, total_bytes: int, share: int,
+                                cap: int = 64) -> int:
+        import os
+
+        env = int(os.environ.get("PRESTO_TPU_HYBRID_JOIN_PARTS", "0"))
+        if env > 0:
+            return env
+        # 2x headroom per partition (arXiv:2112.02480: over-partitioning
+        # is cheap, under-partitioning forces recursion)
+        return min(max(-(-total_bytes * 2 // max(share, 1)), 2), cap)
+
+    def _hybrid_setup(self, node: N.Join, spilled) -> dict:
+        """Eager setup phase of the hybrid hash join: hash-partition the
+        build side, choose the resident set, and upload + build it. Runs
+        BEFORE the probe stream is touched, so a fault here can fall back
+        to the chunked path cleanly (nothing consumed, nothing acked,
+        nothing emitted)."""
+        import os
+
+        from .spill import hash_partition_indices
+
+        share = self._spill_share()
+        row_b = max(spilled.row_bytes, 1)
+        total_bytes = spilled.num_rows * row_b
+        P = self._hybrid_partition_count(total_bytes, share)
+        chunk_rows = max(share // (2 * row_b), 1 << 10)
+        parts = hash_partition_indices(
+            spilled, node.right_keys, P, chunk_rows, salt=0
+        )
+        # resident set: smallest partitions first, up to half the share
+        # (the other half belongs to probe batches / output pages)
+        resident: List[int] = []
+        acc = 0
+        for p in sorted(range(P), key=lambda q: len(parts[q])):
+            nb = len(parts[p]) * row_b
+            if len(parts[p]) and acc + nb <= share // 2:
+                resident.append(p)
+                acc += nb
+        resident_set = frozenset(resident)
+        deferred = [
+            p for p in range(P)
+            if p not in resident_set and len(parts[p])
+        ]
+        bs_mem = None
+        mem_held = 0
+        if resident:
+            idx = np.concatenate([parts[p] for p in sorted(resident)])
+            mem_page = spilled.take_page(idx)
+            mem_held = page_device_bytes(mem_page)
+            self.pool.reserve(mem_held, "hybrid join resident build")
+            try:
+                bs_mem = build(mem_page, node.right_keys)
+            except BaseException:
+                self.pool.free(mem_held)
+                raise
+        res_np = np.zeros(P, np.bool_)
+        res_np[resident] = True
+        return {
+            "P": P,
+            "chunk_rows": chunk_rows,
+            "parts": parts,
+            "deferred": deferred,
+            "bs_mem": bs_mem,
+            "mem_held": mem_held,
+            "res_np": res_np,
+            "max_depth": int(
+                os.environ.get("PRESTO_TPU_HYBRID_JOIN_MAX_DEPTH", "3")
+            ),
+        }
+
+    def _hybrid_hash_join(self, node: N.Join, spilled, right_names, setup):
+        """Partitioned hybrid hash join over an offloaded build side
+        (reference HashBuilderOperator SPILLING_INPUT +
+        GenericPartitioningSpiller; design trade-offs per
+        arXiv:2112.02480): hash-partition build AND probe, keep the
+        partitions that fit on device and probe them in ONE pass over the
+        probe stream, spill the rest of the probe, then join each
+        deferred (build, probe) partition pair — recursively
+        repartitioning oversized partitions on fresh hash bits up to
+        PRESTO_TPU_HYBRID_JOIN_MAX_DEPTH, after which an all-ties
+        partition degrades to the chunked build loop."""
+        from ..expr.compiler import evaluate
+        from ..ops.filter import compact
+        from ..ops.hashing import hash_rows
+        from .spill import SpilledRows, hash_partition_indices, to_host_page
+
+        P = setup["P"]
+        chunk_rows = setup["chunk_rows"]
+        parts = setup["parts"]
+        deferred = setup["deferred"]
+        bs_mem = setup["bs_mem"]
+        mem_held = setup["mem_held"]
+        max_depth = setup["max_depth"]
+        self.spill_events.append("hybrid_hash_join")
+        self.spill_stats["hybrid_parts"] = max(
+            self.spill_stats["hybrid_parts"], P
+        )
+        res_lut = jnp.asarray(setup["res_np"])
+        probe_spill = (
+            SpilledRows(space=self._spill(), tag="hybrid_probe")
+            if deferred else None
+        )
+        preprobe = getattr(node, "dynamic_filters", ()) and any(
+            not consumed for _f, _i, consumed in node.dynamic_filters
+        )
+        first_probe: Optional[Page] = None
+        yielded = False
+        try:
+            # ONE pass over the probe: resident partitions join now,
+            # deferred partitions' rows spill alongside the build
+            for batch in self.stream(node.left):
+                if preprobe:
+                    batch = self.local._apply_preprobe(node, batch)
+                if first_probe is None:
+                    first_probe = batch
+                keys = [evaluate(e, batch) for e in node.left_keys]
+                h = hash_rows(keys)
+                part = (h % jnp.uint64(P)).astype(jnp.int32)
+                live = batch.live_mask()
+                if bs_mem is not None:
+                    mem_batch = compact(batch, res_lut[part] & live)
+                    if int(mem_batch.count) > 0:
+                        for out in self._probe_with(
+                            node, bs_mem, right_names, iter([mem_batch])
+                        ):
+                            yielded = True
+                            yield out
+                if probe_spill is not None:
+                    d_batch = compact(batch, (~res_lut[part]) & live)
+                    if int(d_batch.count) > 0:
+                        probe_spill.append(to_host_page(d_batch))
+        finally:
+            if mem_held:
+                self.pool.free(mem_held)
+        bs_mem = None
+        if probe_spill is not None and probe_spill.num_rows:
+            pparts = hash_partition_indices(
+                probe_spill, node.left_keys, P, chunk_rows, salt=0
+            )
+            for p in deferred:
+                if not len(pparts[p]):
+                    continue
+                for out in self._join_partition(
+                    node, spilled.subset(parts[p]),
+                    probe_spill.subset(pparts[p]), right_names, 0,
+                    chunk_rows, max_depth,
+                ):
+                    yielded = True
+                    yield out
+        if not yielded and first_probe is not None:
+            # schema carrier: join one probe batch against an empty build
+            # so downstream sinks always see the output schema. A probe
+            # stream that yielded NOTHING (possible for an exchange source
+            # whose producer finished empty) has no carrier to offer —
+            # and nothing downstream to feed either.
+            empty = spilled.take_page(np.empty(0, np.int64))
+            yield from self._probe_with(
+                node, build(empty, node.right_keys), right_names,
+                iter([first_probe]),
+            )
+
+    def _join_partition(self, node: N.Join, build_sub, probe_sub,
+                        right_names, depth: int, chunk_rows: int,
+                        max_depth: int):
+        """Join one deferred (build, probe) partition pair: upload the
+        build whole when it fits, recursively repartition on fresh hash
+        bits when it doesn't, and fall back to the chunked build loop
+        when partitioning stops making progress (all-ties keys) or the
+        depth bound is hit."""
+        from .spill import hash_partition_indices
+
+        share = self._spill_share()
+        row_b = max(build_sub.row_bytes, 1)
+        bbytes = build_sub.num_rows * row_b
+        if bbytes * 2 <= share or build_sub.num_rows <= 1:
+            page = build_sub.take_page(np.arange(build_sub.num_rows))
+            nb = page_device_bytes(page)
+            self.pool.reserve(nb, "hybrid join partition build")
+            try:
+                bs = build(page, node.right_keys)
+                yield from self._probe_with(
+                    node, bs, right_names,
+                    self._spilled_pages(probe_sub, chunk_rows),
+                )
+            finally:
+                self.pool.free(nb)
+            return
+        if depth < max_depth:
+            P2 = self._hybrid_partition_count(bbytes, share, cap=16)
+            salt = 7 * (depth + 1)  # fresh hash bits each level
+            bparts = hash_partition_indices(
+                build_sub, node.right_keys, P2, chunk_rows, salt=salt
+            )
+            if max(len(i) for i in bparts) < build_sub.num_rows:
+                # made progress: recurse on each co-partition pair
+                self.spill_stats["hybrid_depth"] = max(
+                    self.spill_stats["hybrid_depth"], depth + 1
+                )
+                pparts = hash_partition_indices(
+                    probe_sub, node.left_keys, P2, chunk_rows, salt=salt
+                )
+                for p in range(P2):
+                    if len(bparts[p]) and len(pparts[p]):
+                        yield from self._join_partition(
+                            node, build_sub.subset(bparts[p]),
+                            probe_sub.subset(pparts[p]), right_names,
+                            depth + 1, chunk_rows, max_depth,
+                        )
+                return
+        # all-ties partition (one key value defeats every hash) or depth
+        # exhausted: inner joins distribute over build chunks
+        self.spill_stats["chunk_fallbacks"] += 1
+        rows_per = max(int((share // 2) // row_b), 1)
+        n = build_sub.num_rows
+        for s in range(0, n, rows_per):
+            page = build_sub.take_page(np.arange(s, min(s + rows_per, n)))
+            nb = page_device_bytes(page)
+            self.pool.reserve(nb, "hybrid join build chunk")
+            try:
+                bs = build(page, node.right_keys)
+                yield from self._probe_with(
+                    node, bs, right_names,
+                    self._spilled_pages(probe_sub, chunk_rows),
+                )
+            finally:
+                self.pool.free(nb)
+
+    @staticmethod
+    def _spilled_pages(spilled, chunk_rows: int):
+        """Device pages of a spilled store, chunk-by-chunk."""
+        n = spilled.num_rows
+        step = max(chunk_rows, 1)
+        for start in range(0, n, step):
+            yield spilled.take_page(np.arange(start, min(start + step, n)))
+
+    def _publish_host_filters(self, node: N.Join, spilled) -> None:
+        """Derive filters from an offloaded build side (numpy columns,
+        host or disk tier; the spilled-build analog of
+        _publish_dynamic_filters)."""
         from ..expr import ir as _ir
         from .breaker import BREAKERS
         from .dynfilter import HostFilterAccumulator, filter_from_summary
@@ -783,15 +1138,24 @@ class StreamingExecutor:
             return
         for fid, i, _c in node.dynamic_filters:
             key = node.right_keys[i]
-            if not isinstance(key, _ir.ColumnRef) or key.name not in host.names:
-                continue
+            df = None
             try:
-                idx = host.names.index(key.name)
                 acc = HostFilterAccumulator(key.name)
-                acc.add_numpy(
-                    host.columns[idx], host.valids[idx], host.types[idx]
-                )
-                df = filter_from_summary(acc.summary(), host.types[idx])
+                key_type = None
+                for chunk in spilled.iter_host_chunks():
+                    if not isinstance(key, _ir.ColumnRef) or (
+                        key.name not in chunk.names
+                    ):
+                        acc = None
+                        break
+                    idx = chunk.names.index(key.name)
+                    key_type = chunk.types[idx]
+                    acc.add_numpy(
+                        chunk.columns[idx], chunk.valids[idx], key_type
+                    )
+                if acc is None:
+                    continue
+                df = filter_from_summary(acc.summary(), key_type)
             except Exception as exc:  # noqa: BLE001 — degrade, don't fail
                 BREAKERS.record_failure("dynamic_filter", repr(exc))
                 return
@@ -806,9 +1170,24 @@ class StreamingExecutor:
         preprobe = getattr(node, "dynamic_filters", ()) and any(
             not consumed for _f, _i, consumed in node.dynamic_filters
         )
-        for batch in (probe if probe is not None else self.stream(node.left)):
-            if preprobe:
-                batch = self.local._apply_preprobe(node, batch)
+
+        def batches():
+            for batch in (
+                probe if probe is not None else self.stream(node.left)
+            ):
+                if preprobe:
+                    yield self.local._apply_preprobe(node, batch)
+                else:
+                    yield batch
+
+        yield from self._probe_with(node, bs, right_names, batches())
+
+    def _probe_with(
+        self, node: N.Join, bs, right_names, batches
+    ) -> Iterator[Page]:
+        """Probe pre-filtered batches against a prepared BuildSide (the
+        shared probe loop of the device, chunked, and hybrid join paths)."""
+        for batch in batches:
             if node.unique_build:
                 out = join_n1(
                     batch, bs, node.left_keys, right_names, right_names,
@@ -951,7 +1330,7 @@ class StreamingExecutor:
             return self.local._shrink(out)
 
         def spill_all(pages: List[Page]) -> None:
-            """Move partial-state pages to the host store (re-finalizable:
+            """Move partial-state pages to the spill store (re-finalizable:
             `final` over partial columns is idempotent, so spilled merged
             state and raw partials share one schema)."""
             nonlocal spilled
@@ -959,7 +1338,7 @@ class StreamingExecutor:
 
             if spilled is None:
                 self.spill_events.append("aggregate")
-                spilled = SpilledRows()
+                spilled = SpilledRows(space=self._spill(), tag="aggregate")
             for p in pages:
                 if int(p.count) > 0 or spilled.num_rows == 0:
                     spilled.append(p)
@@ -981,7 +1360,8 @@ class StreamingExecutor:
             pending.append(part)
             pending_rows += int(part.count)
             pending_bytes = sum(page_device_bytes(p) for p in pending)
-            if pending_rows >= merge_rows or not self.pool.can_reserve(
+            self.pool.accumulated = pending_bytes
+            if pending_rows >= merge_rows or not self.pool.can_accumulate(
                 pending_bytes
             ):
                 parts = ([state] if state is not None else []) + pending
@@ -989,16 +1369,20 @@ class StreamingExecutor:
                 self.pool.free(state_held)
                 state_held = 0
                 nb = page_device_bytes(new_state)
-                if self.pool.can_reserve(nb):
+                if self.pool.can_accumulate(nb):
                     state_held = self.pool.reserve(nb, "aggregation state")
                     state = new_state
                 else:
-                    # group state outgrew the budget: switch to spilling
+                    # group state outgrew the budget (or a revoke asked
+                    # for it back): switch to spilling
                     # (SpillableHashAggregationBuilder.spillToDisk)
                     spill_all([new_state])
+                    self.pool.note_revoked(nb)
                     state = None
                 pending = []
                 pending_rows = 0
+                self.pool.accumulated = 0
+        self.pool.accumulated = 0
         if spilled is not None:
             spill_all(pending)
             return self._finalize_spilled_agg(

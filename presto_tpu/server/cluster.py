@@ -318,6 +318,10 @@ class SchedulerStats:
     # and the wire capability set the attempt negotiated
     exchange: Dict[str, dict] = dataclasses.field(default_factory=dict)
     wire_caps: Optional[dict] = None
+    # memory-arbitration rollup polled from task statuses (worker-side
+    # memoryStats/spillStats): disk bytes spilled, revocations absorbed,
+    # spill events seen — the cluster half of EXPLAIN ANALYZE's memory line
+    memory: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -421,6 +425,7 @@ class HttpScheduler:
         with self._lock:
             self.stats.wire_caps = wire_caps
             self.stats.exchange = {}
+            self.stats.memory = {}
         all_tasks: List[Tuple[str, str]] = []
         try:
             fragment, specs = self._cut(root)
@@ -687,15 +692,33 @@ class HttpScheduler:
         query cleanup) into the scheduler's observable accounting."""
         entry = ex_stats.snapshot()
         encode = WireStats()
+        mem_events: set = set()
+        spilled = revocations = 0
         for uri, task in handles:
             try:
                 st = self._task_status(uri, task)
             except Exception:  # noqa: BLE001 — observability, best effort
                 continue
             encode.merge_snapshot(st.get("exchangeStats") or {})
+            sp = st.get("spillStats") or {}
+            spilled += int(sp.get("disk_bytes") or 0)
+            mem_events.update(sp.get("events") or ())
+            ms = st.get("memoryStats") or {}
+            revocations += int(ms.get("revocations") or 0)
         entry["producer"] = encode.snapshot()
         with self._lock:
             self.stats.exchange[sid] = entry
+            if spilled or revocations or mem_events:
+                m = self.stats.memory
+                m["spilled_bytes"] = (
+                    int(m.get("spilled_bytes") or 0) + spilled
+                )
+                m["revocations"] = (
+                    int(m.get("revocations") or 0) + revocations
+                )
+                m["events"] = sorted(
+                    set(m.get("events") or ()) | mem_events
+                )
 
     def _run_sharded_stage(self, node: N.PlanNode, output,
                            all_workers: List[str], all_tasks,
@@ -985,13 +1008,30 @@ class ClusterMemoryManager:
     strategy) by aborting its tasks on every worker."""
 
     def __init__(self, nodes: NodeManager, interval: float = 0.25,
-                 on_kill=None, grace_polls: int = 4):
+                 on_kill=None, grace_polls: int = 4,
+                 revoke_watermark: Optional[float] = None):
         self.nodes = nodes
         self.interval = interval
         self.on_kill = on_kill
         self.grace_polls = grace_polls  # sustained blockage before a kill
+        self.revoke_watermark = (
+            float(os.environ.get("PRESTO_TPU_REVOKE_WATERMARK", "0.8"))
+            if revoke_watermark is None else revoke_watermark
+        )
         self._blocked_streak = 0
         self.killed: List[str] = []
+        # memory-manager blindness observability: per-worker poll
+        # failures are counted and surfaced, never silently skipped
+        self.poll_failures: Dict[str, int] = {}
+        self._unpollable: set = set()
+        self.loop_errors = 0
+        self.last_loop_error = ""
+        self.last_snapshot: Dict[str, dict] = {}
+        self._pressure = False
+        # PER-WORKER last-seen revocation counters: a flapping worker's
+        # counter dropping out of (and back into) a summed total would
+        # oscillate the progress signal and indefinitely defer the killer
+        self._last_rev_by_worker: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
@@ -1007,31 +1047,96 @@ class ClusterMemoryManager:
         while not self._stop.wait(self.interval):
             try:
                 self.poll_once()
-            except Exception:  # noqa: BLE001 - keep polling
-                pass
+            except Exception as exc:  # noqa: BLE001 - keep polling, but
+                # COUNT the blindness instead of swallowing it bare
+                self.loop_errors += 1
+                self.last_loop_error = repr(exc)[:300]
+
+    def above_watermark(self) -> bool:
+        """Is any worker above the revocation watermark (or blocked)?
+        Resource-group admission refuses to start new queries while True
+        (server/resource_groups.py cluster_pressure)."""
+        return self._pressure
+
+    def _note_poll_failure(self, uri: str, exc: Exception) -> None:
+        self.poll_failures[uri] = self.poll_failures.get(uri, 0) + 1
+        if uri not in self._unpollable:
+            self._unpollable.add(uri)
+            bus = getattr(self.nodes, "event_bus", None)
+            if bus is not None:
+                # memory-manager blindness is an observable worker event,
+                # not an invisible `continue`
+                bus.fire_worker_state(
+                    uri, "MEMORY_UNPOLLABLE",
+                    f"/v1/memory poll failed: {exc!r}"[:200],
+                )
 
     def poll_once(self) -> Optional[str]:
         """One manager cycle; returns the killed query id, if any."""
         states = []
+        snapshot: Dict[str, dict] = {}
         for uri in self.nodes.active_workers():
             try:
                 with urllib.request.urlopen(
                     f"{uri}/v1/memory", timeout=5
                 ) as resp:
                     states.append((uri, json.loads(resp.read())))
-            except Exception:  # noqa: BLE001 - failure detector's job
+            except Exception as exc:  # noqa: BLE001 - count + surface;
+                # liveness demotion stays the failure detector's job
+                self._note_poll_failure(uri, exc)
+                snapshot[uri] = {
+                    "unreachable": True,
+                    "poll_failures": self.poll_failures[uri],
+                }
                 continue
+            if uri in self._unpollable:
+                self._unpollable.discard(uri)
+                bus = getattr(self.nodes, "event_bus", None)
+                if bus is not None:
+                    bus.fire_worker_state(
+                        uri, "MEMORY_POLLABLE", "memory polls recovered"
+                    )
         # live gauge snapshot for system.jmx.memory
-        self.last_snapshot = {
-            uri: {
-                "reserved": int(st.get("reserved") or 0),
-                "limit": st.get("limit") or 0,
+        progress = False
+        pressure = False
+        for uri, st in states:
+            reserved = int(st.get("reserved") or 0)
+            limit = st.get("limit") or 0
+            rev = st.get("revocations") or {}
+            completed = int(rev.get("completed") or 0)
+            # progress is judged PER WORKER against its own last-seen
+            # counter (only updated when the worker answers), so an
+            # unpollable worker neither fakes nor hides progress
+            if completed > self._last_rev_by_worker.get(uri, completed):
+                progress = True
+            self._last_rev_by_worker[uri] = completed
+            if st.get("blocked") or (
+                limit and reserved >= self.revoke_watermark * limit
+            ):
+                pressure = True
+            snapshot[uri] = {
+                "reserved": reserved,
+                "limit": limit,
                 "blocked": len(st.get("blocked") or ()),
+                "exec_reserved": int(st.get("exec_reserved") or 0),
+                "revocations": rev,
+                "over_frees": int(st.get("over_frees") or 0),
+                "spilled_bytes": int(
+                    (st.get("spill") or {}).get("total_written") or 0
+                ),
+                "poll_failures": self.poll_failures.get(uri, 0),
             }
-            for uri, st in states
-        }
+        self.last_snapshot = snapshot
+        self._pressure = pressure
         blocked = any(st.get("blocked") for _, st in states)
         if not blocked:
+            self._blocked_streak = 0
+            return None
+        # revoke-before-kill: while executors keep completing revocations
+        # (freeing state into the spill tier), the blockage is being
+        # WORKED ON — the killer only fires after revocation fails to
+        # free enough for `grace_polls` consecutive polls
+        if progress:
             self._blocked_streak = 0
             return None
         # transient blocking is normal flow control (acks free bytes
@@ -1147,6 +1252,14 @@ class HttpClusterSession:
                 + f", encode {prod.get('encode_ms', 0)}ms, decode "
                 f"{ex['decode_ms']}ms, pull peak {ex['peak_concurrent']} "
                 f"concurrent"
+            )
+        if st.memory:
+            m = st.memory
+            lines.append(
+                "-- memory: spill "
+                + ",".join(m.get("events") or ("none",))
+                + f", disk {m.get('spilled_bytes', 0):,}B, "
+                f"revocations {m.get('revocations', 0)}"
             )
         return "\n".join(lines)
 

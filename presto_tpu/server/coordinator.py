@@ -54,7 +54,7 @@ class CoordinatorServer:
                  max_concurrent: int = 1, resource_groups=None,
                  selectors=None, listeners=None, node_manager=None,
                  access_control=None, authenticator=None, tls=None,
-                 impersonation_principals=()):
+                 impersonation_principals=(), cluster_pressure=None):
         # expose system.runtime.* through the served session's catalog
         # (reference connector/system/; the user's own session is untouched).
         # Duck-typed sessions (HttpClusterSession) are served as-is — they
@@ -77,10 +77,19 @@ class CoordinatorServer:
                 user=session.user,
             )
             self.syscat = syscat
+        # cluster_pressure: admission gate fed by the cluster memory
+        # manager (HttpClusterSession.memory_manager.above_watermark) —
+        # new queries queue while the fleet is above the revocation
+        # watermark. Derived automatically for cluster sessions.
+        if cluster_pressure is None:
+            mm = getattr(session, "memory_manager", None)
+            if mm is not None:
+                cluster_pressure = mm.above_watermark
         self.manager = QueryManager(
             served, max_concurrent=max_concurrent,
             resource_groups=resource_groups, selectors=selectors,
             listeners=listeners, access_control=access_control,
+            cluster_pressure=cluster_pressure,
         )
         if self.syscat is not None:
             self.syscat.manager = self.manager

@@ -48,7 +48,10 @@ class WorkerStateEvent:
     HeartbeatFailureDetector's state changes surfaced via node-state
     JMX + the coordinator log). States: ACTIVE (re-admitted / up),
     FAILED (heartbeat probes exhausted), BLACKLISTED (drained after
-    consecutive task failures)."""
+    consecutive task failures), MEMORY_UNPOLLABLE / MEMORY_POLLABLE
+    (the cluster memory manager lost / regained sight of the worker's
+    /v1/memory — manager blindness is observable, not an invisible
+    skipped poll)."""
 
     uri: str
     state: str  # ACTIVE | FAILED | BLACKLISTED

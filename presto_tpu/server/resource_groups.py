@@ -195,20 +195,37 @@ class ResourceGroupManager:
         selectors: Optional[List[dict]] = None,
         dispatch: Optional[Callable[[object], None]] = None,
         poll_interval_s: float = 0.2,
+        cluster_pressure: Optional[Callable[[], bool]] = None,
     ):
         self.root = ResourceGroup(root_spec)
         self.selectors = [Selector(**s) for s in (selectors or [])]
         self.dispatch = dispatch or (lambda info: None)
+        # memory-pressure gate (the admission rung of the degradation
+        # ladder): while the cluster memory manager reports usage above
+        # the revocation watermark, new queries QUEUE instead of starting
+        # (reference: ClusterMemoryManager's lastKilledQuery admission
+        # backoff). Typically ClusterMemoryManager.above_watermark.
+        self.cluster_pressure = cluster_pressure
+        self.pressure_deferrals = 0  # submissions queued due to pressure
         self._lock = threading.Lock()
         self._groups_of: Dict[str, ResourceGroup] = {}
-        # periodic drain: CPU quotas refill with TIME, not with query
-        # completions, so queued queries need a ticker to wake them
-        # (reference: ResourceGroupManager's scheduled processQueuedQueries)
-        if self._has_cpu_quota(self.root):
+        # periodic drain: CPU quotas refill with TIME (and memory
+        # pressure clears with time), not with query completions, so
+        # queued queries need a ticker to wake them (reference:
+        # ResourceGroupManager's scheduled processQueuedQueries)
+        if self._has_cpu_quota(self.root) or cluster_pressure is not None:
             t = threading.Thread(
                 target=self._poll_loop, args=(poll_interval_s,), daemon=True
             )
             t.start()
+
+    def _under_pressure(self) -> bool:
+        if self.cluster_pressure is None:
+            return False
+        try:
+            return bool(self.cluster_pressure())
+        except Exception:  # noqa: BLE001 - a broken gauge must not wedge
+            return False  # admission (fail open, the killer still guards)
 
     @staticmethod
     def _has_cpu_quota(group: ResourceGroup) -> bool:
@@ -250,6 +267,11 @@ class ResourceGroupManager:
                     chain_ok = False
                     break
                 g = g.parent
+            if chain_ok and self._under_pressure():
+                # cluster above the revocation watermark: queue instead
+                # of piling more reservations onto a straining fleet
+                chain_ok = False
+                self.pressure_deferrals += 1
             if chain_ok and not group.queue:
                 group.on_start()
                 released.append(info)
@@ -270,7 +292,7 @@ class ResourceGroupManager:
 
     def _drain_eligible_locked(self) -> List[object]:
         out = []
-        while self.root.can_run_more():
+        while self.root.can_run_more() and not self._under_pressure():
             nxt = self.root.pop_next()
             if nxt is None:
                 break

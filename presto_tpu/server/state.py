@@ -63,7 +63,7 @@ class QueryManager:
     def __init__(self, session, max_concurrent: int = 1,
                  max_history: int = 100, resource_groups: Optional[dict] = None,
                  selectors: Optional[list] = None, listeners=None,
-                 access_control=None):
+                 access_control=None, cluster_pressure=None):
         from .events import EventBus
         from .resource_groups import ResourceGroupManager
 
@@ -86,8 +86,13 @@ class QueryManager:
             "hard_concurrency_limit": max_concurrent,
             "max_queued": 10_000,
         }
+        # cluster_pressure (typically ClusterMemoryManager.above_watermark
+        # when serving an HttpClusterSession): admission refuses to start
+        # queries while the cluster is above the revocation watermark
         self.groups = ResourceGroupManager(
-            spec, selectors, dispatch=lambda info: self._queue.put(info.query_id)
+            spec, selectors,
+            dispatch=lambda info: self._queue.put(info.query_id),
+            cluster_pressure=cluster_pressure,
         )
         # enough executor threads to honor the root group's concurrency;
         # beyond the thread cap, clamp the group limit so admission never

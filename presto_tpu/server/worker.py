@@ -56,18 +56,144 @@ class QueryKilledError(RuntimeError):
 
 
 class WorkerMemoryPool:
-    """Worker-wide memory accounting for task OUTPUT buffers (reference:
-    worker MemoryPool polled by ClusterMemoryManager.process,
-    memory/ClusterMemoryManager.java:89). Reservations past the limit
-    BLOCK (the reference's blocking futures) until space frees or the
-    cluster memory manager kills a query."""
+    """Worker-wide memory accounting (reference: worker MemoryPool polled
+    by ClusterMemoryManager.process, memory/ClusterMemoryManager.java:89).
 
-    def __init__(self, limit: Optional[int] = None):
+    Two ledgers share one limit:
+    * OUTPUT buffers (`reserve`/`free`): reservations past the limit
+      BLOCK (the reference's blocking futures) until space frees, a
+      revocation frees executor state, or the cluster memory manager
+      kills a query.
+    * EXECUTION state (`reserve_execution`/`free_execution`): build
+      tables, accumulator state and spilled-pending bytes mirrored from
+      each task's exec MemoryPool (exec/memory.py parent mirroring) —
+      accounting-only (the executor enforces its own device budget), but
+      counted against the limit/watermark so `/v1/memory` and the killer
+      see REAL usage.
+
+    Crossing the revocation watermark asks running executors to revoke
+    (offload -> disk spill) in largest-revocable-first order — the
+    MemoryRevokingScheduler analog (MemoryRevokingScheduler.java:46) —
+    BEFORE anything blocks long enough for the killer to fire."""
+
+    def __init__(self, limit: Optional[int] = None,
+                 revoke_watermark: Optional[float] = None):
+        import os
+
         self.limit = limit
-        self.reserved = 0
+        self.revoke_watermark = (
+            float(os.environ.get("PRESTO_TPU_REVOKE_WATERMARK", "0.8"))
+            if revoke_watermark is None else revoke_watermark
+        )
+        self.reserved = 0  # output-buffer bytes
         self.by_query: Dict[str, int] = {}
+        self.exec_reserved = 0  # executor-held bytes (mirrored)
+        self.exec_by_query: Dict[str, int] = {}
         self.blocked: set = set()  # query ids currently waiting
+        # double-free observability (never silently clamp)
+        self.over_frees = 0
+        self.over_freed_bytes = 0
+        # leaked exec reservations force-released at task unregister —
+        # nonzero means a driver leak (the chaos suite asserts zero)
+        self.leaked_exec_bytes = 0
+        self.revocations_requested = 0
+        self.watermark_breaches = 0
+        self._revocations_base = 0  # completed, from unregistered pools
+        self._exec_pools: Dict[int, object] = {}  # id -> exec MemoryPool
         self._cond = threading.Condition()
+
+    # -- execution ledger (exec/memory.MemoryPool parent mirroring) --
+
+    def register_exec_pool(self, pool) -> None:
+        with self._cond:
+            self._exec_pools[id(pool)] = pool
+
+    def unregister_exec_pool(self, pool) -> None:
+        """Detach a finished task's pool; any bytes it still holds are a
+        driver leak — force-release them so the worker stays healthy, but
+        COUNT them (tests assert zero)."""
+        with self._cond:
+            self._exec_pools.pop(id(pool), None)
+            self._revocations_base += pool.revocations
+        leaked = pool.reserved
+        if leaked:
+            with self._cond:
+                self.leaked_exec_bytes += leaked
+            self.free_execution(pool.query_id, leaked)
+
+    def reserve_execution(self, query_id: str, nbytes: int) -> None:
+        maybe_revoke = False
+        with self._cond:
+            self.exec_reserved += nbytes
+            self.exec_by_query[query_id] = (
+                self.exec_by_query.get(query_id, 0) + nbytes
+            )
+            maybe_revoke = (
+                self.limit is not None
+                and self.reserved + self.exec_reserved
+                > self.revoke_watermark * self.limit
+            )
+            if maybe_revoke:
+                self._request_revocations_locked(0)
+
+    def free_execution(self, query_id: str, nbytes: int) -> None:
+        from ..exec.memory import GLOBAL_ACCOUNTING
+
+        with self._cond:
+            if nbytes > self.exec_reserved:
+                self.over_frees += 1
+                self.over_freed_bytes += nbytes - self.exec_reserved
+                GLOBAL_ACCOUNTING["over_frees"] += 1
+                GLOBAL_ACCOUNTING["over_freed_bytes"] += (
+                    nbytes - self.exec_reserved
+                )
+                nbytes = self.exec_reserved
+            self.exec_reserved -= nbytes
+            left = self.exec_by_query.get(query_id, 0) - nbytes
+            if left > 0:
+                self.exec_by_query[query_id] = left
+            else:
+                self.exec_by_query.pop(query_id, None)
+            self._cond.notify_all()
+
+    def total_reserved(self) -> int:
+        with self._cond:
+            return self.reserved + self.exec_reserved
+
+    # -- revocation (the rung between "blocked" and "killed") --
+
+    def _request_revocations_locked(self, need: int) -> None:
+        """Ask executors to revoke until the projected freeing covers the
+        excess over the watermark, largest-revocable-first (reference
+        MemoryRevokingScheduler.requestMemoryRevoking)."""
+        if self.limit is None:
+            return
+        floor = int(self.revoke_watermark * self.limit)
+        excess = self.reserved + self.exec_reserved + need - floor
+        if excess <= 0:
+            return
+        self.watermark_breaches += 1
+        pools = sorted(
+            self._exec_pools.values(),
+            key=lambda p: -p.revocable_bytes(),
+        )
+        for pool in pools:
+            if excess <= 0:
+                break
+            if pool.request_revoke():
+                self.revocations_requested += 1
+            # even a pool with nothing revocable RIGHT NOW is asked: its
+            # next accumulation window observes the pending revoke and
+            # offloads instead of growing
+            excess -= max(pool.revocable_bytes(), 1)
+
+    def revocations_completed(self) -> int:
+        with self._cond:
+            return self._revocations_base + sum(
+                p.revocations for p in self._exec_pools.values()
+            )
+
+    # -- output-buffer ledger --
 
     def reserve(self, query_id: str, nbytes: int, abort: threading.Event,
                 timeout: float = 600.0) -> None:
@@ -78,7 +204,7 @@ class WorkerMemoryPool:
             return
         deadline = time.time() + timeout
         with self._cond:
-            while self.reserved + nbytes > self.limit:
+            while self.reserved + self.exec_reserved + nbytes > self.limit:
                 if abort.is_set():
                     self.blocked.discard(query_id)
                     raise QueryKilledError(
@@ -91,15 +217,31 @@ class WorkerMemoryPool:
                         f"worker memory exhausted: {nbytes:,}B requested, "
                         f"{self.reserved:,}B of {self.limit:,}B reserved"
                     )
+                # revoke-before-kill: ask executors to free revocable
+                # state instead of waiting for the low-memory killer
+                self._request_revocations_locked(nbytes)
                 self.blocked.add(query_id)
                 self._cond.wait(timeout=0.05)
             self.blocked.discard(query_id)
             self.reserved += nbytes
             self.by_query[query_id] = self.by_query.get(query_id, 0) + nbytes
+            # the watermark can be crossed by buffer growth alone: ask
+            # for revocations BEFORE anything blocks, not only after
+            self._request_revocations_locked(0)
 
     def free(self, query_id: str, nbytes: int) -> None:
+        from ..exec.memory import GLOBAL_ACCOUNTING
+
         with self._cond:
-            self.reserved = max(0, self.reserved - nbytes)
+            if nbytes > self.reserved:
+                self.over_frees += 1
+                self.over_freed_bytes += nbytes - self.reserved
+                GLOBAL_ACCOUNTING["over_frees"] += 1
+                GLOBAL_ACCOUNTING["over_freed_bytes"] += (
+                    nbytes - self.reserved
+                )
+                nbytes = self.reserved
+            self.reserved -= nbytes
             left = self.by_query.get(query_id, 0) - nbytes
             if left > 0:
                 self.by_query[query_id] = left
@@ -113,11 +255,35 @@ class WorkerMemoryPool:
 
     def snapshot(self) -> dict:
         with self._cond:
+            queries: Dict[str, int] = dict(self.by_query)
+            for qid, nbytes in self.exec_by_query.items():
+                queries[qid] = queries.get(qid, 0) + nbytes
+            revoke_pending = any(
+                p.revoke_pending for p in self._exec_pools.values()
+            )
             return {
                 "limit": self.limit,
-                "reserved": self.reserved,
-                "queries": dict(self.by_query),
+                # total usage: buffers + executor-held bytes, so the
+                # cluster memory manager kills on REAL reservation
+                "reserved": self.reserved + self.exec_reserved,
+                "buffer_reserved": self.reserved,
+                "exec_reserved": self.exec_reserved,
+                "queries": queries,
+                "buffers": dict(self.by_query),
+                "execution": dict(self.exec_by_query),
                 "blocked": sorted(self.blocked),
+                "over_frees": self.over_frees,
+                "over_freed_bytes": self.over_freed_bytes,
+                "leaked_exec_bytes": self.leaked_exec_bytes,
+                "revocations": {
+                    "watermark_breaches": self.watermark_breaches,
+                    "requested": self.revocations_requested,
+                    "completed": self._revocations_base + sum(
+                        p.revocations for p in self._exec_pools.values()
+                    ),
+                    "pending": revoke_pending,
+                },
+                "watermark": self.revoke_watermark,
             }
 
 
@@ -159,15 +325,29 @@ class OutputBuffers:
                         "output buffer consumer stalled past the bound"
                     )
                 self._cond.wait(timeout=0.05)
-        self.pool.reserve(self.query_id, len(data), self.abort)
+            if self._drained:
+                raise QueryKilledError("task deleted while producing")
+            # claim the bound bytes under the SAME lock acquisition as
+            # the check: concurrent producers can no longer all pass the
+            # check and overshoot the bound while one of them sits in
+            # pool.reserve below
+            self._unacked += len(data)
+        try:
+            self.pool.reserve(self.query_id, len(data), self.abort)
+        except BaseException:
+            with self._cond:
+                if not self._drained:  # drain() already zeroed _unacked
+                    self._unacked -= len(data)
+                self._cond.notify_all()
+            raise
         with self._cond:
             if self._drained:
                 # task was deleted while this producer was mid-stream:
                 # hand the bytes straight back, never strand them
+                # (drain() zeroed _unacked, so only the pool needs undo)
                 self.pool.free(self.query_id, len(data))
                 raise QueryKilledError("task deleted while producing")
             self._pages.setdefault(buffer_id, []).append(data)
-            self._unacked += len(data)
             self._cond.notify_all()
 
     def finish(self) -> None:
@@ -282,6 +462,13 @@ class TaskState:
 
         self.wire_stats = WireStats()
         self.pull_stats = None  # ExchangeStats, set when sources exist
+        # memory-arbitration observability, filled at task end: the exec
+        # pool snapshot (peak/revocations/over-frees) and spill stats
+        # (events, disk bytes, hybrid join partition/recursion counters)
+        self.executor = None
+        self.spill_space = None
+        self.memory_stats: Optional[dict] = None
+        self.spill_stats: Optional[dict] = None
 
 
 # message fragments marking failures that would recur identically on any
@@ -291,6 +478,11 @@ _FATAL_MARKERS = (
     "memory exhausted",  # worker pool limit: the retry would also exceed it
     "protocol violation",
     "not yet supported",
+    # disk spill tier (exec/spillspace.py): a retry on another worker
+    # would hit the same quota; a corrupt spill file must fail the query
+    # with its structured error, never be retried into wrong rows
+    "spill quota exceeded",
+    "spill file corrupt",
 )
 
 # exception-type / message fragments identifying accelerator kernel
@@ -361,9 +553,14 @@ class StreamingFragmentExecutor(StreamingExecutor):
 
     def __init__(self, catalog, splits, source_streams,
                  batch_rows: int = 1 << 18,
-                 memory_budget: Optional[int] = None):
+                 memory_budget: Optional[int] = None,
+                 query_id: str = "",
+                 worker_pool=None,
+                 spill_space=None):
         super().__init__(
-            catalog, batch_rows=batch_rows, memory_budget=memory_budget
+            catalog, batch_rows=batch_rows, memory_budget=memory_budget,
+            query_id=query_id, worker_pool=worker_pool,
+            spill_space=spill_space,
         )
         self.splits = splits or {}
         self.source_streams = source_streams or {}
@@ -417,10 +614,29 @@ class WorkerServer:
                  task_concurrency: int = 2,
                  fault_rate: float = 0.0,
                  task_timeout: Optional[float] = None,
-                 wire_caps: Optional[dict] = None):
+                 wire_caps: Optional[dict] = None,
+                 exec_budget: Optional[int] = None,
+                 revoke_watermark: Optional[float] = None,
+                 spill_dir: Optional[str] = None,
+                 spill_node_quota: Optional[int] = None,
+                 spill_query_quota: Optional[int] = None):
+        from ..exec.spillspace import SPILL_MANAGER, SpillSpaceManager
         from ..exec.taskqueue import MultilevelScheduler
 
         self.catalog = catalog
+        # per-task streaming-executor device budget: past it, operator
+        # state offloads to host RAM and then the disk spill tier
+        self.exec_budget = exec_budget
+        # disk spill tier (exec/spillspace.py): workers with explicit
+        # quotas/dirs get their own manager; otherwise the process-global
+        # one (both register in the suite-wide leak oracle)
+        if spill_dir or spill_node_quota or spill_query_quota:
+            self.spill = SpillSpaceManager(
+                directory=spill_dir, node_quota=spill_node_quota,
+                query_quota=spill_query_quota,
+            )
+        else:
+            self.spill = SPILL_MANAGER
         # capability-advertisement override (tests: simulate an old node
         # or one without the zstandard wheel in an in-process fleet)
         self.wire_caps = wire_caps
@@ -432,7 +648,9 @@ class WorkerServer:
         # worker's own slot)
         self.task_timeout = task_timeout
         self.tasks: Dict[str, TaskState] = {}
-        self.pool = WorkerMemoryPool(memory_limit)
+        self.pool = WorkerMemoryPool(
+            memory_limit, revoke_watermark=revoke_watermark
+        )
         self.buffer_bound = buffer_bound
         # multilevel feedback gate over per-batch quanta (reference
         # TaskExecutor + MultilevelSplitQueue)
@@ -505,8 +723,11 @@ class WorkerServer:
                     return
                 if parts == ["v1", "memory"]:
                     # reference MemoryResource polled by the coordinator's
-                    # ClusterMemoryManager
-                    self._send(200, outer.pool.snapshot())
+                    # ClusterMemoryManager: buffer + execution ledgers,
+                    # revocation counters, and the disk spill tier
+                    snap = outer.pool.snapshot()
+                    snap["spill"] = outer.spill.snapshot()
+                    self._send(200, snap)
                     return
                 if parts[:2] == ["v1", "task"] and len(parts) == 3:
                     t = outer.tasks.get(parts[2])
@@ -524,6 +745,8 @@ class WorkerServer:
                         "errorInfo": t.error_info,
                         "dynFilters": t.dyn_filters or None,
                         "exchangeStats": ex_stats,
+                        "memoryStats": t.memory_stats,
+                        "spillStats": t.spill_stats,
                     })
                     return
                 if (
@@ -634,6 +857,8 @@ class WorkerServer:
     def _run_task(self, task_id: str, spec: dict, state: TaskState):
         # broadcast consumers never ack (pages are shared; freed at task
         # DELETE), so a bounded buffer would deadlock its producer
+        stream_iter = None  # closed in the finally for deterministic
+        # generator teardown (reservations return before unregister)
         bound = None if spec.get("buffer_unbounded") else self.buffer_bound
         buffers = OutputBuffers(
             self.pool, state.query_id, state.abort, bound=bound
@@ -695,7 +920,22 @@ class WorkerServer:
                 )
                 for sid, src in (spec.get("sources") or {}).items()
             }
-            ex = StreamingFragmentExecutor(self.catalog, splits, streams)
+            # per-task spill space: quota-accounted under the QUERY id,
+            # released in this thread's finally — kills, failures and
+            # clean finishes all delete their spill files
+            spill_space = self.spill.open(state.query_id)
+            state.spill_space = spill_space
+            ex = StreamingFragmentExecutor(
+                self.catalog, splits, streams,
+                memory_budget=self.exec_budget,
+                query_id=state.query_id,
+                worker_pool=self.pool,
+                spill_space=spill_space,
+            )
+            state.executor = ex
+            # executor-held bytes join the worker ledger + the revoking
+            # scheduler's candidate set (revoke-before-kill)
+            self.pool.register_exec_pool(ex.pool)
             # cross-task dynamic filters shipped by the coordinator: seed
             # the executor registry so annotated scans in this fragment
             # prune (exec/dynfilter.py). Missing/late filters simply stay
@@ -787,6 +1027,30 @@ class WorkerServer:
             state.error_info = _classify_failure(exc)
             state.state = "FAILED"
         finally:
+            # deterministic teardown (not GC): closing the stream runs
+            # every suspended generator's finally, returning executor
+            # reservations before the pool unregisters
+            if stream_iter is not None:
+                try:
+                    stream_iter.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            ex_obj = getattr(state, "executor", None)
+            if ex_obj is not None:
+                try:
+                    ex_obj.release_spill()  # fold disk counters
+                except Exception:  # noqa: BLE001
+                    pass
+                state.memory_stats = ex_obj.pool.snapshot()
+                state.spill_stats = dict(ex_obj.spill_stats)
+                state.spill_stats["events"] = sorted(
+                    set(ex_obj.spill_events)
+                )
+                self.pool.unregister_exec_pool(ex_obj.pool)
+            space = getattr(state, "spill_space", None)
+            if space is not None:
+                # guaranteed spill cleanup on finish, failure AND kill
+                space.release()
             buffers.finish()
             state.done.set()
 

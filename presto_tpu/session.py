@@ -1068,8 +1068,36 @@ class Session:
             )
             if snap["wait_s"]:
                 dyn_txt += f", wait {snap['wait_s']:.2f}s"
+        # memory-arbitration line: every rung of the degradation ladder
+        # the query touched (offload events, disk tier, hybrid-join
+        # partitioning/recursion, revocations) + over-free accounting
+        mem_txt = ""
+        spill_ev = getattr(ex, "spill_events", None)
+        if spill_ev is not None:
+            st = getattr(ex, "spill_stats", {}) or {}
+            pool = getattr(ex, "pool", None)
+            revs = getattr(pool, "revocations", 0) if pool else 0
+            overs = getattr(pool, "over_frees", 0) if pool else 0
+            if spill_ev or revs or overs or st.get("disk_bytes"):
+                parts = []
+                if spill_ev:
+                    parts.append("spill " + ",".join(sorted(set(spill_ev))))
+                if st.get("disk_bytes"):
+                    parts.append(f"disk {st['disk_bytes']:,}B")
+                if st.get("hybrid_parts"):
+                    parts.append(
+                        f"hybrid parts={st['hybrid_parts']} "
+                        f"depth={st.get('hybrid_depth', 0)}"
+                    )
+                if st.get("chunk_fallbacks"):
+                    parts.append(f"chunk_fallbacks={st['chunk_fallbacks']}")
+                if revs:
+                    parts.append(f"revocations={revs}")
+                if overs:
+                    parts.append(f"over_frees={overs}")
+                mem_txt = "\n-- memory: " + ", ".join(parts)
         return (
-            f"{tree}{dyn_txt}{breaker_txt}\n"
+            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
         )
 

@@ -31,6 +31,47 @@ def rng():
     return np.random.default_rng(42)
 
 
+# -- memory/spill accounting guard (every test) ------------------------------
+#
+# After EVERY test: no spill file may be left on disk and no spill bytes
+# may still be charged against any quota (a leaked reservation in one test
+# silently shrinks the budget of every later query on a shared node), and
+# no MemoryPool anywhere may have recorded an over-free (a double-free
+# accounting bug masks real leaks). Worker task threads are daemons and may
+# still be mid-teardown when the test body returns, so the spill check
+# polls briefly before declaring a leak.
+
+
+@pytest.fixture(autouse=True)
+def _memory_accounting_guard():
+    from presto_tpu.exec import spillspace
+    from presto_tpu.exec.memory import GLOBAL_ACCOUNTING
+
+    over0 = GLOBAL_ACCOUNTING["over_frees"]
+    yield
+    import time as _time
+
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        if spillspace.all_active_bytes() == 0 and (
+            spillspace.all_active_files() == 0
+        ):
+            break
+        _time.sleep(0.05)
+    assert spillspace.all_active_bytes() == 0, (
+        f"leaked spill bytes: {spillspace.all_active_bytes()} "
+        "(a query finished/was killed without releasing its spill space)"
+    )
+    assert spillspace.all_active_files() == 0, (
+        f"leaked spill files: {spillspace.all_active_files()}"
+    )
+    over = GLOBAL_ACCOUNTING["over_frees"] - over0
+    assert over == 0, (
+        f"{over} memory over-free(s) recorded during this test — a "
+        "double-free accounting bug (exec/memory.py MemoryPool.free)"
+    )
+
+
 # -- per-test wall-clock guard (no pytest-timeout in the image) --------------
 #
 # The distributed/cluster modules talk to real HTTP worker threads; a wedged
@@ -51,6 +92,8 @@ _MODULE_TIMEOUTS = {
     "test_parallel.py": 300,
     "test_jdbc.py": 240,
     "test_auth_tls.py": 240,
+    "test_memory_pressure.py": 300,
+    "test_overload_chaos.py": 300,
 }
 
 _SLOW_CANDIDATE_S = 30.0

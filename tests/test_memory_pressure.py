@@ -1,0 +1,546 @@
+"""Memory arbitration under pressure (PR 7): the disk spill tier below
+the host-RAM offload (exec/spillspace.py), the partitioned hybrid hash
+join with bounded recursive repartitioning (exec/stream.py; design
+trade-offs per arXiv:2112.02480), revoke-before-kill arbitration
+(server/worker.py WorkerMemoryPool + exec/memory.py), and the accounting
+invariants (no over-frees, no leaked spill files — enforced suite-wide by
+the conftest guard)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.exec.breaker import BREAKERS
+from presto_tpu.exec.memory import GLOBAL_ACCOUNTING, MemoryPool
+from presto_tpu.exec.spillspace import (
+    DiskRows,
+    SpillCorruptionError,
+    SpillQuotaExceededError,
+    SpillSpaceManager,
+)
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+SF = 0.01
+BATCH = 512
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return TpchCatalog(sf=SF)
+
+
+@pytest.fixture(scope="module")
+def plain(catalog):
+    return Session(catalog)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    BREAKERS.reset()
+    yield
+    BREAKERS.reset()
+
+
+def _streaming(catalog, **kw):
+    kw.setdefault("batch_rows", BATCH)
+    return Session(catalog, streaming=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# disk tier: forced-spill oracle equality (host ceiling 0 -> every spilled
+# byte goes through the CRC-checked spill files)
+# ---------------------------------------------------------------------------
+
+
+def test_disk_tier_external_sort(catalog, plain, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    sql = (
+        "select l_orderkey, l_extendedprice, l_shipdate from lineitem "
+        "order by l_extendedprice desc, l_orderkey"
+    )
+    s = _streaming(catalog, memory_budget=1 << 20)
+    got = s.query(sql).rows()
+    assert got == plain.query(sql).rows()
+    assert "sort" in s.executor.spill_events
+    assert s.executor.spill_stats["disk_bytes"] > 0, "disk tier never hit"
+
+
+def test_disk_tier_aggregation(catalog, plain, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    sql = (
+        "select l_orderkey, sum(l_quantity) q, count(*) n "
+        "from lineitem group by l_orderkey"
+    )
+    s = _streaming(catalog, memory_budget=192 << 10, batch_rows=4096)
+    got = sorted(s.query(sql).rows())
+    assert got == sorted(plain.query(sql).rows())
+    assert "aggregate" in s.executor.spill_events
+    assert s.executor.spill_stats["disk_bytes"] > 0
+
+
+def test_disk_tier_window(catalog, plain, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    sql = (
+        "select o_orderkey, rank() over "
+        "(partition by o_custkey order by o_totalprice desc) r from orders"
+    )
+    s = _streaming(catalog, memory_budget=256 << 10)
+    got = sorted(s.query(sql).rows())
+    assert got == sorted(plain.query(sql).rows())
+    assert "window" in s.executor.spill_events
+    assert s.executor.spill_stats["disk_bytes"] > 0
+
+
+def test_disk_tier_varchar_key_join_uses_chunked(monkeypatch):
+    # varchar keys route around the hybrid join (dictionary codes hash
+    # per-table) but the chunked path still runs through the disk tier
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    rng = np.random.default_rng(4)
+    n_b, n_p = 10_000, 20_000
+    b = Page.from_dict(
+        {
+            "bk": [f"key_{i:05d}" for i in range(n_b)],
+            "bv": rng.integers(0, 100, n_b).astype(np.int64),
+        }
+    )
+    p = Page.from_dict(
+        {
+            "pk": [
+                f"key_{i:05d}" for i in rng.integers(0, n_b, n_p)
+            ],
+            "pv": rng.integers(0, 100, n_p).astype(np.int64),
+        }
+    )
+    cat = MemoryCatalog({"b": b, "p": p})
+    sql = "select count(*) c, sum(bv + pv) s from p join b on pk = bk"
+    want = Session(cat).query(sql).rows()
+    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=64 << 10)
+    assert s.query(sql).rows() == want
+    assert "join_build" in s.executor.spill_events
+    assert "hybrid_hash_join" not in s.executor.spill_events
+    assert s.executor.spill_stats["chunk_fallbacks"] >= 1
+    assert s.executor.spill_stats["disk_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# partitioned hybrid hash join
+# ---------------------------------------------------------------------------
+
+
+def _join_tables(n_build=4_000, n_probe=8_000, tie_key=None, seed=3):
+    rng = np.random.default_rng(seed)
+    if tie_key is None:
+        bk = np.arange(n_build, dtype=np.int64)
+    else:
+        bk = np.full(n_build, tie_key, np.int64)  # all-ties build key
+    b = Page.from_dict(
+        {"bk": bk, "bv": rng.integers(0, 1000, n_build).astype(np.int64)}
+    )
+    p = Page.from_dict(
+        {
+            "pk": rng.integers(0, max(n_build, 1), n_probe).astype(np.int64),
+            "pv": rng.integers(0, 1000, n_probe).astype(np.int64),
+        }
+    )
+    return MemoryCatalog({"b": b, "p": p})
+
+
+JOIN_SQL = "select count(*) c, sum(bv + pv) s from p join b on pk = bk"
+
+
+def test_hybrid_join_recursion_at_sixteenth_budget(monkeypatch):
+    """Acceptance: oracle-equal at a budget <= 1/16 of build bytes with
+    recursive repartitioning exercised (depth >= 1 in stats)."""
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    monkeypatch.setenv("PRESTO_TPU_HYBRID_JOIN_PARTS", "4")
+    cat = _join_tables()
+    want = Session(cat).query(JOIN_SQL).rows()
+    build_bytes = 4_000 * 16  # 2 int64 columns
+    s = Session(
+        cat, streaming=True, batch_rows=2048,
+        memory_budget=build_bytes // 16,
+    )
+    got = s.query(JOIN_SQL).rows()
+    assert got == want
+    assert "hybrid_hash_join" in s.executor.spill_events
+    assert s.executor.spill_stats["hybrid_depth"] >= 1, (
+        f"recursive repartitioning never fired: {s.executor.spill_stats}"
+    )
+    assert s.executor.spill_stats["disk_bytes"] > 0
+
+
+def test_hybrid_join_auto_partitions(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    cat = _join_tables(n_build=8_000, n_probe=16_000, seed=5)
+    want = Session(cat).query(JOIN_SQL).rows()
+    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=32 << 10)
+    assert s.query(JOIN_SQL).rows() == want
+    assert "hybrid_hash_join" in s.executor.spill_events
+    assert s.executor.spill_stats["hybrid_parts"] >= 2
+    # EXPLAIN ANALYZE surfaces the ladder (re-runs the query, so it rides
+    # on this smaller shape)
+    txt = s.explain_analyze(JOIN_SQL)
+    assert "hybrid" in txt and "-- memory:" in txt
+
+
+def test_hybrid_join_all_ties_build_key(monkeypatch):
+    """A single build key value defeats hash partitioning at every salt:
+    the join must detect no-progress and degrade to the chunked build
+    loop, still oracle-equal."""
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    rng = np.random.default_rng(9)
+    # ties table MUCH smaller than the probe so the planner builds on it
+    n_build = 4_000
+    b = Page.from_dict(
+        {
+            "bk": np.full(n_build, 7, np.int64),
+            "bv": rng.integers(0, 100, n_build).astype(np.int64),
+        }
+    )
+    pk = rng.integers(0, 500, 20_000).astype(np.int64)  # a few rows hit 7
+    p = Page.from_dict(
+        {"pk": pk, "pv": rng.integers(0, 100, 20_000).astype(np.int64)}
+    )
+    cat = MemoryCatalog({"b": b, "p": p})
+    want = Session(cat).query(JOIN_SQL).rows()
+    s = Session(cat, streaming=True, batch_rows=1024, memory_budget=32 << 10)
+    assert s.query(JOIN_SQL).rows() == want
+    assert "hybrid_hash_join" in s.executor.spill_events
+    assert s.executor.spill_stats["chunk_fallbacks"] >= 1
+
+
+def test_hybrid_join_breaker_fallback(monkeypatch):
+    """An open hybrid_join breaker routes the query through the legacy
+    chunked path, oracle-equal (acceptance: falls back cleanly)."""
+    cat = _join_tables(n_build=20_000, n_probe=40_000, seed=7)
+    want = Session(cat).query(JOIN_SQL).rows()
+    BREAKERS.get("hybrid_join").record_failure("forced by test")
+    assert not BREAKERS.allow("hybrid_join")
+    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=64 << 10)
+    got = s.query(JOIN_SQL).rows()
+    assert got == want
+    assert "join_build" in s.executor.spill_events
+    assert "hybrid_hash_join" not in s.executor.spill_events
+    assert s.executor.spill_stats["chunk_fallbacks"] >= 1
+
+
+def test_hybrid_join_setup_fault_degrades(monkeypatch):
+    """A fault during hybrid partitioning (before any row is emitted)
+    records a breaker failure and falls back to the chunked path."""
+    import presto_tpu.exec.stream as stream_mod
+
+    cat = _join_tables(n_build=20_000, n_probe=40_000, seed=8)
+    want = Session(cat).query(JOIN_SQL).rows()
+
+    def boom(self, total_bytes, share, cap=64):
+        raise RuntimeError("injected hybrid partitioning fault")
+
+    monkeypatch.setattr(
+        stream_mod.StreamingExecutor, "_hybrid_partition_count", boom
+    )
+    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=64 << 10)
+    assert s.query(JOIN_SQL).rows() == want
+    assert BREAKERS.get("hybrid_join").total_failures >= 1
+    assert "hybrid_hash_join" not in s.executor.spill_events
+
+
+# ---------------------------------------------------------------------------
+# spill-file integrity + quotas
+# ---------------------------------------------------------------------------
+
+
+def test_spill_corruption_is_structured_error(tmp_path):
+    mgr = SpillSpaceManager(directory=str(tmp_path))
+    space = mgr.open("q_corrupt")
+    rows = DiskRows(space, "t", ("a",), (None,))
+    rows.append_chunk([np.arange(100, dtype=np.int64)], [None], (None,), 100)
+    # flip a byte in the middle of the record payload
+    with open(rows.file.path, "r+b") as f:
+        f.seek(40)
+        b = f.read(1)
+        f.seek(40)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(SpillCorruptionError, match="spill file corrupt"):
+        rows.read_chunk(0)
+    space.release()
+    assert mgr.active_bytes == 0 and mgr.active_files == 0
+
+
+def test_spill_truncation_is_structured_error(tmp_path):
+    mgr = SpillSpaceManager(directory=str(tmp_path))
+    space = mgr.open("q_trunc")
+    rows = DiskRows(space, "t", ("a",), (None,))
+    rows.append_chunk([np.arange(500, dtype=np.int64)], [None], (None,), 500)
+    with open(rows.file.path, "r+b") as f:
+        f.truncate(64)  # torn write
+    with pytest.raises(SpillCorruptionError, match="truncated"):
+        rows.read_chunk(0)
+    space.release()
+
+
+def test_spill_query_quota_enforced(tmp_path, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
+    mgr = SpillSpaceManager(directory=str(tmp_path), query_quota=4 << 10)
+    rng = np.random.default_rng(2)
+    t = Page.from_dict(
+        {"a": rng.random(50_000), "b": np.arange(50_000, dtype=np.int64)}
+    )
+    cat = MemoryCatalog({"t": t})
+    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=64 << 10)
+    s.executor._spill_space = mgr.open("q_quota")
+    s.executor._owns_spill = True
+    with pytest.raises(SpillQuotaExceededError, match="spill quota exceeded"):
+        s.query("select a, b from t order by a").rows()
+    # guaranteed cleanup even on quota failure
+    assert mgr.active_bytes == 0 and mgr.active_files == 0
+
+
+def test_spill_node_quota_enforced(tmp_path):
+    mgr = SpillSpaceManager(directory=str(tmp_path), node_quota=1 << 10)
+    space = mgr.open("qa")
+    rows = DiskRows(space, "t", ("a",), (None,))
+    with pytest.raises(SpillQuotaExceededError, match="per-node quota"):
+        rows.append_chunk(
+            [np.arange(10_000, dtype=np.int64)], [None], (None,), 10_000
+        )
+    assert mgr.snapshot()["quota_rejections"] >= 1
+    space.release()
+    assert mgr.active_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# over-free accounting (satellite: count, surface, fail on nonzero)
+# ---------------------------------------------------------------------------
+
+
+def test_memory_pool_counts_over_frees():
+    before = dict(GLOBAL_ACCOUNTING)
+    pool = MemoryPool(max_bytes=1000)
+    pool.reserve(100)
+    pool.free(150)  # double-free: 50 bytes never reserved
+    assert pool.over_frees == 1 and pool.over_freed_bytes == 50
+    assert pool.reserved == 0
+    assert pool.snapshot()["over_frees"] == 1
+    assert GLOBAL_ACCOUNTING["over_frees"] == before["over_frees"] + 1
+    # restore the global ledger: the intentional over-free above must not
+    # trip the suite-wide conftest guard
+    GLOBAL_ACCOUNTING["over_frees"] = before["over_frees"]
+    GLOBAL_ACCOUNTING["over_freed_bytes"] = before["over_freed_bytes"]
+
+
+def test_worker_pool_counts_over_frees():
+    from presto_tpu.server.worker import WorkerMemoryPool
+
+    before = dict(GLOBAL_ACCOUNTING)
+    pool = WorkerMemoryPool(None)
+    ev = threading.Event()
+    pool.reserve("qa", 100, ev)
+    pool.free("qa", 160)
+    assert pool.over_frees == 1 and pool.over_freed_bytes == 60
+    snap = pool.snapshot()
+    assert snap["over_frees"] == 1 and snap["reserved"] == 0
+    GLOBAL_ACCOUNTING["over_frees"] = before["over_frees"]
+    GLOBAL_ACCOUNTING["over_freed_bytes"] = before["over_freed_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# revocation: the rung between "blocked" and "killed"
+# ---------------------------------------------------------------------------
+
+
+def test_revoke_forces_offload_and_is_counted(catalog, plain):
+    """A pending revoke makes the driver offload at the next batch even
+    with NO device budget — and the completion is counted."""
+    sql = "select o_orderkey from orders order by o_totalprice"
+    s = _streaming(catalog)  # no budget: would normally never spill
+    s.executor.pool.request_revoke()
+    got = s.query(sql).rows()
+    assert got == plain.query(sql).rows()
+    assert "sort" in s.executor.spill_events
+    assert s.executor.pool.revocations >= 1
+
+
+def test_worker_pool_revokes_largest_first():
+    from presto_tpu.server.worker import WorkerMemoryPool
+
+    wp = WorkerMemoryPool(limit=1000, revoke_watermark=0.5)
+    small = MemoryPool(name="small", parent=wp, query_id="q_small")
+    big = MemoryPool(name="big", parent=wp, query_id="q_big")
+    wp.register_exec_pool(small)
+    wp.register_exec_pool(big)
+    small.reserve(300)
+    assert wp.revocations_requested == 0  # under the watermark
+    big.reserve(600)  # crosses 500: scheduler asks the LARGEST holder
+    assert wp.revocations_requested >= 1
+    assert big.revoke_pending and not small.revoke_pending
+    snap = wp.snapshot()
+    assert snap["exec_reserved"] == 900
+    assert snap["queries"] == {"q_small": 300, "q_big": 600}
+    assert snap["revocations"]["pending"]
+    big.note_revoked(600)
+    assert wp.revocations_completed() == 1
+    big.free(600)
+    small.free(300)
+    wp.unregister_exec_pool(small)
+    wp.unregister_exec_pool(big)
+    assert wp.snapshot()["exec_reserved"] == 0
+    assert wp.leaked_exec_bytes == 0
+
+
+def test_exec_pool_mirrors_into_worker_ledger():
+    from presto_tpu.server.worker import WorkerMemoryPool
+
+    wp = WorkerMemoryPool(None)
+    p = MemoryPool(name="q1", parent=wp, query_id="q1")
+    p.reserve(500, "build table")
+    assert wp.snapshot()["execution"] == {"q1": 500}
+    assert wp.snapshot()["reserved"] == 500  # real usage, not just buffers
+    p.free(500)
+    assert wp.snapshot()["execution"] == {}
+
+
+def test_revoke_request_expires():
+    pool = MemoryPool()
+    pool.revoke_grace_s = 0.05
+    pool.request_revoke()
+    assert pool.revoke_pending
+    time.sleep(0.1)
+    assert not pool.revoke_pending  # a stuck driver is not punished forever
+
+
+# ---------------------------------------------------------------------------
+# output-buffer bound: no concurrent overshoot (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_output_buffer_bound_never_overshoots():
+    from presto_tpu.server.worker import OutputBuffers, WorkerMemoryPool
+
+    pool = WorkerMemoryPool(None)
+    abort = threading.Event()
+    bound = 1000
+    buf = OutputBuffers(pool, "q", abort, bound=bound)
+    data = b"x" * 400
+    peak = [0]
+    stop = threading.Event()
+
+    def monitor():
+        while not stop.is_set():
+            peak[0] = max(peak[0], buf._unacked)
+            time.sleep(0.0005)
+
+    def producer():
+        for _ in range(6):
+            buf.put(0, data, timeout=30)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    producers = [
+        threading.Thread(target=producer, daemon=True) for _ in range(3)
+    ]
+    for t in producers:
+        t.start()
+    # slow consumer: ack one page at a time so producers contend on the
+    # bound (pre-fix, all three passed the check together and overshot)
+    token = 0
+    deadline = time.time() + 30
+    while token < 18 and time.time() < deadline:
+        got, complete, ready = buf.get(0, token, timeout=5)
+        if not ready:
+            continue
+        token += 1
+        time.sleep(0.002)
+        buf.ack(0, token)
+    stop.set()
+    for t in producers:
+        t.join(timeout=10)
+    assert token == 18
+    assert peak[0] <= bound, (
+        f"bound overshoot: saw {peak[0]}B unacked past the {bound}B bound"
+    )
+    assert pool.snapshot()["reserved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cluster memory manager: poll-failure observability (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_memory_manager_poll_failures_are_observable():
+    from presto_tpu.server.cluster import ClusterMemoryManager, NodeManager
+    from presto_tpu.server.events import EventBus, EventListener
+
+    seen = []
+
+    class L(EventListener):
+        def worker_state_changed(self, ev):
+            seen.append(ev)
+
+    dead = "http://127.0.0.1:1"  # nothing listens on port 1
+    nodes = NodeManager([dead], interval=3600, event_bus=EventBus([L()]))
+    mm = ClusterMemoryManager(nodes)  # not started: poll synchronously
+    mm.poll_once()
+    assert mm.poll_failures[dead] == 1
+    assert mm.last_snapshot[dead]["unreachable"] is True
+    assert mm.last_snapshot[dead]["poll_failures"] == 1
+    assert [e.state for e in seen] == ["MEMORY_UNPOLLABLE"]
+    mm.poll_once()  # counted again, but no duplicate transition event
+    assert mm.poll_failures[dead] == 2
+    assert [e.state for e in seen] == ["MEMORY_UNPOLLABLE"]
+
+
+def test_memory_manager_loop_counts_errors(monkeypatch):
+    from presto_tpu.server.cluster import ClusterMemoryManager, NodeManager
+
+    nodes = NodeManager([], interval=3600)
+    mm = ClusterMemoryManager(nodes, interval=0.01)
+
+    def boom():
+        raise RuntimeError("poll exploded")
+
+    monkeypatch.setattr(mm, "poll_once", boom)
+    mm.start()
+    deadline = time.time() + 5
+    while mm.loop_errors == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    mm.stop()
+    assert mm.loop_errors >= 1
+    assert "poll exploded" in mm.last_loop_error
+
+
+# ---------------------------------------------------------------------------
+# resource-group admission under memory pressure
+# ---------------------------------------------------------------------------
+
+
+def test_admission_queues_under_pressure():
+    import dataclasses
+
+    from presto_tpu.server.resource_groups import ResourceGroupManager
+
+    @dataclasses.dataclass
+    class Info:
+        query_id: str
+
+    pressure = {"on": True}
+    started = []
+    mgr = ResourceGroupManager(
+        {"name": "g", "hard_concurrency_limit": 4, "max_queued": 10},
+        dispatch=started.append,
+        poll_interval_s=0.02,
+        cluster_pressure=lambda: pressure["on"],
+    )
+    mgr.submit(Info("q1"))
+    assert started == []  # refused while above the watermark
+    assert mgr.pressure_deferrals == 1
+    assert mgr.root.queued_count() == 1
+    pressure["on"] = False  # watermark cleared: the ticker drains the queue
+    deadline = time.time() + 5
+    while not started and time.time() < deadline:
+        time.sleep(0.01)
+    assert [i.query_id for i in started] == ["q1"]

@@ -37,6 +37,11 @@ GATED = (
     # 208 MB/s) checked via the mbps_floors table below
     "serde_lz4", "serde_encoded", "serde_parallel_stripes",
     "exchange_pull_pipelined",
+    # memory-arbitration degradation path (PR 7): the partitioned hybrid
+    # hash join and the external sort must stay fast even when forced
+    # through the CRC-checked disk spill tier — a regression here is an
+    # overload-behavior regression even if in-memory paths stay green
+    "hybrid_join_spill", "external_sort_disk",
 )
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
