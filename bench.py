@@ -359,7 +359,8 @@ def main():
             from presto_tpu.session import Session
 
             cat = DeviceTpchCatalog(sf=sql_sf)
-            sess = Session(cat)
+            # result_cache off: the SQL stage times execution, not serving
+            sess = Session(cat, result_cache=False)
             q3 = (
                 "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev, "
                 "o_orderdate, o_shippriority "

@@ -1077,6 +1077,7 @@ def run_hybrid_join_spill_bench(sf: float, runs: int = RUNS) -> Dict:
         sess = Session(
             cat, streaming=True, batch_rows=1 << 16,
             memory_budget=max(build_bytes // 8, 96 << 10),
+            result_cache=False,  # timing EXECUTION, not cache serving
         )
         sess.query(sql).rows()  # warm (compile)
         best = float("inf")
@@ -1126,6 +1127,7 @@ def run_external_sort_disk_bench(sf: float, runs: int = RUNS) -> Dict:
         sess = Session(
             cat, streaming=True, batch_rows=1 << 16,
             memory_budget=max(16 * n // 8, 128 << 10),
+            result_cache=False,  # timing EXECUTION, not cache serving
         )
         sess.query(sql).rows()  # warm
         best = float("inf")
@@ -1149,6 +1151,52 @@ def run_external_sort_disk_bench(sf: float, runs: int = RUNS) -> Dict:
     }
 
 
+def run_plan_cache_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Warm serving fast path end to end (exec/qcache.py): repeated
+    EXECUTE of one prepared dashboard statement through the plan-skeleton
+    + result caches — parse + cache lookups + validated page serve, no
+    re-plan, no kernel dispatch. rows/s counts the orders rows each
+    served result logically covers (the serving analog of a scan micro);
+    raises when the warm path failed to hit either cache so the gate
+    catches a broken fast path, not just a slow one."""
+    from ..connectors.tpch import TpchCatalog
+    from ..exec import qcache
+    from ..session import Session
+
+    cat = TpchCatalog(sf=min(sf, 0.1))
+    sess = Session(cat)
+    rows_per_exec = cat.exact_row_count("orders")
+    sess.query(
+        "prepare qps_micro from select count(*) c, sum(o_totalprice) s "
+        "from orders where o_custkey > ?"
+    )
+    sess.query("execute qps_micro using 100")  # cold: plan+compile+store
+    execs = 100
+    s0 = qcache.snapshot_all()
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        for _i in range(execs):
+            sess.query("execute qps_micro using 100")
+        best = min(best, time.perf_counter() - t0)
+    s1 = qcache.snapshot_all()
+    ph = s1["plan"]["hits"] - s0["plan"]["hits"]
+    rh = s1["result"]["hits"] - s0["result"]["hits"]
+    if ph == 0 or rh == 0:
+        raise RuntimeError(
+            f"warm EXECUTE missed the caches (plan +{ph}, result +{rh})"
+        )
+    n = rows_per_exec * execs
+    return {
+        "name": "plan_cache_hit",
+        "rows": n,
+        "rows_per_s": round(n / best),
+        "ms": round(best * 1e3, 3),
+        "note": f"{execs} warm EXECUTEs at {round(best / execs * 1e6)}us "
+                f"each; hits plan+{ph} result+{rh}",
+    }
+
+
 HOST_BENCHES = {
     "serde_lz4": run_serde_bench,
     "serde_encoded": run_serde_encoded_bench,
@@ -1156,6 +1204,7 @@ HOST_BENCHES = {
     "exchange_pull_pipelined": run_exchange_pull_bench,
     "hybrid_join_spill": run_hybrid_join_spill_bench,
     "external_sort_disk": run_external_sort_disk_bench,
+    "plan_cache_hit": run_plan_cache_bench,
 }
 
 

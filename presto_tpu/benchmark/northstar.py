@@ -38,7 +38,10 @@ def run(sf: float, runs: int = 3, prewarm: int = 1, queries=None):
 
     dev = jax.devices()[0]
     cat = DeviceTpchCatalog(sf=sf)
-    sess = Session(cat)
+    # result_cache off: this driver times EXECUTION — serving repeats
+    # from the result cache would time a dictionary lookup instead (the
+    # serving fast path has its own driver, northstar_qps)
+    sess = Session(cat, result_cache=False)
     li_rows = cat.exact_row_count("lineitem")
     out = {
         "suite": "northstar_device_sql",

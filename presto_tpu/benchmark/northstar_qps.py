@@ -1,0 +1,281 @@
+"""northstar_qps — concurrent prepared-statement serving benchmark.
+
+The north star is thousands of small dashboard queries, not one big
+scan; this driver measures that shape directly. N client threads replay
+a MIXED prepared-statement workload (each statement's parameters drawn
+from a small rotating pool, the dashboard pattern) against one shared
+Session (default) or over HTTP against an embedded CoordinatorServer
+(--http), and report:
+
+* cold p50/p99 — first-ever execution per statement: parse + plan +
+  trace + XLA compile + execute (what every query paid before the
+  serving fast path existed),
+* warm p50/p99 + aggregate QPS under concurrency — the steady state the
+  plan/result/kernel caches (exec/qcache.py) are built for,
+* per-cache hit rates over the run (the same counters /v1/status serves).
+
+Reference protocol: presto-benchto concurrency benchmarks (tpch.yaml
+`concurrency:` runs). Gated by tools/bench_gate.py against the
+BASELINE.json `qps_gate` floors (warm p50 ceiling, QPS floor, and the
+>=5x warm-vs-cold p50 acceptance line).
+
+    python -m presto_tpu.benchmark.northstar_qps --sf 0.01 --clients 8 \
+        --iters 30 [--http] [--no-cache]
+
+Prints ONE JSON line. The workload is join-free on purpose: the shared
+Session's dynamic-filter registry is per-query state and this driver's
+point is cache behavior under concurrency, not join planning.
+
+--no-cache (the A/B baseline) is best run with --clients 1: with caches
+off every request re-plans and re-traces, and concurrent re-tracing can
+trip the pre-existing single-process pure_callback deadlock the cached
+path never reaches (one more reason the serving path wants the caches).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+# (name, prepared SQL, parameter value pool rendered as SQL literals)
+WORKLOAD = (
+    ("cust_orders",
+     "select count(*) c, sum(o_totalprice) s from orders "
+     "where o_custkey = ?",
+     ("37", "755", "1234", "400")),
+    ("segment_count",
+     "select count(*) c from customer where c_mktsegment = ?",
+     ("'BUILDING'", "'MACHINERY'", "'AUTOMOBILE'", "'FURNITURE'")),
+    ("order_lines",
+     "select count(*) c, sum(l_extendedprice) s from lineitem "
+     "where l_orderkey = ?",
+     ("1", "357", "1988", "4000")),
+    ("open_orders",
+     "select count(*) c from orders "
+     "where o_orderdate >= date '1995-01-01' and o_orderstatus = ?",
+     ("'O'", "'F'", "'P'", "'O'")),
+    ("top_orders",
+     "select o_orderkey, o_totalprice from orders "
+     "order by o_totalprice desc limit ?",
+     ("10", "25", "10", "50")),
+)
+
+
+def _pctl(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    i = min(int(len(s) * q), len(s) - 1)
+    return s[i]
+
+
+class _SessionTransport:
+    def __init__(self, sess):
+        self.sess = sess
+
+    def execute(self, sql: str):
+        return self.sess.query(sql).rows()
+
+
+class _HttpTransport:
+    def __init__(self, uri: str):
+        from ..server.client import Client
+
+        self.client = Client(uri)
+
+    def execute(self, sql: str):
+        _cols, rows = self.client.execute(sql)
+        return rows
+
+
+def run(sf: float = 0.01, clients: int = 8, iters: int = 30,
+        http: bool = False, use_cache: bool = True,
+        workload=WORKLOAD, join_timeout_s: float = 300.0) -> Dict:
+    import jax
+
+    from ..connectors.tpch import TpchCatalog
+    from ..exec import qcache
+    from ..session import Session
+
+    cat = TpchCatalog(sf=sf)
+    sess = Session(cat, plan_cache=use_cache, result_cache=use_cache)
+    server = None
+    try:
+        if http:
+            from ..server.coordinator import CoordinatorServer
+
+            server = CoordinatorServer(
+                sess, max_concurrent=max(clients, 2)
+            ).start()
+            # the served session is a sibling wrapping a SystemCatalog:
+            # propagate the cache switches the A/B flag selected
+            server.manager.session.plan_cache = use_cache
+            server.manager.session.result_cache = use_cache
+            transport = _HttpTransport(server.uri)
+        else:
+            transport = _SessionTransport(sess)
+
+        for name, sql, _pool in workload:
+            transport.execute(f"prepare {name} from {sql}")
+
+        def exec_stmt(name: str, pool, k: int):
+            return f"execute {name} using {pool[k % len(pool)]}"
+
+        # cold: first-ever execution per statement (plan+compile+run)
+        cache0 = qcache.snapshot_all()
+        cold_ms: List[float] = []
+        for name, _sql, pool in workload:
+            t0 = time.perf_counter()
+            transport.execute(exec_stmt(name, pool, 0))
+            cold_ms.append((time.perf_counter() - t0) * 1e3)
+
+        # warm-up sweep: every (statement, value) combination once, so
+        # the concurrent phase measures steady-state serving
+        for k in range(max(len(p) for _n, _s, p in workload)):
+            for name, _sql, pool in workload:
+                transport.execute(exec_stmt(name, pool, k))
+
+        # concurrent phase
+        lat_ms: List[float] = []
+        errors: List[str] = []
+        lock = threading.Lock()
+        counter = [0]
+        barrier = threading.Barrier(clients + 1)
+
+        def client_loop(cid: int):
+            local: List[float] = []
+            t = (
+                _HttpTransport(server.uri)
+                if http else _SessionTransport(sess)
+            )
+            barrier.wait()
+            for i in range(iters):
+                for name, _sql, pool in workload:
+                    with lock:
+                        k = counter[0]
+                        counter[0] += 1
+                    t0 = time.perf_counter()
+                    try:
+                        t.execute(exec_stmt(name, pool, k))
+                    except Exception as e:  # noqa: BLE001 — record
+                        with lock:
+                            errors.append(repr(e)[:200])
+                        continue
+                    local.append((time.perf_counter() - t0) * 1e3)
+            with lock:
+                lat_ms.extend(local)
+
+        threads = [
+            threading.Thread(target=client_loop, args=(c,), daemon=True)
+            for c in range(clients)
+        ]
+        for th in threads:
+            th.start()
+        barrier.wait()
+        t_start = time.perf_counter()
+        # bounded joins: a wedged query must turn into a reported error,
+        # never an infinite hang (client threads are daemons)
+        deadline = t_start + join_timeout_s
+        for th in threads:
+            th.join(timeout=max(deadline - time.perf_counter(), 0.1))
+        wedged = sum(1 for th in threads if th.is_alive())
+        if wedged:
+            with lock:
+                errors.append(
+                    f"{wedged} client thread(s) wedged past "
+                    f"{join_timeout_s:.0f}s — aborting the run"
+                )
+        wall_s = time.perf_counter() - t_start
+        cache1 = qcache.snapshot_all()
+
+        def delta(name: str) -> dict:
+            h = cache1[name]["hits"] - cache0[name]["hits"]
+            m = cache1[name]["misses"] - cache0[name]["misses"]
+            return {
+                "hits": h,
+                "misses": m,
+                "hit_rate": round(h / (h + m), 4) if h + m else None,
+                "bytes": cache1[name]["bytes"],
+                "evictions": cache1[name]["evictions"]
+                - cache0[name]["evictions"],
+            }
+
+        n_req = len(lat_ms)
+        cold_p50 = _pctl(cold_ms, 0.50)
+        warm_p50 = _pctl(lat_ms, 0.50)
+        return {
+            "suite": "northstar_qps",
+            "backend": jax.devices()[0].platform,
+            "sf": sf,
+            "clients": clients,
+            "iters": iters,
+            "http": http,
+            "use_cache": use_cache,
+            "requests": n_req,
+            "errors": len(errors),
+            "error_sample": errors[:3],
+            "qps": round(n_req / wall_s, 1) if wall_s else None,
+            "wall_s": round(wall_s, 3),
+            "cold_p50_ms": round(cold_p50, 2),
+            "cold_p99_ms": round(_pctl(cold_ms, 0.99), 2),
+            "warm_p50_ms": round(warm_p50, 2),
+            "warm_p99_ms": round(_pctl(lat_ms, 0.99), 2),
+            "speedup_p50": round(cold_p50 / warm_p50, 1) if warm_p50 else None,
+            "caches": {
+                "plan": delta("plan"),
+                "result": delta("result"),
+                "kernel": delta("kernel"),
+            },
+        }
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def main(argv: Optional[List[str]] = None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--http", action="store_true",
+                    help="drive an embedded CoordinatorServer over HTTP")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="A/B baseline: disable the plan + result caches")
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import os
+        import re
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # >=2 virtual devices: the single-device CPU runtime has a known
+        # flaky pure_callback deadlock on the host-routed TopN kernel
+        # (pre-existing; the test harness always runs 8 virtual devices)
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import presto_tpu  # noqa: F401  (enables x64)
+
+    out = run(args.sf, clients=args.clients, iters=args.iters,
+              http=args.http, use_cache=not args.no_cache)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    main()
+    sys.stdout.flush()
+    import os
+
+    os._exit(0)  # skip native teardown (see bench.py)

@@ -413,6 +413,7 @@ def run_scale(
     sess = Session(
         cat, streaming=True, batch_rows=batch_rows,
         memory_budget=memory_budget,
+        result_cache=False,  # timing execution, not cache serving
     )
     n_li = cat.exact_row_count("lineitem")
     out = {"sf": sf, "memory_budget": memory_budget, "queries": {}}
@@ -454,6 +455,7 @@ def run_sf100(
     sess = Session(
         cat, streaming=True, batch_rows=batch_rows,
         memory_budget=memory_budget,
+        result_cache=False,  # timing execution, not cache serving
     )
     n = cat.row_count("lineitem")
     out = {"sf": sf, "rows": n, "memory_budget": memory_budget, "queries": {}}

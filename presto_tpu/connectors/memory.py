@@ -29,9 +29,24 @@ class MemoryCatalog(WritableConnector):
     ):
         self.tables = dict(tables)
         self.unique = unique or {}
+        # per-table monotonic snapshot versions (plan/result cache
+        # invalidation, exec/qcache.py): bumped BY NAME on every write so
+        # a re-created table never resumes an old version sequence
+        self._versions: Dict[str, int] = {}
+
+    def _bump(self, table: str) -> None:
+        self._versions[table] = self._versions.get(table, 0) + 1
+
+    def table_version(self, table: str) -> int:
+        if table not in self.tables:
+            # unknown names must not look like a constant version 0 —
+            # wrappers (SystemCatalog) probe through this
+            raise KeyError(f"table {table!r} does not exist")
+        return self._versions.get(table, 0)
 
     def add(self, name: str, page: Page) -> None:
         self.tables[name] = page
+        self._bump(name)
 
     def table_names(self) -> List[str]:
         return list(self.tables)
@@ -57,13 +72,16 @@ class MemoryCatalog(WritableConnector):
         from ..ops.union import empty_page
 
         self.tables[table] = empty_page(schema)
+        self._bump(table)
 
     def create_table_from_page(self, table: str, page: Page) -> None:
         self.tables[table] = page
+        self._bump(table)
 
     def drop_table(self, table: str) -> None:
         del self.tables[table]
         self.unique.pop(table, None)
+        self._bump(table)
 
     def append(self, table: str, page: Page) -> None:
         from ..ops.union import concat_pages
@@ -73,6 +91,8 @@ class MemoryCatalog(WritableConnector):
             self.tables[table] = page
         elif int(page.count) > 0:
             self.tables[table] = concat_pages([base, page])
+        self._bump(table)
 
     def replace(self, table: str, page: Page) -> None:
         self.tables[table] = page
+        self._bump(table)

@@ -166,6 +166,24 @@ class ShardStoreCatalog(WritableConnector):
             ).fetchone()
         return int(row[0]) * 1_000_003 + int(row[1])
 
+    def table_version(self, table: str) -> int:
+        """Connector snapshot version (exec/qcache.py): the shard-set
+        version — shard ids are AUTOINCREMENT, so every write produces a
+        fresh id and equal versions imply equal row sets (compaction
+        changes the version without changing data: a spurious but safe
+        invalidation) — mixed with the schema hash so DROP + re-CREATE
+        under a different schema can never alias the empty-table
+        version."""
+        import zlib
+
+        with self._db_lock:
+            row = self.db.execute(
+                "SELECT schema_json FROM tables WHERE name = ?", (table,)
+            ).fetchone()
+        if row is None:
+            raise KeyError(f"table {table!r} does not exist")
+        return (self._version(table) << 32) ^ zlib.crc32(row[0].encode())
+
     # -- writes ------------------------------------------------------------
 
     def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:

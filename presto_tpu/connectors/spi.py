@@ -64,6 +64,15 @@ class Connector(Catalog):
     def exact_row_count(self, table: str) -> int:
         return int(self.page(table).count)
 
+    def table_version(self, table: str) -> Optional[int]:
+        """Monotonic snapshot version for `table`, bumped by every
+        INSERT/DELETE/DDL through this connector, or None when the
+        connector cannot observe data changes. The plan/result caches
+        (exec/qcache.py) treat None as UNCACHEABLE — a connector without
+        versioning can never serve a stale result. Immutable connectors
+        (tpch/tpcds generators) return a constant."""
+        return None
+
     # -- statistics (reference ConnectorMetadata.getTableStatistics /
     # spi/statistics/TableStatistics) --
 

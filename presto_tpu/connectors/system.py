@@ -232,6 +232,13 @@ class SystemCatalog(Connector):
             return []
         return self.wrapped.unique_columns(table)
 
+    def table_version(self, table: str):
+        # system.runtime.* are live views of server state: NEVER cacheable
+        if table in self._SYSTEM_TABLES:
+            return None
+        fn = getattr(self.wrapped, "table_version", None)
+        return None if fn is None else fn(table)
+
     # -- data --
 
     def page(self, table: str) -> Page:

@@ -1424,6 +1424,13 @@ class TpcdsCatalog:
     def unique_columns(self, tname: str):
         return _UNIQUE_COLUMNS.get(tname, [])
 
+    def table_version(self, tname: str) -> int:
+        """Immutable generated data: constant version, always cacheable
+        (exec/qcache.py)."""
+        if tname not in TABLE_NAMES:
+            raise KeyError(f"table {tname!r} does not exist")
+        return 0
+
     def page(self, tname: str):
         pg = self._pages.get(tname)
         if pg is None:

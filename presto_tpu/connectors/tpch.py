@@ -585,6 +585,13 @@ class TpchCatalog:
     def unique_columns(self, tname: str):
         return _UNIQUE_COLUMNS.get(tname, [])
 
+    def table_version(self, tname: str) -> int:
+        """Generated data is immutable: a constant snapshot version makes
+        every tpch read cacheable forever (exec/qcache.py)."""
+        if tname not in TABLE_NAMES:
+            raise KeyError(f"table {tname!r} does not exist")
+        return 0
+
     def page(self, tname: str) -> "Page":
         """Full-table Page with SOURCE column names (executor renames to
         plan channels). Cached: repeated queries reuse device arrays."""

@@ -2,10 +2,15 @@
 
 Re-designed equivalent of presto-jdbc (presto-jdbc/src/main/java/com/
 facebook/presto/jdbc/ — PrestoConnection/PrestoStatement/PrestoResultSet
-over the same /v1/statement protocol). Python's DB-API is the JDBC analog
-here; `qmark` parameters are bound client-side by literal substitution
-with SQL escaping (the reference's JDBC driver also textualizes simple
-statements before POSTing).
+over the same /v1/statement protocol). `qmark` parameters are bound
+SERVER-SIDE: the statement text (with its `?` placeholders intact) is
+PREPAREd once per connection and each execute sends
+`EXECUTE <name> USING <literals>`, where the values appear only in the
+USING list as typed literals the server parses and binds as constants —
+never spliced into arbitrary SQL positions (the old client-side
+substitution was both a quoting/injection hazard and a plan-cache key
+leak: every distinct value produced a distinct statement text, so no two
+executions could share a cached plan skeleton; see exec/qcache.py).
 
     import presto_tpu.dbapi as dbapi
     conn = dbapi.connect("http://localhost:8080")
@@ -59,7 +64,9 @@ def _escape(v) -> str:
 
 def _substitute(sql: str, params: Sequence) -> str:
     """Replace ? placeholders outside string literals, quoted identifiers,
-    and comments."""
+    and comments. LEGACY: kept for callers that need a textualized
+    statement (and for tests of the escaper); Cursor.execute now binds
+    server-side via PREPARE/EXECUTE USING instead."""
     out = []
     it = iter(params)
     i = 0
@@ -123,11 +130,20 @@ class Cursor:
 
     def execute(self, operation: str, parameters: Sequence = ()) -> "Cursor":
         self._check()
-        sql = _substitute(operation, parameters) if parameters else operation
         try:
-            cols, rows = self._conn._client.execute(sql)
+            if parameters:
+                cols, rows = self._conn._execute_prepared(
+                    operation, parameters
+                )
+            else:
+                cols, rows = self._conn._client.execute(operation)
+        except Error:
+            raise
         except Exception as e:  # noqa: BLE001 - wrap in DB-API error
-            raise DatabaseError(str(e)) from e
+            msg = str(e)
+            if "parameters" in msg and "expects" in msg:
+                raise ProgrammingError(msg) from e
+            raise DatabaseError(msg) from e
         self.description = [
             (c["name"], c["type"], None, None, None, None, None)
             for c in (cols or [])
@@ -194,6 +210,40 @@ class Connection:
 
         self._client = Client(uri, timeout=timeout)
         self._closed = False
+        self._prepared: dict = {}  # statement text -> server-side name
+
+    # -- server-side parameter binding --
+
+    def _prepare(self, operation: str) -> str:
+        """PREPARE `operation` once per connection under a deterministic
+        content-hashed name (concurrent connections preparing the same
+        text collide onto the identical statement — benign)."""
+        name = self._prepared.get(operation)
+        if name is None:
+            import hashlib
+
+            name = "dbapi_" + hashlib.sha1(
+                operation.encode()
+            ).hexdigest()[:16]
+            self._client.execute(f"prepare {name} from {operation}")
+            self._prepared[operation] = name
+        return name
+
+    def _execute_prepared(self, operation: str, parameters: Sequence):
+        using = ", ".join(_escape(v) for v in parameters)
+        name = self._prepare(operation)
+        sql = f"execute {name} using {using}"
+        try:
+            return self._client.execute(sql)
+        except Exception as e:  # noqa: BLE001
+            # match the server's specific missing-statement error, not any
+            # message containing "not found" (404s say that too)
+            if "prepared statement" in str(e) and "not found" in str(e):
+                # server restarted / session recycled: re-prepare once
+                self._prepared.pop(operation, None)
+                name = self._prepare(operation)
+                return self._client.execute(f"execute {name} using {using}")
+            raise
 
     def cursor(self) -> Cursor:
         if self._closed:
@@ -207,6 +257,16 @@ class Connection:
         raise DatabaseError("transactions are not supported")
 
     def close(self):
+        # DEALLOCATE this connection's server-side statements: the
+        # coordinator session is shared, so leaked names would grow its
+        # prepared map for the process lifetime. Best-effort — another
+        # connection using the same content-hashed name simply re-PREPAREs.
+        for name in self._prepared.values():
+            try:
+                self._client.execute(f"deallocate prepare {name}")
+            except Exception:  # noqa: BLE001 — closing must not raise
+                pass
+        self._prepared.clear()
         self._closed = True
 
     def __enter__(self):

@@ -58,10 +58,21 @@ class Executor:
         # one-hot-matmul MXU path (ops/matmul_agg.py) before falling back
         # to the sort strategy; same auto semantics as pallas_groupby
         self.matmul_groupby = matmul_groupby
-        # (plan node, static params) -> jitted kernel; the analog of the
-        # reference caching compiled PageProcessors per plan
-        # (LocalExecutionPlanner compiles once, Drivers reuse)
-        self._kernels: Dict = {}
+        # (plan node, static params) -> jitted kernel. Backed by the
+        # PROCESS-WIDE LRU (exec/qcache.KERNEL_CACHE) keyed additionally
+        # on (backend, jit flag): back-to-back queries from different
+        # sessions reuse traced executables — the analog of the reference
+        # caching compiled PageProcessors per plan (LocalExecutionPlanner
+        # compiles once, Drivers reuse), promoted cross-query.
+        # PRESTO_TPU_COMPILE_CACHE_DIR additionally persists XLA
+        # executables to disk so restarts warm-start.
+        self._backend = None  # resolved lazily (never init jax at import)
+        # kernels over time-/context-dependent expressions (now(), ...)
+        # bake the value at TRACE time and must not outlive this
+        # executor: they stay in a per-executor dict (the pre-PR-8
+        # compile-once scope) instead of the process-wide cache
+        self._local_kernels: Dict = {}
+        self._det_keys: Dict = {}  # kernel key -> is-deterministic verdict
         # EXPLAIN ANALYZE support (exec/stats.py); None = no accounting
         self.collector = collector
         self._retries = 0  # adaptive-capacity re-runs since last snapshot
@@ -83,11 +94,39 @@ class Executor:
     def _kernel(self, key, make_fn):
         """Compile-once cache for per-node kernels. jax.jit retraces per
         input shape bucket automatically; `key` carries the static config
-        (the node itself plus capacity-like ints)."""
-        fn = self._kernels.get(key)
+        (the node itself plus capacity-like ints). The store is the
+        process-wide bounded LRU in exec/qcache.py, keyed additionally on
+        (backend, jit) — kernels close over plan-node config only, never
+        the catalog, so cross-executor reuse is sound."""
+        from .qcache import (
+            KERNEL_CACHE,
+            enable_persistent_compile_cache,
+            plan_is_deterministic,
+        )
+
+        if self._backend is None:
+            enable_persistent_compile_cache()
+            self._backend = jax.default_backend()
+        # determinism is static per key: memoize so the per-batch hot
+        # path pays one dict probe, not a plan-subtree walk per call
+        det = self._det_keys.get(key)
+        if det is None:
+            det = self._det_keys[key] = plan_is_deterministic(key)
+        if not det:
+            # now()/current_date/... are CONSTANTS baked at trace time:
+            # sharing such a kernel across sessions would serve the
+            # first trace's clock forever. Per-executor scope matches
+            # the pre-cache behavior (one session reuses its own trace).
+            fn = self._local_kernels.get(key)
+            if fn is None:
+                fn = jax.jit(make_fn()) if self.jit else make_fn()
+                self._local_kernels[key] = fn
+            return fn
+        gkey = (self._backend, self.jit, key)
+        fn = KERNEL_CACHE.get(gkey)
         if fn is None:
             fn = jax.jit(make_fn()) if self.jit else make_fn()
-            self._kernels[key] = fn
+            KERNEL_CACHE.put(gkey, fn)
         return fn
 
     def _kernel_guarded(self, breaker_name, key, make_fn, *args):
@@ -224,18 +263,24 @@ class Executor:
         _est_rows."""
         cache = getattr(self, "_ps_cache", None)
         if cache is None:
-            cache = self._ps_cache = {}
-        if len(cache) > 1024:
-            cache.clear()
-        if node in cache:
-            return cache[node]
+            from .qcache import LRUCache
+
+            # bounded LRU, not clear-on-threshold: a long session crossing
+            # the old wholesale clear() triggered a recompute storm over
+            # every live plan's stats
+            cache = self._ps_cache = LRUCache(
+                max_entries=1024, name="plan_stats"
+            )
+        hit = cache.get(node, count=False)
+        if hit is not None:
+            return hit[0]
         try:
             from ..plan.stats import derive
 
             ps = derive(node, self.catalog)
         except Exception:  # noqa: BLE001 — estimation is best-effort
             ps = None
-        cache[node] = ps
+        cache.put(node, (ps,))
         return ps
 
     # -- composite-key packing (ops/keypack.py) --
@@ -350,23 +395,26 @@ class Executor:
         """CBO row estimate for a node's output (cached per plan node).
 
         Keyed by the node OBJECT (kept referenced by the cache, so ids
-        cannot be recycled mid-flight) and bounded: a long-lived server
-        session executes unboundedly many plans."""
+        cannot be recycled mid-flight) and bounded by LRU eviction: a
+        long-lived server session executes unboundedly many plans, and
+        the old clear-everything-at-threshold caused recompute storms."""
         cache = getattr(self, "_est_cache", None)
         if cache is None:
-            cache = self._est_cache = {}
-        if len(cache) > 4096:
-            cache.clear()
-        key = node
-        if key in cache:
-            return cache[key]
+            from .qcache import LRUCache
+
+            cache = self._est_cache = LRUCache(
+                max_entries=4096, name="row_est"
+            )
+        hit = cache.get(node, count=False)
+        if hit is not None:
+            return hit[0]
         try:
             from ..plan.stats import derive
 
             est = float(derive(node, self.catalog).rows)
         except Exception:  # noqa: BLE001 — estimation is best-effort
             est = None
-        cache[key] = est
+        cache.put(node, (est,))
         return est
 
     # -- dynamic filters (exec/dynfilter.py) --

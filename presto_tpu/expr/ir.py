@@ -53,6 +53,13 @@ class ColumnRef(RowExpression):
 class Literal(RowExpression):
     value: Any  # python scalar; None = SQL NULL; str for varchar
     _type: T.Type
+    # EXECUTE-parameter provenance (exec/qcache.py): literals bound from a
+    # prepared statement's USING list carry their parameter index so a
+    # cached plan skeleton can be rebound to new values by a tree walk.
+    # Param-tagged literals are opaque to constant folding and to
+    # value-sensitive plan rules — the plan SHAPE must not depend on the
+    # value, only the kernels traced from it.
+    param: Optional[int] = None
 
     @property
     def type(self) -> T.Type:

@@ -150,7 +150,13 @@ def _conjoin(parts: List[ir.RowExpression]) -> ir.RowExpression:
 
 
 def _is_literal(e, value=None) -> bool:
-    return isinstance(e, ir.Literal) and (value is None or e.value == value)
+    # value-sensitive matches ignore param-tagged literals: a plan shape
+    # decided by one EXECUTE's value would be wrong after a rebind
+    return (
+        isinstance(e, ir.Literal)
+        and e.param is None
+        and (value is None or e.value == value)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -246,7 +252,9 @@ def _collapse_limits(node: N.Limit, caps) -> Optional[N.PlanNode]:
 
 def _false_filter(node: N.Filter, caps) -> Optional[N.PlanNode]:
     p = node.predicate
-    if isinstance(p, ir.Literal) and (p.value is False or p.value is None):
+    if isinstance(p, ir.Literal) and p.param is None and (
+        p.value is False or p.value is None
+    ):
         return N.Limit(node.child, 0)
     return None
 
@@ -486,7 +494,9 @@ def _foldable(e: ir.RowExpression) -> bool:
         if e.name in _NONDETERMINISTIC:
             return False
         return all(_foldable(a) for a in e.args)
-    return isinstance(e, ir.Literal)
+    # param-tagged literals (EXECUTE skeletons, exec/qcache.py) must stay
+    # symbolic: folding would bake one execution's value into the plan
+    return isinstance(e, ir.Literal) and e.param is None
 
 
 def _fold_expr(e: ir.RowExpression) -> Tuple[ir.RowExpression, bool]:

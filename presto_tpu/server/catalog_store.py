@@ -193,6 +193,13 @@ class CatalogStore(MultiCatalog):
         m, t = self._owner_and_table(table)
         return m.column_stats(t, column)
 
+    def table_version(self, table: str):
+        # snapshot versions route to the owning catalog; catalogs without
+        # versioning stay uncacheable (exec/qcache.py)
+        m, t = self._owner_and_table(table)
+        fn = getattr(m, "table_version", None)
+        return None if fn is None else fn(t)
+
     def page(self, table: str):
         m, t = self._owner_and_table(table)
         return m.page(t)

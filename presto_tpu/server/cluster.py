@@ -322,6 +322,10 @@ class SchedulerStats:
     # memoryStats/spillStats): disk bytes spilled, revocations absorbed,
     # spill events seen — the cluster half of EXPLAIN ANALYZE's memory line
     memory: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # serving-cache counters (exec/qcache.py snapshot_all) refreshed after
+    # every cluster query — plan/result hits the coordinator served plus
+    # the process-wide kernel cache
+    caches: Optional[dict] = None
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -1203,16 +1207,46 @@ class HttpClusterSession:
             ClusterMemoryManager(nodes).start() if memory_manager else None
         )
 
-    def _run_fragmented(self, sql: str):
+    def _run_fragmented(self, sql: str, use_result_cache: bool = True):
         """The one plan -> fragment -> schedule pipeline both query()
         and explain_analyze() go through; returns (fragmented node,
-        result page)."""
+        result page). Both serving caches (exec/qcache.py) sit in front
+        of the scheduler: the fragmented plan is cached per (sql, worker
+        count, broadcast config) and validated against connector snapshot
+        versions, and a snapshot-identical repeat serves its page without
+        touching the fleet at all. Worker-count changes (blacklist,
+        re-admission) change the plan key, so failover replans instead of
+        reusing a stale fragmentation."""
+        from ..exec import qcache
         from ..plan.fragment import fragment_plan
 
-        node = self._planner.plan(sql)
-        node = fragment_plan(node, self.catalog, self.broadcast_threshold,
-                             num_workers=max(len(self.scheduler.nodes.active_workers()), 2))
+        n_workers = max(len(self.scheduler.nodes.active_workers()), 2)
+        pkey = ("c", sql, self.broadcast_threshold, n_workers,
+                id(self.catalog))
+        ent = qcache.PLAN_CACHE.lookup(pkey, self.catalog)
+        if ent is not None:
+            node = ent.plan
+        else:
+            node = self._planner.plan(sql)
+            node = fragment_plan(node, self.catalog,
+                                 self.broadcast_threshold,
+                                 num_workers=n_workers)
+            qcache.PLAN_CACHE.store(pkey, node, self.catalog)
+        rkey = ("cr", sql, self.broadcast_threshold, n_workers,
+                id(self.catalog))
+        pre = None
+        if use_result_cache:
+            hit = qcache.RESULT_CACHE.lookup(rkey, self.catalog)
+            if hit is not None:
+                self.scheduler.stats.caches = qcache.snapshot_all()
+                return node, hit.page
+            pre = qcache.RESULT_CACHE.preversions(node, self.catalog)
         page = self.scheduler.run(node, query_id=f"q_{next(self._query_ids)}")
+        if pre is not None and qcache.plan_is_deterministic(node):
+            qcache.RESULT_CACHE.store(
+                rkey, page, getattr(node, "titles", ()), self.catalog, pre
+            )
+        self.scheduler.stats.caches = qcache.snapshot_all()
         return node, page
 
     def query(self, sql: str):
@@ -1227,7 +1261,9 @@ class HttpClusterSession:
         compression ratio, encode/decode wall, and pull concurrency —
         the distributed half of EXPLAIN ANALYZE (the single-process half
         lives in Session.explain_analyze_plan)."""
-        node, _page = self._run_fragmented(sql)
+        # bypass the result cache: EXPLAIN ANALYZE must actually execute
+        # to have wire/memory stats worth reporting
+        node, _page = self._run_fragmented(sql, use_result_cache=False)
         tree = N.plan_tree_str(node)
         lines = [tree]
         st = self.scheduler.stats
@@ -1261,6 +1297,10 @@ class HttpClusterSession:
                 + f", disk {m.get('spilled_bytes', 0):,}B, "
                 f"revocations {m.get('revocations', 0)}"
             )
+        if st.caches:
+            from ..exec import qcache
+
+            lines.append("-- caches: " + qcache.format_summary(st.caches))
         return "\n".join(lines)
 
     def close(self):

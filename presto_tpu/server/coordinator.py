@@ -254,7 +254,17 @@ class CoordinatorServer:
                     )
                     return
                 if parts == ["v1", "status"]:
-                    self._send(200, {"state": "ACTIVE", "version": VERSION})
+                    from ..exec import qcache
+
+                    # serving-cache observability (exec/qcache.py):
+                    # hits/misses/evictions/bytes for the plan, result
+                    # and kernel caches — the dashboard the qps driver
+                    # and ops polling read hit rates from
+                    self._send(200, {
+                        "state": "ACTIVE",
+                        "version": VERSION,
+                        "caches": qcache.snapshot_all(),
+                    })
                     return
                 if not parts or parts == ["ui"]:
                     self._send(

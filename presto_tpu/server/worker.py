@@ -100,7 +100,24 @@ class WorkerMemoryPool:
         self.watermark_breaches = 0
         self._revocations_base = 0  # completed, from unregistered pools
         self._exec_pools: Dict[int, object] = {}  # id -> exec MemoryPool
+        # attached serving caches (exec/qcache.py ResultCache): bytes are
+        # counted toward the watermark and the caches are revoked FIRST —
+        # cached results are the cheapest memory on the node to give back
+        self._caches: Dict[str, object] = {}
         self._cond = threading.Condition()
+
+    # -- attached serving caches --
+
+    def attach_cache(self, cache) -> None:
+        with self._cond:
+            self._caches[getattr(cache, "name", "cache")] = cache
+
+    def detach_cache(self, cache) -> None:
+        with self._cond:
+            self._caches.pop(getattr(cache, "name", "cache"), None)
+
+    def _cache_bytes_locked(self) -> int:
+        return sum(c.stats.bytes for c in self._caches.values())
 
     # -- execution ledger (exec/memory.MemoryPool parent mirroring) --
 
@@ -131,6 +148,7 @@ class WorkerMemoryPool:
             maybe_revoke = (
                 self.limit is not None
                 and self.reserved + self.exec_reserved
+                + self._cache_bytes_locked()
                 > self.revoke_watermark * self.limit
             )
             if maybe_revoke:
@@ -169,10 +187,23 @@ class WorkerMemoryPool:
         if self.limit is None:
             return
         floor = int(self.revoke_watermark * self.limit)
-        excess = self.reserved + self.exec_reserved + need - floor
+        excess = (
+            self.reserved + self.exec_reserved
+            + self._cache_bytes_locked() + need - floor
+        )
         if excess <= 0:
             return
         self.watermark_breaches += 1
+        # serving caches revoke FIRST: evicting a cached result is free
+        # (the entry re-materializes on the next miss) while revoking an
+        # executor forces a spill — only the remaining excess reaches the
+        # spill ladder
+        for cache in self._caches.values():
+            if excess <= 0:
+                return
+            excess -= cache.revoke(excess)
+        if excess <= 0:
+            return
         pools = sorted(
             self._exec_pools.values(),
             key=lambda p: -p.revocable_bytes(),
@@ -284,6 +315,18 @@ class WorkerMemoryPool:
                     "pending": revoke_pending,
                 },
                 "watermark": self.revoke_watermark,
+                # attached serving caches (exec/qcache.py): bytes held +
+                # bytes given back under pressure, per cache
+                "cache_reserved": self._cache_bytes_locked(),
+                "caches": {
+                    name: {
+                        "bytes": c.stats.bytes,
+                        "entries": len(c),
+                        "revoked_bytes": c.stats.revoked_bytes,
+                        "evictions": c.stats.evictions,
+                    }
+                    for name, c in self._caches.items()
+                },
             }
 
 
@@ -619,7 +662,8 @@ class WorkerServer:
                  revoke_watermark: Optional[float] = None,
                  spill_dir: Optional[str] = None,
                  spill_node_quota: Optional[int] = None,
-                 spill_query_quota: Optional[int] = None):
+                 spill_query_quota: Optional[int] = None,
+                 account_result_cache: bool = False):
         from ..exec.spillspace import SPILL_MANAGER, SpillSpaceManager
         from ..exec.taskqueue import MultilevelScheduler
 
@@ -651,6 +695,17 @@ class WorkerServer:
         self.pool = WorkerMemoryPool(
             memory_limit, revoke_watermark=revoke_watermark
         )
+        # opt-in: account the process-wide result cache (exec/qcache.py)
+        # in THIS worker's pool — its bytes then show in /v1/memory,
+        # count toward the revocation watermark, and are revoked first.
+        # Opt-in because one process can host several in-process workers
+        # (tests) and the cache can only be charged to one of them.
+        self._accounted_cache = None
+        if account_result_cache:
+            from ..exec.qcache import RESULT_CACHE
+
+            self.pool.attach_cache(RESULT_CACHE)
+            self._accounted_cache = RESULT_CACHE
         self.buffer_bound = buffer_bound
         # multilevel feedback gate over per-batch quanta (reference
         # TaskExecutor + MultilevelSplitQueue)
@@ -715,10 +770,12 @@ class WorkerServer:
                     # without the zstandard wheel, or still on wire v1)
                     # agrees on a format instead of failing deserialize
                     from .serde import local_capabilities
+                    from ..exec import qcache
 
                     self._send(200, {
                         "state": "ACTIVE",
                         "wire": outer.wire_caps or local_capabilities(),
+                        "caches": qcache.snapshot_all(),
                     })
                     return
                 if parts == ["v1", "memory"]:
@@ -1065,6 +1122,9 @@ class WorkerServer:
         self.pool.wake()
 
     def stop(self):
+        if self._accounted_cache is not None:
+            self.pool.detach_cache(self._accounted_cache)
+            self._accounted_cache = None
         self._httpd.shutdown()
         self._httpd.server_close()
 

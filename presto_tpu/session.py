@@ -65,6 +65,8 @@ SESSION_PROPERTIES = {
     "pallas_groupby": _parse_bool,  # small-G aggregation via the Pallas kernel
     "matmul_groupby": _parse_bool,  # dense-key aggregation via MXU matmuls
     "dynamic_filtering": _parse_bool,  # build-side runtime filters on probes
+    "plan_cache": _parse_bool,    # serve plans from exec/qcache.PLAN_CACHE
+    "result_cache": _parse_bool,  # serve results from exec/qcache.RESULT_CACHE
 }
 
 
@@ -107,12 +109,15 @@ class Session:
         matmul_groupby=None,  # None = auto (ON on TPU, OFF on CPU)
         exchange_budget=None,  # per-shard bytes for exchanged joins
         dynamic_filtering: bool = True,  # build-side runtime join filters
+        plan_cache: bool = True,    # plan/skeleton reuse (exec/qcache.py)
+        result_cache: bool = True,  # snapshot-validated result reuse
     ):
         self.access_control = access_control
         self.user = user
         self.catalog = catalog
         self.mesh = mesh
         self.broadcast_threshold = broadcast_threshold
+        self.exchange_budget = exchange_budget
         if mesh is not None:
             from .exec.dist import DistributedExecutor
 
@@ -133,6 +138,8 @@ class Session:
         self.pallas_groupby = pallas_groupby
         self.matmul_groupby = matmul_groupby
         self.dynamic_filtering = dynamic_filtering
+        self.plan_cache = plan_cache
+        self.result_cache = result_cache
         local = getattr(self.executor, "local", self.executor)
         if pallas_groupby is not None and hasattr(local, "pallas_groupby"):
             local.pallas_groupby = pallas_groupby
@@ -198,6 +205,9 @@ class Session:
                 dynamic_filtering=engine.get(
                     "dynamic_filtering", self.dynamic_filtering
                 ),
+                exchange_budget=self.exchange_budget,
+                plan_cache=engine.get("plan_cache", self.plan_cache),
+                result_cache=engine.get("result_cache", self.result_cache),
             )
             # statement-layer state is session-wide, not per-override
             derived.views = self.views
@@ -212,6 +222,37 @@ class Session:
             ast = ast.query
         if not isinstance(ast, t.Query):
             raise ValueError("only SELECT queries supported here")
+        return self._plan_query_cached(ast)
+
+    # -- plan cache (exec/qcache.py) --
+
+    def _plan_env_key(self):
+        """Planning-relevant session state: plans keyed by the same AST
+        are only interchangeable within one catalog object, view set,
+        join-distribution config, and mesh width."""
+        mesh_n = self.mesh.devices.size if self.mesh is not None else 0
+        views_fp = tuple(sorted(self.views.items())) if self.views else ()
+        return (id(self.catalog), mesh_n, self.broadcast_threshold, views_fp)
+
+    def _engine_env_key(self):
+        """Execution-engine identity, part of the RESULT cache key: two
+        sessions only share materialized pages when they would execute
+        the same way. Results are oracle-equal across engines, but what
+        an execution PRODUCES also includes observability (spill events,
+        dynamic-filter stats, breaker counters) and A/B harnesses rely
+        on differently-configured sessions actually executing."""
+        return (
+            type(self.executor).__name__,
+            self.streaming,
+            self.batch_rows,
+            self.memory_budget,
+            self.exchange_budget,
+            self.pallas_groupby,
+            self.matmul_groupby,
+            self.dynamic_filtering,
+        )
+
+    def _plan_query_uncached(self, ast: t.Query) -> N.PlanNode:
         planner = Planner(self.catalog, views=self.views)
         rp = planner.plan_query(ast, outer=None, ctes={})
         scope = rp.scope
@@ -227,6 +268,24 @@ class Session:
                 node, self.catalog, self.broadcast_threshold,
                 num_workers=self.mesh.devices.size,
             )
+        return node
+
+    def _plan_query_cached(self, ast: t.Query) -> N.PlanNode:
+        """Plan via the process-wide plan cache. Entries are validated
+        against the catalog object AND every referenced table's connector
+        snapshot version, so a write (which can change schemas and the
+        CBO stats planning depends on) replans; unversioned connectors
+        are never cached."""
+        from .exec import qcache
+
+        if not self.plan_cache:
+            return self._plan_query_uncached(ast)
+        key = ("q", ast, self._plan_env_key())
+        ent = qcache.PLAN_CACHE.lookup(key, self.catalog)
+        if ent is not None:
+            return ent.plan
+        node = self._plan_query_uncached(ast)
+        qcache.PLAN_CACHE.store(key, node, self.catalog)
         return node
 
     def explain(self, sql: str) -> str:
@@ -270,50 +329,73 @@ class Session:
 
     def _dispatch_query(self, sql, ast, effective):
         node = self.plan(sql)
-        if isinstance(ast, t.Explain):
-            from .page import Page
+        if not isinstance(ast, t.Explain):
+            # plain SELECT: the result-cache fast path
+            return self._execute_plan_cached(node)
+        from .page import Page
 
-            etype = getattr(ast, "etype", "logical")
-            if ast.analyze:
-                lines = self.explain_analyze_plan(node).split("\n")
-            elif etype == "validate":
-                # reference ExplainTask TYPE VALIDATE: analysis+planning
-                # succeeded if we got here
-                pg = Page.from_dict({"Valid": [True]})
-                return QueryResult(pg, ("Valid",))
-            elif etype == "io":
-                # reference IOPlanPrinter: the tables/columns the plan reads
-                scans = []
+        etype = getattr(ast, "etype", "logical")
+        if ast.analyze:
+            lines = self.explain_analyze_plan(node).split("\n")
+        elif etype == "validate":
+            # reference ExplainTask TYPE VALIDATE: analysis+planning
+            # succeeded if we got here
+            pg = Page.from_dict({"Valid": [True]})
+            return QueryResult(pg, ("Valid",))
+        elif etype == "io":
+            # reference IOPlanPrinter: the tables/columns the plan reads
+            scans = []
 
-                def walk(n):
-                    if isinstance(n, N.TableScan):
-                        cols = ", ".join(c for _, c, _ in n.columns)
-                        scans.append(f"{n.table} [{cols}]")
-                    for c in n.children:
-                        walk(c)
+            def walk(n):
+                if isinstance(n, N.TableScan):
+                    cols = ", ".join(c for _, c, _ in n.columns)
+                    scans.append(f"{n.table} [{cols}]")
+                for c in n.children:
+                    walk(c)
 
-                walk(node)
-                pg = Page.from_dict({"Table": scans or [None]})
-                if not scans:
-                    pg = Page(pg.blocks, pg.names, 0)
-                return QueryResult(pg, ("Table",))
-            elif etype == "distributed":
-                # reference PlanPrinter.textDistributedPlan over fragments
-                from .plan.fragment import fragment_plan
+            walk(node)
+            pg = Page.from_dict({"Table": scans or [None]})
+            if not scans:
+                pg = Page(pg.blocks, pg.names, 0)
+            return QueryResult(pg, ("Table",))
+        elif etype == "distributed":
+            # reference PlanPrinter.textDistributedPlan over fragments
+            from .plan.fragment import fragment_plan
 
-                workers = (
-                    self.mesh.devices.size if self.mesh is not None else 2
-                )
-                froot = fragment_plan(
-                    node, self.catalog, self.broadcast_threshold,
-                    num_workers=workers,
-                )
-                lines = N.plan_tree_str(froot).split("\n")
-            else:
-                lines = N.plan_tree_str(node).split("\n")
-            pg = Page.from_dict({"Query Plan": lines})
-            return QueryResult(pg, ("Query Plan",))
+            workers = (
+                self.mesh.devices.size if self.mesh is not None else 2
+            )
+            froot = fragment_plan(
+                node, self.catalog, self.broadcast_threshold,
+                num_workers=workers,
+            )
+            lines = N.plan_tree_str(froot).split("\n")
+        else:
+            lines = N.plan_tree_str(node).split("\n")
+        pg = Page.from_dict({"Query Plan": lines})
+        return QueryResult(pg, ("Query Plan",))
+
+    def _execute_plan_cached(self, node) -> QueryResult:
+        """Execute a planned query through the result cache: a hit serves
+        the materialized page without touching the executor; a miss
+        executes and stores under the snapshot versions read BEFORE
+        execution (a concurrent writer can only waste the entry, never
+        stale it). Plans over unversioned connectors, TABLESAMPLE, or
+        nondeterministic functions bypass the cache entirely."""
+        from .exec import qcache
+
+        if not self.result_cache:
+            return QueryResult(self.executor.run(node), node.titles)
+        key = ("r", node, self._plan_env_key(), self._engine_env_key())
+        hit = qcache.RESULT_CACHE.lookup(key, self.catalog)
+        if hit is not None:
+            return QueryResult(hit.page, hit.titles)
+        pre = qcache.RESULT_CACHE.preversions(node, self.catalog)
         page = self.executor.run(node)
+        if pre is not None and qcache.plan_is_deterministic(node):
+            qcache.RESULT_CACHE.store(
+                key, page, node.titles, self.catalog, pre
+            )
         return QueryResult(page, node.titles)
 
     # -- DDL / DML tasks (reference execution/CreateTableTask.java,
@@ -337,22 +419,11 @@ class Session:
         return cat
 
     def _run_query_ast(self, ast: t.Query):
-        """Plan + execute a Query AST; returns (page, titles, scope)."""
-        planner = Planner(self.catalog, views=self.views)
-        rp = planner.plan_query(ast, outer=None, ctes={})
-        channels = tuple(f.channel for f in rp.scope.fields)
-        titles = tuple(f.name for f in rp.scope.fields)
-        from .plan.optimizer import optimize
-
-        node = optimize(N.Output(rp.node, channels, titles))
-        if self.mesh is not None:
-            from .plan.fragment import fragment_plan
-
-            node = fragment_plan(
-                node, self.catalog, self.broadcast_threshold,
-                num_workers=self.mesh.devices.size,
-            )
-        return self.executor.run(node), titles, rp.scope
+        """Plan + execute a Query AST; returns (page, titles, scope).
+        Plans come from the snapshot-validated plan cache; results are
+        NOT result-cached here (DML sources execute fresh)."""
+        node = self._plan_query_cached(ast)
+        return self.executor.run(node), node.titles, None
 
     def _table_schema(self, cat, name: str):
         if name not in cat.table_names():
@@ -696,35 +767,7 @@ class Session:
                 raise ValueError(f"prepared statement {ast.name!r} not found")
             return self._row_count_result(0)
         if isinstance(ast, t.ExecutePrepared):
-            sql2 = self._prepared_sql(ast.name)
-            from .sql.parser import parse as _parse
-
-            past = _parse(sql2)
-            n_params = t.count_parameters(past)
-            if len(ast.params) != n_params:
-                raise ValueError(
-                    f"prepared statement {ast.name!r} expects {n_params} "
-                    f"parameters, got {len(ast.params)}"
-                )
-            bound = t.substitute_parameters(past, ast.params)
-            # the prepared text was an opaque string to the PREPARE-time
-            # check: the BOUND statement must pass the same enforcement a
-            # direct query would (EXECUTE is not a privilege bypass)
-            if self.access_control is not None:
-                from .security import enforce
-
-                enforce(self.access_control, user, bound, views=self.views)
-            if isinstance(bound, t.Query):
-                # SET SESSION overrides apply to prepared executions the
-                # same as to direct queries
-                target = (
-                    self.with_properties(dict(self._session_overrides))
-                    if self._session_overrides
-                    else self
-                )
-                page, titles, _scope = target._run_query_ast(bound)
-                return QueryResult(page, titles)
-            return self._execute_statement(bound, user)
+            return self._execute_prepared(ast, user)
         if isinstance(ast, t.DescribeInput):
             sql2 = self._prepared_sql(ast.name)
             from .sql.parser import parse as _parse
@@ -820,6 +863,146 @@ class Session:
         if sql is None:
             raise ValueError(f"prepared statement {name!r} not found")
         return sql
+
+    # -- EXECUTE fast path (exec/qcache.py plan skeletons) --
+
+    def _execute_prepared(self, ast: t.ExecutePrepared, user) -> QueryResult:
+        """EXECUTE binds USING values as TYPED CONSTANTS into a cached
+        plan skeleton: N executions of one dashboard statement parse and
+        plan once, and identical (statement, values, snapshot) executions
+        serve straight from the result cache. There is no text
+        substitution anywhere on this path — a string parameter is a
+        varchar constant, never SQL."""
+        sql2 = self._prepared_sql(ast.name)
+        from .sql.parser import parse as _parse
+
+        past = _parse(sql2)
+        n_params = t.count_parameters(past)
+        if len(ast.params) != n_params:
+            raise ValueError(
+                f"prepared statement {ast.name!r} expects {n_params} "
+                f"parameters, got {len(ast.params)}"
+            )
+        bound = t.substitute_parameters(past, ast.params)
+        # the prepared text was an opaque string to the PREPARE-time
+        # check: the BOUND statement must pass the same enforcement a
+        # direct query would (EXECUTE is not a privilege bypass)
+        if self.access_control is not None:
+            from .security import enforce
+
+            enforce(self.access_control, user, bound, views=self.views)
+        if not isinstance(bound, t.Query):
+            return self._execute_statement(bound, user)
+        # SET SESSION overrides apply to prepared executions the same as
+        # to direct queries
+        target = (
+            self.with_properties(dict(self._session_overrides))
+            if self._session_overrides
+            else self
+        )
+        node = target._plan_prepared(past, ast.params, bound)
+        return target._execute_plan_cached(node)
+
+    def _plan_prepared(
+        self, past, params, bound: t.Query
+    ) -> N.PlanNode:
+        """Plan an EXECUTE through the skeleton cache: parameters become
+        param-tagged typed literals, the optimized plan is cached once
+        per (statement, parameter-type signature, planning env), and new
+        values REBIND the cached tree instead of re-planning. Guards, in
+        order: (1) the skeleton is only kept when every parameter index
+        survives into the plan (a value consumed at plan time — LIMIT ?,
+        a folded negation — disqualifies it), (2) the first rebind to new
+        values is verified against one direct re-plan, then trusted,
+        (3) anything non-rebindable falls back to the ordinary per-value
+        plan cache."""
+        from .exec import qcache
+
+        if not self.plan_cache or not params:
+            return self._plan_query_cached(bound)
+        lits = [self._param_literal(p) for p in params]
+        if any(lv is None for lv in lits):
+            # non-literal USING expressions: per-value plan cache only
+            return self._plan_query_cached(bound)
+        values = tuple(lv.value for lv in lits)
+        sig = tuple(str(lv.type) for lv in lits)
+        key = ("x", past, sig, self._plan_env_key())
+        ent = qcache.PLAN_CACHE.lookup(key, self.catalog)
+        if ent is not None and ent.rebindable:
+            if values == ent.values0:
+                return ent.plan
+            plan = qcache.rebind_plan(ent.plan, values)
+            if not ent.verified:
+                direct = self._plan_query_uncached(bound)
+                if qcache.strip_params(plan) == direct:
+                    ent.verified = True
+                else:
+                    ent.rebindable = False
+                    return direct
+            return plan
+        if ent is not None:  # known-non-rebindable statement shape
+            return self._plan_query_cached(bound)
+        wrapped = t.substitute_parameters(
+            past,
+            tuple(t.BoundParameter(i, p) for i, p in enumerate(params)),
+        )
+        try:
+            skel = self._plan_query_uncached(wrapped)
+        except Exception:  # noqa: BLE001 — param in a literal-only spot
+            skel = None
+        rebindable = skel is not None and (
+            qcache.collect_param_indices(skel) == set(range(len(params)))
+        )
+        if not rebindable:
+            fallback = self._plan_query_cached(bound)
+            qcache.PLAN_CACHE.store(
+                key, fallback, self.catalog,
+                rebindable=False, values0=values,
+            )
+            return fallback
+        qcache.PLAN_CACHE.store(
+            key, skel, self.catalog,
+            rebindable=True, verified=False, values0=values,
+        )
+        return skel
+
+    @staticmethod
+    def _param_literal(node):
+        """Plan one USING argument as a typed ir constant (mirrors the
+        planner's literal cases), or None when it is not a plain literal."""
+        from .expr import ir
+        from . import types as T
+        from .sql.planner import _number_literal, _parse_timestamp_literal
+
+        if isinstance(node, t.UnaryOp) and node.op == "-" and isinstance(
+            node.operand, t.NumberLiteral
+        ):
+            lit = _number_literal(node.operand.text)
+            if not isinstance(lit.value, (int, float)):
+                return None  # Decimal lanes stay symbolic (planner parity)
+            return ir.Literal(-lit.value, lit.type)
+        if isinstance(node, t.NumberLiteral):
+            return _number_literal(node.text)
+        if isinstance(node, t.StringLiteral):
+            return ir.Literal(node.value, T.VARCHAR)
+        if isinstance(node, t.BooleanLiteral):
+            return ir.Literal(node.value, T.BOOLEAN)
+        if isinstance(node, t.NullLiteral):
+            return ir.Literal(None, T.UNKNOWN)
+        if isinstance(node, t.DateLiteral):
+            return ir.Literal(node.value, T.DATE)
+        if isinstance(node, t.TimestampLiteral):
+            return ir.Literal(
+                _parse_timestamp_literal(node.value), T.TIMESTAMP
+            )
+        if isinstance(node, t.IntervalLiteral):
+            n = int(node.value) * (-1 if node.negative else 1)
+            if node.unit in ("year", "month"):
+                months = n * (12 if node.unit == "year" else 1)
+                return ir.Literal(months, T.INTERVAL_YEAR_MONTH)
+            if node.unit == "day":
+                return ir.Literal(n, T.INTERVAL_DAY)
+        return None
 
     @staticmethod
     def _literal_value(node):
@@ -1096,8 +1279,17 @@ class Session:
                 if overs:
                     parts.append(f"over_frees={overs}")
                 mem_txt = "\n-- memory: " + ", ".join(parts)
+        # serving-cache observability (exec/qcache.py): process-wide
+        # hits/misses/evictions/bytes for the plan, result and kernel
+        # caches — EXPLAIN ANALYZE itself always re-executes, so these
+        # are the counters the profiled query runs alongside
+        from .exec import qcache
+
+        cache_txt = "\n-- caches: " + qcache.format_summary(
+            qcache.snapshot_all()
+        )
         return (
-            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}\n"
+            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{cache_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
         )
 

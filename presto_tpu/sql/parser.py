@@ -532,6 +532,12 @@ class Parser:
         if self.accept_kw("limit"):
             if self.accept_kw("all"):
                 limit = None
+            elif self.tok.kind == "?":
+                # LIMIT ? in a prepared statement: bound to an integer at
+                # EXECUTE time (the planner rejects an unbound Parameter)
+                self.i += 1
+                limit = t.Parameter(self._param_count)
+                self._param_count += 1
             else:
                 if self.tok.kind != "number":
                     self.error("expected LIMIT count")

@@ -167,6 +167,18 @@ class Planner:
     def channel(self, base: str) -> str:
         return f"{base}#{next(self._counter)}"
 
+    @staticmethod
+    def _limit_count(limit) -> int:
+        """Coerce a bound LIMIT parameter to its integer count."""
+        if isinstance(limit, t.BoundParameter):
+            limit = limit.inner
+        if isinstance(limit, t.Parameter):
+            raise PlanningError("LIMIT parameter is not bound")
+        if isinstance(limit, t.NumberLiteral) and "." not in limit.text \
+                and "e" not in limit.text.lower():
+            return int(limit.text)
+        raise PlanningError("LIMIT must be an integer literal")
+
     # -- statements --
     def plan_statement(self, ast: t.Node) -> N.PlanNode:
         if isinstance(ast, t.Query):
@@ -186,6 +198,13 @@ class Planner:
                 ctes[item.name.lower()] = item
 
         rp = self.plan_query_body(q.body, outer, ctes)
+        if q.limit is not None and not isinstance(q.limit, int):
+            # LIMIT ? bound at EXECUTE time (parser stores the Parameter;
+            # substitution delivers a literal AST). The count is consumed
+            # HERE, at plan time — a skeleton cache (exec/qcache.py) then
+            # sees the parameter index missing from the plan and correctly
+            # refuses to rebind across values.
+            q = dataclasses.replace(q, limit=self._limit_count(q.limit))
 
         node, scope = rp.node, rp.scope
         if q.order_by:
@@ -2482,6 +2501,17 @@ class SelectContext:
             if is_outer:
                 self.outer_refs.append(ref)
             return ref
+        if isinstance(ast, t.BoundParameter):
+            # EXECUTE parameter bound as a typed constant; tag the literal
+            # with its index so plan skeletons rebind (exec/qcache.py). A
+            # parameter planning to anything but a plain literal is left
+            # untagged — the skeleton coverage check then disqualifies it.
+            inner = self._tr(ast.inner)
+            if isinstance(inner, ir.Literal) and inner.param is None:
+                import dataclasses as _dc
+
+                return _dc.replace(inner, param=ast.index)
+            return inner
         if isinstance(ast, t.NumberLiteral):
             return _number_literal(ast.text)
         if isinstance(ast, t.StringLiteral):
@@ -2509,8 +2539,10 @@ class SelectContext:
             if ast.op == "-":
                 if isinstance(v, ir.Literal) and isinstance(
                     v.value, (int, float)
-                ):
-                    # fold so literal-argument functions see -n as a literal
+                ) and v.param is None:
+                    # fold so literal-argument functions see -n as a
+                    # literal (param-tagged literals stay symbolic: the
+                    # fold would detach the value from its rebind tag)
                     return ir.Literal(-v.value, v.type)
                 return ir.Call("negate", (v,), v.type)
             return v

@@ -455,6 +455,17 @@ class Parameter(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class BoundParameter(Node):
+    """A parameter bound to a literal AST for plan-skeleton caching
+    (exec/qcache.py): the planner plans `inner` and tags the resulting
+    ir.Literal with `index` so new EXECUTE values rebind the cached plan
+    without re-planning."""
+
+    index: int
+    inner: Node
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateView(Node):
     name: str
     query_sql: str  # original text of the view query

@@ -43,6 +43,23 @@ def rng():
 
 
 @pytest.fixture(autouse=True)
+def _query_cache_isolation():
+    """Drop plan/result cache ENTRIES before each test: the caches are
+    process-wide and keyed partly by catalog object identity, so a
+    module-scoped catalog fixture would otherwise let one test serve a
+    result another test expected to EXECUTE (fault-injection and
+    observability tests monkeypatch internals and assert side effects).
+    The kernel (compile) cache is intentionally left warm — cross-test
+    compiled-kernel reuse is exactly its production behavior and only
+    speeds the suite up. Within-test cache behavior is unaffected."""
+    from presto_tpu.exec import qcache
+
+    qcache.PLAN_CACHE.clear()
+    qcache.RESULT_CACHE.clear()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _memory_accounting_guard():
     from presto_tpu.exec import spillspace
     from presto_tpu.exec.memory import GLOBAL_ACCOUNTING
@@ -94,6 +111,7 @@ _MODULE_TIMEOUTS = {
     "test_auth_tls.py": 240,
     "test_memory_pressure.py": 300,
     "test_overload_chaos.py": 300,
+    "test_query_cache.py": 240,
 }
 
 _SLOW_CANDIDATE_S = 30.0

@@ -565,7 +565,12 @@ def test_cluster_wait_timeout_proceeds_without_filter(tpch, monkeypatch):
         want = sorted(map(repr, local.query(Q3).rows()))
         assert got == want  # proceed-without-filter is an identity
         assert sched.stats.dynfilter_timeouts > 0
-        assert sched.stats.dynfilters_shipped == 0
+        # NOTE: no `dynfilters_shipped == 0` — with the process-wide
+        # kernel cache (PR 8, exec/qcache.py) a warm build stage can
+        # legitimately publish its summary inside even a 1ms window;
+        # the guard here is that expired waits are OBSERVED and the
+        # filterless path is an identity, not that no filter ever wins
+        # the race
     finally:
         for w in workers:
             w.stop()

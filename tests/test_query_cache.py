@@ -1,0 +1,457 @@
+"""Serving fast path (exec/qcache.py): plan/result/kernel caches.
+
+Covers the PR 8 acceptance surface: snapshot-version staleness (zero
+stale reads, interleaved and concurrent), the unversioned-connector
+bypass, EXECUTE parameter binding as typed constants (skeleton rebinding
++ injection shapes), bounded-LRU replacement of the old clear-everything
+stat caches, result-cache memory accounting in the worker pool
+(first-to-revoke under the PR 7 watermark), and the observability
+surfaces (/v1/status, EXPLAIN ANALYZE, scheduler stats).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.exec import qcache
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+def _cat(n=64):
+    return MemoryCatalog({
+        "t": Page.from_dict({
+            "x": np.arange(n, dtype=np.int64),
+            "s": ["s%d" % (i % 7) for i in range(n)],
+        })
+    })
+
+
+def q(sess, sql):
+    return sess.query(sql).rows()
+
+
+# -- plan + result cache basics ---------------------------------------------
+
+
+def test_repeat_query_hits_plan_and_result_cache():
+    sess = Session(_cat())
+    p0 = qcache.PLAN_CACHE.stats.snapshot()
+    r0 = qcache.RESULT_CACHE.stats.snapshot()
+    a = q(sess, "select count(*) from t where x > 5")
+    b = q(sess, "select count(*) from t where x > 5")
+    assert a == b == [(58,)]
+    p1 = qcache.PLAN_CACHE.stats.snapshot()
+    r1 = qcache.RESULT_CACHE.stats.snapshot()
+    assert p1["hits"] - p0["hits"] >= 1
+    assert r1["hits"] - r0["hits"] >= 1
+    assert r1["bytes"] > 0
+
+
+def test_session_property_disables_caches():
+    sess = Session(_cat(), plan_cache=False, result_cache=False)
+    s0 = qcache.snapshot_all()
+    q(sess, "select count(*) from t")
+    q(sess, "select count(*) from t")
+    s1 = qcache.snapshot_all()
+    assert s1["plan"]["hits"] == s0["plan"]["hits"]
+    assert s1["result"]["hits"] == s0["result"]["hits"]
+    # the SET SESSION property routes through the same switches
+    sess2 = Session(_cat())
+    q(sess2, "set session result_cache = false")
+    q(sess2, "select count(*) from t")
+    q(sess2, "select count(*) from t")
+    assert qcache.snapshot_all()["result"]["hits"] == s1["result"]["hits"]
+
+
+def test_nondeterministic_queries_bypass_result_cache():
+    sess = Session(_cat(256))
+    s0 = qcache.RESULT_CACHE.stats.snapshot()
+    q(sess, "select max(x) from t where now() is not null")
+    q(sess, "select max(x) from t where now() is not null")
+    q(sess, "select count(*) from t tablesample bernoulli (50)")
+    q(sess, "select count(*) from t tablesample bernoulli (50)")
+    s1 = qcache.RESULT_CACHE.stats.snapshot()
+    assert s1["hits"] == s0["hits"]
+    assert s1["stores"] == s0["stores"]
+
+
+def test_unversioned_connector_is_provably_bypassed():
+    class NoVersion(MemoryCatalog):
+        def table_version(self, table):  # connector without snapshots
+            return None
+
+    sess = Session(NoVersion({"t": Page.from_dict(
+        {"x": np.arange(8, dtype=np.int64)}
+    )}))
+    s0 = qcache.snapshot_all()
+    a = q(sess, "select sum(x) from t")
+    b = q(sess, "select sum(x) from t")
+    assert a == b
+    s1 = qcache.snapshot_all()
+    assert s1["result"]["stores"] == s0["result"]["stores"]
+    assert s1["result"]["hits"] == s0["result"]["hits"]
+    assert s1["plan"]["stores"] == s0["plan"]["stores"]
+
+
+# -- staleness oracle (zero stale reads) ------------------------------------
+
+
+def test_staleness_oracle_interleaved_writes_memory():
+    """Interleave INSERT/DELETE/CTAS/DROP with cached reads; every read
+    must equal a cache-free oracle session's, and a result-cache hit
+    must be impossible across a version bump."""
+    cat = _cat(16)
+    sess = Session(cat)
+    oracle = Session(cat, plan_cache=False, result_cache=False)
+    reads = (
+        "select count(*) c, sum(x) s from t",
+        "select s, count(*) c from t group by s order by s",
+    )
+    writes = (
+        "insert into t values (100, 'zz')",
+        "insert into t select x + 200, s from t where x < 3",
+        "delete from t where x >= 200",
+        "create table t2 as select x, s from t where x < 50",
+        "insert into t2 values (7777, 'w')",
+        "drop table t2",
+        "delete from t where x = 100",
+    )
+    for r in reads:  # populate
+        assert q(sess, r) == q(oracle, r)
+    for w in writes:
+        hits_before = qcache.RESULT_CACHE.stats.hits
+        q(sess, w)
+        for r in reads:
+            got, want = q(sess, r), q(oracle, r)
+            assert got == want, (w, r, got, want)
+        # first post-write read of each statement cannot be a cache hit
+        # for the OLD version: re-running them all again must now hit
+        assert qcache.RESULT_CACHE.stats.hits >= hits_before
+        for r in reads:
+            assert q(sess, r) == q(oracle, r)
+
+
+def test_staleness_oracle_shardstore(tmp_path):
+    from presto_tpu.connectors.shardstore import ShardStoreCatalog
+
+    cat = ShardStoreCatalog(str(tmp_path / "shards"))
+    sess = Session(cat)
+    oracle = Session(cat, plan_cache=False, result_cache=False)
+    q(sess, "create table t (x bigint, s varchar)")
+    q(sess, "insert into t values (1, 'a'), (2, 'b'), (3, 'a')")
+    read = "select s, sum(x) v from t group by s order by s"
+    assert q(sess, read) == q(oracle, read)
+    assert q(sess, read) == q(oracle, read)  # cached
+    q(sess, "insert into t values (10, 'a')")
+    assert q(sess, read) == q(oracle, read)
+    q(sess, "delete from t where x = 2")
+    assert q(sess, read) == q(oracle, read)
+    # DROP + re-CREATE with a DIFFERENT schema must never serve the old
+    # empty-table shape
+    q(sess, "select count(*) from t")
+    q(sess, "drop table t")
+    q(sess, "create table t (y double)")
+    assert q(sess, "select count(*) from t") == [(0,)]
+    assert list(cat.schema("t")) == ["y"]
+
+
+def test_concurrent_writer_reader_chaos():
+    """Writers append monotonically increasing keys while readers poll a
+    cached aggregate: counts observed by ANY reader must be monotonic
+    (a stale cached result would go backwards) and the final cached read
+    must see every row."""
+    cat = MemoryCatalog({"t": Page.from_dict(
+        {"x": np.arange(4, dtype=np.int64)}
+    )})
+    sess = Session(cat)
+    n_writes = 10
+    errors = []
+    seen = {"last": 4}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer():
+        w = Session(cat, result_cache=False)
+        try:
+            for i in range(n_writes):
+                w.query(f"insert into t values ({100 + i})")
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                c = sess.query("select count(*) c from t").rows()[0][0]
+                with lock:
+                    if c < seen["last"]:
+                        errors.append((seen["last"], c))
+                    seen["last"] = max(seen["last"], c)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(2)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+    assert not errors, errors[:5]
+    # final read (served cached or fresh) must see every committed row
+    assert sess.query("select count(*) c from t").rows() == [(4 + n_writes,)]
+
+
+# -- EXECUTE typed binding + skeleton rebinding -----------------------------
+
+
+def test_execute_skeleton_rebinds_across_values():
+    sess = Session(_cat(64))
+    q(sess, "prepare px from select count(*) c from t where x > ?")
+    a = q(sess, "execute px using 9")
+    hits0 = qcache.PLAN_CACHE.stats.hits
+    b = q(sess, "execute px using 31")
+    c = q(sess, "execute px using 9")
+    assert (a, b, c) == ([(54,)], [(32,)], [(54,)])
+    # both warm executions served their plan from the skeleton cache
+    assert qcache.PLAN_CACHE.stats.hits >= hits0 + 2
+
+
+def test_execute_binds_strings_as_constants_not_sql():
+    sess = Session(_cat(64))
+    q(sess, "prepare ps from select count(*) c from t where s = ?")
+    assert q(sess, "execute ps using 's1'") == [(9,)]
+    # classic injection shapes arrive as plain varchar constants
+    assert q(sess, "execute ps using 's1'' or ''1''=''1'") == [(0,)]
+    assert q(sess, "execute ps using '''; drop table t; --'") == [(0,)]
+    assert "t" in sess.catalog.table_names()
+
+
+def test_execute_param_types_round_trip():
+    cat = MemoryCatalog({})
+    sess = Session(cat)
+    q(sess, "create table d (w date, v double)")
+    q(sess, "insert into d values (date '2020-01-01', 1.5), "
+            "(date '2021-06-15', 2.5), (date '2022-12-31', 3.5)")
+    q(sess, "prepare pd from select count(*) c from d where w >= ?")
+    assert q(sess, "execute pd using date '2021-01-01'") == [(2,)]
+    assert q(sess, "execute pd using date '1999-01-01'") == [(3,)]
+    q(sess, "prepare pv from select count(*) c from d where v > ?")
+    assert q(sess, "execute pv using 2.0") == [(2,)]
+    assert q(sess, "execute pv using 3.25") == [(1,)]
+    q(sess, "prepare pn from select count(*) c from d where v > ? or ? is null")
+    assert q(sess, "execute pn using 99.0, null") == [(3,)]
+
+
+def test_execute_limit_parameter():
+    """LIMIT ? is consumed at plan time: the skeleton must refuse to
+    rebind (coverage check) and still answer correctly per value."""
+    sess = Session(_cat(64))
+    q(sess, "prepare pl from select x from t order by x desc limit ?")
+    assert len(q(sess, "execute pl using 3")) == 3
+    assert len(q(sess, "execute pl using 7")) == 7
+    assert q(sess, "execute pl using 2") == [(63,), (62,)]
+
+
+def test_execute_parameter_count_errors():
+    sess = Session(_cat())
+    q(sess, "prepare pc from select count(*) from t where x > ? and x < ?")
+    with pytest.raises(ValueError, match="expects 2 parameters"):
+        q(sess, "execute pc using 1")
+    with pytest.raises(ValueError, match="expects 2 parameters"):
+        q(sess, "execute pc using 1, 2, 3")
+
+
+def test_dbapi_binds_server_side(tmp_path):
+    """The DB-API client must PREPARE + EXECUTE USING (typed constants),
+    not splice text: a quote-laden parameter behaves as a value."""
+    import presto_tpu.dbapi as dbapi
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    server = CoordinatorServer(Session(_cat(32)), max_concurrent=2).start()
+    try:
+        with dbapi.connect(server.uri) as conn:
+            cur = conn.cursor()
+            cur.execute("select count(*) c from t where s = ?", ("s1",))
+            n_plain = cur.fetchone()[0]
+            assert n_plain > 0
+            cur.execute(
+                "select count(*) c from t where s = ?", ("s1' or '1'='1",)
+            )
+            assert cur.fetchone()[0] == 0
+            # repeated parameterized executes reuse ONE prepared name
+            assert len(conn._prepared) == 1
+            cur.execute(
+                "select x from t where x <= ? order by 1 limit ?", (9, 4)
+            )
+            assert len(cur.fetchall()) == 4
+    finally:
+        server.stop()
+
+
+# -- bounded LRU stat caches ------------------------------------------------
+
+
+def test_lru_cache_evicts_oldest_not_everything():
+    c = qcache.LRUCache(max_entries=4)
+    for i in range(4):
+        c.put(i, i)
+    assert c.get(0) == 0  # refresh 0
+    c.put(9, 9)  # evicts 1 (LRU), NOT everything
+    assert len(c) == 4
+    assert c.get(1) is None
+    assert c.get(0) == 0 and c.get(9) == 9
+    assert c.stats.evictions == 1
+
+
+def test_executor_stat_caches_bounded():
+    from presto_tpu.exec.executor import Executor
+
+    ex = Executor(_cat())
+    for i in range(5000):
+        ex._est_cache if hasattr(ex, "_est_cache") else None
+        ex._est_rows(("fake", i))  # unhashable-safe: tuples hash fine
+    assert len(ex._est_cache) <= 4096
+    # recent keys survive (LRU, not clear-on-threshold)
+    assert ex._est_cache.get(("fake", 4999), count=False) is not None
+
+
+def test_time_dependent_kernels_not_shared_across_sessions():
+    """now()/current_timestamp are baked at TRACE time: the process-wide
+    kernel cache must not serve one session's clock to a later session
+    (regression: the first global-kernel-cache cut did exactly that)."""
+    import time
+
+    cat = _cat(8)
+    t1 = Session(cat).query("select max(now()) n from t").rows()[0][0]
+    time.sleep(0.05)
+    t2 = Session(cat).query("select max(now()) n from t").rows()[0][0]
+    assert t2 > t1, (t1, t2)
+
+
+def test_kernel_cache_shared_across_executors():
+    from presto_tpu.exec.executor import Executor
+
+    cat = _cat(32)
+    sess1 = Session(cat, result_cache=False, plan_cache=False)
+    node = sess1.plan("select x + 1 p from t where x > 3")
+    sess1.executor.run(node)
+    k0 = qcache.KERNEL_CACHE.stats.hits
+    ex2 = Executor(cat)
+    ex2.run(node)
+    assert qcache.KERNEL_CACHE.stats.hits > k0
+
+
+# -- memory accounting + revocation -----------------------------------------
+
+
+def test_result_cache_bytes_in_worker_memory_and_revoked_first():
+    from presto_tpu.server.worker import WorkerMemoryPool
+
+    cache = qcache.ResultCache(max_bytes=1 << 20)
+    pool = WorkerMemoryPool(limit=10_000, revoke_watermark=0.5)
+    pool.attach_cache(cache)
+    cache.put("a", ("page",), nbytes=2000)
+    cache.put("b", ("page",), nbytes=2000)
+    snap = pool.snapshot()
+    assert snap["cache_reserved"] == 4000
+    assert snap["caches"]["result"]["bytes"] == 4000
+    # crossing the watermark (5000) revokes the CACHE, not executors
+    pool.reserve_execution("q1", 3000)
+    snap2 = pool.snapshot()
+    assert snap2["cache_reserved"] < 4000
+    assert cache.stats.revoked_bytes > 0
+    assert pool.revocations_requested == 0  # no executor was asked
+    pool.free_execution("q1", 3000)
+    pool.detach_cache(cache)
+    assert pool.snapshot()["cache_reserved"] == 0
+
+
+def test_worker_v1_memory_reports_cache(tmp_path):
+    import json
+    import urllib.request
+
+    from presto_tpu.connectors.tpch import TpchCatalog
+    from presto_tpu.server.worker import WorkerServer
+
+    w = WorkerServer(TpchCatalog(sf=0.001), account_result_cache=True)
+    w.start()
+    try:
+        sess = Session(TpchCatalog(sf=0.001))
+        sess.query("select count(*) from orders").rows()
+        sess.query("select count(*) from orders").rows()
+        with urllib.request.urlopen(w.uri + "/v1/memory", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert "caches" in snap and "result" in snap["caches"]
+        assert snap["cache_reserved"] == snap["caches"]["result"]["bytes"]
+        assert snap["caches"]["result"]["bytes"] > 0
+    finally:
+        w.stop()
+
+
+# -- observability surfaces -------------------------------------------------
+
+
+def test_coordinator_status_and_explain_analyze_expose_caches():
+    import json
+    import urllib.request
+
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    sess = Session(_cat())
+    server = CoordinatorServer(sess, max_concurrent=2).start()
+    try:
+        with urllib.request.urlopen(server.uri + "/v1/status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert set(status["caches"]) == {"plan", "result", "kernel"}
+        for s in status["caches"].values():
+            assert {"hits", "misses", "evictions", "bytes"} <= set(s)
+    finally:
+        server.stop()
+    txt = sess.explain_analyze("select count(*) from t")
+    line = [ln for ln in txt.splitlines() if ln.startswith("-- caches:")]
+    assert line and "plan" in line[0] and "result" in line[0]
+
+
+def test_cluster_session_caches_and_stats():
+    from presto_tpu.server.cluster import HttpClusterSession, NodeManager
+    from presto_tpu.server.worker import WorkerServer
+
+    cat = MemoryCatalog({"t": Page.from_dict(
+        {"x": np.arange(512, dtype=np.int64)}
+    )})
+    workers = [WorkerServer(cat).start() for _ in range(2)]
+    nodes = NodeManager([w.uri for w in workers]).start()
+    try:
+        cs = HttpClusterSession(cat, nodes)
+        r0 = qcache.RESULT_CACHE.stats.hits
+        a = cs.query("select count(*) c, sum(x) s from t").rows()
+        b = cs.query("select count(*) c, sum(x) s from t").rows()
+        assert a == b == [(512, 130816)]
+        assert qcache.RESULT_CACHE.stats.hits > r0
+        assert cs.scheduler.stats.caches is not None
+        # a write through the connector invalidates the cluster cache too
+        cat.append("t", Page.from_dict(
+            {"x": np.array([9999], dtype=np.int64)}
+        ))
+        assert cs.query("select count(*) c, sum(x) s from t").rows() == [
+            (513, 140815)
+        ]
+    finally:
+        for w in workers:
+            w.stop()
+        nodes.stop()
+
+
+def test_plan_cache_entry_invalidated_by_write():
+    cat = _cat(16)
+    sess = Session(cat)
+    q(sess, "select count(*) from t")
+    inv0 = qcache.PLAN_CACHE.stats.invalidations
+    cat.append("t", Page.from_dict({
+        "x": np.array([500], dtype=np.int64), "s": ["zz"],
+    }))
+    q(sess, "select count(*) from t")  # stale entry dropped, replanned
+    assert qcache.PLAN_CACHE.stats.invalidations > inv0
