@@ -1,0 +1,94 @@
+"""prestolint: repo-specific AST static analysis, gated in tier-1.
+
+Run with ``python -m presto_tpu.analysis --check``. See
+docs/static-analysis.md for the pass catalog, the baseline/suppression
+workflow, and how to add a pass."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .core import (
+    CheckResult,
+    Finding,
+    Project,
+    evaluate_against_baseline,
+    load_baseline,
+    load_project,
+    save_baseline,
+)
+from .passes import ALL_PASSES, PASSES_BY_NAME
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def run_passes(
+    project: Project, passes: Optional[Sequence] = None
+) -> List[Finding]:
+    """All findings from `passes` (default: every registered pass), with
+    source-level `# prestolint: allow(rule)` suppressions applied."""
+    out: List[Finding] = []
+    for p in passes if passes is not None else ALL_PASSES:
+        for f in p.run(project):
+            sf = project.file(f.file)
+            if sf is not None and sf.suppressed(f.line, f.rule):
+                continue
+            out.append(f)
+    return out
+
+
+def run_check(
+    repo_root: Optional[os.PathLike] = None,
+    baseline_path: Optional[os.PathLike] = None,
+    passes: Optional[Sequence] = None,
+) -> CheckResult:
+    project = load_project(repo_root)
+    findings = run_passes(project, passes)
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None else DEFAULT_BASELINE
+    )
+    if passes is not None:
+        # a scoped check only produced the selected passes' findings:
+        # other passes' baseline entries must not be reported stale
+        owned = {r for p in passes for r in p.rules}
+        baseline = {
+            fp: e for fp, e in baseline.items() if e["rule"] in owned
+        }
+    return evaluate_against_baseline(findings, baseline)
+
+
+def update_baseline(
+    repo_root: Optional[os.PathLike] = None,
+    baseline_path: Optional[os.PathLike] = None,
+    passes: Optional[Sequence] = None,
+) -> int:
+    """Regenerate the baseline. With a `passes` subset, only entries for
+    those passes' declared rules are regenerated — everything else in the
+    existing baseline is preserved verbatim, so scoping the update to one
+    pass can't silently suppress another pass's open findings."""
+    project = load_project(repo_root)
+    path = baseline_path if baseline_path is not None else DEFAULT_BASELINE
+    findings = run_passes(project, passes)
+    if passes is None:
+        save_baseline(path, findings)
+        return len(findings)
+    owned = {r for p in passes for r in p.rules}
+    kept = [
+        e for e in load_baseline(path).values() if e["rule"] not in owned
+    ]
+    save_baseline(path, findings, keep=kept)
+    return len(findings) + len(kept)
+
+
+__all__ = [
+    "ALL_PASSES",
+    "PASSES_BY_NAME",
+    "CheckResult",
+    "Finding",
+    "run_check",
+    "run_passes",
+    "update_baseline",
+    "load_project",
+]

@@ -1,0 +1,95 @@
+"""CLI: ``python -m presto_tpu.analysis --check`` (tier-1 gate) /
+``--baseline-update`` (re-baseline after an intentional change)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from . import (
+    ALL_PASSES,
+    DEFAULT_BASELINE,
+    PASSES_BY_NAME,
+    run_check,
+    update_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m presto_tpu.analysis",
+        description="prestolint: repo-specific AST static analysis",
+    )
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--check", action="store_true",
+        help="fail (exit 1) on any finding not in the baseline [default]",
+    )
+    mode.add_argument(
+        "--baseline-update", action="store_true",
+        help="regenerate the suppression baseline from current findings",
+    )
+    mode.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    ap.add_argument(
+        "--pass", dest="only", action="append", metavar="NAME",
+        help="run only this pass (repeatable); default all",
+    )
+    ap.add_argument("--root", default=None, help="repo root (default: auto)")
+    ap.add_argument(
+        "--baseline", default=None, help="baseline path (default: committed)"
+    )
+    ap.add_argument(
+        "--all", action="store_true",
+        help="with --check: print baselined findings too",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.name}: {p.description}")
+        return 0
+
+    passes = None
+    if args.only:
+        unknown = [n for n in args.only if n not in PASSES_BY_NAME]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+            print(f"known: {', '.join(PASSES_BY_NAME)}", file=sys.stderr)
+            return 2
+        passes = [PASSES_BY_NAME[n] for n in args.only]
+
+    if args.baseline_update:
+        n = update_baseline(args.root, args.baseline, passes)
+        path = args.baseline or DEFAULT_BASELINE
+        scope = f" ({', '.join(args.only)} scoped)" if args.only else ""
+        print(f"prestolint: baselined {n} finding(s){scope} -> {path}")
+        return 0
+
+    t0 = time.monotonic()
+    result = run_check(args.root, args.baseline, passes)
+    dt = time.monotonic() - t0
+    if args.all:
+        for f in result.baselined:
+            print(f"{f.render()}  [baselined]")
+    for f in result.new:
+        print(f.render())
+    if result.expired:
+        print(
+            f"prestolint: {len(result.expired)} baseline entr"
+            f"{'y is' if len(result.expired) == 1 else 'ies are'} stale "
+            "(finding no longer present) — run --baseline-update to prune"
+        )
+    verdict = "clean" if result.ok else "FAILED"
+    print(
+        f"prestolint {verdict}: {len(result.new)} new, "
+        f"{len(result.baselined)} baselined, {len(result.expired)} expired "
+        f"({len(result.all_findings)} total) in {dt:.2f}s"
+    )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,362 @@
+"""prestolint pass framework.
+
+The reference engine front-loads failure detection: the planner runs
+PlanSanityChecker validators after every optimizer rule and the
+bytecode-gen layer fails at generation time, not execution time
+(presto-main/.../sql/planner/sanity/, .../sql/gen/). This reproduction's
+equivalents — plan rewrites, jitted kernels, threaded server code — fail
+at runtime, sometimes by deadlocking. prestolint is the analog: a small
+AST pass framework with repo-specific rules (tracing safety, lock
+discipline, exception hygiene, plan-node exhaustiveness, memory
+accounting), gated in tier-1 so "added a node, forgot a dispatcher" or
+"host callback reachable from jit" fails at lint time.
+
+Design:
+
+- every ``.py`` file under ``presto_tpu/`` parses once into a
+  :class:`SourceFile` (ast tree + raw lines, for suppression comments);
+- passes subclass :class:`AnalysisPass` and emit :class:`Finding`s with a
+  rule id, severity, file, line and the enclosing def/class context;
+- ``# prestolint: allow(rule-id) -- reason`` on the finding's line (or
+  the line above) suppresses it at the source;
+- pre-existing findings live in a committed ``baseline.json``; ``--check``
+  fails only on NEW findings, so the suite could gate tier-1 from day one
+  while the burndown proceeded. Fingerprints hash (rule, file, enclosing
+  context, message) — NOT line numbers — so unrelated edits above a
+  finding don't churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+# comment grammar: `# prestolint: allow(rule-a, rule-b) -- free-form reason`
+_ALLOW_PREFIX = "# prestolint: allow("
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str  # 'error' | 'warning'
+    file: str  # path relative to the repo root, posix separators
+    line: int  # 1-based
+    message: str
+    context: str = ""  # enclosing Class.func qualname ('' at module level)
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Identity WITHOUT the line number: line drift from unrelated
+        edits must not invalidate the baseline."""
+        return (self.rule, self.file, self.context, self.message)
+
+    def render(self) -> str:
+        ctx = f" ({self.context})" if self.context else ""
+        return (
+            f"{self.file}:{self.line}: [{self.severity}] "
+            f"{self.rule}: {self.message}{ctx}"
+        )
+
+
+def _fingerprints(findings: Sequence[Finding]) -> List[str]:
+    """One stable fingerprint per finding. Identical (rule, file, context,
+    message) tuples — e.g. two textually identical swallows in one
+    function — disambiguate by occurrence ordinal, counted in line order
+    so the mapping is deterministic."""
+    seen: Dict[Tuple[str, str, str, str], int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule)):
+        k = f.key()
+        n = seen.get(k, 0)
+        seen[k] = n + 1
+        raw = "\x00".join((f.rule, f.file, f.context, f.message, str(n)))
+        out.append(hashlib.sha1(raw.encode()).hexdigest()[:16])
+    return out
+
+
+class SourceFile:
+    """One parsed module: ast tree + raw lines + suppression lookup."""
+
+    def __init__(self, rel: str, abspath: str, text: str):
+        self.rel = rel
+        self.abspath = abspath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self._allow: Optional[Dict[int, Tuple[str, ...]]] = None
+
+    def _allowed_rules(self, line: int) -> Tuple[str, ...]:
+        if self._allow is None:
+            allow: Dict[int, Tuple[str, ...]] = {}
+            for i, raw in enumerate(self.lines, start=1):
+                at = raw.find(_ALLOW_PREFIX)
+                if at < 0:
+                    continue
+                inner = raw[at + len(_ALLOW_PREFIX):]
+                close = inner.find(")")
+                if close < 0:
+                    continue
+                rules = tuple(
+                    r.strip() for r in inner[:close].split(",") if r.strip()
+                )
+                allow[i] = rules
+            self._allow = allow
+        return self._allow.get(line, ())
+
+    def _comment_block(self, line: int):
+        """`line` itself plus every line of the contiguous comment block
+        directly above it — the shared scan behind both allow()
+        suppressions and marker comments, so the two accept identical
+        comment placements."""
+        yield line
+        ln = line - 1
+        while ln >= 1 and self.line_text(ln).strip().startswith("#"):
+            yield ln
+            ln -= 1
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """True when `line` itself, or any line of the contiguous
+        comment block directly above it, carries an allow() for `rule`
+        — multi-line justifications are encouraged."""
+        return any(
+            rule in self._allowed_rules(ln) for ln in self._comment_block(line)
+        )
+
+    def has_marker(self, line: int, marker: str) -> bool:
+        """True when `line` or its contiguous comment block above
+        contains the literal `marker` text (e.g. `# prestolint:
+        host-function`)."""
+        return any(
+            marker in self.line_text(ln) for ln in self._comment_block(line)
+        )
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class Project:
+    """The analyzed file set plus lazily-built cross-file symbol tables."""
+
+    def __init__(self, root: Path, files: List[SourceFile]):
+        self.root = root
+        self.files = files
+        self._by_rel = {f.rel: f for f in files}
+        self._symbols: Dict[str, object] = {}
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+    def iter_files(self, prefix: str = "") -> Iterable[SourceFile]:
+        for f in self.files:
+            if f.rel.startswith(prefix):
+                yield f
+
+    def symbol(self, key: str, build):
+        """Memoized cross-file symbol table (e.g. the plan-node class
+        list): built once per run, shared by all passes."""
+        if key not in self._symbols:
+            self._symbols[key] = build(self)
+        return self._symbols[key]
+
+
+_SKIP_DIRS = {"__pycache__"}
+
+
+def load_project(
+    repo_root: Optional[os.PathLike] = None,
+    package: str = "presto_tpu",
+) -> Project:
+    """Parse every .py under `package` (relative paths keyed off the repo
+    root, so findings read `presto_tpu/ops/sort.py:296`)."""
+    root = Path(
+        repo_root
+        if repo_root is not None
+        else Path(__file__).resolve().parents[2]
+    )
+    files: List[SourceFile] = []
+    base = root / package
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            ap = os.path.join(dirpath, name)
+            rel = Path(ap).relative_to(root).as_posix()
+            with open(ap, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            try:
+                files.append(SourceFile(rel, ap, text))
+            except SyntaxError as exc:
+                # a file that doesn't parse is itself a finding-worthy
+                # state, but the loader can't represent it as a pass
+                # result — surface it loudly instead of skipping
+                raise RuntimeError(f"prestolint: {rel} failed to parse: {exc}")
+    return Project(root, files)
+
+
+class AnalysisPass:
+    """Base class: subclasses set `name`/`description` and implement
+    run(project) -> findings. Suppression filtering happens in the
+    driver, not in the passes."""
+
+    name = ""
+    description = ""
+    rules: Tuple[str, ...] = ()  # every rule id this pass can emit
+
+    def run(self, project: Project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# -- context helpers shared by passes ---------------------------------------
+
+
+class ContextVisitor(ast.NodeVisitor):
+    """Tracks the enclosing Class.func qualname while walking a module.
+    Subclasses read `self.context` when emitting findings."""
+
+    def __init__(self):
+        self._stack: List[str] = []
+
+    @property
+    def context(self) -> str:
+        return ".".join(self._stack)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_func(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def iter_scoped_defs(body: Sequence[ast.stmt]):
+    """Yield ``(fn_node, class_node_or_None)`` for every function defined
+    at module or class level, descending through compound statements
+    (try/if/with/for — serde.py defines its zstd helpers inside a
+    module-level ``try``) but never into other functions. For functions
+    inside nested classes the INNERMOST class is reported."""
+
+    def walk(stmts, cls):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (s, cls)
+            elif isinstance(s, ast.ClassDef):
+                yield from walk(s.body, s)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, attr, None)
+                    if sub:
+                        yield from walk(sub, cls)
+                for h in getattr(s, "handlers", ()):
+                    yield from walk(h.body, cls)
+
+    yield from walk(body, None)
+
+
+def shallow_walk(root: ast.AST, skip=(ast.FunctionDef, ast.AsyncFunctionDef)):
+    """Yield `root` and its descendants WITHOUT descending into `skip`
+    subtrees — nested defs (and, where the caller says so, lambdas) run
+    on their own schedule, not where they are defined, so their bodies
+    must not inherit the enclosing context (held locks, device/guard
+    flags). Skip-typed children are still yielded once, so callers can
+    recurse into them explicitly with fresh context."""
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, skip):
+                yield c
+            else:
+                stack.append(c)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """`a.b.c` for Attribute/Name chains, '' for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: os.PathLike) -> Dict[str, dict]:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text())
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save_baseline(
+    path: os.PathLike,
+    findings: Sequence[Finding],
+    keep: Sequence[dict] = (),
+) -> None:
+    """Write the baseline from `findings`, plus `keep` — pre-existing raw
+    entries preserved verbatim during a partial (`--pass`-scoped)
+    update."""
+    fps = _fingerprints(findings)
+    ordered = sorted(findings, key=lambda f: (f.file, f.line, f.rule))
+    entries = [
+        {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "file": f.file,
+            "context": f.context,
+            "message": f.message,
+        }
+        for fp, f in zip(fps, ordered)
+    ]
+    entries = sorted(
+        entries + list(keep),
+        key=lambda e: (e["file"], e["rule"], e["message"], e["fingerprint"]),
+    )
+    payload = {"version": 1, "findings": entries}
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    all_findings: List[Finding]
+    new: List[Finding]  # not baselined, not suppressed -> check fails
+    baselined: List[Finding]
+    expired: List[dict]  # baseline entries no longer found
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def evaluate_against_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, dict]
+) -> CheckResult:
+    fps = _fingerprints(findings)
+    ordered = sorted(findings, key=lambda f: (f.file, f.line, f.rule))
+    new, old = [], []
+    seen = set()
+    for fp, f in zip(fps, ordered):
+        seen.add(fp)
+        (old if fp in baseline else new).append(f)
+    expired = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return CheckResult(list(ordered), new, old, expired)

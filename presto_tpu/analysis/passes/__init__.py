@@ -1,0 +1,13 @@
+"""prestolint pass registry. Import order is report order."""
+
+from . import exceptions, exhaustive, locks, memory, tracing
+
+ALL_PASSES = (
+    tracing.PASS,
+    locks.PASS,
+    exceptions.PASS,
+    exhaustive.PASS,
+    memory.PASS,
+)
+
+PASSES_BY_NAME = {p.name: p for p in ALL_PASSES}

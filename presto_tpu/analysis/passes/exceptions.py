@@ -1,0 +1,157 @@
+"""exception-hygiene: broad handlers must record, re-raise, or justify.
+
+PR 7 set the norm: infrastructure loops count their failures into stats
+or fire worker events instead of `except Exception: pass`-ing them into
+the void (ClusterMemoryManager poll failures -> MEMORY_UNPOLLABLE
+events). This pass makes that norm checkable.
+
+Rules
+-----
+broad-except-swallow (error)
+    `except Exception` / bare `except` / `except BaseException` whose
+    body is pure control flow (`pass`/`continue`/`break`/bare `return`/
+    `return None`/ellipsis) — the error vanishes without a trace.
+
+broad-except-silent (warning)
+    A broad handler that does real work but neither re-raises nor calls
+    anything that looks like recording (substring match on
+    record/stat/event/log/warn/count/... in any called name) — likely a
+    silent fallback; either record the failure or justify it.
+
+Suppressions: ``# prestolint: allow(broad-except-silent) -- reason`` on
+the `except` line, or the tree's existing idiom — a ``# noqa: BLE001``
+comment that CARRIES A REASON after a dash. A bare ``# noqa: BLE001``
+does not count: the reason is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from ..core import (
+    AnalysisPass,
+    ContextVisitor,
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+_RECORD_TOKENS = (
+    "record", "stat", "event", "log", "warn", "error", "exception",
+    "count", "emit", "fire", "note", "fail", "abort", "blacklist",
+    "increment", "observe", "retry", "degrade", "report",
+    # a handler that prints is surfacing, not swallowing (CLI/REPL loops)
+    "print",
+)
+
+# `# noqa: BLE001 — reason` / `# noqa: BLE001 -- reason` (reason REQUIRED)
+_NOQA_REASON = re.compile(r"#\s*noqa:\s*BLE001\s*[—–-]+\s*\S")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names = []
+    if isinstance(handler.type, ast.Tuple):
+        names = [dotted_name(e).split(".")[-1] for e in handler.type.elts]
+    else:
+        names = [dotted_name(handler.type).split(".")[-1]]
+    return any(n in _BROAD for n in names)
+
+
+def _pure_control_flow(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None
+            or (isinstance(stmt.value, ast.Constant) and stmt.value.value is None)
+        ):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _records_or_raises(body: List[ast.stmt]) -> bool:
+    for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func).lower()
+            if any(tok in name for tok in _RECORD_TOKENS):
+                return True
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                tn = dotted_name(t) or (
+                    dotted_name(t.value) + "[]"
+                    if isinstance(t, ast.Subscript)
+                    else ""
+                )
+                if any(tok in tn.lower() for tok in _RECORD_TOKENS):
+                    return True
+    return False
+
+
+class ExceptionHygienePass(AnalysisPass):
+    name = "exception-hygiene"
+    description = "broad except handlers that swallow errors untracked"
+    rules = ("broad-except-swallow", "broad-except-silent")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.iter_files("presto_tpu/"):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        outer = self
+
+        class V(ContextVisitor):
+            def visit_Try(self, node: ast.Try):
+                for h in node.handlers:
+                    if _is_broad(h):
+                        outer._check_handler(sf, h, self.context, findings)
+                self.generic_visit(node)
+
+        V().visit(sf.tree)
+        return findings
+
+    def _check_handler(self, sf, handler, ctx, findings):
+        # the existing reasoned-noqa idiom counts as a suppression
+        if _NOQA_REASON.search(sf.line_text(handler.lineno)):
+            return
+        if _pure_control_flow(handler.body):
+            findings.append(
+                Finding(
+                    "broad-except-swallow", "error", sf.rel, handler.lineno,
+                    "broad except swallows the error with no trace: count "
+                    "it into stats, fire an event, or annotate why it is "
+                    "safe to drop",
+                    ctx,
+                )
+            )
+            return
+        if not _records_or_raises(handler.body):
+            findings.append(
+                Finding(
+                    "broad-except-silent", "warning", sf.rel, handler.lineno,
+                    "broad except neither re-raises nor records: a silent "
+                    "fallback hides real faults — record the failure or "
+                    "justify with an allow()/reasoned noqa",
+                    ctx,
+                )
+            )
+
+
+PASS = ExceptionHygienePass()

@@ -1,0 +1,154 @@
+"""plan/IR exhaustiveness: every node class must be handled everywhere.
+
+The reference planner's IdentityTranslator/visitor hierarchy makes an
+unhandled plan node a compile error; here the dispatch surfaces are
+string-built method names and isinstance chains, so "added a node,
+forgot a dispatcher" surfaces as an AttributeError mid-query — or worse,
+as an EXPLAIN that silently prints nothing. This pass closes the gap at
+lint time.
+
+Surfaces (rule `plan-dispatch-missing`, error):
+
+- ``Executor._exec_<node>`` in exec/executor.py — every PlanNode
+  subclass from plan/nodes.py AND plan/fragment.py (Exchange,
+  AggFinalize) needs a method; `run()` getattr's with no default.
+- ``Fragmenter._v_<node>`` in plan/fragment.py — every plan/nodes.py
+  class; the fragmenter raises on a miss, but only when a query first
+  exercises it.
+- ``plan_tree_str`` in plan/nodes.py (EXPLAIN) — every node class must
+  be MENTIONED (isinstance branch or name-string match). Nodes with no
+  interesting config belong in the explicit name-only branch, so the
+  next reader knows the omission is deliberate.
+- ``evaluate`` in expr/compiler.py — every RowExpression subclass from
+  expr/ir.py must be mentioned, if only to be explicitly rejected
+  (a bare Lambda outside a lambda-form call).
+
+exec/dist.py's ``_d_<node>`` visitor is deliberately NOT a surface: it
+has a sound generic fallback (gather to single-node execution) and
+raises a structured error on sharded input it cannot handle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import AnalysisPass, Finding, Project
+from ..symbols import (
+    class_def,
+    function_def,
+    ir_node_classes,
+    method_names,
+    plan_node_classes,
+)
+
+
+def _mentions(fn: ast.AST) -> Set[str]:
+    """Every Name and string constant inside `fn` — the 'is this class
+    handled here' oracle for isinstance chains, dispatch-dict literals
+    and `name == "Exchange"` string dispatch alike."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+    return out
+
+
+class ExhaustivenessPass(AnalysisPass):
+    name = "plan-exhaustiveness"
+    description = "every plan/IR node handled in executor, fragmenter, EXPLAIN"
+    rules = ("plan-dispatch-missing",)
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        nodes = plan_node_classes(project)
+        node_names = [c for _, c in nodes]
+        from_nodes_py = [
+            c for f, c in nodes if f == "presto_tpu/plan/nodes.py"
+        ]
+
+        self._method_surface(
+            project, findings,
+            file="presto_tpu/exec/executor.py", cls="Executor",
+            prefix="_exec_", required=node_names,
+            surface="Executor dispatch (run() getattr's _exec_<node>)",
+        )
+        self._method_surface(
+            project, findings,
+            file="presto_tpu/plan/fragment.py", cls="Fragmenter",
+            prefix="_v_", required=from_nodes_py,
+            surface="Fragmenter visitor (_v_<node>)",
+        )
+        self._mention_surface(
+            project, findings,
+            file="presto_tpu/plan/nodes.py", func="plan_tree_str",
+            required=node_names,
+            surface="EXPLAIN rendering (plan_tree_str)",
+        )
+        self._mention_surface(
+            project, findings,
+            file="presto_tpu/expr/compiler.py", func="evaluate",
+            required=[c for _, c in ir_node_classes(project)],
+            surface="expression evaluation (evaluate)",
+        )
+        return findings
+
+    def _method_surface(
+        self, project, findings, *, file, cls, prefix, required, surface
+    ):
+        sf = project.file(file)
+        if sf is None:
+            return
+        have = {
+            m[len(prefix):]
+            for m in method_names(sf, cls)
+            if m.startswith(prefix)
+        }
+        anchor = class_def(sf, cls)
+        line = anchor.lineno if anchor is not None else 1
+        for node in required:
+            if node.lower() not in have:
+                findings.append(
+                    Finding(
+                        "plan-dispatch-missing", "error", file, line,
+                        f"{surface}: no {prefix}{node.lower()} for plan "
+                        f"node {node} — add the handler (or an explicit "
+                        "rejecting one) before the node ships",
+                        cls,
+                    )
+                )
+
+    def _mention_surface(
+        self, project, findings, *, file, func, required, surface
+    ):
+        sf = project.file(file)
+        if sf is None:
+            return
+        fn = function_def(sf, func)
+        if fn is None:
+            findings.append(
+                Finding(
+                    "plan-dispatch-missing", "error", file, 1,
+                    f"{surface}: function {func} not found", "",
+                )
+            )
+            return
+        seen = _mentions(fn)
+        for node in required:
+            if node not in seen:
+                findings.append(
+                    Finding(
+                        "plan-dispatch-missing", "error", file, fn.lineno,
+                        f"{surface}: {func} never mentions {node} — handle "
+                        "it, or add it to the explicit name-only branch so "
+                        "the omission is visibly deliberate",
+                        func,
+                    )
+                )
+
+
+PASS = ExhaustivenessPass()

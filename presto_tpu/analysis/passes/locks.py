@@ -1,0 +1,475 @@
+"""lock-discipline: blocking calls under locks and acquisition-order cycles.
+
+The server and executor now hold 16+ lock sites across three interacting
+domains (worker memory pool, output buffers, query caches). Two bug
+classes this pass makes structural:
+
+lock-blocking-call (error)
+    A call that can block indefinitely — HTTP (`urlopen`/`requests.*`),
+    `queue.get()` without a timeout, `future.result()` without a
+    timeout, `thread.join()`, `time.sleep`, `cond.wait()` without a
+    timeout while OTHER locks are held, blocking `lock.acquire()`,
+    device sync (`block_until_ready`/`jax.device_get`) — made while
+    holding a lock. One slow peer then stalls every thread behind the
+    lock; the PR 4 exchange threads and PR 7 memory killers both fan in
+    here.
+
+lock-order-inversion (error)
+    Lock pair (A, B) acquired in both orders somewhere in the tree —
+    the classic ABBA deadlock. Edges come from literal `with` nesting
+    AND from one level of calls: `with self._lock: self.pool.reserve()`
+    adds an edge to every lock `reserve` takes, resolved through
+    `self.pool = WorkerMemoryPool(...)`-style attribute types.
+
+Lock identity is `ClassName.attr` (or `module.name` for globals), so the
+same attribute on different instances unifies — exactly what you want
+for ordering discipline, at the cost of treating two instances of one
+class as one lock (document real cases with an allow())."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (
+    AnalysisPass,
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+    iter_scoped_defs,
+    shallow_walk,
+)
+from ..symbols import attr_kinds
+
+_BLOCKING_NAME_PARTS = {"urlopen"}
+_REQUESTS_METHODS = {"get", "post", "put", "delete", "head", "request"}
+
+
+def _kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    key: Tuple[str, str, str]  # (file, class or '', func)
+    acquires: Set[str]  # lock ids taken via `with` anywhere inside
+    # (held locks at the call, callee key or attr-call spec, line)
+    calls: List[Tuple[Tuple[str, ...], Tuple[str, str], int]]
+    # (held tuple, new lock id, line) for nested-with edges
+    edges: List[Tuple[Tuple[str, ...], str, int]]
+    blocking: List[Tuple[Tuple[str, ...], str, int]]
+
+
+def _class_index(project: Project) -> Dict[str, List[Tuple[str, str]]]:
+    def build(p: Project):
+        out: Dict[str, List[Tuple[str, str]]] = {}
+        for sf in p.files:
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    out.setdefault(node.name, []).append((sf.rel, node.name))
+        return out
+
+    return project.symbol("class_index", build)
+
+
+def _attr_classes(project: Project) -> Dict[Tuple[str, str], Dict[str, str]]:
+    """(file, class) -> {attr: ClassName} for `self.attr = ClassName(...)`."""
+
+    def build(p: Project):
+        classes = _class_index(p)
+        out: Dict[Tuple[str, str], Dict[str, str]] = {}
+        for sf in p.files:
+            for node in sf.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                m: Dict[str, str] = {}
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    if not isinstance(sub.value, ast.Call):
+                        continue
+                    ctor = dotted_name(sub.value.func).split(".")[-1]
+                    if ctor not in classes:
+                        continue
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            m[t.attr] = ctor
+                if m:
+                    out[(sf.rel, node.name)] = m
+        return out
+
+    return project.symbol("attr_classes", build)
+
+
+class LockDisciplinePass(AnalysisPass):
+    name = "lock-discipline"
+    description = "blocking calls while holding locks; ABBA order inversions"
+    rules = ("lock-blocking-call", "lock-order-inversion")
+
+    def run(self, project: Project) -> List[Finding]:
+        kinds = attr_kinds(project)
+        # project-wide class -> {attr: kind} and class -> base names, so
+        # `with self._cv:` in a SUBCLASS resolves to the defining class
+        # (lock identity must unify across the inheritance chain)
+        cls_attr: Dict[str, Dict[str, str]] = {}
+        cls_bases: Dict[str, List[str]] = {}
+        for sf in project.files:
+            for cname, attrs in kinds[sf.rel].classes.items():
+                m = cls_attr.setdefault(cname, {})
+                for a, k in attrs.items():
+                    m.setdefault(a, k)
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    cls_bases.setdefault(
+                        node.name,
+                        [dotted_name(b).split(".")[-1] for b in node.bases],
+                    )
+
+        def resolve_attr(cls: Optional[str], attr: str):
+            """(defining class, kind) for self.<attr>, walking bases
+            breadth-first; (None, None) when unknown."""
+            queue, seen = [cls] if cls else [], set()
+            while queue:
+                cur = queue.pop(0)
+                if cur in seen or cur is None:
+                    continue
+                seen.add(cur)
+                if attr in cls_attr.get(cur, {}):
+                    return cur, cls_attr[cur][attr]
+                queue.extend(cls_bases.get(cur, []))
+            return None, None
+
+        methods: Dict[Tuple[str, str, str], MethodInfo] = {}
+        for sf in project.iter_files("presto_tpu/"):
+            self._collect_file(sf, kinds[sf.rel], methods, resolve_attr)
+        return self._report(project, methods)
+
+    # -- phase A: per-method collection ------------------------------------
+
+    def _collect_file(self, sf: SourceFile, ak, methods, resolve_attr):
+        mod = os.path.basename(sf.rel).rsplit(".", 1)[0]
+        # per-function scratch read by classify_blocking (refreshed in
+        # enter_func; nested defs share the enclosing function's view)
+        state = {"future_locals": set()}
+
+        def lock_id(expr, cls: Optional[str]) -> Optional[str]:
+            """Resolve a with-item / receiver to a lock id, or None.
+            Identity is `DefiningClass.attr` so subclasses unify with the
+            class that created the lock."""
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+            ):
+                owner, kind = resolve_attr(cls, expr.attr)
+                if kind == "lock":
+                    return f"{owner}.{expr.attr}"
+                return None
+            if isinstance(expr, ast.Name) and ak.module.get(expr.id) == "lock":
+                return f"{mod}.{expr.id}"
+            return None
+
+        def recv_kind(expr, cls: Optional[str]) -> Optional[str]:
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+            ):
+                return resolve_attr(cls, expr.attr)[1]
+            if isinstance(expr, ast.Name):
+                return ak.module.get(expr.id)
+            return None
+
+        def classify_blocking(call: ast.Call, cls, held) -> Optional[str]:
+            name = dotted_name(call.func)
+            tail = name.split(".")[-1]
+            root = name.split(".")[0]
+            if name == "time.sleep" or tail == "sleep" and root == "time":
+                return "time.sleep"
+            if any(p in name for p in _BLOCKING_NAME_PARTS):
+                return name
+            if root == "requests" and tail in _REQUESTS_METHODS:
+                return name
+            if not isinstance(call.func, ast.Attribute):
+                return None
+            recv = call.func.value
+            kind = recv_kind(recv, cls)
+            # dotted_name is "" for chains rooted at a call (e.g.
+            # pool.submit(x).result()) — the method name itself is
+            # always on the Attribute node
+            tail = call.func.attr
+            if tail == "result" and not call.args and not _kw(call, "timeout"):
+                # gate on evidence of future-ness, like queue.get/thread
+                # .join — an unrelated .result() method (a builder, a
+                # parser) must not fail the tier-1 gate
+                is_future = kind == "future"
+                if (
+                    isinstance(recv, ast.Name)
+                    and recv.id in state["future_locals"]
+                ):
+                    is_future = True
+                if isinstance(recv, ast.Call) and dotted_name(
+                    recv.func
+                ).split(".")[-1] == "submit":
+                    is_future = True  # pool.submit(...).result()
+                if is_future:
+                    label = dotted_name(call.func) or f"<future>.{tail}"
+                    return f"{label}() without timeout"
+            if tail == "get" and kind == "queue":
+                # only a LITERAL block=False is non-blocking — the mere
+                # presence of the kwarg must not suppress (block=True is
+                # exactly the indefinite wait this rule exists for)
+                block_false = call.args and (
+                    isinstance(call.args[0], ast.Constant)
+                    and call.args[0].value is False
+                )
+                bkw = next(
+                    (k.value for k in call.keywords if k.arg == "block"),
+                    None,
+                )
+                if isinstance(bkw, ast.Constant) and bkw.value is False:
+                    block_false = True
+                if not _kw(call, "timeout") and not block_false:
+                    return "queue.get() without timeout"
+            if tail == "join" and kind == "thread":
+                if not call.args and not _kw(call, "timeout"):
+                    return "thread.join() without timeout"
+            if tail == "wait" and not call.args and not _kw(call, "timeout"):
+                rid = lock_id(recv, cls)
+                if rid is not None and (
+                    len(held) > 1 or (held and held[-1] != rid)
+                ):
+                    return (
+                        f"{rid}.wait() without timeout while holding "
+                        f"{[h for h in held if h != rid]}"
+                    )
+            if tail == "acquire" and not _kw(call, "timeout") and not (
+                call.args
+            ):
+                rid = lock_id(recv, cls)
+                if rid is not None and held:
+                    return f"blocking {rid}.acquire()"
+            if tail == "block_until_ready":
+                return "device sync (block_until_ready)"
+            if name == "jax.device_get":
+                return "device sync (jax.device_get)"
+            return None
+
+        def walk(stmts, cls, fn_key, held: Tuple[str, ...]):
+            info = methods[fn_key]
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # a nested def runs later, not under these locks, so
+                    # its calls must not enter this MethodInfo (phase B
+                    # would attribute them to every caller that invokes
+                    # the method under a lock) — but closures like thread
+                    # targets are prime blocking-under-lock candidates,
+                    # so analyze the body as its OWN scope with a fresh
+                    # held set, keyed by qualified name
+                    nkey = (sf.rel, cls or "", f"{fn_key[2]}.{stmt.name}")
+                    if nkey not in methods:
+                        methods[nkey] = MethodInfo(nkey, set(), [], [], [])
+                    walk(stmt.body, cls, nkey, ())
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    new = []
+                    for item in stmt.items:
+                        lid = lock_id(item.context_expr, cls)
+                        if lid is not None:
+                            # items earlier in the same `with a, b:` are
+                            # already held when the next one acquires —
+                            # a->b is a real ordering edge, same as the
+                            # nested-with form
+                            eff = tuple(
+                                h for h in held + tuple(new) if h != lid
+                            )
+                            if eff:
+                                info.edges.append((eff, lid, stmt.lineno))
+                            new.append(lid)
+                            info.acquires.add(lid)
+                    self._scan_exprs(
+                        stmt.items, cls, info, held, classify_blocking
+                    )
+                    walk(stmt.body, cls, fn_key, held + tuple(new))
+                    continue
+                # recurse into compound statements under the same held set
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        walk(sub, cls, fn_key, held)
+                for h in getattr(stmt, "handlers", ()):
+                    walk(h.body, cls, fn_key, held)
+                # scan only the HEADER expressions of compound statements
+                # — their bodies were just walked; scanning the whole
+                # subtree again would double-count every call
+                if isinstance(stmt, (ast.If, ast.While)):
+                    headers = [stmt.test]
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    headers = [stmt.iter]
+                elif isinstance(stmt, ast.Try):
+                    headers = []
+                else:
+                    headers = [stmt]
+                self._scan_exprs(headers, cls, info, held, classify_blocking)
+
+        def enter_func(fn, cls):
+            key = (sf.rel, cls or "", fn.name)
+            if key not in methods:
+                methods[key] = MethodInfo(key, set(), [], [], [])
+            # locals assigned from submit()/Future() in this function
+            # (incl. its closures) count as future-typed receivers
+            futs = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    if dotted_name(node.value.func).split(".")[-1] in (
+                        "submit", "Future",
+                    ):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                futs.add(t.id)
+            state["future_locals"] = futs
+            walk(fn.body, cls, key, ())
+
+        for fn, cnode in iter_scoped_defs(sf.tree.body):
+            enter_func(fn, cnode.name if cnode is not None else None)
+
+    def _scan_exprs(self, nodes, cls, info, held, classify_blocking):
+        """Record blocking calls and outgoing method calls at this held
+        set. Skips nested statements (the walker handles those)."""
+        # lambdas and nested defs are deferred execution: a callback
+        # BUILT under a lock does not RUN under it, so their bodies are
+        # excluded from the held-set scan entirely
+        deferred = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        for top in nodes:
+            for node in shallow_walk(top, skip=deferred):
+                if isinstance(node, deferred):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                # blocking calls record even with held=() — phase B uses
+                # them to flag `with lock: self._helper()` where the
+                # helper is what blocks
+                what = classify_blocking(node, cls, held)
+                if what:
+                    info.blocking.append((held, what, node.lineno))
+                if not held:
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    recv = node.func.value
+                    if isinstance(recv, ast.Name) and recv.id == "self":
+                        info.calls.append(
+                            (held, ("self", node.func.attr), node.lineno)
+                        )
+                    elif (
+                        isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                    ):
+                        info.calls.append(
+                            (held, (recv.attr, node.func.attr), node.lineno)
+                        )
+                elif isinstance(node.func, ast.Name):
+                    # bare module-level helper in the same file
+                    info.calls.append(
+                        (held, ("", node.func.id), node.lineno)
+                    )
+
+    # -- phase B: edges + report -------------------------------------------
+
+    def _report(self, project: Project, methods) -> List[Finding]:
+        findings: List[Finding] = []
+        attr_cls = _attr_classes(project)
+        # method lookup: (class, func) -> candidate MethodInfos. Class
+        # names duplicate across files (plan/nodes.Join vs sql/tree.Join)
+        # so resolution prefers the caller's file and gives up when the
+        # cross-file candidates are ambiguous — a wrong-class body would
+        # fabricate (or hide) lock findings
+        by_cls: Dict[Tuple[str, str], List[MethodInfo]] = {}
+        for (f, c, fn), info in sorted(methods.items()):
+            by_cls.setdefault((c, fn), []).append(info)
+
+        def lookup_method(cls_name, callee, caller_file):
+            cands = by_cls.get((cls_name, callee), [])
+            same = [i for i in cands if i.key[0] == caller_file]
+            if same:
+                return same[0]
+            if len(cands) == 1:
+                return cands[0]
+            return None
+
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for (f, c, fn), info in sorted(methods.items()):
+            ctx = f"{c}.{fn}" if c else fn
+            for held, what, line in info.blocking:
+                if not held:
+                    continue  # kept only for phase-B propagation
+                findings.append(
+                    Finding(
+                        "lock-blocking-call", "error", f, line,
+                        f"{what} while holding {list(held)}",
+                        ctx,
+                    )
+                )
+            for held, lid, line in info.edges:
+                for h in held:
+                    edges.setdefault((h, lid), (f, line, ctx))
+            # one level through the call graph
+            for held, (recv, callee), line in info.calls:
+                if recv == "self":
+                    target = lookup_method(c, callee, f)
+                elif recv == "":
+                    target = methods.get((f, "", callee))
+                else:
+                    tcls = attr_cls.get((f, c), {}).get(recv)
+                    target = (
+                        lookup_method(tcls, callee, f) if tcls else None
+                    )
+                if target is None:
+                    continue
+                callee_ctx = ".".join(x for x in target.key[1:] if x)
+                for bheld, what, _bline in target.blocking:
+                    if not bheld:
+                        findings.append(
+                            Finding(
+                                "lock-blocking-call", "error", f, line,
+                                f"{what} (inside {callee_ctx}) while "
+                                f"holding {list(held)}",
+                                ctx,
+                            )
+                        )
+                for lid in target.acquires:
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault(
+                                (h, lid),
+                                (f, line, f"{ctx} -> {callee_ctx}"),
+                            )
+
+        reported = set()
+        for (a, b), (f, line, ctx) in sorted(edges.items()):
+            if (b, a) in edges and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                # the message must stay line-number-free so baseline
+                # fingerprints survive unrelated edits near either site
+                f2, _line2, ctx2 = edges[(b, a)]
+                findings.append(
+                    Finding(
+                        "lock-order-inversion", "error", f, line,
+                        f"lock order inversion: {a} -> {b} here but "
+                        f"{b} -> {a} in {f2} ({ctx2})",
+                        ctx,
+                    )
+                )
+        return findings
+
+
+PASS = LockDisciplinePass()

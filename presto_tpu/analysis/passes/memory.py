@@ -1,0 +1,191 @@
+"""memory-accounting: every reserve must reach a matching free.
+
+MemoryPool.free() counts over-frees into GLOBAL_ACCOUNTING instead of
+clamping (PR 7), and the test conftest fails any test that leaks a
+reservation — but both only fire when a test happens to drive the leaky
+path. This pass enforces the structure statically.
+
+The tree's idiom (exec/stream.py, exec/spill.py)::
+
+    nb = page_device_bytes(page)
+    self.pool.reserve(nb, "what")
+    try:
+        ...
+    finally:
+        self.pool.free(nb)
+
+Ownership transfers are legal: a builder reserves and RETURNS the held
+bytes for a consumer method of the same class to free (the hybrid-join
+build side). Hence two rules at different strictness:
+
+memory-reserve-unpaired (error)
+    A function reserves on receiver R but neither it nor any method of
+    the same class ever frees on R — the reservation cannot be released.
+
+memory-reserve-no-finally (warning)
+    A function both reserves and frees on R, but no free sits in a
+    `finally`/`except` block: an exception between the two leaks the
+    reservation (and, under a parent pool, permanently shrinks the
+    worker's admission budget).
+
+Receiver matching is textual on the dotted chain (`self.pool`, `pool`,
+`self._pool`, names containing "pool"/"memory"), and `reserve*`/`free*`
+are prefix-matched so reserve_execution/free_execution pair too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import (
+    AnalysisPass,
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+    iter_scoped_defs,
+)
+
+
+def _pool_receiver(call: ast.Call) -> Tuple[str, str]:
+    """('self.pool', 'reserve') for pool-ish reserve/free calls, else
+    ('', '')."""
+    if not isinstance(call.func, ast.Attribute):
+        return "", ""
+    meth = call.func.attr
+    if not (meth.startswith("reserve") or meth.startswith("free")):
+        return "", ""
+    recv = dotted_name(call.func.value)
+    if not recv:
+        return "", ""
+    tail = recv.split(".")[-1].lower()
+    if "pool" in tail or "memory" in tail:
+        return recv, meth
+    return "", ""
+
+
+def _collect(fn: ast.AST):
+    """(reserves, frees, protected_frees) by receiver for one function,
+    ignoring nested defs (they run on their own schedule)."""
+    reserves: Dict[str, int] = {}
+    frees: Set[str] = set()
+    protected: Set[str] = set()
+
+    def scan(node, in_cleanup: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Try):
+                for b in child.body + child.orelse:
+                    scan(b, in_cleanup)
+                for b in child.finalbody:
+                    scan(b, True)
+                for h in child.handlers:
+                    for b in h.body:
+                        scan(b, True)
+                continue
+            scan(child, in_cleanup)
+            if isinstance(child, ast.Call):
+                recv, meth = _pool_receiver(child)
+                if not recv:
+                    continue
+                if meth.startswith("reserve"):
+                    reserves.setdefault(recv, child.lineno)
+                else:
+                    frees.add(recv)
+                    if in_cleanup:
+                        protected.add(recv)
+
+    scan(fn, False)
+    return reserves, frees, protected
+
+
+def _direct_nested_defs(fn):
+    """Function defs nested inside `fn` (any statement depth) WITHOUT
+    descending into them — each gets its own check_fn visit."""
+    out = []
+
+    def scan(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(child)
+            else:
+                scan(child)
+
+    scan(fn)
+    return out
+
+
+class MemoryAccountingPass(AnalysisPass):
+    name = "memory-accounting"
+    description = "MemoryPool.reserve paths must reach a matching free"
+    rules = ("memory-reserve-unpaired", "memory-reserve-no-finally")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.iter_files("presto_tpu/"):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def check_fn(fn, ctx: str, class_frees: Set[str]):
+            qual = f"{ctx}.{fn.name}" if ctx else fn.name
+            reserves, frees, protected = _collect(fn)
+            for recv, line in sorted(reserves.items()):
+                if recv not in frees:
+                    if recv in class_frees:
+                        continue  # ownership transfer within the class
+                    findings.append(
+                        Finding(
+                            "memory-reserve-unpaired", "error", sf.rel, line,
+                            f"{recv}.reserve() with no matching free "
+                            "anywhere in the function or its class — the "
+                            "reservation can never be released",
+                            qual,
+                        )
+                    )
+                elif recv not in protected:
+                    findings.append(
+                        Finding(
+                            "memory-reserve-no-finally", "warning", sf.rel,
+                            line,
+                            f"{recv}.reserve() whose free is not in a "
+                            "finally/except: an exception in between leaks "
+                            "the reservation against the worker budget",
+                            qual,
+                        )
+                    )
+            for fsub in _direct_nested_defs(fn):
+                check_fn(fsub, qual, class_frees)
+
+        # class-level frees computed once per class (ownership transfer:
+        # reserve in one method, free in another)
+        frees_by_class: Dict[int, Set[str]] = {}
+
+        def class_frees_of(cnode) -> Set[str]:
+            if cnode is None:
+                return set()
+            got = frees_by_class.get(id(cnode))
+            if got is None:
+                got = set()
+                for sub in ast.walk(cnode):
+                    if isinstance(sub, ast.Call):
+                        recv, meth = _pool_receiver(sub)
+                        if recv and meth.startswith("free"):
+                            got.add(recv)
+                frees_by_class[id(cnode)] = got
+            return got
+
+        for fn, cnode in iter_scoped_defs(sf.tree.body):
+            check_fn(
+                fn,
+                cnode.name if cnode is not None else "",
+                class_frees_of(cnode),
+            )
+        return findings
+
+
+PASS = MemoryAccountingPass()

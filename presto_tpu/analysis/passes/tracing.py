@@ -1,0 +1,306 @@
+"""tracing-safety: host callbacks and Python-on-tracer patterns.
+
+The marquee bug class: `jax.pure_callback` reachable from jitted code
+wedges forever on the single-device CPU runtime (the main thread blocks
+synchronizing the kernel while the callback thread starves — the ORDER
+BY >= 14k deadlock bisected to PR 2 and root-fixed alongside this pass),
+and silently recomputes or crashes on sharded inputs. Related classes:
+Python truthiness on a tracer raises TracerBoolConversionError at trace
+time, and `np.*` applied to a tracer either crashes or silently forces a
+host sync.
+
+Rules
+-----
+tracing-host-callback (error)
+    A `pure_callback`/`io_callback` call whose enclosing function has no
+    concreteness guard. A guard is a reference to `Tracer` (an
+    `isinstance(x, jax.core.Tracer)` eager bypass) or a call to a
+    `_concrete`-style helper — the fixed idiom in ops/sort.py: run numpy
+    DIRECTLY when operands are concrete, keep the callback only as the
+    under-trace fallback, and make the caller route host plans around
+    jit.
+
+tracing-tracer-bool (error)
+    `if`/`while`/`assert`/`not` applied directly to an array-returning
+    `jnp.any`/`jnp.all`/`.any()`/`.all()` call inside a device function
+    (a function whose body uses jnp/lax). Under jit the test raises; the
+    device idiom is `jnp.where`/`lax.cond`, or return the predicate
+    array to an eager caller (ops/sort.py's `ok` flags).
+
+tracing-numpy-on-device (warning)
+    An ARRAY-CONSUMING `np.<fn>` (asarray/argsort/flatnonzero/...)
+    inside a device function in `ops/` or `expr/` that is neither a
+    host-callback target nor a `_host_*` helper. numpy on a tracer
+    fails at trace time; on a concrete device array it forces a host
+    transfer mid-kernel. Constructors (np.zeros/np.array over host
+    data) are the established host-side dictionary idiom and stay
+    legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import (
+    AnalysisPass,
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+    iter_scoped_defs,
+    shallow_walk,
+)
+
+_CALLBACKS = {"pure_callback", "io_callback"}
+_BOOL_REDUCERS = {"any", "all"}
+
+# np.* calls that CONSUME an existing array — applied to a tracer these
+# fail at trace time, applied to a device array they force a host sync.
+# Constructors (np.zeros/empty/array over host data) are the tree's
+# established host-side varchar-dictionary idiom and are trace-safe, so
+# this is an explicit flag-list, not an allow-list.
+_NP_ARRAY_CONSUMERS = {
+    "asarray", "ascontiguousarray", "asfortranarray", "copy",
+    "flatnonzero", "nonzero", "argwhere",
+    "argsort", "lexsort", "sort", "argpartition", "partition",
+    "unique", "searchsorted", "bincount", "digitize",
+    "concatenate", "stack", "hstack", "vstack", "split",
+    "take", "clip", "where", "cumsum", "cumprod",
+    "sum", "prod", "min", "max", "argmin", "argmax", "mean",
+    "isnan", "isfinite", "isinf", "frombuffer",
+}
+
+_DEVICE_ROOTS = {"jnp", "lax"}
+_DEVICE_DOTTED = {"jax.numpy", "jax.lax"}
+
+
+def _uses_device_ops(fn: ast.AST) -> bool:
+    # shallow: a nested helper's jnp usage must not make the OUTER
+    # function a device function (the helper is analyzed on its own)
+    for node in shallow_walk(fn):
+        if isinstance(node, ast.Attribute):
+            name = dotted_name(node)
+            root = name.split(".")[0]
+            if root in _DEVICE_ROOTS or any(
+                name.startswith(d + ".") or name == d for d in _DEVICE_DOTTED
+            ):
+                return True
+    return False
+
+
+def _mentions_guard(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "Tracer":
+            return True
+        if isinstance(node, ast.Call):
+            tail = dotted_name(node.func).split(".")[-1]
+            if tail in {"_concrete", "is_concrete"}:
+                return True
+    return False
+
+
+def _guard_ifs(fn: ast.AST):
+    """``(if_node, body_terminates)`` for every `if` in `fn` whose test
+    references Tracer / a ``_concrete``-style helper. Shallow: a guard
+    inside a nested helper guards the HELPER, not the enclosing
+    function."""
+    out = []
+    for node in shallow_walk(fn):
+        if isinstance(node, ast.If) and _mentions_guard(node.test):
+            terminates = bool(node.body) and isinstance(
+                node.body[-1], (ast.Return, ast.Raise, ast.Continue)
+            )
+            out.append((node, terminates))
+    return out
+
+
+def _call_is_guarded(call: ast.Call, guards) -> bool:
+    """A callback call is guarded only when it sits INSIDE a
+    guard-conditional's subtree (either branch: the author explicitly
+    branched on concreteness) or AFTER a guard whose body early-returns
+    (the ops/sort.py eager-bypass idiom). A guard elsewhere in the
+    function must not silence an unrelated callback — that is how the
+    single-device deadlock class would re-enter the tree."""
+    for g, terminates in guards:
+        end = getattr(g, "end_lineno", None) or g.lineno
+        if g.lineno <= call.lineno <= end:
+            return True
+        if terminates and end < call.lineno:
+            return True
+    return False
+
+
+def _callback_targets(tree: ast.Module) -> Set[str]:
+    """Names passed as the callback argument to pure_callback/io_callback
+    anywhere in the module — those functions RUN on the host."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            tail = dotted_name(node.func).split(".")[-1]
+            if tail in _CALLBACKS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    out.add(first.id)
+                elif isinstance(first, ast.Call):
+                    # factory idiom: pure_callback(_host_topn(cap), ...)
+                    factory = dotted_name(first.func).split(".")[-1]
+                    if factory:
+                        out.add(factory)
+    return out
+
+
+def _is_bool_reducer_call(node: ast.AST) -> Optional[ast.Call]:
+    """The offending Call when `node` is jnp.any/all(...) or x.any()/.all(),
+    unwrapping a leading `not`."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        node = node.operand
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    parts = name.split(".")
+    if parts[-1] not in _BOOL_REDUCERS:
+        return None
+    if len(parts) >= 2 and parts[0] in _DEVICE_ROOTS | {"jax"}:
+        return node
+    # method form x.any(): only when the receiver is itself a device
+    # expression we can see (jnp call) — bare names are too ambiguous
+    if isinstance(node.func, ast.Attribute) and isinstance(
+        node.func.value, ast.Call
+    ):
+        recv = dotted_name(node.func.value.func)
+        if recv.split(".")[0] in _DEVICE_ROOTS | {"jax"}:
+            return node
+    return None
+
+
+class TracingSafetyPass(AnalysisPass):
+    name = "tracing-safety"
+    description = (
+        "host callbacks under jit, tracer truthiness, numpy on device arrays"
+    )
+    rules = (
+        "tracing-host-callback",
+        "tracing-numpy-on-device",
+        "tracing-tracer-bool",
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.iter_files("presto_tpu/"):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        host_fns = _callback_targets(sf.tree)
+        # numpy/truthiness rules only police kernel-land (ops/, expr/):
+        # exec/ and server/ legitimately mix eager numpy with device code
+        kernel_land = sf.rel.startswith(
+            ("presto_tpu/ops/", "presto_tpu/expr/")
+        )
+
+        def marked_host(fn) -> bool:
+            # explicit escape hatch for host-orchestrated functions that
+            # legally mix numpy with jnp setup/teardown:
+            # `# prestolint: host-function` on the def line or in the
+            # contiguous comment block above it (same placement contract
+            # as allow() suppressions — one shared scan in core.py)
+            return sf.has_marker(fn.lineno, "# prestolint: host-function")
+
+        def walk_fn(fn: ast.FunctionDef, ctx: str, host: bool):
+            qual = f"{ctx}.{fn.name}" if ctx else fn.name
+            is_host = host or fn.name in host_fns or fn.name.startswith(
+                "_host_"
+            ) or marked_host(fn)
+            device_fn = not is_host and _uses_device_ops(fn)
+            guards = _guard_ifs(fn)
+            for node in fn.body:
+                self._walk_stmts(
+                    node, sf, qual, is_host, device_fn, guards,
+                    kernel_land, findings, walk_fn,
+                )
+
+        for fn, cls in iter_scoped_defs(sf.tree.body):
+            walk_fn(fn, cls.name if cls is not None else "", host=False)
+        return findings
+
+    def _walk_stmts(
+        self, node, sf, qual, is_host, device_fn, guards, kernel_land,
+        findings, walk_fn,
+    ):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, qual, host=is_host)
+            return
+        # shallow: defs nested inside compound statements re-enter
+        # walk_fn with their OWN host/device/guard flags instead of
+        # being scanned under the enclosing function's. Lambdas are NOT
+        # boundaries here — in kernel code they typically run inline
+        # under the same trace (lax.cond branches etc.).
+        for sub in shallow_walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if sub is not node:
+                    walk_fn(sub, qual, host=is_host)
+                continue
+            if isinstance(sub, ast.Call):
+                tail = dotted_name(sub.func).split(".")[-1]
+                if tail in _CALLBACKS and not is_host and not (
+                    _call_is_guarded(sub, guards)
+                ):
+                    findings.append(
+                        Finding(
+                            "tracing-host-callback", "error", sf.rel,
+                            sub.lineno,
+                            f"{tail} without a concreteness guard: add an "
+                            "eager direct-numpy bypass (isinstance(x, "
+                            "jax.core.Tracer) / _concrete()) — the jitted "
+                            "callback path deadlocks on single-device CPU "
+                            "and breaks on sharded inputs",
+                            qual,
+                        )
+                    )
+                if (
+                    kernel_land
+                    and device_fn
+                    and dotted_name(sub.func).split(".")[0] == "np"
+                ):
+                    attr = dotted_name(sub.func).split(".")[1:]
+                    if attr and attr[0] in _NP_ARRAY_CONSUMERS:
+                        findings.append(
+                            Finding(
+                                "tracing-numpy-on-device", "warning", sf.rel,
+                                sub.lineno,
+                                f"np.{'.'.join(attr)} inside a device "
+                                "function: fails on tracers under jit and "
+                                "forces a host sync eagerly — use jnp, or "
+                                "move the host step behind a guarded "
+                                "callback/_host_ helper",
+                                qual,
+                            )
+                        )
+            tests = []
+            if isinstance(sub, (ast.If, ast.While)):
+                tests.append(sub.test)
+            elif isinstance(sub, ast.Assert):
+                tests.append(sub.test)
+            elif isinstance(sub, ast.IfExp):
+                tests.append(sub.test)
+            for t in tests:
+                if not (kernel_land and device_fn):
+                    break
+                bad = _is_bool_reducer_call(t)
+                if bad is not None:
+                    findings.append(
+                        Finding(
+                            "tracing-tracer-bool", "error", sf.rel,
+                            bad.lineno,
+                            "Python truthiness on a device-array reduction: "
+                            "raises TracerBoolConversionError under jit — "
+                            "use jnp.where/lax.cond or return the predicate "
+                            "to an eager caller",
+                            qual,
+                        )
+                    )
+
+
+PASS = TracingSafetyPass()

@@ -1,0 +1,159 @@
+"""Cross-file symbol tables shared by prestolint passes.
+
+Small, purpose-built views of the tree: which classes are plan nodes /
+IR nodes, which methods a class defines, which attributes a class binds
+to locks or queues or threads. Everything is name-based AST analysis —
+no imports of the analyzed code, so the linter can run on a tree that
+would fail at import time."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Project, SourceFile, dotted_name
+
+PLAN_NODE_FILES = ("presto_tpu/plan/nodes.py", "presto_tpu/plan/fragment.py")
+IR_FILE = "presto_tpu/expr/ir.py"
+
+
+def _subclasses_of(sf: SourceFile, bases: Set[str]) -> List[str]:
+    """Class names in `sf` whose direct base matches one of `bases`
+    (matching both `PlanNode` and `N.PlanNode` spellings)."""
+    out = []
+    for node in sf.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for b in node.bases:
+            name = dotted_name(b)
+            if name in bases or name.split(".")[-1] in bases:
+                out.append(node.name)
+                break
+    return out
+
+
+def plan_node_classes(project: Project) -> List[Tuple[str, str]]:
+    """[(file, class)] for every concrete PlanNode subclass."""
+
+    def build(p: Project):
+        found = []
+        for rel in PLAN_NODE_FILES:
+            sf = p.file(rel)
+            if sf is None:
+                continue
+            for cls in _subclasses_of(sf, {"PlanNode"}):
+                found.append((rel, cls))
+        return found
+
+    return project.symbol("plan_nodes", build)
+
+
+def ir_node_classes(project: Project) -> List[Tuple[str, str]]:
+    """[(file, class)] for every RowExpression subclass."""
+
+    def build(p: Project):
+        sf = p.file(IR_FILE)
+        if sf is None:
+            return []
+        return [(IR_FILE, c) for c in _subclasses_of(sf, {"RowExpression"})]
+
+    return project.symbol("ir_nodes", build)
+
+
+def class_def(sf: SourceFile, name: str) -> Optional[ast.ClassDef]:
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def method_names(sf: SourceFile, cls: str) -> Set[str]:
+    node = class_def(sf, cls)
+    if node is None:
+        return set()
+    return {
+        n.name
+        for n in node.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def function_def(sf: SourceFile, name: str) -> Optional[ast.FunctionDef]:
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name == name
+        ):
+            return node
+    return None
+
+
+# -- attribute classification (locks / queues / threads) ---------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+_THREAD_CTORS = {"Thread"}
+_FUTURE_SOURCES = {"Future", "submit"}  # Future() ctor or pool.submit(...)
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    tail = dotted_name(value.func).split(".")[-1]
+    if tail in _LOCK_CTORS:
+        return "lock"
+    if tail in _QUEUE_CTORS:
+        return "queue"
+    if tail in _THREAD_CTORS:
+        return "thread"
+    if tail in _FUTURE_SOURCES:
+        return "future"
+    return None
+
+
+class AttrKinds:
+    """Per-file map of lock/queue/thread-valued names.
+
+    - ``classes[cls][attr] -> kind`` for ``self.attr = threading.Lock()``
+    - ``module[name] -> kind``       for module-level ``name = Lock()``
+    """
+
+    def __init__(self):
+        self.classes: Dict[str, Dict[str, str]] = {}
+        self.module: Dict[str, str] = {}
+
+
+def attr_kinds(project: Project) -> Dict[str, AttrKinds]:
+    """file rel -> AttrKinds, built once for the whole tree."""
+
+    def build(p: Project):
+        out: Dict[str, AttrKinds] = {}
+        for sf in p.files:
+            ak = AttrKinds()
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign):
+                    kind = _ctor_kind(node.value)
+                    if kind:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                ak.module[t.id] = kind
+                elif isinstance(node, ast.ClassDef):
+                    attrs: Dict[str, str] = {}
+                    for sub in ast.walk(node):
+                        if not isinstance(sub, ast.Assign):
+                            continue
+                        kind = _ctor_kind(sub.value)
+                        if not kind:
+                            continue
+                        for t in sub.targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ):
+                                attrs[t.attr] = kind
+                    if attrs:
+                        ak.classes[node.name] = attrs
+            out[sf.rel] = ak
+        return out
+
+    return project.symbol("attr_kinds", build)

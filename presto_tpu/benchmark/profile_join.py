@@ -105,7 +105,8 @@ def main(sf: float = 0.1, runs: int = 5):
     ):
         try:
             out[name] = round(_chained(fn, runs) * 1e3, 3)
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 - the error IS the
+            # recorded measurement for this row
             out[name] = f"error: {repr(e)[:120]}"
     import jax
 

@@ -343,14 +343,24 @@ class Executor:
         if not BREAKERS.allow(breaker_name):
             return None
         if plan.host_sort:
-            # host-routed plans run numpy through jax.pure_callback, which
-            # DEADLOCKS on mesh-resident (multi-device) inputs — pages
-            # gathered from the distributed executor arrive that way.
-            # Commit them to one device first (cheap on the CPU backend,
-            # and host-sort plans only exist there).
+            # host-routed plans run numpy on the host. Commit
+            # mesh-sharded pages (gathered from the distributed
+            # executor) to one device first — cheap on the CPU backend,
+            # and host-sort plans only exist there.
             page = self._commit_single_device(page)
         try:
-            fn = self._kernel((node, label, plan), make_fn)
+            if plan.host_sort:
+                # EAGER, never jitted: under jit the host step becomes a
+                # jax.pure_callback, which deadlocks on the single-device
+                # CPU runtime (main thread blocks synchronizing the
+                # kernel while the callback thread starves — the PR 2
+                # ORDER BY >= 14k wedge). Eagerly, ops/sort.py calls
+                # numpy directly and there is nothing to deadlock; the
+                # sort dominates the cost, so losing jit fusion of the
+                # cheap pack arithmetic is noise.
+                fn = make_fn()
+            else:
+                fn = self._kernel((node, label, plan), make_fn)
             out, ok = fn(page)
         except Exception as exc:  # noqa: BLE001 — degrade, don't fail
             BREAKERS.record_failure(breaker_name, repr(exc))
@@ -809,10 +819,10 @@ class Executor:
                     page, node.group_exprs, node.group_names, node.aggs,
                     node.mask,
                 )
-            except Exception:
-                # fall back for THIS aggregation only — the matmul path
-                # is plain XLA, so a failure is shape-specific, unlike a
-                # Mosaic compile failure (which disables pallas above)
+            except Exception:  # noqa: BLE001 — fall back for THIS
+                # aggregation only: the matmul path is plain XLA, so a
+                # failure is shape-specific, unlike a Mosaic compile
+                # failure (which disables pallas above)
                 out = None
             if out is not None:
                 self._strategy_note(node, "mxu-matmul")
@@ -903,7 +913,8 @@ class Executor:
                 out = maybe_matmul_grouped_aggregate(
                     page, exprs, page.names, (), None
                 )
-            except Exception:
+            except Exception:  # noqa: BLE001 — shape-specific matmul
+                # fallback, same contract as _exec_aggregate's
                 out = None
             if out is not None:
                 self._strategy_note(node, "mxu-occupancy")

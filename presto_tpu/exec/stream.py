@@ -1343,65 +1343,83 @@ class StreamingExecutor:
                 if int(p.count) > 0 or spilled.num_rows == 0:
                     spilled.append(p)
 
-        for batch in self._agg_input_stream(node):
-            mg = round_capacity(min(max(int(batch.count), 1), 1 << 16))
-            while True:
-                part = grouped_aggregate_sorted(
-                    batch, node.group_exprs, node.group_names, partial, mg,
-                    node.mask,
-                )
-                if int(part.count) <= mg:
-                    break
-                mg = round_capacity(int(part.count))
-            part = self.local._shrink(part)
+        # state_held rotates through the loop; the finally releases
+        # whatever is still reserved when a kernel faults or a
+        # MemoryExceededError fires mid-stream (found by prestolint
+        # memory-accounting: a leaked reservation here permanently
+        # shrinks the worker's admission budget until task cleanup).
+        # Normal paths zero state_held as they free so the finally is a
+        # no-op for them.
+        try:
+            for batch in self._agg_input_stream(node):
+                mg = round_capacity(min(max(int(batch.count), 1), 1 << 16))
+                while True:
+                    part = grouped_aggregate_sorted(
+                        batch, node.group_exprs, node.group_names, partial,
+                        mg, node.mask,
+                    )
+                    if int(part.count) <= mg:
+                        break
+                    mg = round_capacity(int(part.count))
+                part = self.local._shrink(part)
+                if spilled is not None:
+                    spill_all([part])
+                    continue
+                pending.append(part)
+                pending_rows += int(part.count)
+                pending_bytes = sum(page_device_bytes(p) for p in pending)
+                self.pool.accumulated = pending_bytes
+                if pending_rows >= merge_rows or not self.pool.can_accumulate(
+                    pending_bytes
+                ):
+                    parts = ([state] if state is not None else []) + pending
+                    new_state = merge(parts, pending_rows + int(state.count if state is not None else 0))
+                    self.pool.free(state_held)
+                    state_held = 0
+                    nb = page_device_bytes(new_state)
+                    if self.pool.can_accumulate(nb):
+                        state_held = self.pool.reserve(nb, "aggregation state")
+                        state = new_state
+                    else:
+                        # group state outgrew the budget (or a revoke asked
+                        # for it back): switch to spilling
+                        # (SpillableHashAggregationBuilder.spillToDisk)
+                        spill_all([new_state])
+                        self.pool.note_revoked(nb)
+                        state = None
+                    pending = []
+                    pending_rows = 0
+                    self.pool.accumulated = 0
+            self.pool.accumulated = 0
             if spilled is not None:
-                spill_all([part])
-                continue
-            pending.append(part)
-            pending_rows += int(part.count)
-            pending_bytes = sum(page_device_bytes(p) for p in pending)
-            self.pool.accumulated = pending_bytes
-            if pending_rows >= merge_rows or not self.pool.can_accumulate(
-                pending_bytes
-            ):
-                parts = ([state] if state is not None else []) + pending
-                new_state = merge(parts, pending_rows + int(state.count if state is not None else 0))
+                spill_all(pending)
+                return self._finalize_spilled_agg(
+                    node, spilled, group_refs, final, post
+                )
+            # stream() always yields at least one batch: parts is non-empty
+            parts = ([state] if state is not None else []) + pending
+            est = sum(page_device_bytes(p) for p in parts)
+            if not self.pool.can_reserve(est - state_held):
+                # the final merged state itself would not fit: finish on
+                # the spill path, which emits a host-backed result
+                spill_all(parts)
                 self.pool.free(state_held)
                 state_held = 0
-                nb = page_device_bytes(new_state)
-                if self.pool.can_accumulate(nb):
-                    state_held = self.pool.reserve(nb, "aggregation state")
-                    state = new_state
-                else:
-                    # group state outgrew the budget (or a revoke asked
-                    # for it back): switch to spilling
-                    # (SpillableHashAggregationBuilder.spillToDisk)
-                    spill_all([new_state])
-                    self.pool.note_revoked(nb)
-                    state = None
-                pending = []
-                pending_rows = 0
-                self.pool.accumulated = 0
-        self.pool.accumulated = 0
-        if spilled is not None:
-            spill_all(pending)
-            return self._finalize_spilled_agg(
-                node, spilled, group_refs, final, post
-            )
-        # stream() always yields at least one batch, so parts is non-empty
-        parts = ([state] if state is not None else []) + pending
-        est = sum(page_device_bytes(p) for p in parts)
-        if not self.pool.can_reserve(est - state_held):
-            # the final merged state itself would not fit: finish on the
-            # spill path, which emits a host-backed result
-            spill_all(parts)
+                return self._finalize_spilled_agg(
+                    node, spilled, group_refs, final, post
+                )
+            out = merge(parts, pending_rows + int(state.count if state is not None else 0))
             self.pool.free(state_held)
-            return self._finalize_spilled_agg(
-                node, spilled, group_refs, final, post
-            )
-        out = merge(parts, pending_rows + int(state.count if state is not None else 0))
-        self.pool.free(state_held)
-        return apply_avg_post(out, node.aggs, post)
+            state_held = 0
+            return apply_avg_post(out, node.aggs, post)
+        finally:
+            if state_held:
+                self.pool.free(state_held)
+            # pending partials are dropped with the exception — without
+            # this the pool keeps reporting their bytes as revocable and
+            # the revoking scheduler keeps picking a dead query whose
+            # revoke can never complete
+            self.pool.accumulated = 0
 
     def _finalize_spilled_agg(
         self, node: N.Aggregate, spilled, group_refs, final, post

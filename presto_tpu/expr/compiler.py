@@ -85,6 +85,15 @@ def evaluate(expr: RowExpression, page: Page, n: Optional[int] = None) -> Val:
     if isinstance(expr, Literal):
         return _literal_val(expr, cap)
 
+    if isinstance(expr, Lambda):
+        # exhaustive over the IR: a Lambda is only meaningful as an
+        # argument of a lambda-form Call (transform/filter/reduce...),
+        # where _eval_lambda_form binds its parameters. Reaching one
+        # bare means the planner emitted it in a value position.
+        raise TypeError(
+            f"bare Lambda {expr} outside a lambda-form call — planner bug"
+        )
+
     assert isinstance(expr, Call), expr
     name = expr.name
 

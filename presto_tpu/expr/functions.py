@@ -1609,7 +1609,7 @@ def _from_iso8601_date(a: Val, out_type: T.Type) -> Val:
     def parse(s: str):
         try:
             return dt.parse_date_literal(s), True
-        except Exception:
+        except Exception:  # noqa: BLE001 — malformed input -> SQL NULL
             return 0, False
 
     return _dict_table_nullable(a, parse, np.int32, T.DATE)
@@ -1652,7 +1652,7 @@ def _json_get(s: str, steps):
 
     try:
         v = _json.loads(s)
-    except Exception:
+    except Exception:  # noqa: BLE001 — malformed JSON -> SQL NULL
         return None, False
     for step in steps:
         if isinstance(step, int):
@@ -1729,7 +1729,7 @@ def _json_array_length(a: Val, out_type: T.Type) -> Val:
     def f(s: str):
         try:
             v = _json.loads(s)
-        except Exception:
+        except Exception:  # noqa: BLE001 — malformed JSON -> SQL NULL
             return 0, False
         return (len(v), True) if isinstance(v, list) else (0, False)
 
@@ -1747,7 +1747,7 @@ def _json_array_contains(a: Val, needle: Val, out_type: T.Type) -> Val:
         # JsonFunctions is @SqlNullable)
         try:
             v = _json.loads(s)
-        except Exception:
+        except Exception:  # noqa: BLE001 — malformed JSON -> SQL NULL
             return False, False
         if not isinstance(v, list):
             return False, False
@@ -1775,7 +1775,8 @@ def _json_format(a: Val, out_type: T.Type) -> Val:
     def f(s: str) -> str:
         try:
             return _json.dumps(_json.loads(s), separators=(",", ":"))
-        except Exception:
+        except Exception:  # noqa: BLE001 — non-JSON passes through
+            # verbatim (reference json_format behavior)
             return s
 
     return _dict_transform(a, f)
@@ -1806,7 +1807,7 @@ def _url_part(name: str, getter):
         def f(s: str):
             try:
                 v = getter(urlparse(s), s)
-            except Exception:
+            except Exception:  # noqa: BLE001 — unparseable URL -> SQL NULL
                 return "", False
             return (v, True) if v is not None else ("", False)
 
@@ -1834,7 +1835,7 @@ def _url_extract_port(a: Val, out_type: T.Type) -> Val:
     def f(s: str):
         try:
             p = urlparse(s).port
-        except Exception:
+        except Exception:  # noqa: BLE001 — invalid port -> SQL NULL
             p = None
         return (p, True) if p is not None else (0, False)
 

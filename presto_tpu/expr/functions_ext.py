@@ -809,7 +809,7 @@ def _url_extract_parameter(a: Val, namev: Val, out_type: T.Type) -> Val:
     def f(s: str):
         try:
             q = parse_qs(urlparse(s).query, keep_blank_values=True)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — unparseable URL -> SQL NULL
             return "", False
         vals = q.get(pname)
         return (vals[0], True) if vals else ("", False)

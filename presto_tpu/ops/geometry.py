@@ -288,6 +288,8 @@ def ring_perimeter(verts: jnp.ndarray, nv: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+# prestolint: host-function -- host-orchestrated candidate pruning; only
+# the exact containment test dips into jnp, on concrete arrays
 def grid_spatial_join(
     px: np.ndarray, py: np.ndarray,
     polys: List[np.ndarray],

@@ -450,6 +450,8 @@ def key_info_from_block(block, lo: Optional[int] = None,
     )
 
 
+# prestolint: host-function -- setup-time planning with a deliberate
+# one-off host sync per key; never reachable from jitted code
 def plan_from_page(
     page,
     keys,

@@ -286,13 +286,33 @@ def _host_topn(n: int):
     return select
 
 
+def _concrete(*arrays) -> bool:
+    """True when every operand is a real array (not a jit/vmap tracer) —
+    the host route can then run numpy DIRECTLY instead of through
+    `jax.pure_callback`. The callback path wedges forever on the
+    single-device CPU runtime (the main thread blocks synchronizing the
+    kernel while the callback thread starves — the PR 2 deadlock), so
+    the executor routes host-sort plans around jit and this guard keeps
+    the op layer honest about which world it is in."""
+    import jax
+
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
 def packed_sort_perm(lanes, plan, cap: int) -> jnp.ndarray:
     """Stable permutation sorting the packed lane(s) ascending — ONE
-    device sort, or one numpy argsort through `jax.pure_callback` when
-    the plan was made for the CPU backend (plan.host_sort)."""
+    device sort, or one numpy argsort on the host when the plan was made
+    for the CPU backend (plan.host_sort). The host route runs numpy
+    directly on concrete operands (the executor executes host-sort plans
+    eagerly, outside jit); `jax.pure_callback` remains only as the
+    under-trace fallback and is unsafe on single-device CPU."""
     import jax
 
     if plan.host_sort:
+        if _concrete(*lanes):
+            return jnp.asarray(_host_argsort(*lanes))
+        # prestolint: allow(tracing-host-callback) -- under-trace
+        # fallback only; executor routes host_sort plans around jit
         return jax.pure_callback(
             _host_argsort,
             jax.ShapeDtypeStruct((cap,), jnp.int32),
@@ -341,11 +361,16 @@ def top_n_packed(page: Page, keys: Sequence[SortKey], n: int, plan):
     lanes, ok = pack_keys(vals, plan, page.live_mask())
     cap = min(n, page.capacity)
     if plan.host_sort and cap < page.capacity:
-        perm = jax.pure_callback(
-            _host_topn(cap),
-            jax.ShapeDtypeStruct((cap,), jnp.int32),
-            lanes[0],
-        )
+        if _concrete(lanes[0]):
+            perm = jnp.asarray(_host_topn(cap)(lanes[0]))
+        else:
+            # prestolint: allow(tracing-host-callback) -- under-trace
+            # fallback only; executor routes host_sort plans around jit
+            perm = jax.pure_callback(
+                _host_topn(cap),
+                jax.ShapeDtypeStruct((cap,), jnp.int32),
+                lanes[0],
+            )
     else:
         # packed keys are < 2**62 (dead rows INT64_MAX): negation is safe
         # and turns "n smallest" into top_k's "n largest"
@@ -438,15 +463,21 @@ def distinct_packed(page: Page, plan):
         # occupy [0, count) by the Page contract); equal packed keys are
         # identical rows, so representative choice is free and the
         # unstable (faster) numpy sort kinds are safe
-        sel, cnt = jax.pure_callback(
-            _host_distinct_sel,
-            (
-                jax.ShapeDtypeStruct((page.capacity,), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32),
-            ),
-            page.count,
-            *lanes,
-        )
+        if _concrete(page.count, *lanes):
+            sel, cnt = _host_distinct_sel(page.count, *lanes)
+            sel, cnt = jnp.asarray(sel), jnp.asarray(cnt)
+        else:
+            # prestolint: allow(tracing-host-callback) -- under-trace
+            # fallback only; executor routes host_sort plans around jit
+            sel, cnt = jax.pure_callback(
+                _host_distinct_sel,
+                (
+                    jax.ShapeDtypeStruct((page.capacity,), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                ),
+                page.count,
+                *lanes,
+            )
         blocks = [b.take_rows(sel) for b in page.blocks]
         return Page(tuple(blocks), page.names, cnt), ok
     out = jax.lax.sort(

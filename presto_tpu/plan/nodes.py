@@ -375,6 +375,15 @@ class Output(PlanNode):
         return (self.child,)
 
 
+def _sort_key_str(k) -> str:
+    """`expr desc nulls first` rendering for one SortKey (reference
+    planPrinter orderings)."""
+    s = f"{k.expr} {'asc' if k.ascending else 'desc'}"
+    if k.nulls_first is not None:
+        s += " nulls first" if k.nulls_first else " nulls last"
+    return s
+
+
 def plan_tree_str(
     node: PlanNode, indent: int = 0, collector=None, stats_of=None
 ) -> str:
@@ -431,15 +440,39 @@ def plan_tree_str(
             f"{l} = {r}" for l, r in zip(node.probe_keys, node.source_keys)
         )
         detail = f" [{'anti' if node.anti else 'semi'}] [{pairs}]"
-    elif isinstance(node, (TopN,)):
-        detail = f" [{node.count}]"
+    elif isinstance(node, (Sort, TopN)):
+        keys = ", ".join(_sort_key_str(k) for k in node.keys)
+        detail = f" [{keys}]"
+        if isinstance(node, TopN):
+            detail = f" [{node.count}]{detail}"
+    elif isinstance(node, Window):
+        parts = ", ".join(str(e) for e in node.partition_exprs)
+        order = ", ".join(_sort_key_str(k) for k in node.order_keys)
+        funcs = ", ".join(getattr(f, "name", str(f)) for f in node.funcs)
+        detail = f" [partition: {parts}] [order: {order}] [{funcs}]"
+    elif isinstance(node, Unnest):
+        detail = f" [{', '.join(node.elem_channels)}]"
+        if node.ordinality_channel is not None:
+            detail += f" [ordinality: {node.ordinality_channel}]"
+    elif isinstance(node, Union):
+        detail = f" [{len(node.inputs)} inputs]" + (
+            " [distinct]" if node.distinct else ""
+        )
     elif isinstance(node, Limit):
         detail = f" [{node.count}]"
     elif isinstance(node, Output):
         detail = f" [{', '.join(node.titles)}]"
+    elif isinstance(node, (Distinct, SingleRow, ScalarApply)):
+        # name-only nodes: no config beyond their children. The explicit
+        # branch keeps the prestolint exhaustiveness surface green — a
+        # NEW node class must show up here deliberately, one way or the
+        # other.
+        pass
     if name == "Exchange":
         keys = ", ".join(str(k) for k in node.keys)
         detail = f" [{node.kind}]" + (f" [{keys}]" if keys else "")
+    if name == "AggFinalize":
+        detail = f" [{', '.join(a.name for a in node.aggs)}]"
     stat = ""
     if collector is not None:
         s = collector.lookup(node)
@@ -449,7 +482,8 @@ def plan_tree_str(
         try:
             est = stats_of(node)
             stat += f" {{est: {est.rows:,.0f} rows}}"
-        except Exception:
+        except Exception:  # noqa: BLE001 — estimates are best-effort
+            # decoration; EXPLAIN itself must never fail on a stats gap
             pass
     lines = [f"{pad}- {name}{detail}{stat}"]
     for c in node.children:

@@ -150,7 +150,8 @@ class StatsDeriver:
             for ch, src, _typ in node.columns:
                 try:
                     cs = get(node.table, src)
-                except Exception:
+                except Exception:  # noqa: BLE001 — connector stats are
+                    # best-effort: a missing column simply has no stats
                     cs = None
                 if cs is not None:
                     cols[ch] = cs

@@ -69,7 +69,8 @@ class Client:
                 # 503 draining) — surface them as QueryError, not HTTPError
                 try:
                     payload = json.loads(e.read())
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — a non-JSON error body
+                    # becomes the QueryError message itself
                     payload = {"error": str(e)}
                 if (
                     e.code == 503

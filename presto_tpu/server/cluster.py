@@ -69,7 +69,8 @@ def _http_error_details(e: "urllib.error.HTTPError") -> Tuple[str, bool]:
     retried away when it says false."""
     try:
         payload = json.loads(e.read())
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — unparseable error body: fall back
+        # to classifying the HTTPError's own message below
         payload = {}
     if not isinstance(payload, dict):
         payload = {}
@@ -984,7 +985,9 @@ class HttpScheduler:
                 # not worth polling out the deadline
                 try:
                     detail = json.loads(e.read()).get("error") or str(e)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — body parse is
+                    # best-effort detail; the TaskFailure below still
+                    # carries the HTTP error either way
                     detail = str(e)
                 raise TaskFailure(
                     f"status of task {task_id} on worker {uri} "

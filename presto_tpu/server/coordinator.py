@@ -234,7 +234,9 @@ class CoordinatorServer:
                     if info.plan is None and info.error is None:
                         try:  # lazily rendered on the detail endpoint only
                             info.plan = outer.manager.session.explain(info.sql)
-                        except Exception:  # noqa: BLE001
+                        except Exception:  # noqa: BLE001 — the plan is UI
+                            # decoration; the query detail (incl. its real
+                            # error field) is served regardless
                             pass
                     d["plan"] = info.plan
                     d["error"] = info.error

@@ -162,7 +162,8 @@ def fetch_pages(
         # (reference: HttpPageBufferClient propagates the task error)
         try:
             detail = json.loads(e.read()).get("error") or str(e)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — body parse is best-effort
+            # detail; the ExchangeError below carries the failure anyway
             detail = str(e)
         raise ExchangeError(
             f"upstream task {task_id} on {uri} results fetch "

@@ -104,7 +104,8 @@ try:
             d = _zstd_tls.d = _zstd.ZstdDecompressor()
         return d.decompress(data, max_output_size=max_output_size)
 
-except Exception:  # noqa: BLE001
+except Exception:  # noqa: BLE001 — zstd missing/broken disables the
+    # codec; capability negotiation routes around it fleet-wide
     _zstd_c = _zstd_d = None
 
 

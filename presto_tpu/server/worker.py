@@ -376,6 +376,9 @@ class OutputBuffers:
             # pool.reserve below
             self._unacked += len(data)
         try:
+            # prestolint: allow(memory-reserve-no-finally) -- both
+            # failure paths DO undo: this except hands back _unacked,
+            # and the drained branch below frees the pool bytes
             self.pool.reserve(self.query_id, len(data), self.abort)
         except BaseException:
             with self._cond:
@@ -1094,12 +1097,17 @@ class WorkerServer:
                     pass
             ex_obj = getattr(state, "executor", None)
             if ex_obj is not None:
+                release_error = None
                 try:
                     ex_obj.release_spill()  # fold disk counters
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # noqa: BLE001 — teardown must
+                    # finish; the failure is recorded into spill_stats
+                    # below instead of vanishing (prestolint burndown)
+                    release_error = repr(exc)
                 state.memory_stats = ex_obj.pool.snapshot()
                 state.spill_stats = dict(ex_obj.spill_stats)
+                if release_error is not None:
+                    state.spill_stats["release_error"] = release_error
                 state.spill_stats["events"] = sorted(
                     set(ex_obj.spill_events)
                 )

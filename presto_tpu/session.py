@@ -717,7 +717,8 @@ class Session:
                 if stats_fn is not None:
                     try:
                         st = stats_fn(name, c)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 - per-column stats
+                        # are advisory, same contract as rows_total above
                         st = None
                 cols.append(c)
                 ndvs.append(None if st is None else st.ndv)

@@ -100,7 +100,7 @@ def verify_query(control, test, sql: str) -> VerifyResult:
     t1 = time.perf_counter()
     try:
         t_rows = test.execute(sql)
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 - reported, not raised
         return VerifyResult(
             sql, "TEST_FAILED", f"{type(e).__name__}: {e}",
             control_ms=(t1 - t0) * 1e3,

@@ -1,0 +1,78 @@
+"""Single-device CPU host-sort deadlock regression (ROADMAP "Known
+issues", bisected to PR 2, root-fixed in PR 9).
+
+On the DEFAULT single-device CPU runtime, ORDER BY over >= ~14k rows
+used to wedge forever in the keypack host-sort `jax.pure_callback`: the
+main thread blocked synchronizing the jitted kernel while the callback
+thread starved. The fix routes host-sort plans AROUND jit (the executor
+runs them eagerly; ops/sort.py calls numpy directly on concrete
+operands, keeping pure_callback only as an under-trace fallback).
+
+The test harness itself forces an 8-device virtual mesh (conftest.py),
+where the bug never fired — so the regression check runs in a clean
+SUBPROCESS on the default single-device runtime. No SIGALRM rescue: a
+wedge fails via the subprocess timeout."""
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+_SCRIPT = r"""
+import sys
+sys.path.insert(0, {root!r})
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")  # beat any sitecustomize
+assert jax.device_count() == 1, f"expected 1 device, got {{jax.device_count()}}"
+
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.session import Session
+
+s = Session(TpchCatalog(sf=0.01))  # orders @ sf0.01 = 15000 rows >= 14k
+r = s.query(
+    "select o_orderkey from orders order by o_custkey, o_orderkey"
+)
+rows = r.rows()
+assert len(rows) == 15000, len(rows)
+# TopN and DISTINCT ride the same host route
+r2 = s.query(
+    "select o_orderkey from orders order by o_custkey desc limit 7"
+)
+assert len(r2.rows()) == 7
+r3 = s.query("select distinct o_orderstatus from orders")
+assert 1 <= len(r3.rows()) <= 3
+print("DEADLOCK_REGRESSION_OK", len(rows))
+"""
+
+
+def test_order_by_14k_rows_single_device_cpu():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # strip the test harness's 8-device flag: the bug only exists (and
+    # the fix only proves itself) on the default single-device runtime
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT.format(root=str(REPO_ROOT))],
+        cwd=str(REPO_ROOT),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,  # a reintroduced wedge fails HERE, loudly
+    )
+    assert proc.returncode == 0, (
+        f"single-device host-sort subprocess failed\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr[-3000:]}"
+    )
+    assert "DEADLOCK_REGRESSION_OK 15000" in proc.stdout

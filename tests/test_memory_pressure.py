@@ -229,6 +229,36 @@ def test_hybrid_join_breaker_fallback(monkeypatch):
     assert s.executor.spill_stats["chunk_fallbacks"] >= 1
 
 
+def test_sink_aggregate_fault_frees_state_and_accumulated(monkeypatch):
+    """A kernel fault mid-aggregation must not leak the rotating
+    aggregation-state reservation OR leave pool.accumulated stale
+    (prestolint memory-accounting finding + review follow-up: a stale
+    accumulated makes the revoking scheduler keep selecting a dead query
+    whose revoke can never complete)."""
+    import presto_tpu.exec.stream as stream_mod
+
+    cat = TpchCatalog(sf=SF)
+    real = stream_mod.grouped_aggregate_sorted
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("injected aggregation kernel fault")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(stream_mod, "grouped_aggregate_sorted", flaky)
+    s = _streaming(cat, memory_budget=1 << 20)
+    with pytest.raises(Exception, match="injected aggregation"):
+        s.query(
+            "select l_orderkey, count(*), sum(l_extendedprice)"
+            " from lineitem group by 1"
+        ).rows()
+    assert calls["n"] >= 3  # the fault actually fired mid-stream
+    assert s.executor.pool.accumulated == 0
+    assert s.executor.pool.reserved == 0
+
+
 def test_hybrid_join_setup_fault_degrades(monkeypatch):
     """A fault during hybrid partitioning (before any row is emitted)
     records a breaker failure and falls back to the chunked path."""

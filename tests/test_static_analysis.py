@@ -1,0 +1,926 @@
+"""prestolint (presto_tpu/analysis): seeded true positives and
+false-positive guards for every pass, suppression/baseline round-trips,
+and the tier-1 gate that keeps the REAL tree clean."""
+
+import json
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from presto_tpu.analysis import (
+    load_project,
+    run_check,
+    run_passes,
+)
+from presto_tpu.analysis.core import (
+    evaluate_against_baseline,
+    load_baseline,
+    save_baseline,
+)
+from presto_tpu.analysis.passes import (
+    PASSES_BY_NAME,
+    exceptions as p_exc,
+    exhaustive as p_exh,
+    locks as p_locks,
+    memory as p_mem,
+    tracing as p_trace,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return load_project(tmp_path)
+
+
+def rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# -- tracing-safety ---------------------------------------------------------
+
+
+def test_tracing_flags_unguarded_callback(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/bad.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def kernel(lanes, cap):
+                return jax.pure_callback(_host, None, *lanes)
+        """,
+    })
+    found = run_passes(proj, [p_trace.PASS])
+    assert "tracing-host-callback" in rules(found)
+
+
+def test_tracing_guarded_callback_is_clean(tmp_path):
+    # the ops/sort.py idiom: eager bypass when concrete, callback only
+    # as the under-trace fallback
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/good.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def kernel(lanes, cap):
+                if not isinstance(lanes[0], jax.core.Tracer):
+                    return _host_argsort(*lanes)
+                return jax.pure_callback(_host_argsort, None, *lanes)
+        """,
+    })
+    assert run_passes(proj, [p_trace.PASS]) == []
+
+
+def test_tracing_guard_is_scoped_not_function_wide(tmp_path):
+    # a guard somewhere in the function must not silence an UNRELATED
+    # callback: only callbacks inside a guard-conditional's subtree, or
+    # after a guard whose body early-returns, count as guarded
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/scoped.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def kernel(lanes, extra):
+                # unguarded callback BEFORE the guard: still flagged
+                pre = jax.pure_callback(_host_prep, None, extra)
+                if _concrete(*lanes):
+                    return _host_argsort(*lanes)
+                return jax.pure_callback(_host_argsort, None, *lanes)
+
+            def sibling(lanes, mode):
+                if _concrete(*lanes):
+                    out = _host_argsort(*lanes)
+                # guard body does NOT return: the later callback is on
+                # an unrelated path and must be flagged
+                return jax.pure_callback(_host_argsort, None, *lanes)
+        """,
+    })
+    found = run_passes(proj, [p_trace.PASS])
+    assert rules(found) == ["tracing-host-callback"] * 2
+    assert sorted(f.context for f in found) == ["kernel", "sibling"]
+
+
+def test_tracing_flags_tracer_truthiness(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/bad.py": """
+            import jax.numpy as jnp
+
+            def kernel(x):
+                if jnp.any(x > 0):
+                    return jnp.sum(x)
+                return x
+        """,
+    })
+    assert "tracing-tracer-bool" in rules(run_passes(proj, [p_trace.PASS]))
+
+
+def test_tracing_flags_numpy_consumer_on_device(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/bad.py": """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def kernel(x):
+                y = jnp.abs(x)
+                return np.argsort(y)
+        """,
+    })
+    assert "tracing-numpy-on-device" in rules(
+        run_passes(proj, [p_trace.PASS])
+    )
+
+
+def test_tracing_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        # _host_ prefix, callback targets, np CONSTRUCTORS over host
+        # data, the host-function marker, and code outside ops//expr/
+        # must all stay clean
+        "presto_tpu/ops/good.py": """
+            import jax
+            import numpy as np
+            import jax.numpy as jnp
+
+            def _host_select(k):
+                return np.argsort(k)
+
+            def entry_table(vals):
+                # constructors over host data: the dictionary idiom
+                table = np.zeros(len(vals) + 1, np.int64)
+                return jnp.asarray(table)
+
+            # prestolint: host-function -- eager orchestration; jnp only
+            # touches concrete arrays here
+            def orchestrate(px):
+                cells = np.clip(px, 0, 8)
+                return jnp.asarray(cells)
+
+            def jitted(lanes):
+                return jax.pure_callback(_host_select, None, lanes[0])
+        """,
+        "presto_tpu/exec/mixed.py": """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def eager_compact(keep):
+                # exec/ mixes worlds legally (eager executor code)
+                return np.flatnonzero(np.asarray(keep))
+        """,
+    })
+    found = run_passes(proj, [p_trace.PASS])
+    # the pure_callback in `jitted` targets _host_select which IS a
+    # callback target; but `jitted` itself has no guard -> still flagged
+    assert rules(found) == ["tracing-host-callback"]
+
+
+def test_tracing_nested_defs_have_own_context(tmp_path):
+    # nested defs are analyzed with their OWN host/guard flags: a
+    # _host_ helper nested inside a compound statement stays clean, and
+    # a guard inside a nested helper does NOT un-flag an unguarded
+    # callback in the outer body
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/nested.py": """
+            import jax
+            import numpy as np
+            import jax.numpy as jnp
+
+            def kernel(lanes, mode):
+                if mode:
+                    def _host_pick(k):
+                        # host helper defined inline: its numpy is legal
+                        return np.argsort(k)
+                else:
+                    def _host_pick(k):
+                        return np.lexsort(k)
+                return jnp.take(lanes[0], jnp.asarray(_host_pick(lanes)))
+
+            def outer(lanes):
+                def guarded_helper(x):
+                    if isinstance(x, jax.core.Tracer):
+                        return None
+                    return x
+                # the helper's guard must not mark `outer` guarded
+                return jax.pure_callback(guarded_helper, None, lanes[0])
+        """,
+    })
+    found = run_passes(proj, [p_trace.PASS])
+    assert rules(found) == ["tracing-host-callback"]
+    assert found[0].context == "outer"
+
+
+def test_passes_see_defs_inside_module_level_try(tmp_path):
+    # serde.py defines its zstd helpers inside a module-level try — a
+    # def wrapped in try/if at module or class level must still be
+    # analyzed by every pass
+    proj = make_project(tmp_path, {
+        "presto_tpu/ops/trywrap.py": """
+            import jax
+
+            try:
+                import zstandard
+
+                def compressed_kernel(lanes):
+                    return jax.pure_callback(_host, None, lanes[0])
+            except ImportError:
+                zstandard = None
+        """,
+        "presto_tpu/exec/trymem.py": """
+            try:
+                def reserve_path(pool, n):
+                    held = pool.reserve(n)
+                    return held
+            except RuntimeError:
+                pass
+        """,
+    })
+    found = run_passes(proj, [p_trace.PASS, p_mem.PASS])
+    rs = rules(found)
+    assert "tracing-host-callback" in rs
+    assert "memory-reserve-unpaired" in rs
+
+
+# -- lock-discipline --------------------------------------------------------
+
+
+def test_lock_flags_blocking_and_inversion(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/bad.py": """
+            import queue
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._out = threading.Lock()
+                    self._q = queue.Queue()
+
+                def a(self):
+                    with self._lock:
+                        time.sleep(0.5)
+                        with self._out:
+                            pass
+
+                def b(self):
+                    with self._out:
+                        with self._lock:
+                            pass
+
+                def c(self):
+                    with self._lock:
+                        return self._q.get()
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    rs = rules(found)
+    assert rs.count("lock-blocking-call") == 2  # sleep + queue.get
+    assert "lock-order-inversion" in rs
+
+
+def test_lock_inversion_multi_item_with(tmp_path):
+    # `with a, b:` acquires left-to-right — the a->b edge must be
+    # recorded exactly as in the nested form, or an opposite-order
+    # nested acquisition elsewhere ships a real ABBA deadlock through
+    # the gate
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/multi.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a, self._b:
+                        pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    assert rules(found) == ["lock-order-inversion"]
+
+
+def test_lock_multi_item_with_consistent_order_is_clean(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/multi_ok.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a, self._b:
+                        pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """,
+    })
+    assert run_passes(proj, [p_locks.PASS]) == []
+
+
+def test_lock_cross_class_inversion_via_call_graph(tmp_path):
+    # Buffers.put: _lock -> (call) Pool._cv; Killer (a Pool subclass,
+    # so self._cv IS Pool._cv): _cv -> (call) Buffers._lock. The two
+    # edges only exist through one level of calls + inheritance-resolved
+    # lock identity — exactly the worker-pool/output-buffer shape.
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/pools.py": """
+            import threading
+
+            class Buffers:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.pool = Pool()
+
+                def drop(self):
+                    with self._lock:
+                        pass
+
+                def put(self, data):
+                    with self._lock:
+                        self.pool.reserve(len(data))
+
+            class Pool:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def reserve(self, n):
+                    with self._cv:
+                        return n
+
+            class Killer(Pool):
+                def __init__(self):
+                    super().__init__()
+                    self.buffers = Buffers()
+
+                def kill(self):
+                    with self._cv:
+                        self.buffers.drop()
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    assert "lock-order-inversion" in rules(found)
+
+
+def test_lock_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/good.py": """
+            import queue
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = queue.Queue()
+
+                def waiter(self):
+                    with self._cond:
+                        # waiting on the HELD condition is the cv idiom
+                        self._cond.wait(timeout=0.1)
+
+                def timed_get(self):
+                    with self._cond:
+                        return self._q.get(timeout=1.0)
+
+                def unlocked(self):
+                    time.sleep(0.01)
+                    return self._q.get()
+        """,
+    })
+    assert run_passes(proj, [p_locks.PASS]) == []
+
+
+def test_lock_deferred_callbacks_not_attributed_to_held_set(tmp_path):
+    # a lambda or nested def BUILT under a lock runs later, without it:
+    # neither its blocking calls nor phase-B propagation may attribute
+    # them to the held set
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/deferred.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = threading.Lock()
+                    import queue
+                    self._jobs = queue.Queue()
+
+                def register(self):
+                    with self._lock:
+                        cb = lambda: self._jobs.get()
+                        return cb
+
+                def helper(self):
+                    def drain():
+                        return self._jobs.get()
+                    return drain
+
+                def caller(self):
+                    with self._lock:
+                        return self.helper()
+
+                def control(self):
+                    # same call made DIRECTLY under the lock: still bad
+                    with self._lock:
+                        return self._jobs.get()
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    assert rules(found) == ["lock-blocking-call"]
+    assert found[0].context == "S.control"
+
+
+def test_lock_blocking_inside_closure_is_flagged(tmp_path):
+    # a nested def is deferred — but its OWN body is analyzed with a
+    # fresh held set: a thread-target closure that blocks while holding
+    # a lock is exactly the deadlock class this pass exists for
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/closure.py": """
+            import threading
+            import urllib.request
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def spawn(self):
+                    def probe(u):
+                        with self._lock:
+                            return urllib.request.urlopen(u)
+                    return threading.Thread(target=probe, args=("x",))
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    assert rules(found) == ["lock-blocking-call"]
+    assert found[0].context == "S.spawn.probe"
+
+
+def test_lock_queue_get_block_true_is_flagged(tmp_path):
+    # block=True is the indefinite wait — only a literal block=False
+    # (or a timeout) makes queue.get non-blocking
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/blockkw.py": """
+            import queue
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def bad(self):
+                    with self._lock:
+                        return self._q.get(block=True)
+
+                def ok(self):
+                    with self._lock:
+                        return self._q.get(block=False)
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    assert rules(found) == ["lock-blocking-call"]
+    assert found[0].context == "S.bad"
+
+
+def test_lock_result_needs_future_evidence(tmp_path):
+    # .result() is only blocking on a FUTURE: a builder/parser method
+    # that happens to be named result() must not fail the gate, while
+    # submit()-sourced futures (attr, local, or chained) must
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/futures.py": """
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pool = ThreadPoolExecutor(2)
+                    self._fut = self._pool.submit(print)
+
+                def attr_future(self):
+                    with self._lock:
+                        return self._fut.result()
+
+                def local_future(self):
+                    f = self._pool.submit(print)
+                    with self._lock:
+                        return f.result()
+
+                def chained(self):
+                    with self._lock:
+                        return self._pool.submit(print).result()
+
+                def not_a_future(self, builder):
+                    with self._lock:
+                        return builder.result()
+        """,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    assert rules(found) == ["lock-blocking-call"] * 3
+    assert sorted(f.context for f in found) == [
+        "S.attr_future", "S.chained", "S.local_future",
+    ]
+
+
+def test_lock_duplicate_class_names_resolve_same_file_first(tmp_path):
+    # two files both define class Worker with a .reserve() method; only
+    # one blocks. A caller in the blocking file must propagate into ITS
+    # Worker; a caller in a THIRD file (ambiguous target) must stay
+    # silent rather than pick whichever parsed first
+    blocking = """
+        import threading
+        import queue
+
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def reserve(self):
+                return self._q.get()
+
+        class Caller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.w = Worker()
+
+            def go(self):
+                with self._lock:
+                    return self.w.reserve()
+    """
+    benign = """
+        class Worker:
+            def __init__(self):
+                self.n = 0
+
+            def reserve(self):
+                return self.n
+    """
+    third = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.n = 1
+
+            def reserve(self):
+                return self.n
+
+        class Other:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.w = Worker()
+
+            def go(self):
+                with self._lock:
+                    return self.w.reserve()
+    """
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/a_block.py": blocking,
+        "presto_tpu/server/b_benign.py": benign,
+        "presto_tpu/server/c_third.py": third,
+    })
+    found = run_passes(proj, [p_locks.PASS])
+    # exactly one finding: a_block.Caller.go -> its own Worker.reserve.
+    # c_third.Other.go resolves to the SAME-FILE benign Worker, clean.
+    assert rules(found) == ["lock-blocking-call"]
+    assert found[0].file == "presto_tpu/server/a_block.py"
+    assert found[0].context == "Caller.go"
+
+
+# -- exception-hygiene ------------------------------------------------------
+
+
+def test_exception_swallow_and_silent(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/bad.py": """
+            def swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def silent():
+                try:
+                    return work()
+                except Exception:
+                    return 42
+        """,
+    })
+    rs = rules(run_passes(proj, [p_exc.PASS]))
+    assert rs == ["broad-except-silent", "broad-except-swallow"]
+
+
+def test_exception_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/good.py": """
+            def reraises():
+                try:
+                    work()
+                except Exception as e:
+                    raise RuntimeError("wrapped") from e
+
+            def records(stats):
+                try:
+                    work()
+                except Exception as e:
+                    stats.record_failure(repr(e))
+
+            def narrow():
+                try:
+                    work()
+                except (ValueError, KeyError):
+                    pass
+
+            def reasoned():
+                try:
+                    work()
+                except Exception:  # noqa: BLE001 — probing optional dep
+                    return None
+
+            def allowed():
+                try:
+                    work()
+                # prestolint: allow(broad-except-swallow) -- dropping is
+                # the documented contract here
+                except Exception:
+                    return None
+        """,
+    })
+    assert run_passes(proj, [p_exc.PASS]) == []
+
+
+# -- plan-exhaustiveness ----------------------------------------------------
+
+_EXH_FILES = {
+    "presto_tpu/plan/nodes.py": """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class PlanNode:
+            pass
+
+        class Alpha(PlanNode):
+            pass
+
+        class Beta(PlanNode):
+            pass
+
+        def plan_tree_str(node):
+            if isinstance(node, Alpha):
+                return "alpha"
+            {beta_branch}
+            return ""
+    """,
+    "presto_tpu/plan/fragment.py": """
+        class Fragmenter:
+            def _v_alpha(self, n):
+                return n
+
+            def _v_beta(self, n):
+                return n
+    """,
+    "presto_tpu/exec/executor.py": """
+        class Executor:
+            def _exec_alpha(self, n):
+                return n
+            {exec_beta}
+    """,
+    "presto_tpu/expr/ir.py": """
+        class RowExpression:
+            pass
+
+        class Leaf(RowExpression):
+            pass
+    """,
+    "presto_tpu/expr/compiler.py": """
+        def evaluate(expr, page):
+            if isinstance(expr, Leaf):
+                return page
+            raise TypeError(expr)
+    """,
+}
+
+
+def _exh_project(tmp_path, *, beta_branch, exec_beta):
+    files = {
+        rel: text.replace("{beta_branch}", beta_branch).replace(
+            "{exec_beta}", exec_beta
+        )
+        for rel, text in _EXH_FILES.items()
+    }
+    return make_project(tmp_path, files)
+
+
+def test_exhaustive_flags_missing_dispatch(tmp_path):
+    proj = _exh_project(tmp_path, beta_branch="", exec_beta="")
+    found = run_passes(proj, [p_exh.PASS])
+    msgs = [f.message for f in found]
+    assert rules(found) == ["plan-dispatch-missing"] * 2
+    assert any("_exec_beta" in m for m in msgs)
+    assert any("plan_tree_str never mentions Beta" in m for m in msgs)
+
+
+def test_exhaustive_clean_when_all_handled(tmp_path):
+    proj = _exh_project(
+        tmp_path,
+        beta_branch="if isinstance(node, Beta):\n                return 'beta'",
+        exec_beta="""
+            def _exec_beta(self, n):
+                return n
+        """,
+    )
+    assert run_passes(proj, [p_exh.PASS]) == []
+
+
+def test_exhaustive_real_tree_surfaces_are_complete():
+    """The real executor/fragmenter/EXPLAIN/evaluate surfaces cover every
+    node class — a NEW node class without handlers must fail this."""
+    proj = load_project(REPO_ROOT)
+    assert run_passes(proj, [p_exh.PASS]) == []
+
+
+# -- memory-accounting ------------------------------------------------------
+
+
+def test_memory_unpaired_and_no_finally(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/bad.py": """
+            class A:
+                def leak(self):
+                    self.pool.reserve(100, "x")
+                    return work()
+
+            class B:
+                def racy(self):
+                    nb = 10
+                    self.pool.reserve(nb, "x")
+                    work()
+                    self.pool.free(nb)
+        """,
+    })
+    rs = rules(run_passes(proj, [p_mem.PASS]))
+    assert rs == ["memory-reserve-no-finally", "memory-reserve-unpaired"]
+
+
+def test_memory_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/good.py": """
+            class Guarded:
+                def ok(self):
+                    nb = 10
+                    self.pool.reserve(nb, "x")
+                    try:
+                        return work()
+                    finally:
+                        self.pool.free(nb)
+
+            class Transfer:
+                def build(self):
+                    held = self.pool.reserve(100, "build")
+                    return held  # ownership moves to the consumer
+
+                def consume(self, held):
+                    try:
+                        work()
+                    finally:
+                        self.pool.free(held)
+
+            class NotAPool:
+                def other(self):
+                    self.slots.reserve(3)
+        """,
+    })
+    assert run_passes(proj, [p_mem.PASS]) == []
+
+
+# -- suppression + baseline -------------------------------------------------
+
+
+def test_allow_comment_suppresses(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/server/s.py": """
+            def swallow():
+                try:
+                    work()
+                # prestolint: allow(broad-except-swallow) -- reason here
+                except Exception:
+                    pass
+        """,
+    })
+    assert run_passes(proj, [p_exc.PASS]) == []
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {
+        "presto_tpu/server/old.py": """
+            def old_swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """,
+    }
+    proj = make_project(tmp_path, files)
+    findings = run_passes(proj, [p_exc.PASS])
+    assert len(findings) == 1
+
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(bl_path, findings)
+    baseline = load_baseline(bl_path)
+    assert len(baseline) == 1
+
+    # baselined -> check passes
+    res = evaluate_against_baseline(findings, baseline)
+    assert res.ok and len(res.baselined) == 1 and not res.expired
+
+    # NEW finding in another file -> only IT fails
+    (tmp_path / "presto_tpu/server/new.py").write_text(
+        textwrap.dedent("""
+            def new_swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """)
+    )
+    proj2 = load_project(tmp_path)
+    f2 = run_passes(proj2, [p_exc.PASS])
+    res2 = evaluate_against_baseline(f2, load_baseline(bl_path))
+    assert not res2.ok
+    assert [f.file for f in res2.new] == ["presto_tpu/server/new.py"]
+    assert [f.file for f in res2.baselined] == ["presto_tpu/server/old.py"]
+
+    # fix the OLD file -> its entry expires; update prunes it
+    (tmp_path / "presto_tpu/server/old.py").write_text("def old():\n    pass\n")
+    proj3 = load_project(tmp_path)
+    f3 = run_passes(proj3, [p_exc.PASS])
+    res3 = evaluate_against_baseline(f3, load_baseline(bl_path))
+    assert len(res3.expired) == 1
+    save_baseline(bl_path, f3)
+    assert len(load_baseline(bl_path)) == 1  # only new.py's finding
+
+
+def test_baseline_fingerprints_survive_line_drift(tmp_path):
+    files = {
+        "presto_tpu/server/s.py": """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """,
+    }
+    proj = make_project(tmp_path, files)
+    findings = run_passes(proj, [p_exc.PASS])
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(bl_path, findings)
+
+    # prepend unrelated code: lines shift, fingerprint must not
+    src = (tmp_path / "presto_tpu/server/s.py").read_text()
+    (tmp_path / "presto_tpu/server/s.py").write_text(
+        "import os\n\nCONST = 1\n\n" + src
+    )
+    proj2 = load_project(tmp_path)
+    res = evaluate_against_baseline(
+        run_passes(proj2, [p_exc.PASS]), load_baseline(bl_path)
+    )
+    assert res.ok and not res.expired
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+
+def test_repo_is_clean_and_fast():
+    """THE gate: zero un-baselined findings on the real tree, in well
+    under the 10s budget. A new finding means: fix it, allow() it with a
+    reason, or (for pre-existing classes) re-baseline deliberately."""
+    t0 = time.monotonic()
+    result = run_check(REPO_ROOT)
+    dt = time.monotonic() - t0
+    assert result.ok, "NEW prestolint findings:\n" + "\n".join(
+        f.render() for f in result.new
+    )
+    assert dt < 10.0, f"prestolint took {dt:.1f}s (budget 10s)"
+
+
+def test_all_five_passes_registered():
+    assert set(PASSES_BY_NAME) == {
+        "tracing-safety", "lock-discipline", "exception-hygiene",
+        "plan-exhaustiveness", "memory-accounting",
+    }

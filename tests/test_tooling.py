@@ -217,3 +217,152 @@ def test_cli_split_statements():
     assert split_statements("select 'it''s; fine'") == [
         "select 'it''s; fine'"
     ]
+
+
+# -- prestolint CLI (presto_tpu/analysis/__main__.py) ------------------------
+#
+# The static-analysis suite's tooling contract: --check exits nonzero on
+# any un-baselined finding (how tier-1 and the verify recipe invoke it),
+# --baseline-update regenerates the suppression file. Pass logic itself
+# is covered in tests/test_static_analysis.py.
+
+
+def _lint_main(argv):
+    from presto_tpu.analysis.__main__ import main
+
+    return main(argv)
+
+
+def _bad_tree(tmp_path):
+    pkg = tmp_path / "presto_tpu" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    return tmp_path
+
+
+def test_lint_check_fails_then_baseline_then_passes(tmp_path, capsys):
+    root = _bad_tree(tmp_path)
+    bl = str(tmp_path / "baseline.json")
+
+    # un-baselined finding -> nonzero
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 1
+    out = capsys.readouterr().out
+    assert "broad-except-swallow" in out and "FAILED" in out
+
+    # --baseline-update writes the suppression file -> check passes
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl]) == 0
+    import json
+
+    entries = json.load(open(bl))["findings"]
+    assert len(entries) == 1 and entries[0]["rule"] == "broad-except-swallow"
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 0
+
+    # NEW finding on top of the baseline -> nonzero again
+    (root / "presto_tpu" / "server" / "worse.py").write_text(
+        "def g():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 1
+    out = capsys.readouterr().out
+    assert "worse.py" in out
+
+
+def test_lint_stale_baseline_reports_expired(tmp_path, capsys):
+    root = _bad_tree(tmp_path)
+    bl = str(tmp_path / "baseline.json")
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl]) == 0
+    # fix the finding: entry goes stale but check still passes
+    (root / "presto_tpu" / "server" / "bad.py").write_text("X = 1\n")
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 0
+    out = capsys.readouterr().out
+    assert "stale" in out
+    # prune
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl]) == 0
+    import json
+
+    assert json.load(open(bl))["findings"] == []
+
+
+def test_lint_pass_filter_and_listing(tmp_path, capsys):
+    root = _bad_tree(tmp_path)
+    bl = str(tmp_path / "nope.json")
+    # a different pass doesn't see the exception finding
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl,
+                       "--pass", "memory-accounting"]) == 0
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl,
+                       "--pass", "no-such-pass"]) == 2
+    assert _lint_main(["--list-passes"]) == 0
+    out = capsys.readouterr().out
+    assert "tracing-safety" in out and "lock-discipline" in out
+
+
+def test_lint_baseline_update_scoped_to_pass(tmp_path, capsys):
+    """`--baseline-update --pass X` regenerates only X's rules; other
+    passes' baseline entries are preserved verbatim and their OPEN
+    findings are never silently suppressed."""
+    import json
+
+    root = _bad_tree(tmp_path)  # broad-except-swallow (exception-hygiene)
+    ops = root / "presto_tpu" / "ops"
+    ops.mkdir()
+    (ops / "bad.py").write_text(
+        "import jax\n\n"
+        "def kernel(lanes, cap):\n"
+        "    return jax.pure_callback(_host, None, *lanes)\n"
+    )  # tracing-host-callback (tracing-safety)
+    bl = str(tmp_path / "baseline.json")
+
+    # scoped update must NOT baseline the other pass's open finding
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl, "--pass", "tracing-safety"]) == 0
+    entries = json.load(open(bl))["findings"]
+    assert [e["rule"] for e in entries] == ["tracing-host-callback"]
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 1
+    out = capsys.readouterr().out
+    assert "broad-except-swallow" in out
+
+    # full update baselines both; a later scoped update keeps the other
+    # pass's entry verbatim
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl]) == 0
+    assert len(json.load(open(bl))["findings"]) == 2
+    # a scoped --check must not mislabel the OTHER pass's still-valid
+    # baseline entries as stale
+    capsys.readouterr()
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl,
+                       "--pass", "tracing-safety"]) == 0
+    assert "stale" not in capsys.readouterr().out
+    (ops / "bad.py").write_text("X = 1\n")  # fix the tracing finding
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl, "--pass", "tracing-safety"]) == 0
+    entries = json.load(open(bl))["findings"]
+    assert [e["rule"] for e in entries] == ["broad-except-swallow"]
+    assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 0
+
+
+def test_lint_module_entrypoint_real_tree():
+    """`python -m presto_tpu.analysis --check` — exactly the tier-1 /
+    verify-recipe invocation — exits 0 on the committed tree."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis", "--check"],
+        cwd=str(root), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
