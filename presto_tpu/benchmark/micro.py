@@ -38,9 +38,14 @@ REPS = 3
 class Bench:
     name: str
     rows: int  # input rows processed per run (rows/s denominator)
-    step: Callable  # (acc: int64, *args) -> int64  (jittable)
+    step: Callable  # (acc: int64, *args) -> int64  (jittable unless eager)
     args: tuple
     note: str = ""
+    # eager steps run UNJITTED — the hash-table join / hash group-by
+    # engine defaults route around jit (host scans need concrete
+    # operands; the ops/sort.py host-sort idiom), so their micros must
+    # measure the same eager dispatch the engine uses
+    eager: bool = False
 
 
 # Peak HBM bandwidth by device kind (bytes/s), for utilization accounting —
@@ -140,7 +145,7 @@ def time_device_bench(b: Bench, runs: int = RUNS, reps: int = REPS) -> float:
     import jax
     import jax.numpy as jnp
 
-    f = jax.jit(b.step)
+    f = b.step if b.eager else jax.jit(b.step)
     acc = f(jnp.int64(0), *b.args)
     int(acc)  # compile + warm
     best = float("inf")
@@ -324,42 +329,73 @@ def _orders_keys_page(sf: float):
 
 
 def bench_join_build(sf: float) -> Bench:
-    """Build-side index construction (ref: BenchmarkHashBuildAndJoinOperators
-    build phase / HashBuilderOperator.finish)."""
+    """Build-side index construction through the ENGINE-DEFAULT path
+    (ref: BenchmarkHashBuildAndJoinOperators build phase /
+    HashBuilderOperator.finish). Since PR 11 build() produces the
+    linear-probe hash table (ops/pallas_join.py) on cpu/tpu, eagerly —
+    the micro measures exactly what the executor dispatches; the sorted
+    fallback layout is measured by forcing PRESTO_TPU_PALLAS_JOIN=off."""
     from .. import types as T
     from ..expr.ir import col
     from ..ops.join import build
+    from ..ops.pallas_join import JoinTable, pallas_join_mode
 
     page = _orders_keys_page(sf)
     keys = (col("o_orderkey", T.BIGINT),)
 
     def step(acc, p):
         bs = build(_chained_page(p, acc), keys)
+        if isinstance(bs, JoinTable):
+            return _consume((bs.slot_tag, bs.slot_row, bs.count))
         return _consume((bs.sorted_hash, bs.order, bs.count))
 
-    return Bench("join_build", int(page.count), step, (page,))
+    return Bench(
+        "join_build", int(page.count), step, (page,),
+        note=f"mode={pallas_join_mode()}",
+        eager=pallas_join_mode() != "off",
+    )
 
 
 def bench_join_probe(sf: float) -> Bench:
-    """FK->PK probe: lineitem x orders (ref: join phase of
-    BenchmarkHashBuildAndJoinOperators; rows/s counts PROBE rows)."""
+    """FK->PK probe through the ENGINE-DEFAULT path: lineitem x orders
+    (ref: join phase of BenchmarkHashBuildAndJoinOperators; rows/s counts
+    PROBE rows). The build side is prepared once (the executor's
+    _probe_stream shape); each run probes the full lineitem page."""
     from .. import types as T
     from ..expr.ir import col
     from ..ops.join import build, join_n1
+    from ..ops.pallas_join import pallas_join_mode
     from .handcoded import _table_page
 
     probe = _table_page("lineitem", sf, ("l_orderkey", "l_extendedprice"))
     bs = build(_orders_keys_page(sf), (col("o_orderkey", T.BIGINT),))
     pkeys = (col("l_orderkey", T.BIGINT),)
+    out_names = ("o_custkey", "o_totalprice")
 
-    def step(acc, p, sorted_hash, order, bpage, count):
+    if pallas_join_mode() == "off":
+        # sorted-layout mode runs JITTED: thread the build arrays as
+        # runtime args (a closure would bake them in as trace constants
+        # and let XLA fold build-side work — not comparable to the
+        # BENCH_r05 baseline this measures against)
         import dataclasses as dc
 
-        b = dc.replace(bs, sorted_hash=sorted_hash, order=order,
-                       page=bpage, count=count)
+        def step(acc, p, sorted_hash, order, bpage, count):
+            b = dc.replace(bs, sorted_hash=sorted_hash, order=order,
+                           page=bpage, count=count)
+            return _consume(
+                join_n1(_chained_page(p, acc), b, pkeys, out_names,
+                        out_names)
+            )
+
+        return Bench(
+            "join_probe_n1", int(probe.count), step,
+            (probe, bs.sorted_hash, bs.order, bs.page, bs.count),
+            note="mode=off",
+        )
+
+    def step(acc, p):
         out = join_n1(
-            _chained_page(p, acc), b, pkeys,
-            ("o_custkey", "o_totalprice"), ("o_custkey", "o_totalprice"),
+            _chained_page(p, acc), bs, pkeys, out_names, out_names
         )
         return _consume(out)
 
@@ -367,7 +403,97 @@ def bench_join_probe(sf: float) -> Bench:
         "join_probe_n1",
         int(probe.count),
         step,
-        (probe, bs.sorted_hash, bs.order, bs.page, bs.count),
+        (probe,),
+        note=f"mode={pallas_join_mode()}",
+        eager=True,
+    )
+
+
+def bench_pallas_join_build(sf: float) -> Bench:
+    """The hash-table build kernel in isolation (ops/pallas_join.py
+    build_table: parallel linear-probing insert + overflow handling) —
+    gated so the kernel path stays fast even if engine defaults move."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.pallas_join import build_table
+
+    page = _orders_keys_page(sf)
+    keys = (col("o_orderkey", T.BIGINT),)
+
+    def step(acc, p):
+        jt = build_table(_chained_page(p, acc), keys)
+        if jt is None:
+            raise RuntimeError("hash-table build unexpectedly ineligible")
+        return _consume((jt.slot_tag, jt.slot_row))
+
+    return Bench("pallas_join_build", int(page.count), step, (page,),
+                 eager=True)
+
+
+def bench_pallas_join_probe(sf: float) -> Bench:
+    """The hash-table probe kernel in isolation (first-verified-match
+    scan + emit, ops/pallas_join.table_join_n1)."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.pallas_join import build_table, table_join_n1
+    from .handcoded import _table_page
+
+    probe = _table_page("lineitem", sf, ("l_orderkey", "l_extendedprice"))
+    jt = build_table(_orders_keys_page(sf), (col("o_orderkey", T.BIGINT),))
+    if jt is None:
+        raise RuntimeError("hash-table build unexpectedly ineligible")
+    pkeys = (col("l_orderkey", T.BIGINT),)
+
+    def step(acc, p):
+        out = table_join_n1(
+            _chained_page(p, acc), jt, pkeys,
+            ("o_custkey", "o_totalprice"), ("o_custkey", "o_totalprice"),
+        )
+        return _consume(out)
+
+    return Bench(
+        "pallas_join_probe", int(probe.count), step, (probe,),
+        note=f"occ={int(jt.occupancy() * 100)}%", eager=True,
+    )
+
+
+def bench_pallas_groupby_hash(sf: float) -> Bench:
+    """Hash-slot grouped aggregation (ops/pallas_groupby.
+    maybe_grouped_aggregate_hash): the arbitrary-key / lifted-ceiling
+    group-by — same l_suppkey shape as agg_sorted_suppkey so the
+    strategy A/B is one artifact diff."""
+    from .. import types as T
+    from ..expr.ir import col
+    from ..ops.aggregate import AggSpec
+    from ..ops.pallas_groupby import maybe_grouped_aggregate_hash
+    from .handcoded import DEC12_2, _table_page
+
+    page = _table_page(
+        "lineitem", sf, ("l_suppkey", "l_quantity", "l_extendedprice")
+    )
+    qty = col("l_quantity", DEC12_2)
+    aggs = (
+        AggSpec("sum", qty, "s", AggSpec.infer_output_type("sum", DEC12_2)),
+        AggSpec("count_star", None, "c", T.BIGINT),
+    )
+    gexprs = (col("l_suppkey", T.BIGINT),)
+
+    def step(acc, p):
+        out = maybe_grouped_aggregate_hash(
+            _chained_page(p, acc), gexprs, ("l_suppkey",), aggs, None
+        )
+        if out is None:
+            raise RuntimeError("hash group-by unexpectedly ineligible")
+        return _consume(out)
+
+    probe = maybe_grouped_aggregate_hash(
+        page, gexprs, ("l_suppkey",), aggs, None
+    )
+    if probe is None:
+        raise RuntimeError("hash group-by unexpectedly ineligible")
+    return Bench(
+        "pallas_groupby_hash", int(page.count), step, (page,),
+        note=f"groups={int(probe.count)}", eager=True,
     )
 
 
@@ -826,6 +952,9 @@ DEVICE_BENCHES = {
     "agg_matmul_suppkey": bench_agg_matmul,
     "join_build": bench_join_build,
     "join_probe_n1": bench_join_probe,
+    "pallas_join_build": bench_pallas_join_build,
+    "pallas_join_probe": bench_pallas_join_probe,
+    "pallas_groupby_hash": bench_pallas_groupby_hash,
     "join_probe_filtered": bench_join_probe_filtered,
     "bloom_build_query": bench_bloom_build_query,
     "semi_join_mark": bench_semi_join,

@@ -610,7 +610,7 @@ replicated subtrees delegate to the single-node Executor."""
                 )
                 out, overflow = join_expand(
                     lx,
-                    build(rx, node.right_keys, host_probe=False),
+                    build(rx, node.right_keys),
                     node.left_keys,
                     lx.names,
                     [(nm, nm) for nm in right_names],
@@ -687,7 +687,7 @@ replicated subtrees delegate to the single-node Executor."""
         def make_n1(l: Page, r: Page) -> Page:
             return join_n1(
                 l,
-                build(r, node.right_keys, host_probe=False),
+                build(r, node.right_keys),
                 node.left_keys,
                 right_names,
                 right_names,
@@ -714,7 +714,7 @@ replicated subtrees delegate to the single-node Executor."""
             def make_expand(l: Page, r: Page):
                 return join_expand(
                     l,
-                    build(r, node.right_keys, host_probe=False),
+                    build(r, node.right_keys),
                     node.left_keys,
                     l.names,
                     [(nm, nm) for nm in right_names],
@@ -763,7 +763,7 @@ replicated subtrees delegate to the single-node Executor."""
         if node.residual is None:
 
             def local(p: Page, s: Page) -> Page:
-                bs = build(s, node.source_keys, host_probe=False)
+                bs = build(s, node.source_keys)
                 return join_n1(
                     p,
                     bs,
@@ -792,7 +792,7 @@ replicated subtrees delegate to the single-node Executor."""
 
             def local(p: Page, s: Page):
                 p2 = self.local._with_row_id(p, rid)
-                bs = build(s, node.source_keys, host_probe=False)
+                bs = build(s, node.source_keys)
                 probe_out = [rid] + [nm for nm in p.names if nm in needed]
                 build_out = [(nm, nm) for nm in s.names if nm in needed]
                 expanded, overflow = join_expand(
@@ -805,7 +805,7 @@ replicated subtrees delegate to the single-node Executor."""
                     kind="inner",
                 )
                 matched = filter_page(expanded, node.residual)
-                bs2 = build(matched, (ir.ColumnRef(rid, rid_t),), host_probe=False)
+                bs2 = build(matched, (ir.ColumnRef(rid, rid_t),))
                 out = join_n1(
                     p2,
                     bs2,

@@ -195,7 +195,108 @@ class Executor:
             return [probe, build]
         return [self._run(c) for c in node.children]
 
+    def _star_spec(self, node: N.PlanNode):
+        """The inner Join of a fusable star shape: two stacked inner n1
+        joins whose probe keys BOTH live on the shared fact side, no
+        residuals — the multiway-probe shape of arXiv:1905.13376 (one
+        pass over the fact resolves both dimensions; see
+        ops/pallas_join.table_multiway_n1)."""
+        from ..expr import ir as _ir
+        from ..ops.pallas_join import pallas_join_mode
+
+        if not isinstance(node, N.Join) or node.kind != "inner":
+            return None
+        if not node.unique_build or node.residual is not None:
+            return None
+        inner = node.left
+        if not isinstance(inner, N.Join) or inner.kind != "inner":
+            return None
+        if not inner.unique_build or inner.residual is not None:
+            return None
+        fact_names = {n for n, _ in inner.left.fields}
+        for k in node.left_keys:
+            if not isinstance(k, _ir.ColumnRef) or k.name not in fact_names:
+                return None
+        if pallas_join_mode() == "off":
+            return None
+        from .breaker import BREAKERS
+
+        if not (
+            BREAKERS.allow("pallas_join_build")
+            and BREAKERS.allow("pallas_join_probe")
+        ):
+            return None
+        return inner
+
+    def _run_star_join(self, node: N.Join, inner: N.Join) -> Page:
+        """Fused multiway execution of a star pair; an ineligible side
+        degrades to plain nested execution on the pages already run
+        (materialized plan results — nothing is consume-once; the
+        fact's preprobe re-application inside _exec_join is an
+        idempotent re-filter)."""
+        from ..ops.pallas_join import table_multiway_n1
+
+        dim1 = self._run(inner.right)
+        if getattr(inner, "dynamic_filters", ()):
+            self._publish_dynamic_filters(inner, dim1)
+        dim2 = self._run(node.right)
+        if getattr(node, "dynamic_filters", ()):
+            self._publish_dynamic_filters(node, dim2)
+        fact = self._run(inner.left)
+        if getattr(inner, "dynamic_filters", ()):
+            fact = self._apply_preprobe(inner, fact)
+        if getattr(node, "dynamic_filters", ()):
+            fact = self._apply_preprobe(node, fact)
+        bs1 = self._build_table_guarded(dim1, inner.right_keys)
+        bs2 = self._build_table_guarded(dim2, node.right_keys)
+        if bs1 is None or bs2 is None:
+            mid = self._exec_join(inner, fact, dim1)
+            return self._exec_join(node, mid, dim2)
+        names1 = tuple(n for n, _ in inner.right.fields)
+        names2 = tuple(n for n, _ in node.right.fields)
+        try:
+            out = table_multiway_n1(
+                fact,
+                (
+                    (bs1, tuple(inner.left_keys), names1, names1),
+                    (bs2, tuple(node.left_keys), names2, names2),
+                ),
+            )
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            from .breaker import BREAKERS
+
+            BREAKERS.record_failure("pallas_join_probe", repr(exc))
+            mid = self._exec_join(inner, fact, dim1)
+            return self._exec_join(node, mid, dim2)
+        from .breaker import BREAKERS
+
+        BREAKERS.record_success("pallas_join_probe")
+        self._strategy_note(inner, "multiway-fused")
+        self._strategy_note(
+            node,
+            f"multiway occ={int(bs1.occupancy() * 100)}%"
+            f"/{int(bs2.occupancy() * 100)}%",
+        )
+        return self._shrink(out, node)
+
     def _run(self, node: N.PlanNode) -> Page:
+        inner = self._star_spec(node)
+        if inner is not None:
+            if self.collector is None:
+                return self._run_star_join(node, inner)
+            import time
+
+            from .stats import page_device_bytes
+
+            t0 = time.perf_counter()
+            out = self._run_star_join(node, inner)
+            # fused execution: the outer node carries the pair's stats
+            # (child scans/builds record their own rows via self._run)
+            self.collector.record(
+                node, time.perf_counter() - t0, [], out.count,
+                page_device_bytes(out),
+            )
+            return out
         pages = self._run_children(node)
         if self.collector is None:
             return self.exec_node(node, *pages)
@@ -807,6 +908,9 @@ class Executor:
             if out is not None:
                 self._strategy_note(node, "pallas")
                 return self._shrink(out, node)
+        out = self._try_hash_groupby(node, page)
+        if out is not None:
+            return out
         if self.matmul_groupby is None:
             import jax
 
@@ -873,6 +977,31 @@ class Executor:
             break
         return self._shrink(out, node)
 
+    def _try_hash_groupby(self, node: N.Aggregate, page: Page) -> Optional[Page]:
+        """Hash-slot grouped aggregation attempt (the PR 11 ceiling lift
+        over the dense pallas path: arbitrary-valued keys, G to 512 on
+        the kernel / 64k on the host twin) behind the pallas_groupby_hash
+        breaker. None = ineligible or faulted; the caller falls through
+        to the matmul / sort strategies unchanged."""
+        from ..ops.pallas_groupby import maybe_grouped_aggregate_hash
+        from .breaker import BREAKERS
+
+        if not BREAKERS.allow("pallas_groupby_hash"):
+            return None
+        try:
+            out = maybe_grouped_aggregate_hash(
+                page, node.group_exprs, node.group_names, node.aggs,
+                node.mask,
+            )
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure("pallas_groupby_hash", repr(exc))
+            return None
+        if out is None:
+            return None
+        BREAKERS.record_success("pallas_groupby_hash")
+        self._strategy_note(node, "hash-slot")
+        return self._shrink(out, node)
+
     def _exec_distinct(self, node: N.Distinct, page: Page) -> Page:
         from ..expr.ir import ColumnRef
 
@@ -924,6 +1053,80 @@ class Executor:
         return self._shrink(fn(page), node)
 
     # -- joins --
+    def _build_table_guarded(self, page: Page, key_exprs):
+        """build_table with build()'s breaker bookkeeping but WITHOUT
+        build()'s sorted fallback — an ineligible table here must cost
+        nothing (the jitted sorted path will build inside its own
+        kernel; an eager sorted build would be discarded)."""
+        from ..ops.pallas_join import build_table
+        from .breaker import BREAKERS
+
+        try:
+            jt = build_table(page, key_exprs)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure("pallas_join_build", repr(exc))
+            return None
+        if jt is not None:
+            BREAKERS.record_success("pallas_join_build")
+        return jt
+
+    def _try_table_join(self, node: N.Join, left: Page, right: Page,
+                        right_names) -> Optional[Page]:
+        """EAGER hash-table join attempt (ops/pallas_join.py) — routed
+        AROUND jit like host-sort plans (the PR 9 idiom): the table path
+        needs concrete operands, and jitting its host scans would mean
+        pure_callback on the single-device CPU runtime. None = take the
+        jitted sorted-hash kernel path below. build()/join_n1()/
+        join_expand() own the breaker bookkeeping and the degrade to the
+        sorted layout on kernel faults."""
+        from ..ops.pallas_join import TABLE_MAX_BUILD, pallas_join_mode
+
+        if pallas_join_mode() == "off" or not node.right_keys:
+            return None
+        if right.capacity > TABLE_MAX_BUILD:
+            return None
+        from .breaker import BREAKERS
+
+        if not (
+            BREAKERS.allow("pallas_join_build")
+            and BREAKERS.allow("pallas_join_probe")
+        ):
+            return None
+        bs = self._build_table_guarded(right, node.right_keys)
+        if bs is None:
+            return None
+        self._strategy_note(
+            node,
+            f"hash-table({pallas_join_mode()}) "
+            f"occ={int(bs.occupancy() * 100)}%"
+            + (f" of={len(bs.of_tag)}" if len(bs.of_tag) else ""),
+        )
+        if node.unique_build:
+            out = join_n1(
+                left, bs, node.left_keys, right_names, right_names,
+                kind=node.kind,
+            )
+        else:
+            est = self._est_rows(node)
+            cap = round_capacity(
+                max(left.capacity, int(est) if est is not None else 1, 1)
+            )
+            while True:
+                out, overflow = join_expand(
+                    left, bs, node.left_keys, left.names,
+                    [(n, n) for n in right_names], out_capacity=cap,
+                    kind=node.kind,
+                )
+                if int(overflow) == 0:
+                    break
+                cap = round_capacity(cap + int(overflow))
+                self._retries += 1
+        if node.residual is not None:
+            if node.kind != "inner":
+                raise ExecutionError("residual on outer join not yet supported")
+            out = filter_page(out, node.residual)
+        return self._shrink(out, node)
+
     def _exec_join(self, node: N.Join, left: Page, right: Page) -> Page:
         if node.kind == "full" or (
             node.kind != "inner" and node.residual is not None
@@ -932,6 +1135,9 @@ class Executor:
         if node.dynamic_filters:
             left = self._apply_preprobe(node, left)
         right_names = right.names
+        table_out = self._try_table_join(node, left, right, right_names)
+        if table_out is not None:
+            return table_out
         if node.unique_build:
             out = self._kernel_guarded(
                 "join_probe",

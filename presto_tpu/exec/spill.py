@@ -498,17 +498,25 @@ def hash_partition_indices(
     num_parts: int,
     chunk_rows: int,
     salt: int = 0,
+    value_safe: bool = False,
 ) -> List[np.ndarray]:
     """Partition spilled row indices by device-computed key hash (the
     GenericPartitioningSpiller layout): rows with equal keys land in the
     same partition, so per-partition processing is complete. `salt`
-    shifts the hash so recursive re-partitioning uses fresh bits."""
+    shifts the hash so recursive re-partitioning uses fresh bits.
+
+    `value_safe=True` hashes varchar keys by dictionary VALUE
+    (ops/hashing.hash_rows_values) so the two sides of a join partition
+    identically even when their dictionaries differ — required whenever
+    build and probe partitions must co-locate equal keys. Single-table
+    partitioning (window buckets, aggregate finalize) can keep the
+    cheaper code hash."""
     from ..expr.compiler import evaluate
-    from ..ops.hashing import hash_rows
+    from ..ops.hashing import hash_rows, hash_rows_values
 
     def eval_hash(page: Page) -> jnp.ndarray:
         keys = [evaluate(e, page) for e in key_exprs]
-        h = hash_rows(keys)
+        h = hash_rows_values(keys) if value_safe else hash_rows(keys)
         return (h >> np.uint64(salt)).astype(jnp.uint64)
 
     h = spilled.column_eval(eval_hash, chunk_rows).astype(np.uint64)

@@ -312,6 +312,14 @@ class StreamingExecutor:
             "hybrid_parts": 0,
             "hybrid_depth": 0,
             "chunk_fallbacks": 0,
+            # ragged paged partition layout (ops/ragged.py): pages
+            # allocated and live-slot occupancy percent for the last
+            # hybrid join's build partitions
+            "ragged_pages": 0,
+            "ragged_occupancy_pct": 0,
+            # batches the aggregate sink routed through the hash-slot
+            # group-by instead of the sort composition
+            "agg_hash_batches": 0,
         }
         self._spill_space = spill_space
         self._owns_spill = spill_space is None
@@ -822,7 +830,7 @@ class StreamingExecutor:
         from .breaker import BREAKERS
 
         if BREAKERS.allow("hybrid_join") and not self._hybrid_unsafe_keys(
-            node
+            node, spilled
         ):
             try:
                 # partitioning + resident-build SETUP runs before the
@@ -861,15 +869,38 @@ class StreamingExecutor:
         self.spill_stats["chunk_fallbacks"] += 1
         yield from self._chunked_host_join(node, spilled, right_names)
 
-    def _hybrid_unsafe_keys(self, node: N.Join) -> bool:
+    def _hybrid_unsafe_keys(self, node: N.Join, spilled) -> bool:
         """Hash partitioning requires build/probe key hashes to agree for
-        equal VALUES; dictionary-encoded varchar columns hash their codes
-        (dictionaries can differ across sides), so those joins keep the
-        chunked path."""
-        for k in tuple(node.left_keys) + tuple(node.right_keys):
-            if isinstance(getattr(k, "type", None), T.VarcharType):
-                return True
-        return False
+        equal VALUES. Varchar keys used to be routed to the chunked path
+        categorically (dictionary codes hash per-table); PR 11 rehashes
+        them by dictionary VALUE (ops/hashing.hash_rows_values), so
+        varchar equi-joins take the partitioned/kernel path whenever the
+        build-side dictionaries admit the one-time value-hash pass. Only
+        a dictionary beyond PRESTO_TPU_VALUE_HASH_MAX_DICT (or one we
+        cannot inspect) still forces the chunked path.
+
+        Scope: only BUILD-side dictionaries are inspectable before the
+        probe stream starts. A probe batch arriving later with an
+        over-cap dictionary still hashes CORRECTLY (hash_rows_values
+        computes whatever value table it needs, cached per dict_id) —
+        the cap bounds predictable cost, it is not a correctness gate."""
+        if not any(
+            isinstance(getattr(k, "type", None), T.VarcharType)
+            for k in tuple(node.left_keys) + tuple(node.right_keys)
+        ):
+            return False
+        from ..expr.compiler import evaluate
+        from ..ops.hashing import value_hashable
+
+        try:
+            sample = spilled.take_page(
+                np.arange(min(spilled.num_rows, 1))
+            )
+            keys = [evaluate(e, sample) for e in node.right_keys]
+        except Exception as exc:  # noqa: BLE001 — uninspectable: chunked
+            self.spill_events.append(f"hybrid_varchar_probe_failed:{exc!r}")
+            return True
+        return not value_hashable(keys)
 
     def _chunked_host_join(self, node: N.Join, spilled, right_names):
         """Legacy offloaded-build execution (the hybrid join's circuit-
@@ -916,7 +947,8 @@ class StreamingExecutor:
         P = self._hybrid_partition_count(total_bytes, share)
         chunk_rows = max(share // (2 * row_b), 1 << 10)
         parts = hash_partition_indices(
-            spilled, node.right_keys, P, chunk_rows, salt=0
+            spilled, node.right_keys, P, chunk_rows, salt=0,
+            value_safe=True,
         )
         # resident set: smallest partitions first, up to half the share
         # (the other half belongs to probe batches / output pages)
@@ -932,6 +964,29 @@ class StreamingExecutor:
             p for p in range(P)
             if p not in resident_set and len(parts[p])
         ]
+        # ragged paged layout over the DEFERRED partitions (ops/ragged.py
+        # — the ones handed to kernels later): skewed partitions allocate
+        # unequal page counts instead of padding to the max, and the
+        # occupancy lands in EXPLAIN ANALYZE's memory line. The layout
+        # TAKES OVER the deferred row-id arrays (their `parts` entries
+        # are dropped) so the memory-pressure path holds one copy, not
+        # two; resident partitions never need pages.
+        from ..ops import ragged as _ragged
+
+        deferred_set = frozenset(deferred)
+        rp = _ragged.from_partitions(
+            [
+                parts[p] if p in deferred_set else np.empty(0, np.int64)
+                for p in range(P)
+            ]
+        )
+        for p in deferred:
+            parts[p] = None  # owned by the ragged layout now
+        self.spill_stats["ragged_pages"] += rp.num_pages
+        if rp.num_pages:
+            self.spill_stats["ragged_occupancy_pct"] = int(
+                rp.occupancy() * 100
+            )
         bs_mem = None
         mem_held = 0
         if resident:
@@ -950,6 +1005,7 @@ class StreamingExecutor:
             "P": P,
             "chunk_rows": chunk_rows,
             "parts": parts,
+            "ragged": rp,
             "deferred": deferred,
             "bs_mem": bs_mem,
             "mem_held": mem_held,
@@ -972,7 +1028,7 @@ class StreamingExecutor:
         partition degrades to the chunked build loop."""
         from ..expr.compiler import evaluate
         from ..ops.filter import compact
-        from ..ops.hashing import hash_rows
+        from ..ops.hashing import hash_rows_values
         from .spill import SpilledRows, hash_partition_indices, to_host_page
 
         P = setup["P"]
@@ -1005,7 +1061,9 @@ class StreamingExecutor:
                 if first_probe is None:
                     first_probe = batch
                 keys = [evaluate(e, batch) for e in node.left_keys]
-                h = hash_rows(keys)
+                # value-safe: must agree with the build-side partitioning
+                # for equal VALUES (varchar dictionaries differ per side)
+                h = hash_rows_values(keys)
                 part = (h % jnp.uint64(P)).astype(jnp.int32)
                 live = batch.live_mask()
                 if bs_mem is not None:
@@ -1026,13 +1084,15 @@ class StreamingExecutor:
         bs_mem = None
         if probe_spill is not None and probe_spill.num_rows:
             pparts = hash_partition_indices(
-                probe_spill, node.left_keys, P, chunk_rows, salt=0
+                probe_spill, node.left_keys, P, chunk_rows, salt=0,
+                value_safe=True,
             )
+            ragged = setup["ragged"]
             for p in deferred:
                 if not len(pparts[p]):
                     continue
                 for out in self._join_partition(
-                    node, spilled.subset(parts[p]),
+                    node, spilled.subset(ragged.part_rows(p)),
                     probe_spill.subset(pparts[p]), right_names, 0,
                     chunk_rows, max_depth,
                 ):
@@ -1080,7 +1140,8 @@ class StreamingExecutor:
             P2 = self._hybrid_partition_count(bbytes, share, cap=16)
             salt = 7 * (depth + 1)  # fresh hash bits each level
             bparts = hash_partition_indices(
-                build_sub, node.right_keys, P2, chunk_rows, salt=salt
+                build_sub, node.right_keys, P2, chunk_rows, salt=salt,
+                value_safe=True,
             )
             if max(len(i) for i in bparts) < build_sub.num_rows:
                 # made progress: recurse on each co-partition pair
@@ -1088,7 +1149,8 @@ class StreamingExecutor:
                     self.spill_stats["hybrid_depth"], depth + 1
                 )
                 pparts = hash_partition_indices(
-                    probe_sub, node.left_keys, P2, chunk_rows, salt=salt
+                    probe_sub, node.left_keys, P2, chunk_rows, salt=salt,
+                    value_safe=True,
                 )
                 for p in range(P2):
                     if len(bparts[p]) and len(pparts[p]):
@@ -1281,6 +1343,31 @@ class StreamingExecutor:
 
     # -- sinks ----------------------------------------------------------------
 
+    def _hash_agg_attempt(
+        self, page: Page, group_exprs, group_names, aggs, mask
+    ) -> Optional[Page]:
+        """Hash-slot grouped aggregation attempt for the streaming sink's
+        partial/merge passes (ops/pallas_groupby.maybe_grouped_aggregate_hash
+        behind the pallas_groupby_hash breaker); None falls back to the
+        sort composition. Output schema matches grouped_aggregate_sorted,
+        so partial pages from both strategies merge freely."""
+        from ..ops.pallas_groupby import maybe_grouped_aggregate_hash
+        from .breaker import BREAKERS
+
+        if not BREAKERS.allow("pallas_groupby_hash"):
+            return None
+        try:
+            out = maybe_grouped_aggregate_hash(
+                page, group_exprs, group_names, aggs, mask
+            )
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure("pallas_groupby_hash", repr(exc))
+            return None
+        if out is not None:
+            BREAKERS.record_success("pallas_groupby_hash")
+            self.spill_stats["agg_hash_batches"] += 1
+        return out
+
     def _agg_input_stream(self, node: N.Aggregate) -> Iterator[Page]:
         """Child batches for a (possibly filter-fused) aggregation; a fused
         mask over a direct table scan still pushes pruning hints down."""
@@ -1318,6 +1405,11 @@ class StreamingExecutor:
 
         def merge(parts: List[Page], bound: int) -> Page:
             acc = parts[0] if len(parts) == 1 else concat_pages(parts)
+            out = self._hash_agg_attempt(
+                acc, group_refs, node.group_names, final, None
+            )
+            if out is not None:
+                return self.local._shrink(out)
             mg = round_capacity(min(max(bound, 1), 1 << 22))
             while True:
                 out = grouped_aggregate_sorted(
@@ -1352,15 +1444,22 @@ class StreamingExecutor:
         # no-op for them.
         try:
             for batch in self._agg_input_stream(node):
-                mg = round_capacity(min(max(int(batch.count), 1), 1 << 16))
-                while True:
-                    part = grouped_aggregate_sorted(
-                        batch, node.group_exprs, node.group_names, partial,
-                        mg, node.mask,
+                part = self._hash_agg_attempt(
+                    batch, node.group_exprs, node.group_names, partial,
+                    node.mask,
+                )
+                if part is None:
+                    mg = round_capacity(
+                        min(max(int(batch.count), 1), 1 << 16)
                     )
-                    if int(part.count) <= mg:
-                        break
-                    mg = round_capacity(int(part.count))
+                    while True:
+                        part = grouped_aggregate_sorted(
+                            batch, node.group_exprs, node.group_names,
+                            partial, mg, node.mask,
+                        )
+                        if int(part.count) <= mg:
+                            break
+                        mg = round_capacity(int(part.count))
                 part = self.local._shrink(part)
                 if spilled is not None:
                     spill_all([part])

@@ -21,6 +21,16 @@ Composite keys hash-combine then verify each part.
 
 Supported: inner, left (probe-outer), semi, anti — the shapes TPC-H needs.
 Right/full outer come with the planner's join-side swap in a later round.
+
+PR 11: the sorted-hash layout above is now the FALLBACK. `build()` first
+tries the linear-probe hash-table layout in ops/pallas_join.py (Pallas
+kernels on TPU, the numpy twin on the CPU engine default) behind the
+pallas_join_build / pallas_join_probe circuit breakers; join_n1 /
+join_expand / semi_match_mask dispatch on which layout `build()`
+produced, and a probe-side kernel fault degrades back to this file's
+composition (rebuilding the sorted layout from the table's retained
+build page). Traced callers (jitted executors, the shard_map mesh path)
+always get the sorted layout — the table path is eager by design.
 """
 
 from __future__ import annotations
@@ -37,7 +47,20 @@ from .. import types as T
 from ..expr.compiler import evaluate
 from ..expr.functions import Val, and_valid
 from ..page import Block, Page
-from .hashing import hash_rows
+from .hashing import hash_rows, hash_rows_values, value_hashable
+
+
+def _want_value_hash(keys, count) -> bool:
+    """Eager build with varchar keys whose dictionaries admit the
+    one-time value pass -> hash by VALUE so cross-dictionary equi-joins
+    meet (see BuildSide.value_hashed)."""
+    if not any(getattr(k, "dict_id", None) is not None for k in keys):
+        return False
+    concrete = not any(
+        isinstance(a, jax.core.Tracer)
+        for a in [count] + [k.data for k in keys]
+    )
+    return concrete and value_hashable(keys)
 
 # numpy scalar (not a device array) so importing this module does no device work
 MAX_HASH = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -57,27 +80,25 @@ class BuildSide:
     # `bucket_bits` of the hash) span [bucket_start[b], bucket_start[b+1])
     bucket_start: Optional[jnp.ndarray] = None  # int32, (2^bits + 1,)
     bucket_bits: int = 0  # static per build shape
-    # CPU backend: candidate ranges via numpy searchsorted through
-    # jax.pure_callback (see _host_probe_ranges) instead of device gathers
-    host_probe: bool = False
+    # True when varchar keys were hashed by dictionary VALUE
+    # (hash_rows_values): probes MUST hash the same way or equal strings
+    # with different codes never meet (the pre-PR-11 cross-dictionary
+    # varchar equi-join wrong-result, now fixed for eager builds). Traced
+    # builds keep code hashing — both sides of a traced join share one
+    # trace, so they stay consistent (and reach only same-dictionary
+    # data in practice: the mesh shards one table's pages).
+    value_hashed: bool = False
 
 
-def _default_host_probe() -> bool:
-    """Whether to route the sorted-build binary-search probe through numpy
-    via jax.pure_callback (mirroring the keypack CPU sort routing,
-    ops/keypack.py). Resolved at PLAN (trace) time from the env.
-
-    Default OFF everywhere, by measurement: at the join_probe_n1 shape
-    (600k probes x 256k-cap build, CPU backend) numpy's searchsorted runs
-    ~300ms — binary search over random uint64 is cache-miss-bound and
-    single-threaded — while the bucket-directory probe (two vectorized
-    gathers) runs the same probe in ~77ms (~7.8M rows/s). The callback
-    marshalling itself is cheap (~7ms); numpy just loses this race, unlike
-    the keypack sorts where numpy beats XLA's comparison sort 8-70x. The
-    route stays available (PRESTO_TPU_JOIN_PROBE_HOST=1) as a diagnosis
-    escape hatch for backends where gather-heavy probes misbehave, behind
-    the join_probe_cpu breaker."""
-    return os.environ.get("PRESTO_TPU_JOIN_PROBE_HOST", "0") == "1"
+# The PRESTO_TPU_JOIN_PROBE_HOST pure_callback searchsorted route that
+# lived here (PR 3's `_default_host_probe`, measured 4x slower than the
+# bucket-directory probe and default-off ever since) is DELETED, not just
+# still off: PR 11 re-measured it against the hash-table kernels and the
+# numpy linear-probe scan in ops/pallas_join.py beats it ~7x at the
+# join_probe_n1 shape (22ms vs ~150ms for 600k probes) while also beating
+# the directory probe — so the CPU host route is now the ENGINE DEFAULT
+# via build_table(), and the searchsorted callback (plus its
+# join_probe_cpu breaker) has no remaining niche.
 
 
 def _pick_bucket_bits(capacity: int) -> int:
@@ -87,7 +108,32 @@ def _pick_bucket_bits(capacity: int) -> int:
     return min(bits, 22)  # cap the directory at 4M entries
 
 
-def build(page: Page, key_exprs, host_probe: Optional[bool] = None) -> BuildSide:
+def build(page: Page, key_exprs):
+    """Prepare a build side for probing. First choice: the linear-probe
+    hash-table layout (ops/pallas_join.py — Pallas kernels on TPU, the
+    numpy twin as the CPU engine default), behind the pallas_join_build /
+    pallas_join_probe breakers. Fallback — and the only path for traced
+    operands or cross joins — is the sorted-hash layout of build_sorted."""
+    if key_exprs:
+        from ..exec.breaker import BREAKERS
+
+        if BREAKERS.allow("pallas_join_build") and BREAKERS.allow(
+            "pallas_join_probe"
+        ):
+            from .pallas_join import build_table
+
+            try:
+                jt = build_table(page, key_exprs)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                BREAKERS.record_failure("pallas_join_build", repr(exc))
+            else:
+                if jt is not None:
+                    BREAKERS.record_success("pallas_join_build")
+                    return jt
+    return build_sorted(page, key_exprs)
+
+
+def build_sorted(page: Page, key_exprs) -> BuildSide:
     """Sort the build side by key hash (HashBuilderOperator.finish analog).
     Empty key_exprs = all rows in one bucket (cross join support).
 
@@ -100,23 +146,16 @@ def build(page: Page, key_exprs, host_probe: Optional[bool] = None) -> BuildSide
     different hash are rejected by the existing true-key-equality check."""
     keys = [evaluate(e, page) for e in key_exprs]
     live = page.live_mask()
-    h = hash_rows(keys) if keys else jnp.zeros(page.capacity, jnp.uint64)
+    value_hashed = _want_value_hash(keys, page.count)
+    if not keys:
+        h = jnp.zeros(page.capacity, jnp.uint64)
+    elif value_hashed:
+        h = hash_rows_values(keys)
+    else:
+        h = hash_rows(keys)
     h = jnp.where(live, h, MAX_HASH)  # dead rows cluster at the end
     order = jnp.argsort(h)
     sh = h[order]
-    if host_probe is None:
-        host_probe = _default_host_probe()
-    if host_probe:
-        # host-probe plans still degrade through a breaker: a faulting
-        # callback (e.g. under an unsupported transform) reroutes every
-        # join in the process back to the device probe
-        from ..exec.breaker import BREAKERS
-
-        host_probe = BREAKERS.allow("join_probe_cpu")
-    if host_probe:
-        return BuildSide(
-            sh, order, page, tuple(keys), page.count, host_probe=True
-        )
     use_directory = (
         os.environ.get("PRESTO_TPU_JOIN_PROBE", "directory") == "directory"
     )
@@ -129,7 +168,10 @@ def build(page: Page, key_exprs, host_probe: Optional[bool] = None) -> BuildSide
         use_directory = BREAKERS.allow("join_probe")
     if not use_directory:
         # chip-diagnosis escape hatch / open breaker: searchsorted probe
-        return BuildSide(sh, order, page, tuple(keys), page.count)
+        return BuildSide(
+            sh, order, page, tuple(keys), page.count,
+            value_hashed=value_hashed,
+        )
     bits = _pick_bucket_bits(page.capacity)
     nb = 1 << bits
     bucket = (sh >> np.uint64(64 - bits)).astype(jnp.int32)
@@ -141,7 +183,8 @@ def build(page: Page, key_exprs, host_probe: Optional[bool] = None) -> BuildSide
         bucket, jnp.arange(nb + 1, dtype=jnp.int32), side="left"
     ).astype(jnp.int32)
     return BuildSide(
-        sh, order, page, tuple(keys), page.count, starts, bits
+        sh, order, page, tuple(keys), page.count, starts, bits,
+        value_hashed=value_hashed,
     )
 
 
@@ -156,10 +199,11 @@ def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val], capacity: int):
         lo = jnp.zeros(capacity, jnp.int32)
         hi = jnp.broadcast_to(bs.count.astype(jnp.int32), (capacity,))
         return None, lo, hi
-    h = hash_rows(probe_keys)
-    if bs.host_probe:
-        lo, hi = _host_probe_ranges(bs.sorted_hash, h, capacity)
-        return h, lo, hi
+    h = (
+        hash_rows_values(probe_keys)
+        if bs.value_hashed
+        else hash_rows(probe_keys)
+    )
     if bs.bucket_start is not None:
         b = (h >> np.uint64(64 - bs.bucket_bits)).astype(jnp.int32)
         cnt = bs.count.astype(jnp.int32)
@@ -172,26 +216,6 @@ def _probe_ranges(bs: BuildSide, probe_keys: Sequence[Val], capacity: int):
     lo = jnp.searchsorted(bs.sorted_hash, h, side="left")
     hi = jnp.searchsorted(bs.sorted_hash, h, side="right")
     return h, lo.astype(jnp.int32), hi.astype(jnp.int32)
-
-
-def _host_np_ranges(sh, h):
-    """numpy binary search for probe candidate ranges — runs on the host
-    CPU where it is a multi-pass-free C loop, not an XLA gather cascade."""
-    sh = np.asarray(sh)
-    h = np.asarray(h)
-    lo = np.searchsorted(sh, h, side="left").astype(np.int32)
-    hi = np.searchsorted(sh, h, side="right").astype(np.int32)
-    return lo, hi
-
-
-def _host_probe_ranges(sorted_hash, h, capacity: int):
-    """Exact-hash-run candidate ranges via jax.pure_callback (CPU-backend
-    plans only; see _default_host_probe). Downstream consumers see the
-    same [lo, hi) contract as the searchsorted probe."""
-    out_t = jax.ShapeDtypeStruct((capacity,), jnp.int32)
-    return jax.pure_callback(
-        _host_np_ranges, (out_t, out_t), sorted_hash, h, vmap_method="sequential"
-    )
 
 
 def _keys_equal(bs: BuildSide, probe_keys: Sequence[Val], build_rows):
@@ -257,9 +281,41 @@ def _collision_scan(bs: BuildSide, probe_keys, lo, hi, max_scan: int = 4):
     return matched, build_row
 
 
+def _table_dispatch(bs, run_table, run_legacy):
+    """Route through the hash-table kernels when build() produced a
+    JoinTable; a probe-side kernel fault records on the pallas_join_probe
+    breaker and degrades to the sorted-hash composition by rebuilding
+    from the table's retained build page (rare: the breaker then opens
+    and subsequent build() calls skip the table outright)."""
+    from .pallas_join import JoinTable
+
+    if isinstance(bs, JoinTable):
+        from ..exec.breaker import BREAKERS
+
+        try:
+            out = run_table(bs)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            BREAKERS.record_failure("pallas_join_probe", repr(exc))
+            bs = build_sorted(bs.page, bs.key_exprs)
+            try:
+                return run_legacy(bs)
+            except Exception:
+                # the sorted fallback failed the same way: a semantic /
+                # data-shape error, not a kernel fault — neutralize the
+                # breaker hit so one bad join cannot degrade the kernel
+                # path for the whole process (same contract as
+                # Executor._kernel_guarded)
+                BREAKERS.record_success("pallas_join_probe")
+                raise
+        else:
+            BREAKERS.record_success("pallas_join_probe")
+            return out
+    return run_legacy(bs)
+
+
 def join_n1(
     probe: Page,
-    bs: BuildSide,
+    bs,
     probe_key_exprs,
     build_names: Sequence[str],
     out_build_names: Sequence[str],
@@ -270,6 +326,27 @@ def join_n1(
 
     Output capacity == probe capacity; probe columns pass through, build
     payload columns are gathered (null where unmatched, for `left`)."""
+    from .pallas_join import table_join_n1
+
+    return _table_dispatch(
+        bs,
+        lambda jt: table_join_n1(
+            probe, jt, probe_key_exprs, build_names, out_build_names, kind
+        ),
+        lambda b: _join_n1_sorted(
+            probe, b, probe_key_exprs, build_names, out_build_names, kind
+        ),
+    )
+
+
+def _join_n1_sorted(
+    probe: Page,
+    bs: BuildSide,
+    probe_key_exprs,
+    build_names: Sequence[str],
+    out_build_names: Sequence[str],
+    kind: str = "inner",
+) -> Page:
     probe_keys = [evaluate(e, probe) for e in probe_key_exprs]
     live = probe.live_mask()
     _, lo, hi = _probe_ranges(bs, probe_keys, probe.capacity)
@@ -299,9 +376,21 @@ def join_n1(
     raise ValueError(f"unknown join kind {kind!r}")
 
 
-def semi_match_mask(probe: Page, bs: BuildSide, probe_key_exprs) -> jnp.ndarray:
+def semi_match_mask(probe: Page, bs, probe_key_exprs) -> jnp.ndarray:
     """Boolean per-probe-row match membership (the mark-join kernel:
     reference HashSemiJoinOperator's semiJoinOutput channel)."""
+    from .pallas_join import table_semi_mask
+
+    return _table_dispatch(
+        bs,
+        lambda jt: table_semi_mask(probe, jt, probe_key_exprs),
+        lambda b: _semi_match_mask_sorted(probe, b, probe_key_exprs),
+    )
+
+
+def _semi_match_mask_sorted(
+    probe: Page, bs: BuildSide, probe_key_exprs
+) -> jnp.ndarray:
     probe_keys = [evaluate(e, probe) for e in probe_key_exprs]
     live = probe.live_mask()
     _, lo, hi = _probe_ranges(bs, probe_keys, probe.capacity)
@@ -310,6 +399,33 @@ def semi_match_mask(probe: Page, bs: BuildSide, probe_key_exprs) -> jnp.ndarray:
 
 
 def join_expand(
+    probe: Page,
+    bs,
+    probe_key_exprs,
+    probe_out: Sequence[str],
+    build_out: Sequence[Tuple[str, str]],  # (build col, output name)
+    out_capacity: int,
+    kind: str = "inner",
+) -> Tuple[Page, jnp.ndarray]:
+    """General 1:N join dispatcher — see _join_expand_sorted for the
+    contract; the table path emits VERIFIED pairs so its overflow is
+    exact rather than a candidate bound."""
+    from .pallas_join import table_join_expand
+
+    return _table_dispatch(
+        bs,
+        lambda jt: table_join_expand(
+            probe, jt, probe_key_exprs, probe_out, build_out,
+            out_capacity, kind,
+        ),
+        lambda b: _join_expand_sorted(
+            probe, b, probe_key_exprs, probe_out, build_out,
+            out_capacity, kind,
+        ),
+    )
+
+
+def _join_expand_sorted(
     probe: Page,
     bs: BuildSide,
     probe_key_exprs,

@@ -131,8 +131,12 @@ def q1_partial_sums(qty, price, disc, tax, rf, ls, ship, count, cutoff):
     # trace with x64 OFF: under the repo's global x64 mode the BlockSpec
     # index maps trace to i64 functions, which Mosaic fails to legalize
     # ("func.return (i64)") — every value in this kernel is explicit
-    # int32, so 32-bit tracing is semantics-preserving
-    with jax.enable_x64(False):
+    # int32, so 32-bit tracing is semantics-preserving.
+    # jax.experimental.disable_x64 is the spelling this jax line ships
+    # (plain jax.enable_x64(False) was removed)
+    from jax.experimental import disable_x64
+
+    with disable_x64():
         return pl.pallas_call(
             _kernel,
             grid=(blocks,),

@@ -152,8 +152,12 @@ def _pallas_partials(gid, live, channels, count, num_groups, reduce_kinds,
     )
     # trace with x64 OFF: under global x64 the BlockSpec index maps trace
     # to i64 functions, which Mosaic fails to legalize ("func.return
-    # (i64)"); the kernel is explicit int32/float32 throughout
-    with jax.enable_x64(False):
+    # (i64)"); the kernel is explicit int32/float32 throughout.
+    # jax.experimental.disable_x64 is the spelling this jax line ships
+    # (plain jax.enable_x64(False) was removed)
+    from jax.experimental import disable_x64
+
+    with disable_x64():
         return pl.pallas_call(
             kernel,
             grid=(blocks,),
@@ -463,3 +467,555 @@ def maybe_grouped_aggregate(
 
 def pallas_available() -> bool:
     return True  # interpret mode always works; TPU uses Mosaic
+
+
+# -- hash-slot grouped aggregation (PR 11) -----------------------------------
+#
+# The dense path above needs every key to be a SMALL-DOMAIN dictionary /
+# boolean column (mixed-radix gid over the domain product, G <= 64). The
+# hash-slot path below lifts that ceiling: ARBITRARY-valued keys (int64
+# order keys, composite keys, floats, NULLs) map to dense group ids
+# through the same linear-probe slot machinery as ops/pallas_join.py —
+# a distinct-insert pass assigns each row the slot of its key's first
+# occurrence (true key equality verified against the slot's
+# representative row, so 32-bit tag collisions re-probe instead of
+# merging groups), occupied slots rank-compact to gid 0..G-1, and the
+# accumulation runs over gids:
+#
+# * tpu / interp — the SAME _pallas_partials streaming kernel as the
+#   dense path (gid is just no longer a radix code), eligible while the
+#   output tile fits: rows_pad(G, channels) <= 1024, i.e. G up to 512
+#   with a sum+count plan — an 8x group ceiling lift with identical
+#   exactness (16-bit limb channels).
+# * cpu (engine default for this path) — numpy bincount per limb
+#   channel: one C pass per channel, exact (limb partial sums stay
+#   below 2^53 for any page under 2^37 rows), beating the jitted
+#   sort-compose fallback on high-NDV shapes.
+#
+# Behind the pallas_groupby_hash breaker; ineligible/overflow shapes
+# return None and the caller falls through to the MXU one-hot matmul or
+# the sort strategy exactly as before.
+
+HASH_MAX_GROUPS_HOST = 1 << 16
+_HASH_START_BITS = 13
+_HASH_ROUNDS = 96  # distinct-insert advance bound before resizing
+
+
+def _concrete(*arrays) -> bool:
+    """Eager-only guard (the ops/sort.py idiom): the slot assignment is
+    host work; traced callers keep the XLA compositions."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _keys_match(keys_np, rows_a: np.ndarray, rows_b: np.ndarray):
+    """GROUP BY equality of key tuples at rows_a vs rows_b: NULL == NULL,
+    NaN == NaN, -0.0 == 0.0 (reference doubleToLongBits grouping)."""
+    ok = np.ones(len(rows_a), bool)
+    for data, valid in keys_np:
+        a, b = data[rows_a], data[rows_b]
+        part = a == b
+        if np.issubdtype(data.dtype, np.floating):
+            part = part | (np.isnan(a) & np.isnan(b))
+        if part.ndim == 2:
+            part = part.all(axis=-1)
+        if valid is not None:
+            va, vb = valid[rows_a], valid[rows_b]
+            part = (part & va & vb) | (~va & ~vb)
+        ok &= part
+    return ok
+
+
+def _assign_slots(tag: np.ndarray, keys_np, live: np.ndarray, bits: int):
+    """Distinct-insert: every live row ends at the slot of its key's
+    first occurrence. Returns (slot_of_row, slot_rep, occupied) or None
+    when displacement exhausts _HASH_ROUNDS (caller retries with a
+    bigger table)."""
+    size = (1 << bits) + _HASH_ROUNDS + 2
+    limit = size - 2
+    slot_rep = np.full(size, -1, np.int64)  # representative row per slot
+    slot_tag = np.full(size, np.uint32(0xFFFFFFFF), np.uint32)
+    desired = (tag >> np.uint32(32 - bits)).astype(np.int64)
+    n = len(tag)
+    slot_of = np.full(n, -1, np.int64)
+    pend = np.flatnonzero(live)
+    off = np.zeros(n, np.int64)
+    for _ in range(2 * _HASH_ROUNDS):
+        if not len(pend):
+            break
+        cand = np.minimum(desired[pend] + off[pend], limit)
+        occ = slot_rep[cand] >= 0
+        done = np.zeros(len(pend), bool)
+        # (a) occupied: join when tag AND true keys match the
+        # representative; otherwise advance (collision / other group)
+        if occ.any():
+            same = occ & (slot_tag[cand] == tag[pend])
+            if same.any():
+                si = np.flatnonzero(same)
+                km = _keys_match(
+                    keys_np, pend[si], slot_rep[cand[si]]
+                )
+                joined = si[km]
+                slot_of[pend[joined]] = cand[joined]
+                done[joined] = True
+                off[pend[si[~km]]] += 1
+            off[pend[occ & ~same]] += 1
+        # (b) vacant: race-insert; winners become representatives,
+        # losers retry the SAME slot next round (it is occupied now)
+        vac = ~occ
+        if vac.any():
+            vi = np.flatnonzero(vac)
+            vc = pend[vi]
+            c = cand[vi]
+            slot_rep[c] = vc  # last writer wins
+            won = slot_rep[c] == vc
+            slot_tag[c[won]] = tag[vc[won]]
+            slot_of[vc[won]] = c[won]
+            done[vi[won]] = True
+        if len(pend) and off[pend].max(initial=0) >= _HASH_ROUNDS:
+            return None
+        pend = pend[~done]
+    if len(pend):
+        return None
+    occupied = np.flatnonzero(slot_rep >= 0)
+    return slot_of, slot_rep, occupied
+
+
+_HASH_SUPPORTED = _SUPPORTED  # count / count_star / sum / avg / min / max
+
+
+def _estimate_ndv(tag: np.ndarray, live: np.ndarray, sample: int = 8192) -> int:
+    """Cheap NDV estimate from distinct tags in a strided sample: when
+    the sample is mostly repeats the domain is about the distinct count;
+    when it is mostly unique, scale up linearly (over-estimating is the
+    safe direction — it only skips the hash path)."""
+    rows = np.flatnonzero(live)
+    n = len(rows)
+    if n == 0:
+        return 0
+    if n > sample:
+        rows = rows[:: max(n // sample, 1)][:sample]
+    u = len(np.unique(tag[rows]))
+    s = len(rows)
+    if u < s // 2:
+        return max(int(u * 1.25), 1)
+    return max(int(n * (u / max(s, 1))), 1)
+
+
+# prestolint: host-function -- eager host orchestration: device key eval,
+# host slot assignment, backend-dispatched accumulation
+def maybe_grouped_aggregate_hash(
+    page: Page, group_exprs, group_names, aggs: Sequence[AggSpec], pre_mask
+) -> Optional[Page]:
+    """Hash-slot grouped aggregation; None when ineligible (caller falls
+    through to the matmul / sort strategies)."""
+    if not group_exprs:
+        return None
+    if any(a.func not in _HASH_SUPPORTED for a in aggs):
+        return None
+    from .aggregate import _masked_live
+    from .hashing import hash_rows
+
+    keys = [evaluate(e, page) for e in group_exprs]
+    probe_arrays = [k.data for k in keys] + [page.count]
+    if not _concrete(*probe_arrays):
+        return None
+    mode = _hash_groupby_mode()
+    if mode == "off":
+        return None
+    ins = []
+    for a in aggs:
+        if a.input is None:
+            ins.append(None)
+            continue
+        v = evaluate(a.input, page)
+        if v.data.ndim != 1 or not _concrete(v.data):
+            return None
+        integral = jnp.issubdtype(v.data.dtype, jnp.integer) or isinstance(
+            v.type, T.BooleanType
+        )
+        floating = jnp.issubdtype(v.data.dtype, jnp.floating)
+        if not integral and not floating:
+            return None
+        if floating and a.func in ("min", "max") and mode != "host":
+            return None  # float compares don't ride the int32 channels
+        if (
+            mode != "host"
+            and a.func in ("min", "max")
+            and v.data.dtype.itemsize > 4
+        ):
+            return None  # 64-bit min/max needs the host path
+        if a.func in ("sum", "avg") and not jnp.issubdtype(
+            v.data.dtype, jnp.floating
+        ):
+            amax = int(np.abs(np.asarray(v.data)).max(initial=0))
+            if isinstance(a.input.type, T.DecimalType):
+                # decimal sums must stay EXACT: this path totals in
+                # int64 limbs (the sort strategy carries two-lane d128),
+                # so bail when |sum| could pass 2^61 — avg's HALF_UP
+                # rounding computes 2*|sum|+cnt, which must also fit
+                if amax and amax * page.capacity >= (1 << 61):
+                    return None
+            if mode != "host" and amax >= _SUM_BOUND:
+                # the pallas limb kernel's high-limb block partials sum
+                # in int32 (module header bound: exact for |x| < 2^45);
+                # the host bincount path chunks exactly, so only the
+                # kernel modes bail
+                return None
+        ins.append(v)
+
+    live = np.asarray(_masked_live(page, pre_mask))
+    h = np.asarray(hash_rows(keys))
+    tag = np.minimum(
+        (h >> np.uint64(32)).astype(np.uint32), np.uint32(0xFFFFFFFE)
+    )
+    keys_np = [
+        (
+            np.asarray(k.data),
+            None if k.valid is None else np.asarray(k.valid),
+        )
+        for k in keys
+    ]
+    cap = HASH_MAX_GROUPS_HOST if mode == "host" else 1 << 10
+    # size the table from a sampled NDV estimate: a table sized for the
+    # wrong order of magnitude costs a full doomed insert pass before the
+    # resize loop can react (measured 5x worse than the sort fallback at
+    # NDV 30k), and an estimate far above the cap means the sort/matmul
+    # strategies win anyway — bail before paying anything
+    est = _estimate_ndv(tag, live)
+    if est > 2 * cap:
+        return None
+    # table size is independent of the group cap: start at the estimate
+    # (4x headroom) and grow on displacement overflow / hot load, up to
+    # 2x cap slots (a table larger than the cap only means a cooler load)
+    max_bits = max(
+        _HASH_START_BITS, int(np.ceil(np.log2(max(cap * 2, 2))))
+    )
+    bits = min(
+        max(int(np.ceil(np.log2(max(est * 4, 16)))), 8), max_bits
+    )
+    assigned = None
+    while assigned is None and bits <= max_bits:
+        assigned = _assign_slots(tag, keys_np, live, bits)
+        if assigned is not None and bits < max_bits:
+            # resize when the table ran hot (load > 1/2): scans stay short
+            if len(assigned[2]) * 2 > (1 << bits):
+                assigned = None
+        if assigned is None:
+            bits += 2
+    if assigned is None:
+        return None
+    slot_of, slot_rep, occupied = assigned
+    G = len(occupied)
+    if G == 0 or G > cap:
+        return None
+    rank = np.zeros(len(slot_rep), np.int64)
+    rank[occupied] = np.arange(G)
+    gid = np.where(live, rank[np.maximum(slot_of, 0)], 0)
+    reps = slot_rep[occupied]
+
+    if mode == "host":
+        agg_blocks = _host_accumulate(gid, live, aggs, ins, G)
+    else:
+        agg_blocks = _pallas_accumulate(gid, live, aggs, ins, G, page)
+    if agg_blocks is None:
+        return None
+
+    out_blocks: List[Block] = []
+    out_names: List[str] = []
+    for v, nm in zip(keys, group_names):
+        data, valid = np.asarray(v.data), v.valid
+        out_blocks.append(
+            Block(
+                jnp.asarray(data[reps]),
+                v.type,
+                None if valid is None else jnp.asarray(
+                    np.asarray(valid)[reps]
+                ),
+                v.dict_id,
+            )
+        )
+        out_names.append(nm)
+    for b, a in zip(agg_blocks, aggs):
+        out_blocks.append(b)
+        out_names.append(a.name)
+    return Page.from_blocks(out_blocks, out_names, count=G)
+
+
+def _hash_groupby_mode() -> str:
+    import os
+
+    forced = os.environ.get("PRESTO_TPU_PALLAS_GROUPBY_HASH", "")
+    if forced in ("0", "off"):
+        return "off"
+    if forced == "interp":
+        return "interp"
+    return "pallas" if jax.default_backend() == "tpu" else "host"
+
+
+def _contrib_mask(live, v) -> np.ndarray:
+    if v is None or v.valid is None:
+        return live
+    return live & np.asarray(v.valid)
+
+
+def _host_accumulate(gid, live, aggs, ins, G) -> Optional[List[Block]]:
+    """numpy bincount accumulation: one C pass per limb channel, exact
+    (16-bit limbs keep partial sums below 2^53)."""
+    from . import decimal128 as d128
+
+    out: List[Block] = []
+    counts_cache = {}
+
+    def counts_for(ai, v):
+        c = counts_cache.get(ai)
+        if c is None:
+            m = _contrib_mask(live, v)
+            c = np.bincount(gid[m], minlength=G).astype(np.int64)
+            counts_cache[ai] = c
+        return c
+
+    def exact_sum(x: np.ndarray, m: np.ndarray) -> np.ndarray:
+        g = gid[m]
+        x = x[m].astype(np.int64)
+        # bincount accumulates in f64: 16-bit limbs stay exact to 2^37
+        # rows, but the signed high limb can reach 2^31 — chunk it so no
+        # partial passes 2^53 regardless of value distribution
+        total = np.zeros(G, np.int64)
+        step = 1 << 21
+        for s0 in range(0, len(x), step):
+            xs, gs = x[s0 : s0 + step], g[s0 : s0 + step]
+            l0 = np.bincount(gs, weights=(xs & 0xFFFF).astype(np.float64),
+                             minlength=G).astype(np.int64)
+            l1 = np.bincount(
+                gs, weights=((xs >> 16) & 0xFFFF).astype(np.float64),
+                minlength=G,
+            ).astype(np.int64)
+            l2 = np.bincount(gs, weights=(xs >> 32).astype(np.float64),
+                             minlength=G).astype(np.int64)
+            total += l0 + (l1 << 16) + (l2 << 32)
+        return total
+
+    for ai, (a, v) in enumerate(zip(aggs, ins)):
+        if a.func in ("count", "count_star"):
+            out.append(
+                Block(jnp.asarray(counts_for(ai, v)), T.BIGINT, None)
+            )
+            continue
+        m = _contrib_mask(live, v)
+        data = np.asarray(v.data)
+        has = counts_for(ai, v) > 0
+        if a.func in ("sum", "avg"):
+            if np.issubdtype(data.dtype, np.floating):
+                total = np.bincount(
+                    gid[m], weights=data[m].astype(np.float64), minlength=G
+                )
+            else:
+                total = exact_sum(data, m)
+            if a.func == "avg":
+                cnt = counts_for(ai, v)
+                res = avg_from_sum_count(
+                    jnp.asarray(total), jnp.asarray(cnt), a.output_type,
+                    a.input.type,
+                )
+                out.append(Block(res, a.output_type, jnp.asarray(has)))
+            elif isinstance(a.output_type, T.DecimalType) and (
+                a.output_type.is_long
+            ):
+                out.append(
+                    Block(
+                        d128.from_int64(jnp.asarray(total)), a.output_type,
+                        jnp.asarray(has),
+                    )
+                )
+            else:
+                res = jnp.asarray(total).astype(a.output_type.storage_dtype)
+                out.append(Block(res, a.output_type, jnp.asarray(has)))
+            continue
+        # min / max via ufunc.at (correct for any width; the tpu path
+        # restricts to int32-safe storage instead)
+        if np.issubdtype(data.dtype, np.floating):
+            init = np.inf if a.func == "min" else -np.inf
+            acc = np.full(G, init, np.float64)
+            red = np.minimum if a.func == "min" else np.maximum
+            red.at(acc, gid[m], data[m].astype(np.float64))
+        else:
+            info = np.iinfo(np.int64)
+            init = info.max if a.func == "min" else info.min
+            acc = np.full(G, init, np.int64)
+            red = np.minimum if a.func == "min" else np.maximum
+            red.at(acc, gid[m], data[m].astype(np.int64))
+        res = jnp.asarray(acc).astype(a.output_type.storage_dtype)
+        out.append(Block(res, a.output_type, jnp.asarray(has)))
+    return out
+
+
+# prestolint: host-function -- eager host orchestration around the
+# partials kernel (concrete gid/live; occupancy bincount runs on host)
+def _pallas_accumulate(gid, live, aggs, ins, G, page) -> Optional[List[Block]]:
+    """Accumulate over hash gids with the SAME streaming kernel as the
+    dense path (_pallas_partials): limb channels, per-block partials,
+    int64/f64 combine outside. None when the output tile gate
+    (rows_pad <= 1024) rejects this (G, channels) plan."""
+    channels: List = []
+    kinds: List[str] = []
+    plan: List[Tuple[int, str, int]] = []
+    fchannels: List = []
+    fplan: List[Tuple[int, str, int]] = []
+    livej = jnp.asarray(live)
+    ones = jnp.ones(len(gid), jnp.int32)
+
+    for ai, (a, v) in enumerate(zip(aggs, ins)):
+        contrib = (
+            livej
+            if v is None or v.valid is None
+            else (livej & jnp.asarray(v.valid))
+        )
+        cmask = contrib.astype(jnp.int32)
+        if a.func in ("count", "count_star", "avg"):
+            channels.append(ones * cmask)
+            plan.append((ai, "count", 0))
+            kinds.append("add")
+        if a.func in ("sum", "avg") and jnp.issubdtype(
+            v.data.dtype, jnp.floating
+        ):
+            xf = v.data.astype(jnp.float64)
+            hi = xf.astype(jnp.float32)
+            lo = (xf - hi.astype(jnp.float64)).astype(jnp.float32)
+            fm = cmask.astype(jnp.float32)
+            fchannels.append(hi * fm)
+            fplan.append((ai, "fsum", 0))
+            fchannels.append(lo * fm)
+            fplan.append((ai, "fsum", 1))
+            continue
+        if a.func in ("sum", "avg"):
+            x = v.data.astype(jnp.int64)
+            for li, limb in enumerate(
+                ((x & 0xFFFF), ((x >> 16) & 0xFFFF), (x >> 32))
+            ):
+                channels.append(limb.astype(jnp.int32) * cmask)
+                plan.append((ai, "sum", li))
+                kinds.append("add")
+        if a.func in ("min", "max"):
+            # pre-mask NULL inputs with the fold identity: the kernel's
+            # row mask is group-level liveness only
+            fill = jnp.int32(
+                np.iinfo(np.int32).max if a.func == "min"
+                else np.iinfo(np.int32).min
+            )
+            channels.append(
+                jnp.where(contrib, v.data.astype(jnp.int32), fill)
+            )
+            plan.append((ai, a.func, 0))
+            kinds.append(a.func)
+    if len(channels) > MAX_CHANNELS or len(fchannels) > MAX_CHANNELS:
+        return None
+    if max(
+        _rows_pad(G, len(channels)), _rows_pad(G, len(fchannels)), 8
+    ) > 1024:
+        return None
+
+    gidj = jnp.asarray(gid.astype(np.int32))
+    count = jnp.asarray(np.int32(len(gid)))  # liveness rides the mask
+    CH = len(channels)
+    if CH:
+        partials = _pallas_partials(gidj, livej, channels, count, G, kinds)
+        pv = partials[:, : G * CH, :].reshape(-1, G, CH, 128).astype(
+            jnp.int64
+        )
+        s = jnp.sum(pv, axis=(0, 3))
+        pmin = jnp.min(pv, axis=(0, 3))
+        pmax = jnp.max(pv, axis=(0, 3))
+    else:
+        s = pmin = pmax = jnp.zeros((G, 0), jnp.int64)
+    fs = None
+    if fchannels:
+        CHF = len(fchannels)
+        fpartials = _pallas_partials(
+            gidj, livej, fchannels, count, G, ["add"] * CHF,
+            dtype=jnp.float32,
+        )
+        fs = jnp.sum(
+            fpartials[:, : G * CHF, :].reshape(-1, G, CHF, 128).astype(
+                jnp.float64
+            ),
+            axis=(0, 3),
+        )
+
+    by_agg: dict = {}
+    for k, (ai, role, li) in enumerate(plan):
+        by_agg.setdefault(ai, {})[(role, li)] = k
+    by_agg_f: dict = {}
+    for k, (ai, role, li) in enumerate(fplan):
+        by_agg_f.setdefault(ai, {})[(role, li)] = k
+
+    from . import decimal128 as d128
+
+    out: List[Block] = []
+    for ai, (a, v) in enumerate(zip(aggs, ins)):
+        if a.func in ("count", "count_star"):
+            out.append(Block(s[:, by_agg[ai][("count", 0)]], T.BIGINT, None))
+            continue
+        if ai in by_agg and ("count", 0) in by_agg[ai]:
+            cnt = s[:, by_agg[ai][("count", 0)]]
+        else:
+            m = _contrib_mask(live, v)
+            cnt = jnp.asarray(
+                np.bincount(gid[m], minlength=G).astype(np.int64)
+            )
+        has = cnt > 0
+        if ai in by_agg_f:
+            chs = by_agg_f[ai]
+            total = fs[:, chs[("fsum", 0)]] + fs[:, chs[("fsum", 1)]]
+            if a.func == "avg":
+                out.append(
+                    Block(
+                        avg_from_sum_count(
+                            total, cnt, a.output_type, a.input.type
+                        ),
+                        a.output_type, has,
+                    )
+                )
+            else:
+                out.append(
+                    Block(
+                        total.astype(a.output_type.storage_dtype),
+                        a.output_type, has,
+                    )
+                )
+            continue
+        if a.func in ("sum", "avg"):
+            chs = by_agg[ai]
+            total = (
+                s[:, chs[("sum", 0)]]
+                + (s[:, chs[("sum", 1)]] << 16)
+                + (s[:, chs[("sum", 2)]] << 32)
+            )
+            if a.func == "avg":
+                out.append(
+                    Block(
+                        avg_from_sum_count(
+                            total, cnt, a.output_type, a.input.type
+                        ),
+                        a.output_type, has,
+                    )
+                )
+            elif isinstance(a.output_type, T.DecimalType) and (
+                a.output_type.is_long
+            ):
+                out.append(
+                    Block(d128.from_int64(total), a.output_type, has)
+                )
+            else:
+                out.append(
+                    Block(
+                        total.astype(a.output_type.storage_dtype),
+                        a.output_type, has,
+                    )
+                )
+            continue
+        ch = by_agg[ai][(a.func, 0)]
+        col = pmin[:, ch] if a.func == "min" else pmax[:, ch]
+        out.append(
+            Block(col.astype(a.output_type.storage_dtype), a.output_type, has)
+        )
+    return out

@@ -1273,6 +1273,19 @@ class Session:
                         f"hybrid parts={st['hybrid_parts']} "
                         f"depth={st.get('hybrid_depth', 0)}"
                     )
+                if st.get("ragged_pages"):
+                    # ragged paged partition layout (ops/ragged.py):
+                    # pages allocated for the hybrid build partitions and
+                    # their live-slot occupancy (pad-to-max would be 100%
+                    # only under zero skew)
+                    parts.append(
+                        f"ragged pages={st['ragged_pages']} "
+                        f"occ={st.get('ragged_occupancy_pct', 0)}%"
+                    )
+                if st.get("agg_hash_batches"):
+                    parts.append(
+                        f"agg_hash_batches={st['agg_hash_batches']}"
+                    )
                 if st.get("chunk_fallbacks"):
                     parts.append(f"chunk_fallbacks={st['chunk_fallbacks']}")
                 if revs:

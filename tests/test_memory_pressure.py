@@ -96,9 +96,12 @@ def test_disk_tier_window(catalog, plain, monkeypatch):
     assert s.executor.spill_stats["disk_bytes"] > 0
 
 
-def test_disk_tier_varchar_key_join_uses_chunked(monkeypatch):
-    # varchar keys route around the hybrid join (dictionary codes hash
-    # per-table) but the chunked path still runs through the disk tier
+def test_varchar_key_join_value_rehash_hybrid(monkeypatch):
+    # PR 11: varchar keys now rehash by dictionary VALUE
+    # (ops/hashing.hash_rows_values), so varchar equi-joins take the
+    # partitioned hybrid path even though build and probe dictionaries
+    # differ; only a dictionary beyond PRESTO_TPU_VALUE_HASH_MAX_DICT
+    # still routes to the chunked loop
     monkeypatch.setenv("PRESTO_TPU_HOST_SPILL_BYTES", "0")
     rng = np.random.default_rng(4)
     n_b, n_p = 10_000, 20_000
@@ -116,15 +119,57 @@ def test_disk_tier_varchar_key_join_uses_chunked(monkeypatch):
             "pv": rng.integers(0, 100, n_p).astype(np.int64),
         }
     )
-    cat = MemoryCatalog({"b": b, "p": p})
+    # p2: probe whose values cover b's full domain, so both columns
+    # intern ONE dictionary — the shape the size-gated escape hatch below
+    # is still correct for (cross-dictionary correctness REQUIRES value
+    # hashing; code hashing was silently wrong for it before PR 11)
+    p2 = Page.from_dict(
+        {
+            "pk": [f"key_{i % n_b:05d}" for i in range(2 * n_b)],
+            "pv": rng.integers(0, 100, 2 * n_b).astype(np.int64),
+        }
+    )
+    cat = MemoryCatalog({"b": b, "p": p, "p2": p2})
     sql = "select count(*) c, sum(bv + pv) s from p join b on pk = bk"
-    want = Session(cat).query(sql).rows()
-    s = Session(cat, streaming=True, batch_rows=2048, memory_budget=64 << 10)
+    # python oracle (the engine-vs-engine "oracle" would have blessed the
+    # old code-hash behavior, which silently dropped cross-dictionary
+    # matches)
+    bl = {k: int(v) for k, v in zip(
+        [f"key_{i:05d}" for i in range(n_b)], np.asarray(b.block("bv").data)
+    )}
+    pdict = p.block("pk").dictionary
+    pcodes = np.asarray(p.block("pk").data)[: 20_000]
+    pvals = np.asarray(p.block("pv").data)[: 20_000]
+    matches = [(pdict[int(c)], int(v)) for c, v in zip(pcodes, pvals)]
+    want_c = sum(1 for k, _ in matches if k in bl)
+    want_s = sum(bl[k] + v for k, v in matches if k in bl)
+    want = [(want_c, want_s)]
+    assert Session(cat).query(sql).rows() == want
+    s = Session(
+        cat, streaming=True, batch_rows=2048, memory_budget=64 << 10,
+        result_cache=False,
+    )
     assert s.query(sql).rows() == want
-    assert "join_build" in s.executor.spill_events
-    assert "hybrid_hash_join" not in s.executor.spill_events
-    assert s.executor.spill_stats["chunk_fallbacks"] >= 1
+    assert "hybrid_hash_join" in s.executor.spill_events, (
+        "value-rehashed varchar join should take the hybrid path"
+    )
     assert s.executor.spill_stats["disk_bytes"] > 0
+    # dictionaries over the value-hash cap keep the PRE-PR-11 chunked
+    # routing (the categorical escape hatch, now size-gated). Same-dict
+    # sides here: code hashing is only VALUE-correct when both columns
+    # share one dictionary, which is the only shape the escape hatch can
+    # serve soundly. result_cache=False so the run actually executes.
+    monkeypatch.setenv("PRESTO_TPU_VALUE_HASH_MAX_DICT", "16")
+    sql2 = "select count(*) c, sum(bv + pv) s from p2 join b on pk = bk"
+    assert b.block("bk").dict_id == p2.block("pk").dict_id
+    want2 = Session(cat, result_cache=False).query(sql2).rows()
+    s2 = Session(
+        cat, streaming=True, batch_rows=2048, memory_budget=64 << 10,
+        result_cache=False,
+    )
+    assert s2.query(sql2).rows() == want2
+    assert "hybrid_hash_join" not in s2.executor.spill_events
+    assert s2.executor.spill_stats["chunk_fallbacks"] >= 1
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +282,9 @@ def test_sink_aggregate_fault_frees_state_and_accumulated(monkeypatch):
     whose revoke can never complete)."""
     import presto_tpu.exec.stream as stream_mod
 
+    # pin the sort strategy: the PR 11 hash-slot group-by would otherwise
+    # absorb these batches and the injected fault would never fire
+    monkeypatch.setenv("PRESTO_TPU_PALLAS_GROUPBY_HASH", "off")
     cat = TpchCatalog(sf=SF)
     real = stream_mod.grouped_aggregate_sorted
     calls = {"n": 0}

@@ -285,6 +285,8 @@ def test_join_bucket_directory_stress():
 
     if os.environ.get("PRESTO_TPU_JOIN_PROBE", "directory") != "directory":
         pytest.skip("directory probe gated off via PRESTO_TPU_JOIN_PROBE")
+    from presto_tpu.ops.join import build_sorted
+
     rng = np.random.default_rng(7)
     nb, npr = 5000, 20000
     bk = rng.integers(0, 3000, nb)  # duplicates guaranteed
@@ -295,7 +297,9 @@ def test_join_bucket_directory_stress():
     )
     pk = rng.integers(0, 4000, npr)  # some keys miss entirely
     probe = Page.from_dict({"k": pk.astype(np.int64)}, pad_to=1 << 15)
-    bs = build(build_page, [col("k", T.BIGINT)])
+    # this test pins the SORTED layout's bucket directory (the table
+    # path has its own suite in tests/test_pallas_join.py)
+    bs = build_sorted(build_page, [col("k", T.BIGINT)])
     assert bs.bucket_start is not None and bs.bucket_bits > 0
 
     out = join_n1(probe, bs, [col("k", T.BIGINT)], [], [], kind="semi")

@@ -47,6 +47,13 @@ GATED = (
     # path misses either cache, so the gate catches a broken fast path
     # as well as a slow one
     "plan_cache_hit",
+    # hash-relational kernels (PR 11): join_build/join_probe_n1 measure
+    # the engine-default hash-table path (floors raised ~3x over the
+    # BENCH_r05 sorted-layout rates); the pallas_* rows pin the kernel
+    # family in isolation (build insert, first-match probe, hash-slot
+    # group-by) so a default-path change can't silently shelve them
+    "join_build", "join_probe_n1",
+    "pallas_join_build", "pallas_join_probe", "pallas_groupby_hash",
 )
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
