@@ -4,6 +4,8 @@
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import sys
 import time
 
@@ -45,6 +47,11 @@ def main(argv=None) -> int:
         "--all", action="store_true",
         help="with --check: print baselined findings too",
     )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="with --check: machine-readable result on stdout "
+        "(tools/bench_gate.py and CI consume this)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_passes:
@@ -71,6 +78,22 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     result = run_check(args.root, args.baseline, passes)
     dt = time.monotonic() - t0
+    if args.json:
+        by_rule: dict = {}
+        for f in result.new:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        payload = {
+            "ok": result.ok,
+            "elapsed_s": round(dt, 3),
+            "new": [dataclasses.asdict(f) for f in result.new],
+            "new_by_rule": by_rule,
+            "baselined": len(result.baselined),
+            "expired": len(result.expired),
+            "total": len(result.all_findings),
+            "passes": [p.name for p in (passes or ALL_PASSES)],
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 0 if result.ok else 1
     if args.all:
         for f in result.baselined:
             print(f"{f.render()}  [baselined]")

@@ -172,33 +172,39 @@ _SKIP_DIRS = {"__pycache__"}
 
 def load_project(
     repo_root: Optional[os.PathLike] = None,
-    package: str = "presto_tpu",
+    packages: Sequence[str] = ("presto_tpu", "tests"),
 ) -> Project:
-    """Parse every .py under `package` (relative paths keyed off the repo
-    root, so findings read `presto_tpu/ops/sort.py:296`)."""
+    """Parse every .py under each of `packages` (relative paths keyed off
+    the repo root, so findings read `presto_tpu/ops/sort.py:296`). The
+    test tree loads alongside the package so the tracing/exception passes
+    can lint test helpers too (PR 2's deadlock came from an unguarded
+    `pure_callback` in a test helper); passes opt in per prefix."""
     root = Path(
         repo_root
         if repo_root is not None
         else Path(__file__).resolve().parents[2]
     )
     files: List[SourceFile] = []
-    base = root / package
-    for dirpath, dirnames, filenames in os.walk(base):
-        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            ap = os.path.join(dirpath, name)
-            rel = Path(ap).relative_to(root).as_posix()
-            with open(ap, "r", encoding="utf-8") as fh:
-                text = fh.read()
-            try:
-                files.append(SourceFile(rel, ap, text))
-            except SyntaxError as exc:
-                # a file that doesn't parse is itself a finding-worthy
-                # state, but the loader can't represent it as a pass
-                # result — surface it loudly instead of skipping
-                raise RuntimeError(f"prestolint: {rel} failed to parse: {exc}")
+    for package in packages:
+        base = root / package
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                ap = os.path.join(dirpath, name)
+                rel = Path(ap).relative_to(root).as_posix()
+                with open(ap, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+                try:
+                    files.append(SourceFile(rel, ap, text))
+                except SyntaxError as exc:
+                    # a file that doesn't parse is itself a finding-worthy
+                    # state, but the loader can't represent it as a pass
+                    # result — surface it loudly instead of skipping
+                    raise RuntimeError(
+                        f"prestolint: {rel} failed to parse: {exc}"
+                    )
     return Project(root, files)
 
 
@@ -305,7 +311,10 @@ def load_baseline(path: os.PathLike) -> Dict[str, dict]:
     if not p.exists():
         return {}
     data = json.loads(p.read_text())
-    return {e["fingerprint"]: e for e in data.get("findings", [])}
+    # v2 keeps test-tree findings in their own section so the package
+    # burndown stays readable; both sections share one fingerprint space
+    entries = data.get("findings", []) + data.get("tests_findings", [])
+    return {e["fingerprint"]: e for e in entries}
 
 
 def save_baseline(
@@ -332,7 +341,11 @@ def save_baseline(
         entries + list(keep),
         key=lambda e: (e["file"], e["rule"], e["message"], e["fingerprint"]),
     )
-    payload = {"version": 1, "findings": entries}
+    pkg = [e for e in entries if not e["file"].startswith("tests/")]
+    tst = [e for e in entries if e["file"].startswith("tests/")]
+    payload = {"version": 2, "findings": pkg}
+    if tst:
+        payload["tests_findings"] = tst
     Path(path).write_text(json.dumps(payload, indent=1) + "\n")
 
 

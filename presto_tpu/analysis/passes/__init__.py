@@ -1,13 +1,25 @@
 """prestolint pass registry. Import order is report order."""
 
-from . import exceptions, exhaustive, locks, memory, tracing
+from . import (
+    coverage,
+    exceptions,
+    exhaustive,
+    knobs,
+    locks,
+    memory,
+    races,
+    tracing,
+)
 
 ALL_PASSES = (
     tracing.PASS,
     locks.PASS,
+    races.PASS,
     exceptions.PASS,
     exhaustive.PASS,
     memory.PASS,
+    knobs.PASS,
+    coverage.PASS,
 )
 
 PASSES_BY_NAME = {p.name: p for p in ALL_PASSES}

@@ -1,0 +1,323 @@
+"""observability-coverage: breakers degrade visibly, stats stay reachable.
+
+The ROADMAP guardrail is that degraded execution must be OBSERVABLE:
+every kernel behind a `KernelCircuitBreaker` needs a fallback the
+breaker can route to, and every counter object needs a path to the
+EXPLAIN ANALYZE / snapshot surface — otherwise a new subsystem ships
+dark and the first sign of trouble is a soak-test diff. Four rules:
+
+breaker-no-fallback (error)
+    A breaker name whose `BREAKERS.allow(name)` decision never gates a
+    branch. Calling `allow()` and ignoring the result (or only ever
+    calling `record_*`) means the breaker can open but execution never
+    actually routes to a fallback — the circuit breaks nothing. The
+    decision counts as consumed when it appears in an `if`/`while`/
+    ternary test, is assigned to a variable, is returned, or the name
+    goes through the `_kernel_guarded`/`_run_packed` wrappers (which
+    fall back by construction).
+
+breaker-undocumented (error)
+    A breaker name absent from the docs/fault-tolerance.md breaker
+    catalog (and docs/tuning.md) — the table that names each kernel
+    path and its fallback is the operator-facing strategy mention.
+
+stats-not-snapshotted (error)
+    A `*Stats` class under exec/ or server/ that no snapshot surface
+    consumes: nothing calls `.snapshot()` on an instance of it and its
+    name never appears in a stats/snapshot/explain/summary-named
+    function outside the class itself.
+
+cache-not-snapshotted (error)
+    A module-level `*Cache` instance in exec/qcache.py missing from
+    `snapshot_all()` — the one aggregation point EXPLAIN ANALYZE and
+    the server stats endpoints read."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (
+    AnalysisPass,
+    Finding,
+    Project,
+    dotted_name,
+    iter_scoped_defs,
+)
+from .locks import _attr_classes
+
+_REGISTRY_METHODS = {
+    "allow", "record_failure", "record_success", "forced_fallback",
+}
+_WRAPPERS = {"_kernel_guarded", "_run_packed"}
+_BREAKER_DOCS = ("docs/fault-tolerance.md", "docs/tuning.md")
+_QCACHE_FILE = "presto_tpu/exec/qcache.py"
+_SNAPSHOT_ALL = "snapshot_all"
+_SURFACE_TOKENS = ("snapshot", "stats", "status", "explain", "summary")
+_STATS_SCOPES = ("presto_tpu/exec/", "presto_tpu/server/")
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class ObservabilityCoveragePass(AnalysisPass):
+    name = "observability-coverage"
+    description = "breaker fallback/doc coverage; stats snapshot reach"
+    rules = (
+        "breaker-no-fallback",
+        "breaker-undocumented",
+        "stats-not-snapshotted",
+        "cache-not-snapshotted",
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        findings += self._check_breakers(project)
+        findings += self._check_stats_classes(project)
+        findings += self._check_qcache_globals(project)
+        return findings
+
+    # -- breakers ------------------------------------------------------------
+
+    def _check_breakers(self, project: Project) -> List[Finding]:
+        # name -> [(file, line)], plus whether fallback evidence exists
+        sites: Dict[str, List[Tuple[str, int]]] = {}
+        has_fallback: Set[str] = set()
+        has_allow: Set[str] = set()
+
+        for sf in project.iter_files("presto_tpu/"):
+            # expression positions where a decision gates a branch:
+            # if/while/ternary tests, assignment values, return values
+            gated: Set[int] = set()
+            for node in ast.walk(sf.tree):
+                roots = []
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    roots = [node.test]
+                elif isinstance(node, ast.Assign):
+                    roots = [node.value]
+                elif isinstance(node, (ast.Return, ast.AnnAssign)):
+                    if getattr(node, "value", None) is not None:
+                        roots = [node.value]
+                for r in roots:
+                    for sub in ast.walk(r):
+                        gated.add(id(sub))
+
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    # wrapper helpers called as bare names
+                    tail = dotted_name(node.func)
+                else:
+                    tail = node.func.attr
+                if tail in _WRAPPERS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _WRAPPERS
+                ):
+                    for arg in node.args:
+                        s = _const_str(arg)
+                        if s:
+                            sites.setdefault(s, []).append(
+                                (sf.rel, node.lineno)
+                            )
+                            has_fallback.add(s)
+                            has_allow.add(s)
+                            break
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                if node.func.attr not in _REGISTRY_METHODS:
+                    continue
+                recv = dotted_name(node.func.value)
+                if recv.split(".")[-1] != "BREAKERS":
+                    continue
+                name = _const_str(node.args[0]) if node.args else None
+                if name is None:
+                    continue
+                sites.setdefault(name, []).append((sf.rel, node.lineno))
+                if node.func.attr == "allow":
+                    has_allow.add(name)
+                    if id(node) in gated:
+                        has_fallback.add(name)
+
+        documented = ""
+        for rel in _BREAKER_DOCS:
+            path = project.root / rel
+            if path.exists():
+                documented += path.read_text(encoding="utf-8")
+
+        findings: List[Finding] = []
+        for name in sorted(sites):
+            f, ln = sorted(sites[name])[0]
+            if name not in has_fallback:
+                why = (
+                    "allow() result never gates a branch"
+                    if name in has_allow
+                    else "no allow() gate anywhere — only record_* calls"
+                )
+                findings.append(
+                    Finding(
+                        "breaker-no-fallback", "error", f, ln,
+                        f"breaker '{name}' has no reachable fallback "
+                        f"branch ({why})",
+                    )
+                )
+            if f"`{name}`" not in documented and name not in documented:
+                findings.append(
+                    Finding(
+                        "breaker-undocumented", "error", f, ln,
+                        f"breaker '{name}' missing from the "
+                        f"{_BREAKER_DOCS[0]} fallback catalog",
+                    )
+                )
+        return findings
+
+    # -- *Stats classes ------------------------------------------------------
+
+    def _check_stats_classes(self, project: Project) -> List[Finding]:
+        attr_cls = _attr_classes(project)
+
+        # every *Stats class defined under the runtime scopes
+        stats_classes: Dict[str, Tuple[str, int]] = {}
+        for sf in project.iter_files("presto_tpu/"):
+            if not sf.rel.startswith(_STATS_SCOPES):
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name.endswith(
+                    "Stats"
+                ):
+                    stats_classes.setdefault(
+                        node.name, (sf.rel, node.lineno)
+                    )
+
+        surfaced: Set[str] = set()
+        for sf in project.iter_files("presto_tpu/"):
+            for fn, cnode in iter_scoped_defs(sf.tree.body):
+                cls = cnode.name if cnode is not None else None
+                # (b) class named inside a stats/snapshot/explain/...
+                # function that is not one of its own methods
+                fn_is_surface = any(
+                    t in fn.name for t in _SURFACE_TOKENS
+                )
+                # local/param typing for (a): v = CStats() assigns and
+                # `x: CStats` annotations inside this function
+                typed: Dict[str, str] = {}
+                for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+                    ann = arg.annotation
+                    if ann is None:
+                        continue
+                    t = _const_str(ann) or dotted_name(ann)
+                    t = t.split(".")[-1].strip("'\"")
+                    if t in stats_classes:
+                        typed[arg.arg] = t
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call
+                    ):
+                        ctor = dotted_name(node.value.func).split(".")[-1]
+                        if ctor in stats_classes:
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    typed[t.id] = ctor
+                    if (
+                        fn_is_surface
+                        and isinstance(node, ast.Name)
+                        and node.id in stats_classes
+                        and cls != node.id
+                    ):
+                        surfaced.add(node.id)
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "snapshot"
+                    ):
+                        recv = node.func.value
+                        rcls = None
+                        if isinstance(recv, ast.Name):
+                            rcls = typed.get(recv.id)
+                        elif (
+                            isinstance(recv, ast.Attribute)
+                            and isinstance(recv.value, ast.Name)
+                            and recv.value.id == "self"
+                            and cls is not None
+                        ):
+                            rcls = attr_cls.get((sf.rel, cls), {}).get(
+                                recv.attr
+                            )
+                        if rcls in stats_classes:
+                            surfaced.add(rcls)
+            # module-level globals: G = CStats(); G.snapshot() elsewhere
+            mod_typed: Dict[str, str] = {}
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    ctor = dotted_name(node.value.func).split(".")[-1]
+                    if ctor in stats_classes:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                mod_typed[t.id] = ctor
+            if mod_typed:
+                for node in ast.walk(sf.tree):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "snapshot"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in mod_typed
+                    ):
+                        surfaced.add(mod_typed[node.func.value.id])
+
+        return [
+            Finding(
+                "stats-not-snapshotted", "error", rel, line,
+                f"{name} is not reachable from any snapshot/stats/"
+                f"explain surface — its counters are write-only",
+                name,
+            )
+            for name, (rel, line) in sorted(stats_classes.items())
+            if name not in surfaced
+        ]
+
+    # -- qcache globals ------------------------------------------------------
+
+    def _check_qcache_globals(self, project: Project) -> List[Finding]:
+        sf = project.file(_QCACHE_FILE)
+        if sf is None:
+            return []
+        caches: Dict[str, int] = {}
+        snap_fn = None
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                ctor = dotted_name(node.value.func).split(".")[-1]
+                if ctor.endswith("Cache"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            caches.setdefault(t.id, node.lineno)
+            elif (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == _SNAPSHOT_ALL
+            ):
+                snap_fn = node
+        referenced: Set[str] = set()
+        if snap_fn is not None:
+            for node in ast.walk(snap_fn):
+                if isinstance(node, ast.Name):
+                    referenced.add(node.id)
+        return [
+            Finding(
+                "cache-not-snapshotted", "error", _QCACHE_FILE, line,
+                f"{name} missing from {_SNAPSHOT_ALL}() — EXPLAIN "
+                f"ANALYZE and the stats endpoints cannot see it",
+            )
+            for name, line in sorted(caches.items())
+            if name not in referenced
+        ]
+
+
+PASS = ObservabilityCoveragePass()

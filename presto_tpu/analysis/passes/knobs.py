@@ -1,0 +1,302 @@
+"""knob-consistency: every PRESTO_TPU_* env knob parses once, docs match.
+
+The tuning surface (docs/tuning.md) is part of the serving contract:
+operators set knobs from the docs, and a knob that is parsed in two
+modules with two defaults silently configures half the fleet. This pass
+pins code/doc parity both ways:
+
+knob-multi-parse (error)
+    One `PRESTO_TPU_*` name is parsed (read WITH a default, directly or
+    through an env helper) at more than one site. A knob gets exactly
+    one parse site — a module-level helper or constant that everything
+    else imports — so a default change cannot diverge by file.
+
+knob-undocumented (error)
+    A knob read in code but absent from docs/tuning.md and
+    docs/static-analysis.md. New knobs ship documented or not at all.
+
+knob-near-miss (error)
+    A name within edit distance 1 of a known knob, on either side: code
+    reads a name the docs never mention but a documented knob is one
+    typo away, or the docs describe a name the code never reads but a
+    parsed knob is one typo away. Both are almost always typos, and a
+    typo'd env read fails silent — the default always wins.
+
+knob-stale-doc (warning)
+    A documented knob no code reads or writes any more. Stale docs send
+    operators chasing a control that no longer exists.
+
+Reads WITHOUT a default (`os.environ.get(name)` one-arg, subscripts,
+`in os.environ` membership) are save/restore probes, not parse sites —
+the benchmark harness snapshots and restores knobs this way — and env
+WRITES (`os.environ[k] = v`, setdefault, pop) never count as parsing.
+Env-helper calls count as parse sites when the helper is a module-level
+function anywhere in the tree whose body reads `os.environ`."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, Finding, Project, dotted_name
+
+_PREFIX = "PRESTO_TPU_"
+_DOC_FILES = ("docs/tuning.md", "docs/static-analysis.md")
+_DOC_RE = re.compile(r"PRESTO_TPU_[A-Z0-9_]+")
+
+
+def _edit_distance_1(a: str, b: str) -> bool:
+    """True when a != b and one substitution/insertion/deletion maps
+    a -> b. Cheap specialized check — no DP table needed for d<=1."""
+    if a == b:
+        return False
+    la, lb = len(a), len(b)
+    if abs(la - lb) > 1:
+        return False
+    if la == lb:
+        return sum(x != y for x, y in zip(a, b)) == 1
+    if la > lb:
+        a, b, la, lb = b, a, lb, la
+    # a is shorter by one: b must equal a with one char inserted
+    i = 0
+    while i < la and a[i] == b[i]:
+        i += 1
+    return a[i:] == b[i + 1:]
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _env_helpers(project: Project) -> Set[str]:
+    """Names of module-level functions whose body touches os.environ —
+    `_env_int`-style parse helpers, matched by bare name at call sites."""
+
+    def build(p: Project):
+        out: Set[str] = set()
+        for sf in p.iter_files("presto_tpu/"):
+            for node in sf.tree.body:
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                for sub in ast.walk(node):
+                    name = dotted_name(sub) if isinstance(
+                        sub, ast.Attribute
+                    ) else ""
+                    if name.startswith("os.environ") or name == "os.getenv":
+                        out.add(node.name)
+                        break
+        return out
+
+    return project.symbol("env_helpers", build)
+
+
+class KnobConsistencyPass(AnalysisPass):
+    name = "knob-consistency"
+    description = "PRESTO_TPU_* knobs: one parse site, doc parity, typos"
+    rules = (
+        "knob-multi-parse",
+        "knob-undocumented",
+        "knob-near-miss",
+        "knob-stale-doc",
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        helpers = _env_helpers(project)
+        # knob -> [(file, line, default-repr)]
+        parse_sites: Dict[str, List[Tuple[str, int, str]]] = {}
+        reads: Dict[str, List[Tuple[str, int]]] = {}  # incl. probes
+        writes: Dict[str, List[Tuple[str, int]]] = {}
+
+        for sf in project.iter_files("presto_tpu/"):
+            # `env = os.environ.get` aliases (module or function scope)
+            aliases: Set[str] = set()
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Assign) and dotted_name(
+                    node.value
+                ) in ("os.environ.get", "os.getenv"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    fname = dotted_name(node.func)
+                    tail = fname.split(".")[-1]
+                    first = _const_str(node.args[0]) if node.args else None
+                    if first is None or not first.startswith(_PREFIX):
+                        # os.environ.setdefault/pop with knob first arg
+                        continue
+                    if fname in ("os.environ.get", "os.getenv") or (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id in aliases
+                    ):
+                        reads.setdefault(first, []).append(
+                            (sf.rel, node.lineno)
+                        )
+                        if len(node.args) >= 2:
+                            parse_sites.setdefault(first, []).append(
+                                (
+                                    sf.rel,
+                                    node.lineno,
+                                    self._default_repr(node.args[1]),
+                                )
+                            )
+                    elif fname in (
+                        "os.environ.setdefault", "os.environ.pop",
+                    ):
+                        writes.setdefault(first, []).append(
+                            (sf.rel, node.lineno)
+                        )
+                    elif (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id in helpers
+                    ):
+                        reads.setdefault(first, []).append(
+                            (sf.rel, node.lineno)
+                        )
+                        parse_sites.setdefault(first, []).append(
+                            (
+                                sf.rel,
+                                node.lineno,
+                                self._default_repr(
+                                    node.args[1]
+                                    if len(node.args) >= 2
+                                    else None
+                                ),
+                            )
+                        )
+                elif isinstance(node, ast.Subscript):
+                    if dotted_name(node.value) != "os.environ":
+                        continue
+                    key = _const_str(
+                        node.slice.value
+                        if isinstance(node.slice, ast.Index)  # py<3.9
+                        else node.slice
+                    )
+                    if key is None or not key.startswith(_PREFIX):
+                        continue
+                    if isinstance(node.ctx, (ast.Store, ast.Del)):
+                        writes.setdefault(key, []).append(
+                            (sf.rel, node.lineno)
+                        )
+                    else:
+                        reads.setdefault(key, []).append(
+                            (sf.rel, node.lineno)
+                        )
+                elif isinstance(node, ast.Compare):
+                    # `"PRESTO_TPU_X" in os.environ` membership probe
+                    if any(
+                        dotted_name(c) == "os.environ"
+                        for c in node.comparators
+                    ):
+                        key = _const_str(node.left)
+                        if key and key.startswith(_PREFIX):
+                            reads.setdefault(key, []).append(
+                                (sf.rel, node.lineno)
+                            )
+
+        documented: Dict[str, Tuple[str, int]] = {}
+        for rel in _DOC_FILES:
+            path = project.root / rel
+            if not path.exists():
+                continue
+            for i, line in enumerate(
+                path.read_text(encoding="utf-8").splitlines(), start=1
+            ):
+                for m in _DOC_RE.finditer(line):
+                    name = m.group(0)
+                    if name.endswith("_"):
+                        # family wildcard ("PRESTO_TPU_BREAKER_*"), not
+                        # a knob name — the member knobs stand alone
+                        continue
+                    documented.setdefault(name, (rel, i))
+
+        findings: List[Finding] = []
+        known = set(parse_sites) | set(documented)
+
+        for knob in sorted(parse_sites):
+            sites = sorted(parse_sites[knob])
+            if len(sites) > 1:
+                desc = ", ".join(
+                    f"{f} (default {d})" for f, _ln, d in sites
+                )
+                findings.append(
+                    Finding(
+                        "knob-multi-parse", "error",
+                        sites[0][0], sites[0][1],
+                        f"{knob} parsed at {len(sites)} sites — one "
+                        f"module-level parse site per knob: {desc}",
+                    )
+                )
+
+        near_pairs: set = set()
+        for knob in sorted(reads):
+            if knob in documented:
+                continue
+            near = sorted(
+                d for d in documented if _edit_distance_1(knob, d)
+            )
+            f, ln = sorted(reads[knob])[0]
+            if near:
+                near_pairs.add(frozenset((knob, near[0])))
+                findings.append(
+                    Finding(
+                        "knob-near-miss", "error", f, ln,
+                        f"{knob} read in code but undocumented — one "
+                        f"edit away from documented {near[0]} (typo?)",
+                    )
+                )
+            else:
+                findings.append(
+                    Finding(
+                        "knob-undocumented", "error", f, ln,
+                        f"{knob} read in code but absent from "
+                        f"{' and '.join(_DOC_FILES)}",
+                    )
+                )
+
+        code_names = set(reads) | set(writes)
+        for knob in sorted(documented):
+            if knob in code_names:
+                continue
+            rel, ln = documented[knob]
+            near = sorted(
+                c for c in code_names if _edit_distance_1(knob, c)
+            )
+            if near:
+                # one finding per typo pair: the code-side report above
+                # already covers (code_name, doc_name)
+                if frozenset((knob, near[0])) not in near_pairs:
+                    findings.append(
+                        Finding(
+                            "knob-near-miss", "error", rel, ln,
+                            f"{knob} documented but never read — one "
+                            f"edit away from code knob {near[0]} "
+                            f"(typo?)",
+                        )
+                    )
+            else:
+                findings.append(
+                    Finding(
+                        "knob-stale-doc", "warning", rel, ln,
+                        f"{knob} documented in {rel} but no code reads "
+                        f"or writes it",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _default_repr(node) -> str:
+        if node is None:
+            return "<none>"
+        if isinstance(node, ast.Constant):
+            return repr(node.value)
+        return "<dynamic>"
+
+
+PASS = KnobConsistencyPass()

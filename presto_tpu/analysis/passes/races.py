@@ -1,0 +1,532 @@
+"""guarded-field races: mutations of lock-guarded attrs outside the lock.
+
+The locks pass checks what happens WHILE a lock is held; this pass
+checks that shared state is not touched WITHOUT one. For every class
+that creates a `threading.Lock/RLock/Condition`, it infers which lock
+guards each `self.<attr>` by majority-of-accesses — an attr read or
+written under `with self._lock` in two or more distinct methods is
+"guarded by" that lock — and then flags every mutation of a guarded
+attr made outside it:
+
+race-unguarded-mutation (error)
+    An assignment, aug-assign, `del`, subscript store, or mutating
+    container-method call (`append`/`pop`/`update`/...) on a guarded
+    attr with the guard not held. Mutations inside nested defs and
+    lambdas are analyzed with an EMPTY held set (a callback built under
+    a lock does not run under it), which is exactly how a thread target
+    that scribbles on shared state gets caught. Passing a guarded
+    container into `submit()`/`Thread(...)` outside the lock is also
+    flagged — publication hands the object to another thread with no
+    happens-before edge.
+
+Inference reuses the locks pass's machinery: lock identity is the
+inheritance-resolved `DefiningClass.attr` (so a subclass method holding
+the base's condition counts), `iter_scoped_defs` walks the same scope
+shapes, and one level of call-graph propagation whitelists `_locked`
+-style helpers whose every in-class call site holds the guard.
+
+Cross-object writes get one level of the same treatment: a mutation
+reached through `self.X.Y...` where `self.X = SomeClass(...)` and `Y`
+is guarded inside SomeClass is flagged too — `self.scheduler.stats.x =
+v` from a class that never takes the scheduler's lock races every
+scheduler thread that mutates `stats` under it. Holding the foreign
+lock the chained way (`with self.manager._lock:`) is resolved through
+the same attribute-type table, and method CALLS on a foreign object
+are never flagged (the method synchronizes internally); only direct
+field writes and container-mutator calls reach through.
+
+`__init__` is exempt (construction happens-before publication of self),
+attrs whose value is itself a lock/queue/thread/future/threading.local
+are skipped (those types carry their own synchronization), and reads
+outside the lock are deliberately NOT flagged — a torn stats read is a
+display glitch, not a corruption. Intentional benign races are
+suppressed at the site with:
+
+    # prestolint: unguarded(attr) -- reason
+
+which documents the claim next to the code it covers."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (
+    AnalysisPass,
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+    iter_scoped_defs,
+)
+from ..symbols import attr_kinds
+from .locks import _attr_classes
+
+# container mutators: calling one of these ON a guarded attr mutates it
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "popleft",
+    "sort", "reverse", "__setitem__", "__delitem__",
+}
+# calling one of these PUBLISHES its arguments to another thread
+_PUBLISHERS = {"submit", "Thread", "start_new_thread", "run_in_executor"}
+# attr value kinds that synchronize themselves — never inferred as state
+_SELF_SYNC_KINDS = {"lock", "queue", "thread", "future", "tls"}
+
+_MARKER_FMT = "prestolint: unguarded({attr})"
+
+
+@dataclasses.dataclass
+class Access:
+    scope: str  # method name, dotted for nested defs ("flush.cb")
+    held: Tuple[str, ...]  # lock ids held at the access site
+    mutates: bool
+    publishes: bool  # guarded attr passed into a thread/executor call
+    line: int
+
+
+@dataclasses.dataclass
+class ClassRecord:
+    file: str
+    cls: str
+    accesses: Dict[str, List[Access]] = dataclasses.field(
+        default_factory=dict
+    )
+    # method -> held sets at every `self.m()` call site inside the class
+    call_sites: Dict[str, List[Tuple[str, ...]]] = dataclasses.field(
+        default_factory=dict
+    )
+    # methods handed to a thread/executor as `self.m` — their bodies run
+    # on any thread, so call-site lock propagation is off for them
+    escaped: Set[str] = dataclasses.field(default_factory=set)
+    # (obj_attr, field, scope, held, line): mutations reaching THROUGH
+    # `self.X.Y...` — checked against type(X)'s inferred guards
+    foreign: List[Tuple[str, str, str, Tuple[str, ...], int]] = (
+        dataclasses.field(default_factory=list)
+    )
+
+
+def _base_self_attr(expr) -> Optional[str]:
+    """`self.a`, `self.a.b`, `self.a[k]...` -> 'a'; else None."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        inner = expr.value
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(inner, ast.Name)
+            and inner.id == "self"
+        ):
+            return expr.attr
+        expr = inner
+    return None
+
+
+def _self_spine(expr) -> List[str]:
+    """Pure-attribute spine rooted at self: `self.a.b.c` ->
+    ['a', 'b', 'c']; [] when not self-rooted or broken by a subscript.
+    Cross-object guard checks need the SECOND hop (`self.X.Y`), and a
+    subscript between self and Y would retype the object mid-chain."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name) and expr.id == "self":
+        return list(reversed(parts))
+    return []
+
+
+class GuardedFieldPass(AnalysisPass):
+    name = "guarded-fields"
+    description = "mutations of lock-guarded attrs outside the lock"
+    rules = ("race-unguarded-mutation",)
+
+    def run(self, project: Project) -> List[Finding]:
+        kinds = attr_kinds(project)
+        cls_attr: Dict[str, Dict[str, str]] = {}
+        cls_bases: Dict[str, List[str]] = {}
+        for sf in project.files:
+            for cname, attrs in kinds[sf.rel].classes.items():
+                m = cls_attr.setdefault(cname, {})
+                for a, k in attrs.items():
+                    m.setdefault(a, k)
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    cls_bases.setdefault(
+                        node.name,
+                        [dotted_name(b).split(".")[-1] for b in node.bases],
+                    )
+
+        def resolve_attr(cls: Optional[str], attr: str):
+            queue, seen = [cls] if cls else [], set()
+            while queue:
+                cur = queue.pop(0)
+                if cur in seen or cur is None:
+                    continue
+                seen.add(cur)
+                if attr in cls_attr.get(cur, {}):
+                    return cur, cls_attr[cur][attr]
+                queue.extend(cls_bases.get(cur, []))
+            return None, None
+
+        # class name -> {attr: ClassName}, merged across files, for
+        # typing `self.X.Y` chains (first definition wins on collision)
+        attr_cls = _attr_classes(project)
+        cls_attr_types: Dict[str, Dict[str, str]] = {}
+        for (_f, c), m in sorted(attr_cls.items()):
+            tgt = cls_attr_types.setdefault(c, {})
+            for a, t in m.items():
+                tgt.setdefault(a, t)
+
+        records: List[ClassRecord] = []
+        for sf in project.iter_files("presto_tpu/"):
+            records.extend(
+                self._collect_file(sf, resolve_attr, cls_attr_types)
+            )
+        return self._infer_and_report(
+            project, records, resolve_attr, attr_cls
+        )
+
+    # -- phase A: per-class access collection --------------------------------
+
+    def _collect_file(self, sf: SourceFile, resolve_attr, cls_attr_types):
+        by_cls: Dict[str, ClassRecord] = {}
+
+        def lock_id(expr, cls) -> Optional[str]:
+            if not isinstance(expr, ast.Attribute):
+                return None
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                owner, kind = resolve_attr(cls, expr.attr)
+                if kind == "lock":
+                    return f"{owner}.{expr.attr}"
+                return None
+            # chained receiver: `with self.manager._lock:` — type the
+            # spine through the attribute-class table
+            spine = _self_spine(expr.value)
+            if spine:
+                cur = cls
+                for a in spine:
+                    cur = cls_attr_types.get(cur, {}).get(a)
+                    if cur is None:
+                        return None
+                owner, kind = resolve_attr(cur, expr.attr)
+                if kind == "lock":
+                    return f"{owner}.{expr.attr}"
+            return None
+
+        def record(rec, attr, scope, held, line, mutates, publishes=False):
+            rec.accesses.setdefault(attr, []).append(
+                Access(scope, tuple(held), mutates, publishes, line)
+            )
+
+        def note_foreign(rec, expr, scope, held, line):
+            """Mutation target/receiver reaching through `self.X.Y`."""
+            while isinstance(expr, ast.Subscript):
+                expr = expr.value
+            spine = _self_spine(expr)
+            if len(spine) >= 2:
+                rec.foreign.append(
+                    (spine[0], spine[1], scope, tuple(held), line)
+                )
+
+        def scan_expr(top, rec, cls, scope, held):
+            """Reads, mutating calls, in-class call sites and
+            publications inside one expression. Lambdas are deferred
+            execution: their bodies re-scan with an empty held set."""
+            stack = [(top, tuple(held))]
+            no_read: Set[int] = set()  # Attribute nodes that are call
+            while stack:  # targets, not data reads
+                node, h = stack.pop()
+                if isinstance(node, ast.Lambda):
+                    stack.append((node.body, ()))
+                    continue
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.Call):
+                    tail = (
+                        node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else dotted_name(node.func)
+                    )
+                    if isinstance(node.func, ast.Attribute):
+                        fv = node.func.value
+                        if isinstance(fv, ast.Name) and fv.id == "self":
+                            # `self.m(...)`: a call site for lock
+                            # propagation, not a data read of `m`
+                            rec.call_sites.setdefault(
+                                node.func.attr, []
+                            ).append(h)
+                            no_read.add(id(node.func))
+                        else:
+                            base = _base_self_attr(fv)
+                            if base is not None and tail in _MUTATORS:
+                                record(
+                                    rec, base, scope, h, node.lineno, True
+                                )
+                                note_foreign(
+                                    rec, fv, scope, h, node.lineno
+                                )
+                    if tail in _PUBLISHERS:
+                        args = list(node.args) + [
+                            k.value for k in node.keywords
+                        ]
+                        flat = []
+                        for a in args:
+                            if isinstance(a, (ast.Tuple, ast.List)):
+                                flat.extend(a.elts)
+                            else:
+                                flat.append(a)
+                        for a in flat:
+                            if (
+                                isinstance(a, ast.Attribute)
+                                and isinstance(a.value, ast.Name)
+                                and a.value.id == "self"
+                            ):
+                                # `self.x` handed to another thread:
+                                # treat as both an escape of the method
+                                # name and a publication of the attr
+                                rec.escaped.add(a.attr)
+                                record(
+                                    rec, a.attr, scope, h, node.lineno,
+                                    False, publishes=True,
+                                )
+                                no_read.add(id(a))
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and id(node) not in no_read
+                ):
+                    record(rec, node.attr, scope, h, node.lineno, False)
+                for c in ast.iter_child_nodes(node):
+                    stack.append((c, h))
+
+        def scan_stmt(stmt, rec, cls, scope, held):
+            """Simple-statement classification: mutation targets first,
+            then reads in the value expressions."""
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+
+                def note_target(t):
+                    a = _base_self_attr(t)
+                    if a is None:
+                        return
+                    record(rec, a, scope, held, stmt.lineno, True)
+                    note_foreign(rec, t, scope, held, stmt.lineno)
+
+                for t in targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            note_target(el)
+                    else:
+                        note_target(t)
+                    # subscript/attr chains below the base still read
+                    # other attrs (self.a[self.k] = v) — scan indices
+                    for sub in ast.iter_child_nodes(t):
+                        if not isinstance(sub, ast.Name):
+                            scan_expr(sub, rec, cls, scope, held)
+                value = getattr(stmt, "value", None)
+                if value is not None:
+                    scan_expr(value, rec, cls, scope, held)
+                return
+            if isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    a = _base_self_attr(t)
+                    if a is not None:
+                        record(rec, a, scope, held, stmt.lineno, True)
+                        note_foreign(rec, t, scope, held, stmt.lineno)
+                return
+            scan_expr(stmt, rec, cls, scope, held)
+
+        def walk(stmts, rec, cls, scope, held):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # deferred execution: a nested def built here runs on
+                    # its own schedule — fresh held set, dotted scope
+                    walk(stmt.body, rec, cls, f"{scope}.{stmt.name}", ())
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    new = []
+                    for item in stmt.items:
+                        lid = lock_id(item.context_expr, cls)
+                        if lid is not None:
+                            new.append(lid)
+                        else:
+                            scan_expr(
+                                item.context_expr, rec, cls, scope, held
+                            )
+                    walk(stmt.body, rec, cls, scope, held + tuple(new))
+                    continue
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        walk(sub, rec, cls, scope, held)
+                for h in getattr(stmt, "handlers", ()):
+                    walk(h.body, rec, cls, scope, held)
+                if isinstance(stmt, (ast.If, ast.While)):
+                    scan_expr(stmt.test, rec, cls, scope, held)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_expr(stmt.iter, rec, cls, scope, held)
+                elif isinstance(stmt, (ast.Try, ast.ClassDef)):
+                    pass
+                else:
+                    scan_stmt(stmt, rec, cls, scope, held)
+
+        for fn, cnode in iter_scoped_defs(sf.tree.body):
+            # lock-less classes still collect: their cross-object writes
+            # are checked against the TARGET class's inferred guards
+            if cnode is None:
+                continue
+            rec = by_cls.setdefault(
+                cnode.name, ClassRecord(sf.rel, cnode.name)
+            )
+            walk(fn.body, rec, cnode.name, fn.name, ())
+
+        return list(by_cls.values())
+
+    # -- phase B: inference + report -----------------------------------------
+
+    def _infer_and_report(self, project, records, resolve_attr, attr_cls):
+        # call-site lock propagation per class: methods whose every
+        # in-class call site holds lock L run "as if" under L (the
+        # `_foo_locked` convention), disabled once the method escapes
+        # as a callback handle
+        assumed_by_rec: Dict[int, Dict[str, Set[str]]] = {}
+        for rec in records:
+            assumed: Dict[str, Set[str]] = {}
+            for m, sites in rec.call_sites.items():
+                if m in rec.escaped or not sites:
+                    continue
+                common = set(sites[0])
+                for s in sites[1:]:
+                    common &= set(s)
+                if common:
+                    assumed[m] = common
+            assumed_by_rec[id(rec)] = assumed
+
+        def eff_held(rec: ClassRecord, scope: str, held) -> Set[str]:
+            out = set(held)
+            root = scope.split(".")[0]
+            # propagation covers the method's direct body only — a
+            # nested def inside it still runs later, lock released
+            if scope == root:
+                out |= assumed_by_rec[id(rec)].get(root, set())
+            return out
+
+        # guard inference: majority-of-accesses, >=2 distinct methods
+        guards_by_rec: Dict[int, Dict[str, Tuple[str, int]]] = {}
+        for rec in records:
+            guards: Dict[str, Tuple[str, int]] = {}
+            for attr, accs in rec.accesses.items():
+                _owner, kind = resolve_attr(rec.cls, attr)
+                if kind in _SELF_SYNC_KINDS:
+                    continue
+                by_lock: Dict[str, Set[str]] = {}
+                for a in accs:
+                    if a.scope == "__init__":
+                        continue
+                    for lid in eff_held(rec, a.scope, a.held):
+                        by_lock.setdefault(lid, set()).add(
+                            a.scope.split(".")[0]
+                        )
+                cands = {
+                    lid: ms for lid, ms in by_lock.items() if len(ms) >= 2
+                }
+                if not cands:
+                    continue
+                best = max(len(ms) for ms in cands.values())
+                top = [
+                    lid for lid, ms in cands.items() if len(ms) == best
+                ]
+                if len(top) == 1:  # ambiguous guard: refuse to infer
+                    guards[attr] = (top[0], best)
+            guards_by_rec[id(rec)] = guards
+
+        # class-name view for cross-object checks; conflicting
+        # same-name classes (different files) drop the conflicted attr
+        guards_by_cls: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        for rec in records:
+            g = guards_by_rec[id(rec)]
+            if not g:
+                continue
+            cur = guards_by_cls.setdefault(rec.cls, {})
+            for a, info in g.items():
+                if a in cur and cur[a] != info:
+                    cur[a] = ("", 0)
+                else:
+                    cur.setdefault(a, info)
+
+        findings: List[Finding] = []
+        for rec in records:
+            sf = project.file(rec.file)
+            guards = guards_by_rec[id(rec)]
+
+            for attr, (guard, nmethods) in sorted(guards.items()):
+                marker = _MARKER_FMT.format(attr=attr)
+                for a in rec.accesses[attr]:
+                    if not (a.mutates or a.publishes):
+                        continue
+                    if guard in eff_held(rec, a.scope, a.held):
+                        continue
+                    if a.scope == "__init__":
+                        continue  # happens-before publication of self
+                    if sf is not None and sf.has_marker(a.line, marker):
+                        continue
+                    if a.publishes:
+                        what = (
+                            f"self.{attr} published into a thread/"
+                            f"executor callback outside {guard}"
+                        )
+                    elif "." in a.scope:
+                        what = (
+                            f"self.{attr} mutated in deferred callback "
+                            f"without {guard}"
+                        )
+                    else:
+                        what = f"self.{attr} mutated outside {guard}"
+                    findings.append(
+                        Finding(
+                            "race-unguarded-mutation", "error",
+                            rec.file, a.line,
+                            f"{what} (guarded by {guard} in "
+                            f"{nmethods} methods)",
+                            f"{rec.cls}.{a.scope}",
+                        )
+                    )
+
+            for x, y, scope, held, line in rec.foreign:
+                if scope == "__init__":
+                    continue
+                tcls = attr_cls.get((rec.file, rec.cls), {}).get(x)
+                if tcls is None or tcls == rec.cls:
+                    continue
+                info = guards_by_cls.get(tcls, {}).get(y)
+                if not info or not info[0]:
+                    continue
+                guard, nmethods = info
+                if guard in eff_held(rec, scope, held):
+                    continue
+                if sf is not None and (
+                    sf.has_marker(line, _MARKER_FMT.format(attr=y))
+                    or sf.has_marker(
+                        line, _MARKER_FMT.format(attr=f"{x}.{y}")
+                    )
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        "race-unguarded-mutation", "error",
+                        rec.file, line,
+                        f"self.{x}.{y} mutated outside {guard} "
+                        f"({tcls}.{y} is guarded by {guard} in "
+                        f"{nmethods} methods)",
+                        f"{rec.cls}.{scope}",
+                    )
+                )
+        return findings
+
+
+PASS = GuardedFieldPass()

@@ -187,8 +187,12 @@ class TracingSafetyPass(AnalysisPass):
 
     def run(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
-        for sf in project.iter_files("presto_tpu/"):
-            findings.extend(self._check_file(sf))
+        # tests/ is in scope too: a test that jits a host callback
+        # deadlocks CI the same way product code would (tests-only
+        # findings land in the baseline's tests_findings section)
+        for prefix in ("presto_tpu/", "tests/"):
+            for sf in project.iter_files(prefix):
+                findings.extend(self._check_file(sf))
         return findings
 
     def _check_file(self, sf: SourceFile) -> List[Finding]:

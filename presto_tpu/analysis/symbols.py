@@ -93,12 +93,14 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 _QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
 _THREAD_CTORS = {"Thread"}
 _FUTURE_SOURCES = {"Future", "submit"}  # Future() ctor or pool.submit(...)
+_TLS_CTORS = {"local"}  # threading.local() — per-thread, needs no lock
 
 
 def _ctor_kind(value: ast.AST) -> Optional[str]:
     if not isinstance(value, ast.Call):
         return None
-    tail = dotted_name(value.func).split(".")[-1]
+    name = dotted_name(value.func)
+    tail = name.split(".")[-1]
     if tail in _LOCK_CTORS:
         return "lock"
     if tail in _QUEUE_CTORS:
@@ -107,6 +109,8 @@ def _ctor_kind(value: ast.AST) -> Optional[str]:
         return "thread"
     if tail in _FUTURE_SOURCES:
         return "future"
+    if tail in _TLS_CTORS and name.split(".")[0] in ("threading", "local"):
+        return "tls"
     return None
 
 

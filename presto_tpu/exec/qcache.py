@@ -169,6 +169,16 @@ class LRUCache:
             self._data.clear()
             self.stats.bytes = 0
 
+    def reset(self) -> None:
+        """Drop every entry AND zero the counters. The stats swap must
+        happen under the lock: a concurrent store() holds the lock while
+        it increments stats.bytes, and swapping the object between its
+        insert and its increment strands the increment on the old stats
+        — leaving the NEW stats claiming 0 bytes for a non-empty map."""
+        with self._lock:
+            self._data.clear()
+            self.stats = CacheStats()
+
     def snapshot(self) -> dict:
         out = self.stats.snapshot()
         with self._lock:
@@ -516,5 +526,4 @@ def format_summary(snap: Dict[str, dict]) -> str:
 def reset_all() -> None:
     """Test hook: drop every cached entry AND zero the counters."""
     for c in (PLAN_CACHE, RESULT_CACHE, KERNEL_CACHE):
-        c.clear()
-        c.stats = CacheStats()
+        c.reset()

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..plan import nodes as N
 from ..plan.fragment import Exchange
+from . import knobs
 from .exchange import ExchangeClient, ExchangeError, ExchangeStats
 from .serde import WireStats, negotiate
 from .worker import (
@@ -361,7 +362,7 @@ class HttpScheduler:
         # worker (RUNNING forever, producing nothing) fails the pull
         # instead of hanging the coordinator — the round-5 relay stall
         self.task_deadline = (
-            float(env("PRESTO_TPU_TASK_DEADLINE_S", "300"))
+            knobs.task_deadline_s()
             if task_deadline is None else task_deadline
         )
         self.status_deadline = status_deadline
@@ -377,6 +378,20 @@ class HttpScheduler:
         self._lock = threading.Lock()
 
     # -- public --
+
+    def record_caches(self, snapshot: dict) -> None:
+        """Publish serving-cache counters into stats. Sessions call this
+        after every query, concurrent with worker status polls mutating
+        stats under _lock — the write must take the same lock."""
+        with self._lock:
+            self.stats.caches = snapshot
+
+    def stats_snapshot(self) -> dict:
+        """Point-in-time copy of SchedulerStats for EXPLAIN ANALYZE and
+        the stats surfaces; reading fields off the live object would
+        race the pollers mid-update."""
+        with self._lock:
+            return self.stats.snapshot()
 
     def run(self, root: N.PlanNode, query_id: Optional[str] = None):
         """Execute with bounded query-level re-execution: a retryable
@@ -1022,7 +1037,7 @@ class ClusterMemoryManager:
         self.on_kill = on_kill
         self.grace_polls = grace_polls  # sustained blockage before a kill
         self.revoke_watermark = (
-            float(os.environ.get("PRESTO_TPU_REVOKE_WATERMARK", "0.8"))
+            knobs.revoke_watermark()
             if revoke_watermark is None else revoke_watermark
         )
         self._blocked_streak = 0
@@ -1241,7 +1256,7 @@ class HttpClusterSession:
         if use_result_cache:
             hit = qcache.RESULT_CACHE.lookup(rkey, self.catalog)
             if hit is not None:
-                self.scheduler.stats.caches = qcache.snapshot_all()
+                self.scheduler.record_caches(qcache.snapshot_all())
                 return node, hit.page
             pre = qcache.RESULT_CACHE.preversions(node, self.catalog)
         page = self.scheduler.run(node, query_id=f"q_{next(self._query_ids)}")
@@ -1249,7 +1264,7 @@ class HttpClusterSession:
             qcache.RESULT_CACHE.store(
                 rkey, page, getattr(node, "titles", ()), self.catalog, pre
             )
-        self.scheduler.stats.caches = qcache.snapshot_all()
+        self.scheduler.record_caches(qcache.snapshot_all())
         return node, page
 
     def query(self, sql: str):
@@ -1269,14 +1284,14 @@ class HttpClusterSession:
         node, _page = self._run_fragmented(sql, use_result_cache=False)
         tree = N.plan_tree_str(node)
         lines = [tree]
-        st = self.scheduler.stats
-        if st.wire_caps:
+        st = self.scheduler.stats_snapshot()
+        if st["wire_caps"]:
             lines.append(
                 "-- wire: v%s, codecs %s"
-                % (st.wire_caps.get("version"),
-                   "/".join(st.wire_caps.get("codecs") or ()))
+                % (st["wire_caps"].get("version"),
+                   "/".join(st["wire_caps"].get("codecs") or ()))
             )
-        for sid, ex in sorted(st.exchange.items()):
+        for sid, ex in sorted(st["exchange"].items()):
             prod = ex.get("producer") or {}
             ratio = prod.get("compression_ratio")
             lines.append(
@@ -1292,18 +1307,18 @@ class HttpClusterSession:
                 f"{ex['decode_ms']}ms, pull peak {ex['peak_concurrent']} "
                 f"concurrent"
             )
-        if st.memory:
-            m = st.memory
+        if st["memory"]:
+            m = st["memory"]
             lines.append(
                 "-- memory: spill "
                 + ",".join(m.get("events") or ("none",))
                 + f", disk {m.get('spilled_bytes', 0):,}B, "
                 f"revocations {m.get('revocations', 0)}"
             )
-        if st.caches:
+        if st["caches"]:
             from ..exec import qcache
 
-            lines.append("-- caches: " + qcache.format_summary(st.caches))
+            lines.append("-- caches: " + qcache.format_summary(st["caches"]))
         return "\n".join(lines)
 
     def close(self):

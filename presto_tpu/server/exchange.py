@@ -42,6 +42,7 @@ import urllib.request
 from collections import deque
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from . import knobs
 from .serde import WireStats, deserialize_page
 
 
@@ -251,9 +252,7 @@ class ExchangeClient:
             DEFAULT_STAGING_BYTES if staging_bytes is None else staging_bytes
         )
         if deadline is None:
-            deadline = float(
-                os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600")
-            )
+            deadline = knobs.task_deadline_s()
         self.deadline = deadline
         self.concurrency = max(
             1, DEFAULT_CONCURRENCY if concurrency is None else concurrency

@@ -1,0 +1,34 @@
+"""Single parse sites for server-side PRESTO_TPU_* knobs.
+
+prestolint's knob-consistency pass enforces one parse site per knob:
+before this module, PRESTO_TPU_TASK_DEADLINE_S was parsed in three
+files with two different defaults (300 in the coordinator, 600 in the
+worker relay and the exchange client), so the coordinator abandoned a
+slow task stream at half the budget its own workers were still willing
+to wait — set the env var and the skew disappears, leave it unset and
+it silently configures the fleet two ways. Every server-side knob
+parses HERE, once, and callers import the function.
+
+Knobs are read per call, not cached at import: tests and the benchmark
+harness set/restore env vars around individual runs."""
+
+from __future__ import annotations
+
+import os
+
+
+def task_deadline_s() -> float:
+    """Progress deadline (seconds) for any single task results stream:
+    the wall time between pages before a puller declares the producer
+    wedged and fails retryably. Shared by the coordinator pull, the
+    worker relay pull, and the pipelined exchange client — one clock,
+    or the slowest link decides who gives up first."""
+    return float(os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600"))
+
+
+def revoke_watermark() -> float:
+    """Fraction of the memory limit at which revocation (offload/spill)
+    starts, shared by the worker-local memory pool and the cluster
+    memory manager — the two must agree or the cluster killer fires
+    before workers were asked to revoke."""
+    return float(os.environ.get("PRESTO_TPU_REVOKE_WATERMARK", "0.8"))

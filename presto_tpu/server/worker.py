@@ -33,6 +33,7 @@ from ..exec.stream import StreamingExecutor
 from ..ops.union import concat_pages
 from ..page import Block, Page
 from ..plan import nodes as N
+from . import knobs
 from .serde import serialize_page
 
 
@@ -82,7 +83,7 @@ class WorkerMemoryPool:
 
         self.limit = limit
         self.revoke_watermark = (
-            float(os.environ.get("PRESTO_TPU_REVOKE_WATERMARK", "0.8"))
+            knobs.revoke_watermark()
             if revoke_watermark is None else revoke_watermark
         )
         self.reserved = 0  # output-buffer bytes
@@ -1225,12 +1226,10 @@ def _pull_buffer(uri: str, task_id: str, buffer_id: int, ack: bool = True,
     pull — retryably — instead of hanging its consumer forever (the
     round-5 relay stall). None reads PRESTO_TPU_TASK_DEADLINE_S
     (default 600)."""
-    import os
-
     from .exchange import ack_pages, fetch_pages
 
     if deadline is None:
-        deadline = float(os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600"))
+        deadline = knobs.task_deadline_s()
     give_up = time.time() + deadline
 
     token = 0

@@ -33,7 +33,7 @@ def test_bench_gate_passes_vs_recorded_baseline():
     assert "bench_gate:" in r.stdout
 
 
-def test_bench_gate_skips_on_sf_mismatch(tmp_path):
+def test_bench_gate_skips_on_sf_mismatch(tmp_path, monkeypatch):
     """A baseline recorded at another scale factor must SKIP (exit 0)
     before any benchmark runs."""
     sys.path.insert(0, os.path.join(REPO, "tools"))
@@ -41,6 +41,8 @@ def test_bench_gate_skips_on_sf_mismatch(tmp_path):
         import bench_gate
     finally:
         sys.path.pop(0)
+    # lint gate has its own subprocess test; stub it to keep this fast
+    monkeypatch.setattr(bench_gate, "run_lint_gate", lambda: [])
     baseline = tmp_path / "BASELINE.json"
     baseline.write_text(json.dumps({
         "micro_gate": {
@@ -50,6 +52,31 @@ def test_bench_gate_skips_on_sf_mismatch(tmp_path):
         }
     }))
     assert bench_gate.run_gate(sf=9.9, baseline_path=str(baseline)) == 0
+
+
+def test_bench_gate_fails_on_lint_findings_even_when_perf_skips(
+    tmp_path, monkeypatch
+):
+    """The prestolint gate is backend/scale independent: a new finding
+    fails the build even when the perf comparison skips."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(
+        bench_gate, "run_lint_gate",
+        lambda: ["prestolint: gate not clean (race-unguarded-mutation=1)"],
+    )
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({
+        "micro_gate": {
+            "backend": "cpu",
+            "sf": 0.1,
+            "values": {"sort_2key": 10**12},
+        }
+    }))
+    assert bench_gate.run_gate(sf=9.9, baseline_path=str(baseline)) == 1
 
 
 def test_bench_gate_skips_on_backend_mismatch(tmp_path, monkeypatch):
@@ -62,6 +89,7 @@ def test_bench_gate_skips_on_backend_mismatch(tmp_path, monkeypatch):
         sys.path.pop(0)
     import presto_tpu.benchmark.micro as micro
 
+    monkeypatch.setattr(bench_gate, "run_lint_gate", lambda: [])
     monkeypatch.setattr(
         micro, "run_suite",
         lambda sf, runs, only: {

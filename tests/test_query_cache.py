@@ -455,3 +455,83 @@ def test_plan_cache_entry_invalidated_by_write():
     }))
     q(sess, "select count(*) from t")  # stale entry dropped, replanned
     assert qcache.PLAN_CACHE.stats.invalidations > inv0
+
+
+# -- stats-accounting races (prestolint guarded-fields burndown) ------------
+
+
+def test_reset_concurrent_with_put_keeps_bytes_ledger_consistent():
+    """reset() must swap the stats object UNDER the cache lock. The old
+    reset_all did `clear(); c.stats = CacheStats()` — a put() landing
+    between the two stranded its bytes increment on the dead stats
+    object, leaving the fresh stats claiming 0 bytes for a non-empty
+    map. Hammer put/get against reset and check the ledger matches the
+    live entries at quiescence."""
+    cache = qcache.LRUCache(max_entries=64, name="race-test")
+    stop = threading.Event()
+
+    def hammer(i):
+        k = 0
+        while not stop.is_set():
+            key = ("k", i, k % 17)
+            cache.put(key, "v", 128)
+            cache.get(key)
+            k += 1
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            cache.reset()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    with cache._lock:
+        live = sum(nb for _v, nb in cache._data.values())
+        assert cache.stats.bytes == live
+
+
+def test_scheduler_stats_snapshot_is_torn_read_free():
+    """stats_snapshot() copies SchedulerStats under the scheduler lock.
+    Reading fields off the live object (the old EXPLAIN ANALYZE path)
+    tears: a poller updating two counters together can be observed
+    half-applied. Keep two fields in lockstep under the lock and assert
+    every snapshot sees them equal."""
+    from presto_tpu.server.cluster import HttpScheduler
+
+    sched = HttpScheduler(None, None)
+    stop = threading.Event()
+
+    def mutate():
+        n = 0
+        while not stop.is_set():
+            n += 1
+            with sched._lock:
+                sched.stats.task_retries = n
+                sched.stats.query_retries = n
+
+    t = threading.Thread(target=mutate)
+    t.start()
+    try:
+        for _ in range(2000):
+            snap = sched.stats_snapshot()
+            assert snap["task_retries"] == snap["query_retries"]
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_record_caches_publishes_under_scheduler_lock():
+    """Sessions publish serving-cache counters via record_caches() — the
+    direct `scheduler.stats.caches = ...` write it replaced raced every
+    status poll mutating stats under _lock (caught by prestolint's
+    race-unguarded-mutation rule, which gates this staying fixed)."""
+    from presto_tpu.server.cluster import HttpScheduler
+
+    sched = HttpScheduler(None, None)
+    sched.record_caches({"plan": {"hits": 1}})
+    assert sched.stats_snapshot()["caches"] == {"plan": {"hits": 1}}

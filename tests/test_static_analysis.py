@@ -21,10 +21,13 @@ from presto_tpu.analysis.core import (
 )
 from presto_tpu.analysis.passes import (
     PASSES_BY_NAME,
+    coverage as p_cov,
     exceptions as p_exc,
     exhaustive as p_exh,
+    knobs as p_knobs,
     locks as p_locks,
     memory as p_mem,
+    races as p_races,
     tracing as p_trace,
 )
 
@@ -809,6 +812,419 @@ def test_memory_false_positive_guards(tmp_path):
     assert run_passes(proj, [p_mem.PASS]) == []
 
 
+# -- guarded-fields (race inference) ----------------------------------------
+
+
+def test_races_flags_mutation_call_and_publication(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/bad.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+                    self.count = 0
+
+                def add(self, x):
+                    with self._lock:
+                        self.items.append(x)
+                        self.count += 1
+
+                def drain(self):
+                    with self._lock:
+                        out = list(self.items)
+                        self.items.clear()
+                        self.count = 0
+                    return out
+
+                def racy_assign(self):
+                    self.count = 99
+
+                def racy_call(self, x):
+                    self.items.append(x)
+
+                def racy_publish(self, pool):
+                    pool.submit(work, self.items)
+
+                def racy_deferred(self):
+                    with self._lock:
+                        def cb():
+                            self.items.pop()
+                    return cb
+        """,
+    })
+    found = run_passes(proj, [p_races.PASS])
+    assert rules(found) == ["race-unguarded-mutation"] * 4
+    assert sorted(f.context for f in found) == [
+        "Pool.racy_assign", "Pool.racy_call",
+        "Pool.racy_deferred.cb", "Pool.racy_publish",
+    ]
+
+
+def test_races_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/good.py": """
+            import threading
+
+            class Clean:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []     # __init__ is happens-before
+                    self.hits = 0
+
+                def add(self, x):
+                    with self._lock:
+                        self.items.append(x)
+                        self.hits += 1
+
+                def drain(self):
+                    with self._lock:
+                        self.items.clear()
+                        self.hits += 1
+
+                def read_only(self):
+                    return len(self.items)   # torn read: not flagged
+
+                def flush(self):
+                    with self._lock:
+                        self._flush_locked()
+
+                def compact(self):
+                    with self._lock:
+                        self._flush_locked()
+
+                def _flush_locked(self):
+                    # every in-class call site holds _lock: assumed held
+                    self.items.pop()
+
+                def reset_for_tests(self):
+                    # prestolint: unguarded(items) -- single-threaded test hook
+                    self.items.clear()
+
+            class Ambiguous:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self.x = 0
+
+                def m1(self):
+                    with self._a:
+                        with self._b:
+                            self.x += 1
+
+                def m2(self):
+                    with self._a:
+                        with self._b:
+                            self.x += 1
+
+                def m3(self):
+                    self.x = 5   # tie between _a and _b: refuse to infer
+        """,
+    })
+    assert run_passes(proj, [p_races.PASS]) == []
+
+
+def test_races_escaped_helper_disables_propagation(tmp_path):
+    # handing `self.m` to a thread voids the all-call-sites-hold-L proof
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/esc.py": """
+            import threading
+
+            class Esc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+
+                def a(self):
+                    with self._lock:
+                        self.items.append(1)
+                        self._bump()
+
+                def b(self):
+                    with self._lock:
+                        self.items.append(2)
+                        self._bump()
+
+                def spawn(self, ex):
+                    ex.submit(self._bump)
+
+                def _bump(self):
+                    self.items.pop()
+        """,
+    })
+    found = run_passes(proj, [p_races.PASS])
+    assert rules(found) == ["race-unguarded-mutation"]
+    assert found[0].context == "Esc._bump"
+
+
+def test_races_cross_object_write_needs_owners_lock(tmp_path):
+    # the cluster.py bug shape: another class writes owner.stats.<field>
+    # without taking the owner's lock — holding it the chained way
+    # (`with self.owner._lock:`) is clean
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/owner.py": """
+            import threading
+
+            class Stats:
+                pass
+
+            class Owner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = Stats()
+
+                def poll(self):
+                    with self._lock:
+                        self.stats.polls = 1
+
+                def fail(self):
+                    with self._lock:
+                        self.stats.failures = 1
+
+            class GoodUser:
+                def __init__(self):
+                    self.owner = Owner()
+
+                def publish(self, snap):
+                    with self.owner._lock:
+                        self.owner.stats.caches = snap
+
+            class BadUser:
+                def __init__(self):
+                    self.owner = Owner()
+
+                def publish(self, snap):
+                    self.owner.stats.caches = snap
+
+                def ok_method_call(self):
+                    self.owner.poll()   # method synchronizes internally
+        """,
+    })
+    found = run_passes(proj, [p_races.PASS])
+    assert rules(found) == ["race-unguarded-mutation"]
+    assert found[0].context == "BadUser.publish"
+    assert "Owner._lock" in found[0].message
+
+
+def test_races_real_tree_is_clean():
+    """The burndown acceptance: zero unguarded mutations on the real
+    tree (cluster.py's scheduler.stats.caches write now goes through
+    HttpScheduler.record_caches, which takes the lock)."""
+    proj = load_project(REPO_ROOT)
+    assert run_passes(proj, [p_races.PASS]) == []
+
+
+# -- knob-consistency -------------------------------------------------------
+
+
+def test_knobs_multi_parse_undocumented_and_stale(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/a.py": """
+            import os
+            A = float(os.environ.get("PRESTO_TPU_KNOB_A", "1"))
+            B = os.environ.get("PRESTO_TPU_KNOB_OTHER", "x")
+        """,
+        "presto_tpu/b.py": """
+            import os
+            A2 = float(os.environ.get("PRESTO_TPU_KNOB_A", "2"))
+        """,
+        "docs/tuning.md": """
+            `PRESTO_TPU_KNOB_A` (default 1) does things.
+            `PRESTO_TPU_KNOB_GONE` was removed long ago.
+        """,
+    })
+    found = run_passes(proj, [p_knobs.PASS])
+    assert rules(found) == [
+        "knob-multi-parse", "knob-stale-doc", "knob-undocumented",
+    ]
+    by_rule = {f.rule: f for f in found}
+    assert "PRESTO_TPU_KNOB_A" in by_rule["knob-multi-parse"].message
+    assert "PRESTO_TPU_KNOB_OTHER" in by_rule["knob-undocumented"].message
+    assert "PRESTO_TPU_KNOB_GONE" in by_rule["knob-stale-doc"].message
+
+
+def test_knobs_near_miss_both_directions(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/a.py": """
+            import os
+            # one edit from the documented PRESTO_TPU_STRIDE
+            X = os.environ.get("PRESTO_TPU_STRIDES", "1")
+            Y = os.environ.get("PRESTO_TPU_WIDTH", "2")
+        """,
+        "docs/tuning.md": """
+            `PRESTO_TPU_STRIDE` picks the stride.
+            `PRESTO_TPU_WIDTHS` picks the widths.
+        """,
+    })
+    found = run_passes(proj, [p_knobs.PASS])
+    assert rules(found) == ["knob-near-miss"] * 2
+
+
+def test_knobs_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/a.py": """
+            import os
+
+            # the single parse site, documented: clean
+            TUNED = int(os.environ.get("PRESTO_TPU_TUNED", "4"))
+
+            def save_restore():
+                # probes (no default) and writes are NOT parse sites
+                prev = os.environ.get("PRESTO_TPU_TUNED")
+                os.environ["PRESTO_TPU_TUNED"] = "8"
+                if "PRESTO_TPU_TUNED" in os.environ:
+                    os.environ.pop("PRESTO_TPU_TUNED", None)
+        """,
+        "docs/tuning.md": """
+            `PRESTO_TPU_TUNED` (default 4).
+            The `PRESTO_TPU_FAMILY_*` knobs share a prefix (wildcard —
+            not a knob name, must not count as documented-but-unread).
+        """,
+    })
+    assert run_passes(proj, [p_knobs.PASS]) == []
+
+
+def test_knobs_env_helper_counts_as_parse_site(tmp_path):
+    # parsing through a module-level helper is still one parse site per
+    # knob — two helper calls for the SAME knob is multi-parse
+    proj = make_project(tmp_path, {
+        "presto_tpu/a.py": """
+            import os
+
+            def _env_int(name, default):
+                return int(os.environ.get(name, "") or default)
+
+            A = _env_int("PRESTO_TPU_HELPER_KNOB", 4)
+        """,
+        "presto_tpu/b.py": """
+            from .a import _env_int
+
+            B = _env_int("PRESTO_TPU_HELPER_KNOB", 8)
+        """,
+        "docs/tuning.md": """
+            `PRESTO_TPU_HELPER_KNOB` (default 4).
+        """,
+    })
+    found = run_passes(proj, [p_knobs.PASS])
+    assert rules(found) == ["knob-multi-parse"]
+
+
+# -- observability-coverage -------------------------------------------------
+
+
+def test_coverage_breaker_without_fallback_or_doc(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/k.py": """
+            from .breaker import BREAKERS
+
+            def run(x):
+                BREAKERS.allow("dark_kernel")   # decision ignored
+                out = kernel(x)
+                BREAKERS.record_success("dark_kernel")
+                return out
+
+            def run2(x):
+                # record_* only, never even asks allow()
+                BREAKERS.record_failure("log_only", "boom")
+                return kernel(x)
+        """,
+        "docs/fault-tolerance.md": """
+            | breaker | fallback |
+            |---|---|
+            (neither name is here)
+        """,
+    })
+    found = run_passes(proj, [p_cov.PASS])
+    assert rules(found) == [
+        "breaker-no-fallback", "breaker-no-fallback",
+        "breaker-undocumented", "breaker-undocumented",
+    ]
+
+
+def test_coverage_breaker_false_positive_guards(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/k.py": """
+            from .breaker import BREAKERS
+
+            def gated(x):
+                if BREAKERS.allow("good_kernel"):
+                    return kernel(x)
+                return fallback(x)
+
+            def assigned(x):
+                ok = BREAKERS.allow("assigned_kernel")
+                return kernel(x) if ok else fallback(x)
+
+            def wrapped(x):
+                return _kernel_guarded("wrapped_kernel", kernel, fallback, x)
+        """,
+        "docs/fault-tolerance.md": """
+            | breaker | fallback |
+            |---|---|
+            | `good_kernel` | XLA composition |
+            | `assigned_kernel` | XLA composition |
+            | `wrapped_kernel` | legacy kernel |
+        """,
+    })
+    assert run_passes(proj, [p_cov.PASS]) == []
+
+
+def test_coverage_stats_class_must_reach_a_surface(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/m.py": """
+            class DarkStats:
+                def __init__(self):
+                    self.hits = 0
+
+                def snapshot(self):
+                    return {"hits": self.hits}
+
+            class LitStats:
+                def __init__(self):
+                    self.hits = 0
+
+                def snapshot(self):
+                    return {"hits": self.hits}
+
+            LIT = LitStats()
+
+            def snapshot_all():
+                return {"lit": LIT.snapshot()}
+        """,
+    })
+    found = run_passes(proj, [p_cov.PASS])
+    assert rules(found) == ["stats-not-snapshotted"]
+    assert found[0].context == "DarkStats"
+
+
+def test_coverage_qcache_global_must_be_in_snapshot_all(tmp_path):
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/qcache.py": """
+            class LRUCache:
+                def snapshot(self):
+                    return {}
+
+            SEEN_CACHE = LRUCache()
+            DARK_CACHE = LRUCache()
+
+            def snapshot_all():
+                return {"seen": SEEN_CACHE.snapshot()}
+        """,
+    })
+    found = run_passes(proj, [p_cov.PASS])
+    assert rules(found) == ["cache-not-snapshotted"]
+    assert "DARK_CACHE" in found[0].message
+
+
+def test_coverage_and_knobs_real_tree_clean():
+    """Burndown acceptance for the doc/observability rules: every knob
+    documented with one parse site, every breaker gated + cataloged,
+    every Stats/Cache wired to a snapshot surface."""
+    proj = load_project(REPO_ROOT)
+    assert run_passes(proj, [p_knobs.PASS, p_cov.PASS]) == []
+
+
 # -- suppression + baseline -------------------------------------------------
 
 
@@ -919,8 +1335,9 @@ def test_repo_is_clean_and_fast():
     assert dt < 10.0, f"prestolint took {dt:.1f}s (budget 10s)"
 
 
-def test_all_five_passes_registered():
+def test_all_eight_passes_registered():
     assert set(PASSES_BY_NAME) == {
-        "tracing-safety", "lock-discipline", "exception-hygiene",
-        "plan-exhaustiveness", "memory-accounting",
+        "tracing-safety", "lock-discipline", "guarded-fields",
+        "exception-hygiene", "plan-exhaustiveness", "memory-accounting",
+        "knob-consistency", "observability-coverage",
     }

@@ -352,6 +352,30 @@ def test_lint_baseline_update_scoped_to_pass(tmp_path, capsys):
     assert _lint_main(["--check", "--root", str(root), "--baseline", bl]) == 0
 
 
+def test_lint_json_report(tmp_path, capsys):
+    """`--check --json` emits exactly one machine-readable object on
+    stdout — the contract tools/bench_gate.py's lint gate parses."""
+    import json
+
+    root = _bad_tree(tmp_path)
+    bl = str(tmp_path / "baseline.json")
+    assert _lint_main(["--check", "--json", "--root", str(root),
+                       "--baseline", bl]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False
+    assert payload["new_by_rule"] == {"broad-except-swallow": 1}
+    assert payload["new"][0]["file"].endswith("bad.py")
+    assert len(payload["passes"]) == 8
+
+    assert _lint_main(["--baseline-update", "--root", str(root),
+                       "--baseline", bl]) == 0
+    capsys.readouterr()
+    assert _lint_main(["--check", "--json", "--root", str(root),
+                       "--baseline", bl]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True and payload["baselined"] == 1
+
+
 def test_lint_module_entrypoint_real_tree():
     """`python -m presto_tpu.analysis --check` — exactly the tier-1 /
     verify-recipe invocation — exits 0 on the committed tree."""

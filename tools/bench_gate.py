@@ -59,19 +59,57 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
 
 
+def run_lint_gate() -> list:
+    """prestolint findings count via `python -m presto_tpu.analysis
+    --check --json`: the static-analysis burndown gates CI next to the
+    perf floors — a new unbaselined finding fails the gate the same way
+    a kernel regression does. Returns failure strings ([] = clean)."""
+    import subprocess
+
+    repo_root = os.path.abspath(os.path.join(_HERE, os.pardir))
+    proc = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis", "--check", "--json"],
+        capture_output=True, text=True, cwd=repo_root,
+    )
+    try:
+        payload = json.loads(proc.stdout)
+    except ValueError:
+        return [
+            "prestolint: unparseable --json output "
+            f"(exit {proc.returncode}): {proc.stderr.strip()[:200]}"
+        ]
+    print(
+        f"prestolint: {len(payload['new'])} new finding(s), "
+        f"{payload['baselined']} baselined, {len(payload['passes'])} "
+        f"passes in {payload['elapsed_s']}s"
+    )
+    if not payload["ok"]:
+        by_rule = ", ".join(
+            f"{r}={n}"
+            for r, n in sorted(payload["new_by_rule"].items())
+        ) or f"{payload['expired']} expired baseline entries"
+        return [f"prestolint: gate not clean ({by_rule})"]
+    return []
+
+
 def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
              baseline_path: str = DEFAULT_BASELINE) -> int:
+    # the lint gate is backend/scale independent: it runs (and can fail
+    # the build) even when the perf comparison below has to skip
+    lint_failures = run_lint_gate()
+    for f_ in lint_failures:
+        print(f"  {f_}")
     with open(baseline_path) as f:
         gate = json.load(f).get("micro_gate")
     if not gate or not gate.get("values"):
         print("bench_gate: no micro_gate baseline recorded — skipping")
-        return 0
+        return 1 if lint_failures else 0
     if abs(float(gate.get("sf", sf)) - sf) > 1e-9:
         print(
             f"bench_gate: baseline recorded at sf={gate.get('sf')}, "
             f"run requested sf={sf} — skipping"
         )
-        return 0
+        return 1 if lint_failures else 0
 
     repo_root = os.path.abspath(os.path.join(_HERE, os.pardir))
     if repo_root not in sys.path:  # `python tools/bench_gate.py` puts only
@@ -84,9 +122,9 @@ def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
             f"bench_gate: baseline backend {gate.get('backend')!r} != live "
             f"backend {table['backend']!r} — skipping"
         )
-        return 0
+        return 1 if lint_failures else 0
     got = {r["name"]: r for r in table["results"]}
-    failures = []
+    failures = list(lint_failures)
     for name in GATED:
         base = gate["values"].get(name)
         if base is None:
