@@ -1329,6 +1329,192 @@ def run_plan_cache_bench(sf: float, runs: int = RUNS) -> Dict:
     }
 
 
+def _matview_fixture(sf: float, unique: bool = False):
+    """(catalog, session, base_rows) over a fresh shardstore events
+    table sized by sf — shared setup for the matview/ingest micros."""
+    import tempfile
+
+    from .. import types as T
+    from ..connectors.shardstore import ShardStoreCatalog
+    from ..page import Page
+    from ..session import Session
+
+    n = max(int(2_000_000 * sf), 20_000)
+    cat = ShardStoreCatalog(tempfile.mkdtemp(prefix="mv_micro_"))
+    cat.create_table(
+        "events", {"k": T.BIGINT, "v": T.BIGINT},
+        unique_columns=["k"] if unique else None,
+    )
+    rng = np.random.default_rng(7)
+    page = Page.from_dict({
+        "k": (rng.integers(0, 256, n).astype(np.int64), T.BIGINT),
+        "v": (rng.integers(0, 1000, n).astype(np.int64), T.BIGINT),
+    })
+    cat.append("events", page)
+    return cat, Session(cat), n
+
+
+def run_matview_refresh_delta_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Incremental view maintenance (matview/): delta refresh of an
+    aggregate MV after appending 1% of the base rows, vs a forced full
+    recompute of the same view. RAISES when the refresh did not take the
+    delta path, so the gate catches a broken classifier/scan_delta as
+    well as a slow one; `speedup_vs_full` carries the >=5x acceptance
+    ratio (BASELINE.json ratio_floors)."""
+    from .. import types as T
+    from ..page import Page
+
+    cat, sess, n = _matview_fixture(sf)
+    sess.query(
+        "create materialized view mv_micro as "
+        "select k, count(*) as n, sum(v) as total from events group by k"
+    )
+    mgr = sess.matviews_mgr
+    d = max(n // 100, 1)
+    rng = np.random.default_rng(11)
+    # warmup cycle: both paths compile their kernels untimed (delta's
+    # merge shapes are stable across iterations, so one cycle suffices)
+    cat.append("events", Page.from_dict({
+        "k": (rng.integers(0, 256, d).astype(np.int64), T.BIGINT),
+        "v": (rng.integers(0, 1000, d).astype(np.int64), T.BIGINT),
+    }))
+    if mgr.refresh("mv_micro") != "delta":
+        raise RuntimeError("warmup refresh missed the delta path")
+    mgr.refresh("mv_micro", full=True)
+    best_delta = best_full = float("inf")
+    for _ in range(runs):
+        cat.append("events", Page.from_dict({
+            "k": (rng.integers(0, 256, d).astype(np.int64), T.BIGINT),
+            "v": (rng.integers(0, 1000, d).astype(np.int64), T.BIGINT),
+        }))
+        t0 = time.perf_counter()
+        mode = mgr.refresh("mv_micro")
+        best_delta = min(best_delta, time.perf_counter() - t0)
+        if mode != "delta":
+            raise RuntimeError(
+                f"refresh took mode={mode!r}, expected 'delta' "
+                f"({mgr.views['mv_micro'].last_reason})"
+            )
+        t0 = time.perf_counter()
+        mgr.refresh("mv_micro", full=True)
+        best_full = min(best_full, time.perf_counter() - t0)
+    speedup = best_full / best_delta
+    return {
+        "name": "matview_refresh_delta",
+        "rows": n,
+        "rows_per_s": round(n / best_delta),
+        "ms": round(best_delta * 1e3, 3),
+        "speedup_vs_full": round(speedup, 2),
+        "note": f"1% delta ({d} rows) {best_delta * 1e3:.1f}ms vs full "
+                f"{best_full * 1e3:.1f}ms = {speedup:.1f}x",
+    }
+
+
+def run_ingest_append_bench(sf: float, runs: int = RUNS) -> Dict:
+    """High-rate ingest (shardstore.append_batch): land a batch of many
+    small pages as ONE shard + ONE version bump. rows/s counts rows
+    durably written (parquet + metadata txn) per wall second."""
+    from .. import types as T
+    from ..page import Page
+
+    cat, _sess, _n = _matview_fixture(sf)
+    pages_per_batch = 32
+    rows_per_page = max(int(50_000 * sf), 500)
+    rng = np.random.default_rng(13)
+    batch = [
+        Page.from_dict({
+            "k": (rng.integers(0, 256, rows_per_page).astype(np.int64),
+                  T.BIGINT),
+            "v": (rng.integers(0, 1000, rows_per_page).astype(np.int64),
+                  T.BIGINT),
+        })
+        for _ in range(pages_per_batch)
+    ]
+    total = pages_per_batch * rows_per_page
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        wrote = cat.append_batch("events", batch)
+        best = min(best, time.perf_counter() - t0)
+        if wrote != total:
+            raise RuntimeError(f"append_batch wrote {wrote} != {total}")
+    return {
+        "name": "ingest_append",
+        "rows": total,
+        "rows_per_s": round(total / best),
+        "ms": round(best * 1e3, 3),
+        "note": f"{pages_per_batch} pages x {rows_per_page} rows as one "
+                "shard/version bump",
+    }
+
+
+def run_mixed_soak_qps_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Mixed read/write serving: a writer thread sustains ingest while
+    the reader runs warm prepared-statement EXECUTEs of a decomposable
+    dashboard aggregate — every write stales the cached result, and the
+    qcache PATCH verdict (matview/patch.py) must keep the warm path warm
+    instead of recomputing. rows/s counts base rows each served read
+    logically covers; RAISES when no read was served by a patch."""
+    import threading
+
+    from .. import types as T
+    from ..exec import qcache
+    from ..page import Page
+
+    cat, sess, n = _matview_fixture(sf)
+    sess.query(
+        "prepare soak_dash from "
+        "select k, count(*) as n, sum(v) as total from events group by k"
+    )
+    sess.query("execute soak_dash")  # cold: plan+compile+store
+    reads = 40
+    d = max(n // 200, 1)
+    rng = np.random.default_rng(17)
+    stop = threading.Event()
+
+    def writer():
+        # ~20 appends/s: sustained staleness pressure without growing
+        # the shard set (and with it every later delta scan) unboundedly
+        while not stop.is_set():
+            cat.append("events", Page.from_dict({
+                "k": (rng.integers(0, 256, d).astype(np.int64), T.BIGINT),
+                "v": (rng.integers(0, 1000, d).astype(np.int64), T.BIGINT),
+            }))
+            stop.wait(0.05)
+
+    s0 = qcache.snapshot_all()
+    best = float("inf")
+    for _ in range(runs):
+        th = threading.Thread(target=writer, daemon=True)
+        stop.clear()
+        th.start()
+        try:
+            t0 = time.perf_counter()
+            for _i in range(reads):
+                sess.query("execute soak_dash")
+            best = min(best, time.perf_counter() - t0)
+        finally:
+            stop.set()
+            th.join(timeout=10)
+    s1 = qcache.snapshot_all()
+    patches = s1["result"]["patches"] - s0["result"]["patches"]
+    if patches == 0:
+        raise RuntimeError(
+            "mixed soak served zero patched reads — the patch verdict "
+            "is broken or every read recomputed"
+        )
+    rows = n * reads
+    return {
+        "name": "mixed_soak_qps",
+        "rows": rows,
+        "rows_per_s": round(rows / best),
+        "ms": round(best * 1e3, 3),
+        "note": f"{reads} EXECUTEs under sustained ingest at "
+                f"{round(best / reads * 1e3, 1)}ms each; "
+                f"result patches +{patches}",
+    }
+
+
 HOST_BENCHES = {
     "serde_lz4": run_serde_bench,
     "serde_encoded": run_serde_encoded_bench,
@@ -1337,6 +1523,9 @@ HOST_BENCHES = {
     "hybrid_join_spill": run_hybrid_join_spill_bench,
     "external_sort_disk": run_external_sort_disk_bench,
     "plan_cache_hit": run_plan_cache_bench,
+    "matview_refresh_delta": run_matview_refresh_delta_bench,
+    "ingest_append": run_ingest_append_bench,
+    "mixed_soak_qps": run_mixed_soak_qps_bench,
 }
 
 

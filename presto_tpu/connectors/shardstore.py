@@ -30,6 +30,7 @@ import os
 import sqlite3
 import threading
 import uuid
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -37,7 +38,7 @@ import numpy as np
 from .. import types as T
 from ..page import Page
 from .parquet import arrow_table_to_page, build_sorted_dictionary, page_to_arrow
-from .spi import Predicate, WritableConnector, WriteError
+from .spi import DeltaUnavailable, Predicate, WritableConnector, WriteError
 
 # compaction target: merge small shards until ~this many rows
 DEFAULT_COMPACT_ROWS = 1 << 20
@@ -49,6 +50,29 @@ def _decode_stat(kind: str, txt: str):
     if kind == "date":
         return pydt.date.fromisoformat(txt)
     return float(txt)
+
+
+def _combine_stats(dicts) -> dict:
+    """Combine per-shard column stats dicts: min of mins, max of maxes
+    per column, ignoring shards with no stats for a column."""
+    out: Dict = {}
+    for st in dicts:
+        for col, (kind, mn, mx) in st.items():
+            if kind is None or mn is None:
+                out.setdefault(col, (None, None, None))
+                continue
+            cur = out.get(col)
+            if cur is None or cur[0] is None:
+                out[col] = (kind, mn, mx)
+                continue
+            cmn = min(_decode_stat(kind, cur[1]), _decode_stat(kind, mn))
+            cmx = max(_decode_stat(kind, cur[2]), _decode_stat(kind, mx))
+            enc = (
+                (lambda v: v.isoformat()) if kind == "date"
+                else (str if kind == "str" else (lambda v: repr(float(v))))
+            )
+            out[col] = (kind, enc(cmn), enc(cmx))
+    return out
 
 
 def _coerce_hint(value):
@@ -85,15 +109,31 @@ class ShardStoreCatalog(WritableConnector):
                 id INTEGER PRIMARY KEY AUTOINCREMENT,
                 table_name TEXT NOT NULL, path TEXT NOT NULL,
                 rows INTEGER NOT NULL,
-                seq REAL NOT NULL);
+                seq REAL NOT NULL,
+                max_seq REAL);
             CREATE TABLE IF NOT EXISTS shard_stats (
                 shard_id INTEGER NOT NULL, column_name TEXT NOT NULL,
                 kind TEXT, min_v TEXT, max_v TEXT,
                 PRIMARY KEY (shard_id, column_name));
+            CREATE TABLE IF NOT EXISTS table_meta (
+                name TEXT PRIMARY KEY,
+                created_id INTEGER NOT NULL,
+                data_version INTEGER NOT NULL DEFAULT 0,
+                nonappend_version INTEGER NOT NULL DEFAULT 0,
+                unique_cols TEXT);
+            CREATE TABLE IF NOT EXISTS table_ids (
+                id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT);
             CREATE INDEX IF NOT EXISTS idx_shards_table
                 ON shards(table_name);
             """
         )
+        try:
+            # databases created before the delta-scan work lack the
+            # max_seq column (CREATE IF NOT EXISTS above is a no-op there)
+            self.db.execute("ALTER TABLE shards ADD COLUMN max_seq REAL")
+            self.db.commit()
+        except sqlite3.OperationalError:
+            pass  # column already present (fresh database)
         self.last_scan_files_read = 0
         self.last_scan_files_skipped = 0
         self._dict_cache: Dict = {}  # (table, column, version) -> dict
@@ -132,7 +172,14 @@ class ShardStoreCatalog(WritableConnector):
         return self.row_count(table)
 
     def unique_columns(self, table: str):
-        return []
+        with self._db_lock:
+            row = self.db.execute(
+                "SELECT unique_cols FROM table_meta WHERE name = ?",
+                (table,),
+            ).fetchone()
+        if row is None or row[0] is None:
+            return []
+        return [tuple(json.loads(row[0]))]
 
     def shard_count(self, table: str) -> int:
         with self._db_lock:
@@ -166,27 +213,135 @@ class ShardStoreCatalog(WritableConnector):
             ).fetchone()
         return int(row[0]) * 1_000_003 + int(row[1])
 
-    def table_version(self, table: str) -> int:
-        """Connector snapshot version (exec/qcache.py): the shard-set
-        version — shard ids are AUTOINCREMENT, so every write produces a
-        fresh id and equal versions imply equal row sets (compaction
-        changes the version without changing data: a spurious but safe
-        invalidation) — mixed with the schema hash so DROP + re-CREATE
-        under a different schema can never alias the empty-table
-        version."""
-        import zlib
+    def _ensure_meta_locked(self, table: str):
+        """(created_id, data_version, nonappend_version, unique_cols) for
+        `table`, creating the row for databases that predate table_meta.
+        Caller holds `_db_lock` and owns the transaction/commit."""
+        row = self.db.execute(
+            "SELECT created_id, data_version, nonappend_version, "
+            "unique_cols FROM table_meta WHERE name = ?",
+            (table,),
+        ).fetchone()
+        if row is not None:
+            return row
+        cid = self.db.execute(
+            "INSERT INTO table_ids (name) VALUES (?)", (table,)
+        ).lastrowid
+        # adopted mid-life (legacy database): seed at 1 so version 0
+        # stays the "freshly created, never written" value
+        self.db.execute(
+            "INSERT INTO table_meta VALUES (?, ?, 1, 1, NULL)",
+            (table, cid),
+        )
+        return (cid, 1, 1, None)
 
+    def _bump_meta_locked(self, table: str, nonappend: bool) -> None:
+        """Advance the per-table write counter; `nonappend` marks
+        rewrites (replace/upsert) that invalidate old delta cursors."""
+        self._ensure_meta_locked(table)
+        self.db.execute(
+            "UPDATE table_meta SET data_version = data_version + 1, "
+            "nonappend_version = CASE WHEN ? THEN data_version + 1 "
+            "ELSE nonappend_version END WHERE name = ?",
+            (1 if nonappend else 0, table),
+        )
+
+    def table_version(self, table: str) -> int:
+        """Connector snapshot version (exec/qcache.py): a per-table WRITE
+        counter — bumped by append/replace/upsert, NOT by organize(),
+        which rewrites shard files without changing data, so compaction
+        never invalidates warm caches or forces spurious matview
+        refreshes — mixed with a never-reused creation id (DROP +
+        re-CREATE cannot resume an old version sequence) and the schema
+        hash so a re-CREATE under a different schema can never alias the
+        empty-table version."""
         with self._db_lock:
             row = self.db.execute(
                 "SELECT schema_json FROM tables WHERE name = ?", (table,)
             ).fetchone()
-        if row is None:
-            raise KeyError(f"table {table!r} does not exist")
-        return (self._version(table) << 32) ^ zlib.crc32(row[0].encode())
+            if row is None:
+                raise KeyError(f"table {table!r} does not exist")
+            cid, dv, _nv, _uc = self._ensure_meta_locked(table)
+            self.db.commit()
+        return ((cid * 1_000_003 + dv) << 32) ^ zlib.crc32(row[0].encode())
+
+    def delta_token(self, table: str):
+        """Append-cursor for scan_delta(): (high_seq, data_version,
+        nonappend_version). Every row appended after this token lands in
+        a shard with seq > high_seq; a later token with a DIFFERENT
+        nonappend_version means the table was rewritten in between and
+        deltas against this token are meaningless."""
+        with self._db_lock:
+            if self.db.execute(
+                "SELECT 1 FROM tables WHERE name = ?", (table,)
+            ).fetchone() is None:
+                raise KeyError(f"table {table!r} does not exist")
+            row = self.db.execute(
+                "SELECT MAX(COALESCE(max_seq, seq)) FROM shards "
+                "WHERE table_name = ?",
+                (table,),
+            ).fetchone()
+            _cid, dv, nv, _uc = self._ensure_meta_locked(table)
+            self.db.commit()
+        return (float(row[0] or 0.0), dv, nv)
+
+    def scan_delta(self, table: str, from_seq: float, to_seq: float,
+                   columns=None, _retries: int = 2) -> Page:
+        """Rows appended in the seq interval (from_seq, to_seq] — the
+        delta between two delta_token() cursors. Raises DeltaUnavailable
+        when a shard STRADDLES an endpoint; organize() only merges whole
+        seq-adjacent runs and a merged shard keeps the run's [first seq,
+        max seq] interval, so compaction of shards that are entirely
+        inside (or entirely outside) the range is invisible here."""
+        import pyarrow as pa
+
+        schema = self.schema(table)
+        names = list(columns) if columns is not None else list(schema)
+        with self._db_lock:
+            shards = self.db.execute(
+                "SELECT id, path, seq, COALESCE(max_seq, seq) FROM shards "
+                "WHERE table_name = ? ORDER BY seq",
+                (table,),
+            ).fetchall()
+        kept = []
+        for _sid, path, lo, hi in shards:
+            if hi <= from_seq or lo > to_seq:
+                continue  # fully consumed / fully beyond the range
+            if lo <= from_seq or hi > to_seq:
+                raise DeltaUnavailable(
+                    f"shard seq [{lo}, {hi}] of {table!r} straddles the "
+                    f"delta range ({from_seq}, {to_seq}]"
+                )
+            kept.append(path)
+        try:
+            pieces = [self._read_shard(p).select(names) for p in kept]
+        except FileNotFoundError:
+            # concurrent organize() GC'd a file between listing and read;
+            # retry against fresh metadata (same contract as scan())
+            if _retries <= 0:
+                raise
+            return self.scan_delta(
+                table, from_seq, to_seq, columns=columns,
+                _retries=_retries - 1,
+            )
+        if pieces:
+            tb = pa.concat_tables(pieces)
+        else:
+            from .parquet import _type_to_arrow
+
+            tb = pa.table(
+                {n: pa.array([], type=_type_to_arrow(schema[n]))
+                 for n in names}
+            )
+        return arrow_table_to_page(
+            tb, names, tb.num_rows, None,
+            lambda name: self._dictionary(table, name),
+        )
 
     # -- writes ------------------------------------------------------------
 
-    def create_table(self, table: str, schema: Dict[str, T.Type]) -> None:
+    def create_table(self, table: str, schema: Dict[str, T.Type],
+                     unique_columns=None) -> None:
         with self._db_lock:
             if self.db.execute(
                 "SELECT 1 FROM tables WHERE name = ?", (table,)
@@ -195,6 +350,17 @@ class ShardStoreCatalog(WritableConnector):
             self.db.execute(
                 "INSERT INTO tables VALUES (?, ?)",
                 (table, json.dumps({c: str(t) for c, t in schema.items()})),
+            )
+            # table_ids is never garbage-collected: created_id must not
+            # be reused by a DROP + re-CREATE (version aliasing)
+            cid = self.db.execute(
+                "INSERT INTO table_ids (name) VALUES (?)", (table,)
+            ).lastrowid
+            self.db.execute(
+                "INSERT INTO table_meta VALUES (?, ?, 0, 0, ?)",
+                (table, cid,
+                 json.dumps([str(c) for c in unique_columns])
+                 if unique_columns else None),
             )
             self.db.commit()
 
@@ -255,9 +421,14 @@ class ShardStoreCatalog(WritableConnector):
         return path
 
     def _insert_shard_meta(self, table, path, rows, stats, seq=None,
-                           drop_ids=(), drop_table_shards=False) -> None:
+                           max_seq=None, drop_ids=(),
+                           drop_table_shards=False, bump=True,
+                           nonappend=False) -> None:
         """ONE metadata transaction: optionally drop old shards, insert
-        the new one. seq defaults to the new id (append at the end)."""
+        the new one, and (unless `bump` is False — compaction rewrites
+        files without changing data) advance the table's write counter.
+        seq defaults to the new id (append at the end); `max_seq` records
+        the top of a merged shard's seq interval."""
         with self._db_lock:
             if drop_table_shards:
                 self.db.execute(
@@ -279,9 +450,10 @@ class ShardStoreCatalog(WritableConnector):
                     tuple(drop_ids),
                 )
             cur = self.db.execute(
-                "INSERT INTO shards (table_name, path, rows, seq) "
-                "VALUES (?,?,?,0)",
-                (table, path, rows),
+                "INSERT INTO shards (table_name, path, rows, seq, max_seq)"
+                " VALUES (?,?,?,0,?)",
+                (table, path, rows,
+                 float(max_seq) if max_seq is not None else None),
             )
             sid = cur.lastrowid
             self.db.execute(
@@ -293,6 +465,8 @@ class ShardStoreCatalog(WritableConnector):
                     "INSERT INTO shard_stats VALUES (?,?,?,?,?)",
                     (sid, col, kind, mn, mx),
                 )
+            if bump:
+                self._bump_meta_locked(table, nonappend)
             self.db.commit()
 
     def _write_shard(self, table: str, arrow_table, stats) -> None:
@@ -305,6 +479,92 @@ class ShardStoreCatalog(WritableConnector):
             return
         self._write_shard(table, page_to_arrow(page), self._page_stats(page))
 
+    def append_batch(self, table: str, pages) -> int:
+        """High-rate ingest: concatenate many small pages into ONE shard
+        with ONE metadata transaction and ONE version bump — the
+        table's snapshot version moves at ingest-batch rate, not
+        per-page. Returns the number of rows appended."""
+        import pyarrow as pa
+
+        self.schema(table)  # existence check
+        pages = [p for p in pages if int(p.count)]
+        if not pages:
+            return 0
+        if len(pages) == 1:
+            self.append(table, pages[0])
+            return int(pages[0].count)
+        tb = pa.concat_tables([page_to_arrow(p) for p in pages])
+        stats = _combine_stats([self._page_stats(p) for p in pages])
+        self._write_shard(table, tb, stats)
+        return tb.num_rows
+
+    def upsert(self, table: str, page: Page) -> dict:
+        """INSERT-or-REPLACE keyed on the table's declared unique
+        columns. Fast path: when no incoming key exists yet this is a
+        plain append — the table stays append-only and delta cursors
+        survive. Slow path: a rewrite — rows matching an incoming key
+        are dropped, the shard set swaps in one metadata transaction,
+        and the nonappend version bump tells delta consumers their old
+        cursors are void. Returns {"appended": n, "updated": m}."""
+        import pyarrow as pa
+
+        keys = self.unique_columns(table)
+        if not keys:
+            raise WriteError(
+                f"upsert on {table!r} requires unique columns declared "
+                f"at CREATE TABLE time"
+            )
+        if int(page.count) == 0:
+            return {"appended": 0, "updated": 0}
+        kcols = list(keys[0])
+        missing = [c for c in kcols if c not in page.names]
+        if missing:
+            raise WriteError(
+                f"upsert page for {table!r} lacks key column(s) {missing}"
+            )
+        tb_new = page_to_arrow(page)
+        new_keys = set(
+            zip(*[tb_new.column(c).to_pylist() for c in kcols])
+        )
+        with self._db_lock:
+            shards = self.db.execute(
+                "SELECT id, path, rows FROM shards WHERE table_name = ? "
+                "ORDER BY seq",
+                (table,),
+            ).fetchall()
+        old_tables, hit = [], False
+        for _sid, path, _rows in shards:
+            t = self._read_shard(path)
+            old_tables.append(t)
+            if not hit:
+                hit = any(
+                    k in new_keys
+                    for k in zip(*[t.column(c).to_pylist() for c in kcols])
+                )
+        if not hit:
+            self._write_shard(table, tb_new, self._page_stats(page))
+            return {"appended": tb_new.num_rows, "updated": 0}
+        merged = pa.concat_tables(old_tables)
+        keep = [
+            k not in new_keys
+            for k in zip(*[merged.column(c).to_pylist() for c in kcols])
+        ]
+        kept_tb = merged.filter(pa.array(keep, type=pa.bool_()))
+        if not kept_tb.schema.equals(tb_new.schema):
+            tb_new = tb_new.cast(kept_tb.schema)
+        final = pa.concat_tables([kept_tb, tb_new])
+        path = self._write_file(table, final)
+        # drop only the snapshotted shard ids (not drop_table_shards): a
+        # shard appended concurrently with this rewrite must survive
+        self._insert_shard_meta(
+            table, path, final.num_rows, {},
+            drop_ids=[sid for sid, _p, _r in shards],
+            nonappend=True,
+        )
+        self._gc([p for _sid, p, _r in shards])
+        updated = merged.num_rows - kept_tb.num_rows
+        return {"appended": tb_new.num_rows - updated, "updated": updated}
+
     def replace(self, table: str, page: Page) -> None:
         """Write-new-then-swap in ONE metadata transaction — a crash (or
         concurrent reader) never observes the table without its data."""
@@ -314,7 +574,7 @@ class ShardStoreCatalog(WritableConnector):
             path = self._write_file(table, arrow)
             self._insert_shard_meta(
                 table, path, arrow.num_rows, self._page_stats(page),
-                drop_table_shards=True,
+                drop_table_shards=True, nonappend=True,
             )
         else:
             with self._db_lock:
@@ -326,6 +586,7 @@ class ShardStoreCatalog(WritableConnector):
                 self.db.execute(
                     "DELETE FROM shards WHERE table_name = ?", (table,)
                 )
+                self._bump_meta_locked(table, nonappend=True)
                 self.db.commit()
         self._gc([p for _id, p, _r in old])
 
@@ -341,6 +602,10 @@ class ShardStoreCatalog(WritableConnector):
                 "DELETE FROM shards WHERE table_name = ?", (table,)
             )
             self.db.execute("DELETE FROM tables WHERE name = ?", (table,))
+            # table_ids row intentionally kept: created ids never recycle
+            self.db.execute(
+                "DELETE FROM table_meta WHERE name = ?", (table,)
+            )
             self.db.commit()
         self._gc([p for _id, p, _r in old])
 
@@ -495,23 +760,9 @@ class ShardStoreCatalog(WritableConnector):
                 f"WHERE shard_id IN ({qmarks})",
                 tuple(shard_ids),
             ).fetchall()
-        out: Dict = {}
-        for col, kind, mn, mx in rows:
-            if kind is None or mn is None:
-                out.setdefault(col, (None, None, None))
-                continue
-            cur = out.get(col)
-            if cur is None or cur[0] is None:
-                out[col] = (kind, mn, mx)
-                continue
-            cmn = min(_decode_stat(kind, cur[1]), _decode_stat(kind, mn))
-            cmx = max(_decode_stat(kind, cur[2]), _decode_stat(kind, mx))
-            enc = (
-                (lambda v: v.isoformat()) if kind == "date"
-                else (str if kind == "str" else (lambda v: repr(float(v))))
-            )
-            out[col] = (kind, enc(cmn), enc(cmx))
-        return out
+        return _combine_stats(
+            [{c: (k, mn, mx)} for c, k, mn, mx in rows]
+        )
 
     def organize(self, table: Optional[str] = None) -> dict:
         """Merge CONTIGUOUS runs of small shards into compaction-target-
@@ -529,8 +780,8 @@ class ShardStoreCatalog(WritableConnector):
         for t in tables:
             with self._db_lock:
                 shards = self.db.execute(
-                    "SELECT id, path, rows, seq FROM shards "
-                    "WHERE table_name = ? ORDER BY seq",
+                    "SELECT id, path, rows, seq, COALESCE(max_seq, seq) "
+                    "FROM shards WHERE table_name = ? ORDER BY seq",
                     (t,),
                 ).fetchall()
             merged = 0
@@ -541,27 +792,33 @@ class ShardStoreCatalog(WritableConnector):
                 if len(run) < 2:
                     return 0
                 tb = pa.concat_tables(
-                    [self._read_shard(p) for _i, p, _r, _q in run]
+                    [self._read_shard(p) for _i, p, _r, _q, _m in run]
                 )
                 # the merged shard's stats are the combine of the stored
                 # per-shard stats — no dictionary rebuild, no device
                 # round-trip (reference ShardCompactor merges ColumnStats
                 # the same way)
-                stats = self._merged_stats([i for i, _p, _r, _q in run])
+                stats = self._merged_stats([i for i, *_rest in run])
                 path = self._write_file(_t, tb)
+                # seq interval [first seq, max covered seq] keeps both
+                # offset pagination AND scan_delta() exact across the
+                # merge; bump=False because the data is unchanged —
+                # compaction must never invalidate caches or matviews
                 self._insert_shard_meta(
                     _t, path, tb.num_rows, stats,
                     seq=run[0][3],
-                    drop_ids=[i for i, _p, _r, _q in run],
+                    max_seq=max(m for *_x, m in run),
+                    drop_ids=[i for i, *_rest in run],
+                    bump=False,
                 )
-                self._gc([p for _i, p, _r, _q in run])
+                self._gc([p for _i, p, _r, _q, _m in run])
                 return len(run)
 
-            for sid, path, rows, seq in shards:
+            for sid, path, rows, seq, mseq in shards:
                 if rows < self.compact_rows and acc + rows <= max(
                     self.compact_rows, rows
                 ):
-                    run.append((sid, path, rows, seq))
+                    run.append((sid, path, rows, seq, mseq))
                     acc += rows
                     if acc >= self.compact_rows:
                         merged += flush(run)
@@ -572,7 +829,7 @@ class ShardStoreCatalog(WritableConnector):
                     merged += flush(run)
                     run, acc = [], 0
                     if rows < self.compact_rows:
-                        run.append((sid, path, rows, seq))
+                        run.append((sid, path, rows, seq, mseq))
                         acc = rows
             merged += flush(run)
             if merged:

@@ -155,6 +155,14 @@ class WriteError(RuntimeError):
     pass
 
 
+class DeltaUnavailable(RuntimeError):
+    """A connector's scan_delta() cannot reconstruct the requested seq
+    range exactly (e.g. compaction merged already-consumed rows with
+    unconsumed ones into a single shard). Callers treat this as "delta
+    maintenance not possible right now" and fall back to full
+    recompute — it is never a data-loss signal."""
+
+
 class WritableConnector(Connector):
     """Write protocol (reference ConnectorPageSink / ConnectorMetadata
     beginCreateTable/beginInsert, presto-spi/.../spi/ConnectorPageSink.java).

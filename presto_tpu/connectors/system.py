@@ -20,6 +20,7 @@ from .spi import Connector
 
 QUERIES = "system.runtime.queries"
 NODES = "system.runtime.nodes"
+MATERIALIZED_VIEWS = "system.runtime.materialized_views"
 # jmx-analog runtime metrics (reference presto-jmx connector exposing
 # the JVM's Runtime/Memory/OperatingSystem MBeans as tables): the
 # process table is this interpreter's runtime MBean, the memory table
@@ -182,6 +183,49 @@ _QUERIES_SCHEMA: Dict[str, T.Type] = {
 _NODES_SCHEMA: Dict[str, T.Type] = {
     "node_id": T.VARCHAR, "state": T.VARCHAR, "coordinator": T.VARCHAR,
 }
+_MATVIEWS_SCHEMA: Dict[str, T.Type] = {
+    "name": T.VARCHAR, "base_tables": T.VARCHAR, "incremental": T.VARCHAR,
+    "reason": T.VARCHAR, "staleness_versions": T.BIGINT,
+    "last_refresh_at": T.DOUBLE, "last_mode": T.VARCHAR,
+    "rows_patched": T.BIGINT, "refreshes": T.BIGINT,
+}
+
+
+def _mat_views_page(mgr) -> Page:
+    rows = mgr.rows() if mgr is not None else []
+    if not rows:
+        from ..ops.union import empty_page
+
+        return empty_page(_MATVIEWS_SCHEMA)
+    return Page.from_dict(
+        {
+            "name": _varchar([r["name"] for r in rows]),
+            "base_tables": _varchar([r["base_tables"] for r in rows]),
+            "incremental": _varchar(
+                ["true" if r["incremental"] else "false" for r in rows]
+            ),
+            "reason": _varchar([r["reason"] or None for r in rows]),
+            "staleness_versions": (
+                np.array(
+                    [r["staleness_versions"] for r in rows], np.int64
+                ),
+                T.BIGINT,
+            ),
+            "last_refresh_at": (
+                np.array([r["last_refresh_at"] for r in rows], np.float64),
+                T.DOUBLE,
+            ),
+            "last_mode": _varchar([r["last_mode"] for r in rows]),
+            "rows_patched": (
+                np.array([r["rows_patched"] for r in rows], np.int64),
+                T.BIGINT,
+            ),
+            "refreshes": (
+                np.array([r["refreshes"] for r in rows], np.int64),
+                T.BIGINT,
+            ),
+        }
+    )
 
 
 class SystemCatalog(Connector):
@@ -197,6 +241,9 @@ class SystemCatalog(Connector):
         self.node_manager = node_manager
         self.self_uri = self_uri
         self.memory_manager = memory_manager
+        # set explicitly (not via late getattr) so __getattr__ never
+        # delegates the name to the wrapped catalog
+        self.matview_manager = None
 
     @property
     def name(self):
@@ -204,7 +251,9 @@ class SystemCatalog(Connector):
 
     # -- metadata --
 
-    _SYSTEM_TABLES = (QUERIES, NODES, JMX_PROCESS, JMX_MEMORY)
+    _SYSTEM_TABLES = (
+        QUERIES, NODES, JMX_PROCESS, JMX_MEMORY, MATERIALIZED_VIEWS
+    )
 
     def table_names(self) -> List[str]:
         return list(self.wrapped.table_names()) + list(self._SYSTEM_TABLES)
@@ -218,6 +267,8 @@ class SystemCatalog(Connector):
             return dict(_JMX_PROCESS_SCHEMA)
         if table == JMX_MEMORY:
             return dict(_JMX_MEMORY_SCHEMA)
+        if table == MATERIALIZED_VIEWS:
+            return dict(_MATVIEWS_SCHEMA)
         return self.wrapped.schema(table)
 
     def row_count(self, table: str) -> int:
@@ -225,6 +276,9 @@ class SystemCatalog(Connector):
             return len(self.manager.list_queries()) if self.manager else 0
         if table in (NODES, JMX_PROCESS, JMX_MEMORY):
             return 1
+        if table == MATERIALIZED_VIEWS:
+            mgr = self.matview_manager
+            return len(mgr.views) if mgr is not None else 0
         return self.wrapped.row_count(table)
 
     def unique_columns(self, table: str):
@@ -250,6 +304,8 @@ class SystemCatalog(Connector):
             return _process_page()
         if table == JMX_MEMORY:
             return _memory_page(self.memory_manager, self.node_manager)
+        if table == MATERIALIZED_VIEWS:
+            return _mat_views_page(self.matview_manager)
         return self.wrapped.page(table)
 
     def exact_row_count(self, table: str) -> int:

@@ -60,7 +60,7 @@ def _env_int(name: str, default: int) -> int:
 class CacheStats:
     __slots__ = (
         "hits", "misses", "stores", "evictions", "invalidations", "bytes",
-        "revoked_bytes",
+        "revoked_bytes", "patches",
     )
 
     def __init__(self):
@@ -71,6 +71,7 @@ class CacheStats:
         self.invalidations = 0  # version-bump / identity-mismatch drops
         self.bytes = 0
         self.revoked_bytes = 0  # evicted under memory pressure
+        self.patches = 0  # stale entries updated in place from deltas
 
     def snapshot(self) -> dict:
         total = self.hits + self.misses
@@ -82,6 +83,7 @@ class CacheStats:
             "invalidations": self.invalidations,
             "bytes": self.bytes,
             "revoked_bytes": self.revoked_bytes,
+            "patches": self.patches,
             "hit_rate": round(self.hits / total, 4) if total else None,
         }
 
@@ -208,6 +210,27 @@ def table_versions(catalog, tables) -> Optional[Tuple[int, ...]]:
         if v is None:
             return None
         out.append(int(v))
+    return tuple(out)
+
+
+def delta_tokens(catalog, tables) -> Optional[Tuple[Any, ...]]:
+    """Per-table delta cursors (connector delta_token(), e.g. shardstore
+    seq high-water marks), or None when any table's connector cannot
+    produce one. Tokens make a result entry PATCHABLE: on a version
+    mismatch the cache can apply the (token, now] delta instead of
+    evicting (the matview maintenance machinery)."""
+    fn = getattr(catalog, "delta_token", None)
+    if fn is None:
+        return None
+    out = []
+    for tname in tables:
+        try:
+            tok = fn(tname)
+        except Exception:  # noqa: BLE001 — dropped table: not patchable
+            return None
+        if tok is None:
+            return None
+        out.append(tok)
     return tuple(out)
 
 
@@ -410,6 +433,9 @@ class ResultEntry:
     versions: Tuple[int, ...]
     catalog_ref: Any
     nbytes: int = 0
+    # per-table delta cursors recorded with the entry; None = the entry
+    # can only hit or invalidate, never patch
+    tokens: Optional[Tuple[Any, ...]] = None
 
 
 class ResultCache(SnapshotValidatedCache):
@@ -417,14 +443,19 @@ class ResultCache(SnapshotValidatedCache):
         super().__init__(max_bytes=max_bytes, name="result")
 
     def preversions(self, plan, catalog):
-        """(tables, versions) read BEFORE execution — the ordering that
-        makes a concurrent write waste the entry instead of staling it —
-        or None when any table is unversioned (bypass)."""
+        """(tables, versions, tokens) read BEFORE execution — the
+        ordering that makes a concurrent write waste the entry instead
+        of staling it — or None when any table is unversioned (bypass).
+        Tokens are read AFTER the version vector; store() only keeps
+        them when the versions still match post-execution, which pins
+        the executed data to exactly the tokens' snapshot (a delta
+        applied later can never double-count rows that raced in during
+        execution)."""
         tables = plan_tables(plan)
         versions = table_versions(catalog, tables)
         if versions is None:
             return None
-        return (tables, versions)
+        return (tables, versions, delta_tokens(catalog, tables))
 
     def store(self, key, page, titles, catalog, pre) -> None:
         if not self.enabled or pre is None:
@@ -441,9 +472,55 @@ class ResultCache(SnapshotValidatedCache):
             return
         if self.max_bytes is not None and nbytes > self.max_bytes:
             return  # bigger than the whole cache: not worth thrashing
-        tables, versions = pre
+        tables, versions, tokens = pre
+        if tokens is not None and (
+            table_versions(catalog, tables) != versions
+        ):
+            # a writer raced the execution: the page may hold rows newer
+            # than the tokens claim, and patching from them would apply
+            # those rows twice — keep the entry but make it unpatchable
+            tokens = None
         self.put(key, ResultEntry(page, tuple(titles), tables, versions,
-                                  ref, nbytes), nbytes=nbytes)
+                                  ref, nbytes, tokens), nbytes=nbytes)
+
+    def lookup(self, key, catalog):
+        """Hit / patch / invalidate: the snapshot-validated lookup plus a
+        third verdict — an entry whose base tables moved by pure appends
+        is brought up to date IN PLACE from the (token, now] delta when
+        the plan is delta-patchable (matview maintenance planner),
+        instead of being evicted and recomputed."""
+        ent = self.get(key, count=False)
+        if ent is None:
+            with self._lock:
+                self.stats.misses += 1
+            return None
+        if ent.catalog_ref() is not catalog:
+            self.invalidate(key)
+            with self._lock:
+                self.stats.misses += 1
+            return None
+        if table_versions(catalog, ent.tables) == ent.versions:
+            with self._lock:
+                self.stats.hits += 1
+            return ent
+        patched = None
+        if ent.tokens is not None and len(key) >= 2:
+            try:
+                from ..matview.patch import patch_entry
+
+                patched = patch_entry(key[1], ent, catalog)
+            except Exception:  # noqa: BLE001 — patch is best-effort; a
+                patched = None  # failure falls back to plain invalidate
+        if patched is not None:
+            self.put(key, patched, nbytes=patched.nbytes)
+            with self._lock:
+                self.stats.patches += 1
+                self.stats.hits += 1
+            return patched
+        self.invalidate(key)
+        with self._lock:
+            self.stats.misses += 1
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -517,6 +594,8 @@ def format_summary(snap: Dict[str, dict]) -> str:
         if s is None:
             continue
         line = f"{name} {s['hits']}h/{s['misses']}m/{s['evictions']}e"
+        if s.get("patches"):
+            line += f"/{s['patches']}p"
         if s.get("bytes"):
             line += f" {s['bytes']:,}B"
         parts.append(line)

@@ -1,0 +1,18 @@
+"""Incrementally-maintained materialized views (reference raptor's
+materialized-view shadowing + Presto's REFRESH MATERIALIZED VIEW).
+
+Three layers:
+
+  maintenance.py — classifies a view plan as delta-patchable vs
+      recompute-only, and executes the delta/merge pipeline over
+      connector `scan_delta()` snapshots.
+  patch.py — the qcache "patch" verdict: updates a stale result-cache
+      entry in place from base-table deltas instead of evicting it.
+  manager.py — the session-facing registry: CREATE/REFRESH/DROP
+      MATERIALIZED VIEW, interval-driven auto refresh, and the
+      system.runtime.materialized_views rows.
+"""
+
+from .maintenance import MaintenancePlan, classify  # noqa: F401
+from .manager import MatViewManager, MatViewStats  # noqa: F401
+from .patch import patch_entry  # noqa: F401

@@ -1,0 +1,89 @@
+"""qcache's third verdict: PATCH a stale result entry in place.
+
+A result-cache entry whose plan classifies as delta-patchable doesn't
+need eviction when its base tables advance — the delta rows since the
+entry's recorded tokens run through the view's core plan and merge into
+the cached page. Consistency rule (shared with ResultCache.preversions):
+read the version vector FIRST, the delta tokens SECOND, then the data —
+so a racing writer can only make the patched entry FRESHER than the
+versions it claims, never staler; the next lookup re-validates against
+current versions and patches again or invalidates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..connectors.spi import DeltaUnavailable
+from ..exec import qcache
+from ..exec.stats import page_device_bytes
+from ..page import Page
+from ..plan import nodes as N
+from . import maintenance
+
+
+def patch_entry(plan, ent, catalog) -> Optional[object]:
+    """Return a fresh ResultEntry built by patching `ent` with the
+    deltas between ent.tokens and the current snapshot, or None when
+    patching is impossible/unprofitable (caller invalidates)."""
+    if not maintenance.PATCH_ENABLED:
+        return None
+    if ent.tokens is None or not isinstance(plan, N.Output):
+        return None
+    mplan, _reason = maintenance.classify(plan)
+    if mplan is None or mplan.tables != ent.tables:
+        return None
+
+    scan_delta = getattr(catalog, "scan_delta", None)
+    if scan_delta is None:
+        return None
+    versions = qcache.table_versions(catalog, ent.tables)
+    if versions is None:
+        return None
+    new_tokens = qcache.delta_tokens(catalog, ent.tables)
+    if new_tokens is None:
+        return None
+
+    deltas = {}
+    total_delta = 0
+    base_rows = 0
+    for tb, old_tok, new_tok in zip(ent.tables, ent.tokens, new_tokens):
+        # token = (high_seq, data_version, nonappend_version). A
+        # nonappend bump means rows were rewritten/removed — deltas
+        # can't express that. A receding high_seq means the table was
+        # dropped and recreated.
+        if new_tok[2] != old_tok[2] or new_tok[0] < old_tok[0]:
+            return None
+        try:
+            delta = scan_delta(tb, old_tok[0], new_tok[0])
+        except DeltaUnavailable:
+            return None
+        except Exception:  # noqa: BLE001 — connector raced a drop: bail
+            return None
+        deltas[tb] = delta
+        total_delta += int(delta.count)
+        try:
+            base_rows += int(catalog.row_count(tb))
+        except Exception:  # noqa: BLE001 — stats miss: skip the cap
+            pass
+    if base_rows and total_delta > maintenance.DELTA_MAX_FRAC * base_rows:
+        return None
+
+    # Cached pages are title-named (Output renamed them); the merge
+    # pipeline runs on channel names. Rename is positional both ways —
+    # exactly what Executor._exec_output did.
+    old = Page.from_blocks(
+        list(ent.page.blocks), list(plan.channels), count=ent.page.count
+    )
+    merged, _rows = maintenance.patch_pages(catalog, mplan, old, deltas)
+    new_page = Page.from_blocks(
+        list(merged.blocks), list(plan.titles), count=merged.count
+    )
+    return dataclasses.replace(
+        ent,
+        page=new_page,
+        versions=versions,
+        tokens=new_tokens,
+        nbytes=page_device_bytes(new_page),
+    )

@@ -153,6 +153,23 @@ class Session:
         self.prepared: dict = {}  # name -> prepared statement SQL
         self.schemas = {"default"}
         self._session_overrides: dict = {}  # SET SESSION k = v
+        from .matview.manager import MatViewManager
+
+        self.matviews_mgr = MatViewManager(self)
+        self._attach_matviews()
+
+    def _attach_matviews(self) -> None:
+        """Point the routing SystemCatalog (if any, connectors/system.py)
+        at this session's MV registry so system.runtime.materialized_views
+        serves live rows. Walks the .wrapped chain; only a catalog that
+        DECLARES the slot (SystemCatalog sets it to None in __init__)
+        gets it — __getattr__ delegators must not be tricked by hasattr."""
+        probe = self.catalog
+        while probe is not None:
+            if "matview_manager" in getattr(probe, "__dict__", {}):
+                probe.matview_manager = self.matviews_mgr
+                return
+            probe = getattr(probe, "wrapped", None)
 
     def _swap_catalog(self, catalog) -> None:
         """Point the session AND its executors at a different catalog
@@ -213,6 +230,10 @@ class Session:
             derived.views = self.views
             derived.prepared = self.prepared
             derived.schemas = self.schemas
+            derived.matviews_mgr = self.matviews_mgr
+            # derived's __init__ attached its own (now orphaned) manager
+            # to the shared SystemCatalog — re-attach the session-wide one
+            self._attach_matviews()
             cache[key] = derived
         return derived
 
@@ -314,7 +335,9 @@ class Session:
              t.ResetSession, t.ShowSession, t.RenameTable, t.RenameColumn,
              t.AddColumn, t.DropColumn, t.Grant, t.Revoke,
              t.ShowFunctions, t.ShowCatalogs, t.ShowCreateTable,
-             t.ShowStats, t.Use, t.Analyze, t.ShowGrants),
+             t.ShowStats, t.Use, t.Analyze, t.ShowGrants,
+             t.CreateMaterializedView, t.RefreshMaterializedView,
+             t.DropMaterializedView),
         ):
             # the user travels as an argument: the Session is shared across
             # QueryManager worker threads, so instance state would race
@@ -553,11 +576,17 @@ class Session:
             return self._create_table(ast)
         if isinstance(ast, t.DropTable):
             cat = self._writable()
-            if ast.name.lower() not in cat.table_names():
+            name = ast.name.lower()
+            if name in self.matviews_mgr.views:
+                raise ValueError(
+                    f"{name!r} is a materialized view; "
+                    "use DROP MATERIALIZED VIEW"
+                )
+            if name not in cat.table_names():
                 if ast.if_exists:
                     return self._row_count_result(0)
                 raise ValueError(f"table {ast.name!r} does not exist")
-            cat.drop_table(ast.name.lower())
+            cat.drop_table(name)
             return self._row_count_result(0)
         if isinstance(ast, t.Insert):
             return self._insert(ast)
@@ -568,6 +597,10 @@ class Session:
         # DropViewTask.java; expansion happens in the planner) --
         if isinstance(ast, t.CreateView):
             name = ast.name.lower()
+            if name in self.matviews_mgr.views:
+                raise ValueError(
+                    f"materialized view {name!r} already exists"
+                )
             if name in self.catalog.table_names():
                 raise ValueError(f"table {name!r} already exists")
             if name in self.views and not ast.or_replace:
@@ -601,6 +634,20 @@ class Session:
             txt = f"CREATE VIEW {name} AS {self.views[name]}"
             pg = Page.from_dict({"Create View": [txt]})
             return QueryResult(pg, ("Create View",))
+
+        # -- materialized views (matview/manager.py; reference
+        # execution/CreateMaterializedViewTask.java) --
+        if isinstance(ast, t.CreateMaterializedView):
+            self.matviews_mgr.create(
+                ast.name, ast.query_sql, ast.if_not_exists
+            )
+            return self._row_count_result(0)
+        if isinstance(ast, t.RefreshMaterializedView):
+            self.matviews_mgr.refresh(ast.name, full=ast.full)
+            return self._row_count_result(0)
+        if isinstance(ast, t.DropMaterializedView):
+            self.matviews_mgr.drop(ast.name, ast.if_exists)
+            return self._row_count_result(0)
 
         # -- schemas (reference CreateSchemaTask.java, DropSchemaTask) --
         if isinstance(ast, t.CreateSchema):
@@ -1080,6 +1127,8 @@ class Session:
             # would be permanently shadowed — reject the collision both
             # ways (CREATE VIEW already checks tables)
             raise ValueError(f"view {name!r} already exists")
+        if name in self.matviews_mgr.views:
+            raise ValueError(f"materialized view {name!r} already exists")
         if name in cat.table_names():
             if ast.if_not_exists:
                 return self._row_count_result(0)
@@ -1302,8 +1351,14 @@ class Session:
         cache_txt = "\n-- caches: " + qcache.format_summary(
             qcache.snapshot_all()
         )
+        # materialized-view freshness (matview/manager.py): which views
+        # exist, delta vs recompute maintenance, and how stale each is
+        matview_txt = ""
+        mgr = getattr(self, "matviews_mgr", None)
+        if mgr is not None and mgr.views:
+            matview_txt = "\n-- matview: " + mgr.format_summary()
         return (
-            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{cache_txt}\n"
+            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{cache_txt}{matview_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
         )
 

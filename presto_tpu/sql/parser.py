@@ -250,6 +250,12 @@ class Parser:
             self.finish()
             return stmt
         if self.accept_kw("drop"):
+            if self.accept_word("materialized"):
+                self.expect_word("view")
+                if_exists = self._accept_if_exists()
+                name = self.ident()
+                self.finish()
+                return t.DropMaterializedView(name, if_exists)
             if self.accept_word("view"):
                 if_exists = self._accept_if_exists()
                 name = self.ident()
@@ -265,6 +271,14 @@ class Parser:
             name = self.ident()
             self.finish()
             return t.DropTable(name, if_exists)
+        if self.at_word("refresh"):
+            self.i += 1
+            self.expect_word("materialized")
+            self.expect_word("view")
+            name = self.ident()
+            full = self.accept_word("full")
+            self.finish()
+            return t.RefreshMaterializedView(name, full)
         if self.at_word("alter"):
             self.i += 1
             self.expect_kw("table")
@@ -402,6 +416,14 @@ class Parser:
             return True
         return False
 
+    def _accept_if_not_exists(self) -> bool:
+        if self.tok.kind == "ident" and self.tok.text.lower() == "if":
+            self.i += 1
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
     def accept_word(self, w: str) -> bool:
         """Accept a CONTEXTUAL keyword: matches whether the tokenizer
         classified it as kw or ident (statement heads like VIEW/PREPARE/
@@ -462,21 +484,18 @@ class Parser:
             self.expect_kw("as")
             body = self._rest_of_statement()
             return t.CreateView(name, body, or_replace=False)
+        if self.accept_word("materialized"):
+            self.expect_word("view")
+            if_not_exists = self._accept_if_not_exists()
+            name = self.ident()
+            self.expect_kw("as")
+            body = self._rest_of_statement()
+            return t.CreateMaterializedView(name, body, if_not_exists)
         if self.accept_word("schema"):
-            if_not_exists = False
-            if self.tok.kind == "ident" and self.tok.text.lower() == "if":
-                self.i += 1
-                self.expect_kw("not")
-                self.expect_kw("exists")
-                if_not_exists = True
+            if_not_exists = self._accept_if_not_exists()
             return t.CreateSchema(self.ident(), if_not_exists)
         self.expect_kw("table")
-        if_not_exists = False
-        if self.tok.kind == "ident" and self.tok.text.lower() == "if":
-            self.i += 1
-            self.expect_kw("not")
-            self.expect_kw("exists")
-            if_not_exists = True
+        if_not_exists = self._accept_if_not_exists()
         name = self.ident()
         if self.accept_kw("as"):
             q = self.parse_query()

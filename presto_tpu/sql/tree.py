@@ -484,6 +484,31 @@ class ShowCreateView(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateMaterializedView(Node):
+    """CREATE MATERIALIZED VIEW [IF NOT EXISTS] name AS <query>
+    (reference sql/tree/CreateMaterializedView.java)."""
+
+    name: str
+    query_sql: str  # original text of the view query
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshMaterializedView(Node):
+    """REFRESH MATERIALIZED VIEW name [FULL] (reference
+    sql/tree/RefreshMaterializedView.java; FULL forces recompute)."""
+
+    name: str
+    full: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropMaterializedView(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateSchema(Node):
     name: str
     if_not_exists: bool

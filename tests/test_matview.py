@@ -1,0 +1,440 @@
+"""Materialized views (presto_tpu/matview/): DDL lifecycle, the
+delta-vs-recompute maintenance classifier, delta refresh correctness
+against python oracles, the qcache patch verdict, ingest APIs
+(append_batch/upsert), and the system.runtime.materialized_views /
+EXPLAIN ANALYZE observability surfaces."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.shardstore import ShardStoreCatalog
+from presto_tpu.connectors.system import SystemCatalog
+from presto_tpu.matview import maintenance
+from presto_tpu.page import Page
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def store(tmp_path):
+    cat = ShardStoreCatalog(str(tmp_path / "shards"))
+    cat.create_table("ev", {"k": T.BIGINT, "v": T.BIGINT})
+    cat.append("ev", _page([1, 2, 3, 1, 2], [10, 20, 30, 40, 50]))
+    return cat
+
+
+@pytest.fixture()
+def sess(store):
+    return Session(store)
+
+
+def _page(ks, vs):
+    return Page.from_dict({
+        "k": (np.asarray(ks, np.int64), T.BIGINT),
+        "v": (np.asarray(vs, np.int64), T.BIGINT),
+    })
+
+
+def _oracle_groupby(cat):
+    page = cat.page("ev")
+    n = int(page.count)
+    ks = np.asarray(page.block("k").data[:n])
+    vs = np.asarray(page.block("v").data[:n])
+    out = {}
+    for k, v in zip(ks.tolist(), vs.tolist()):
+        c, s = out.get(k, (0, 0))
+        out[k] = (c + 1, s + v)
+    return sorted((k, c, s) for k, (c, s) in out.items())
+
+
+# -- classifier --
+
+def _classify_sql(sess, sql):
+    return maintenance.classify(sess.plan(sql))
+
+
+def test_classify_aggregate(sess):
+    mplan, reason = _classify_sql(
+        sess, "select k, count(*) as n, sum(v) as total from ev group by k"
+    )
+    assert mplan is not None and mplan.kind == "aggregate", reason
+    assert mplan.tables == ("ev",)
+    assert tuple(a.func for a in mplan.merge_aggs) == ("sum", "sum")
+
+
+def test_classify_aggregate_with_filter_and_order(sess):
+    mplan, reason = _classify_sql(
+        sess,
+        "select k, min(v) as lo, max(v) as hi from ev "
+        "where v > 5 group by k order by k",
+    )
+    assert mplan is not None and mplan.kind == "aggregate", reason
+    assert len(mplan.terminals) == 1  # the Sort
+
+
+def test_classify_append(sess):
+    mplan, reason = _classify_sql(
+        sess, "select k, v from ev where v > 15"
+    )
+    assert mplan is not None and mplan.kind == "append", reason
+
+
+def test_classify_rejects():
+    # fresh session over a two-table store for the join case
+    import tempfile
+
+    cat = ShardStoreCatalog(tempfile.mkdtemp())
+    cat.create_table("ev", {"k": T.BIGINT, "v": T.BIGINT})
+    cat.create_table("dim", {"k": T.BIGINT, "name": T.VARCHAR})
+    cat.append("ev", _page([1], [10]))
+    s = Session(cat)
+    for sql, why in [
+        ("select a.k from ev a join dim b on a.k = b.k", "join"),
+        ("select k, avg(v) as m from ev group by k", "avg"),
+        ("select k, count(*) as n from ev group by k limit 2",
+         "limit above agg"),
+        ("select k, rank() over (order by v) as r from ev", "window"),
+    ]:
+        mplan, reason = maintenance.classify(s.plan(sql))
+        assert mplan is None, (sql, why)
+        assert reason
+
+
+# -- DDL lifecycle + oracle equality --
+
+def test_create_query_drop(sess, store):
+    sess.query(
+        "create materialized view daily as "
+        "select k, count(*) as n, sum(v) as total from ev group by k"
+    )
+    assert sorted(sess.query("select * from daily").rows()) == \
+        _oracle_groupby(store)
+    # MV reads like a table, including through aggregates
+    assert sess.query("select sum(n) from daily").rows() == [(5,)]
+    sess.query("drop materialized view daily")
+    assert "daily" not in sess.matviews_mgr.views
+    assert "daily" not in store.table_names()
+
+
+def test_refresh_delta_oracle(sess, store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess.query(
+        "create materialized view daily as "
+        "select k, count(*) as n, sum(v) as total from ev group by k"
+    )
+    store.append("ev", _page([2, 7], [5, 77]))
+    mode = sess.matviews_mgr.refresh("daily")
+    assert mode == "delta"
+    assert sorted(sess.query("select * from daily").rows()) == \
+        _oracle_groupby(store)
+    mv = sess.matviews_mgr.views["daily"]
+    assert mv.last_mode == "delta" and mv.rows_patched == 2
+
+
+def test_refresh_delta_append_kind(sess, store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess.query(
+        "create materialized view hot as select k, v from ev where v >= 30"
+    )
+    store.append("ev", _page([8, 9], [3, 300]))
+    assert sess.matviews_mgr.refresh("hot") == "delta"
+    assert sorted(sess.query("select * from hot").rows()) == \
+        [(1, 40), (2, 50), (3, 30), (9, 300)]
+    # append-kind keeps the MV storage table append-only: the delta
+    # lands as a new shard instead of a rewrite
+    assert store.shard_count("hot") == 2
+
+
+def test_refresh_full_statement(sess, store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    store.append("ev", _page([5], [500]))
+    sess.query("refresh materialized view daily full")
+    mv = sess.matviews_mgr.views["daily"]
+    assert mv.last_mode == "full" and mv.last_reason == "forced full"
+    assert sorted(sess.query("select * from daily").rows()) == \
+        [(k, s) for k, _c, s in _oracle_groupby(store)]
+
+
+def test_refresh_statement_takes_delta_path(sess, store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    store.append("ev", _page([5], [500]))
+    sess.query("refresh materialized view daily")
+    assert sess.matviews_mgr.views["daily"].last_mode == "delta"
+
+
+def test_join_view_falls_back_full(sess, store):
+    sess.query(
+        "create materialized view selfj as "
+        "select a.k as k, a.v as v from ev a join ev b on a.k = b.k"
+    )
+    mv = sess.matviews_mgr.views["selfj"]
+    assert mv.mplan is None and "Join" in mv.reason
+    store.append("ev", _page([1], [1]))
+    assert sess.matviews_mgr.refresh("selfj") == "full"
+
+
+def test_upsert_rewrite_falls_back_full(tmp_path, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    cat = ShardStoreCatalog(str(tmp_path / "s"))
+    cat.create_table(
+        "ev", {"k": T.BIGINT, "v": T.BIGINT}, unique_columns=["k"]
+    )
+    cat.append("ev", _page([1, 2, 3], [10, 20, 30]))
+    s = Session(cat)
+    s.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    # key collision -> rewrite -> nonappend_version bump -> full refresh
+    res = cat.upsert("ev", _page([2, 4], [99, 44]))
+    assert res == {"appended": 1, "updated": 1}
+    assert s.matviews_mgr.refresh("daily") == "full"
+    assert sorted(s.query("select * from daily").rows()) == \
+        [(1, 10), (2, 99), (3, 30), (4, 44)]
+
+
+def test_delta_too_large_falls_back_full(sess, store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 0.1)
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    store.append("ev", _page([1, 2, 3], [1, 2, 3]))  # 60% of base
+    assert sess.matviews_mgr.refresh("daily") == "full"
+    assert sorted(sess.query("select * from daily").rows()) == \
+        [(k, s_) for k, _c, s_ in _oracle_groupby(store)]
+
+
+def test_noop_refresh_is_delta(sess, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    assert sess.matviews_mgr.refresh("daily") == "delta"
+    assert "no-op" in sess.matviews_mgr.views["daily"].last_reason
+
+
+# -- DDL breadth / error paths --
+
+def test_if_not_exists_and_if_exists(sess):
+    sess.query("create materialized view m as select k from ev")
+    with pytest.raises(ValueError, match="already exists"):
+        sess.query("create materialized view m as select k from ev")
+    sess.query("create materialized view if not exists m as select v from ev")
+    # IF NOT EXISTS kept the original definition
+    assert sess.query("select count(*) from m").rows() == [(5,)]
+    sess.query("drop materialized view m")
+    with pytest.raises(ValueError, match="does not exist"):
+        sess.query("drop materialized view m")
+    sess.query("drop materialized view if exists m")
+
+
+def test_name_collisions(sess):
+    sess.query("create materialized view m as select k from ev")
+    with pytest.raises(ValueError, match="materialized view"):
+        sess.query("create view m as select k from ev")
+    with pytest.raises(ValueError, match="materialized view"):
+        sess.query("create table m (k bigint)")
+    with pytest.raises(ValueError, match="DROP MATERIALIZED VIEW"):
+        sess.query("drop table m")
+    with pytest.raises(ValueError, match="already exists"):
+        sess.query("create materialized view ev as select k from ev")
+    sess.query("create view pv as select k from ev")
+    with pytest.raises(ValueError, match="already exists"):
+        sess.query("create materialized view pv as select k from ev")
+
+
+def test_create_table_if_not_exists_error_paths(sess):
+    sess.query("create table t2 (a bigint)")
+    with pytest.raises(ValueError, match="already exists"):
+        sess.query("create table t2 (a bigint)")
+    sess.query("create table if not exists t2 (a bigint)")
+    sess.query("drop table t2")
+    with pytest.raises(ValueError, match="does not exist"):
+        sess.query("drop table t2")
+    sess.query("drop table if exists t2")
+
+
+def test_refresh_unknown_view_errors(sess):
+    with pytest.raises(ValueError, match="does not exist"):
+        sess.query("refresh materialized view nope")
+
+
+# -- qcache patch verdict --
+
+def test_result_cache_patch(store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    from presto_tpu.exec import qcache
+
+    sess = Session(store)
+    sql = "select k, count(*) as n, sum(v) as total from ev group by k"
+    sess.query(sql)
+    s0 = qcache.RESULT_CACHE.stats.snapshot()
+    store.append("ev", _page([3, 6], [7, 60]))
+    got = sorted(sess.query(sql).rows())
+    s1 = qcache.RESULT_CACHE.stats.snapshot()
+    assert s1["patches"] - s0["patches"] == 1
+    assert got == _oracle_groupby(store)
+    # patched entry serves plain hits until the next write
+    sess.query(sql)
+    s2 = qcache.RESULT_CACHE.stats.snapshot()
+    assert s2["hits"] - s1["hits"] == 1
+    assert s2["patches"] == s1["patches"]
+
+
+def test_result_cache_patch_disabled(store, monkeypatch):
+    from presto_tpu.exec import qcache
+
+    monkeypatch.setattr(maintenance, "PATCH_ENABLED", False)
+    sess = Session(store)
+    sql = "select k, sum(v) as total from ev group by k"
+    sess.query(sql)
+    s0 = qcache.RESULT_CACHE.stats.snapshot()
+    store.append("ev", _page([9], [900]))
+    got = sorted(sess.query(sql).rows())
+    s1 = qcache.RESULT_CACHE.stats.snapshot()
+    assert s1["patches"] == s0["patches"]
+    assert s1["invalidations"] - s0["invalidations"] == 1
+    assert (9, 900) in got
+
+
+def test_result_cache_patch_not_applicable_for_join(store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    from presto_tpu.exec import qcache
+
+    sess = Session(store)
+    sql = ("select a.k as k, sum(a.v) as s from ev a "
+           "join ev b on a.k = b.k group by a.k")
+    oracle = sorted(sess.query(sql).rows())
+    s0 = qcache.RESULT_CACHE.stats.snapshot()
+    store.append("ev", _page([1], [1]))
+    fresh = sorted(sess.query(sql).rows())
+    s1 = qcache.RESULT_CACHE.stats.snapshot()
+    assert s1["patches"] == s0["patches"]  # joins never patch
+    assert fresh != oracle  # and the re-execution saw the new row
+
+
+# -- ingest APIs --
+
+def test_append_batch_single_version_bump(store):
+    v0 = store.table_version("ev")
+    wrote = store.append_batch(
+        "ev", [_page([7], [70]), _page([8], [80]), _page([9], [90])]
+    )
+    assert wrote == 3
+    assert store.shard_count("ev") == 2  # 1 original + 1 merged batch
+    v1 = store.table_version("ev")
+    assert v1 != v0
+    # one bump for the whole batch: a second single append moves the
+    # version exactly as far as the 3-page batch did
+    store.append("ev", _page([10], [100]))
+    assert store.table_version("ev") != v1
+
+
+def test_upsert_pure_new_keys_is_append(tmp_path):
+    cat = ShardStoreCatalog(str(tmp_path / "s"))
+    cat.create_table(
+        "ev", {"k": T.BIGINT, "v": T.BIGINT}, unique_columns=["k"]
+    )
+    cat.append("ev", _page([1, 2], [10, 20]))
+    tok0 = cat.delta_token("ev")
+    assert cat.upsert("ev", _page([3, 4], [30, 40])) == \
+        {"appended": 2, "updated": 0}
+    tok1 = cat.delta_token("ev")
+    # append fast path: nonappend_version unchanged -> delta-visible
+    assert tok1[2] == tok0[2]
+    delta = cat.scan_delta("ev", tok0[0], tok1[0])
+    assert int(delta.count) == 2
+
+
+def test_upsert_requires_unique_columns(store):
+    from presto_tpu.connectors.spi import WriteError
+
+    with pytest.raises(WriteError, match="unique"):
+        store.upsert("ev", _page([1], [1]))
+
+
+# -- observability --
+
+def test_system_table_and_explain_footer(store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess = Session(SystemCatalog(store))
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    sess.query(
+        "create materialized view selfj as "
+        "select a.k as k from ev a join ev b on a.k = b.k"
+    )
+    store.append("ev", _page([1], [1]))
+    sess.matviews_mgr.refresh("daily")
+    rows = sess.query(
+        "select name, incremental, last_mode, rows_patched, refreshes "
+        "from system.runtime.materialized_views order by name"
+    ).rows()
+    assert rows == [
+        ("daily", "true", "delta", 1, 2),
+        ("selfj", "false", "full", 0, 1),
+    ]
+    txt = sess.explain_analyze("select count(*) from ev")
+    (line,) = [ln for ln in txt.split("\n") if ln.startswith("-- matview:")]
+    assert "daily aggregate mode=delta" in line
+    assert "selfj full(" in line
+
+
+def test_staleness_counts_versions(store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    sess = Session(store)
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    mgr = sess.matviews_mgr
+    mv = mgr.views["daily"]
+    assert mgr._staleness(mv) == 0
+    store.append("ev", _page([1], [1]))
+    store.append("ev", _page([2], [2]))
+    assert mgr._staleness(mv) == 2
+    mgr.refresh("daily")
+    assert mgr._staleness(mv) == 0
+
+
+def test_auto_refresh_thread(store, monkeypatch):
+    monkeypatch.setattr(maintenance, "DELTA_MAX_FRAC", 1.0)
+    import time as _t
+
+    sess = Session(store)
+    sess.query(
+        "create materialized view daily as "
+        "select k, sum(v) as total from ev group by k"
+    )
+    mgr = sess.matviews_mgr
+    store.append("ev", _page([6], [600]))
+    assert mgr.start_auto_refresh(0.05)
+    try:
+        deadline = _t.time() + 5.0
+        while _t.time() < deadline:
+            if mgr.views["daily"].versions == \
+                    maintenance.qcache.table_versions(store, ("ev",)):
+                break
+            _t.sleep(0.02)
+        assert (6, 600) in sess.query("select * from daily").rows()
+    finally:
+        mgr.stop_auto_refresh()
+
+
+def test_derived_session_shares_registry(store):
+    sess = Session(store)
+    sess.query("create materialized view m as select k from ev")
+    derived = sess.with_properties({"streaming": True})
+    assert derived.matviews_mgr is sess.matviews_mgr

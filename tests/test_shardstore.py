@@ -186,3 +186,70 @@ def test_offset_pagination_stable_across_compaction(store):
         if start == B:  # compact mid-scan
             assert store.organize().get("t", 0) >= 2
     assert np.array_equal(np.concatenate(got), want)
+
+
+def test_organize_does_not_bump_table_version(store):
+    """Compaction rewrites shards but the DATA is unchanged — bumping
+    table_version would invalidate every warm cache and force spurious
+    MV refreshes on every organizer tick (a real perf bug)."""
+    store.create_table_from_page("t", _page(0, 200))
+    for i in range(1, 8):
+        store.append("t", _page(i * 200, i * 200 + 200))
+    v0 = store.table_version("t")
+    tok0 = store.delta_token("t")
+    assert store.organize().get("t", 0) >= 4
+    assert store.table_version("t") == v0
+    assert store.delta_token("t") == tok0
+    # a real write still bumps
+    store.append("t", _page(1600, 1700))
+    assert store.table_version("t") != v0
+
+
+def test_result_cache_survives_organize(store):
+    from presto_tpu.exec import qcache
+
+    store.create_table_from_page("t", _page(0, 200))
+    for i in range(1, 8):
+        store.append("t", _page(i * 200, i * 200 + 200))
+    sess = Session(store)
+    sql = "select count(*) as c, sum(v) as s from t"
+    want = sess.query(sql).rows()
+    s0 = qcache.RESULT_CACHE.stats.snapshot()
+    assert store.organize().get("t", 0) >= 4
+    assert sess.query(sql).rows() == want
+    s1 = qcache.RESULT_CACHE.stats.snapshot()
+    assert s1["hits"] - s0["hits"] == 1  # warm hit, not re-execution
+    assert s1["invalidations"] == s0["invalidations"]
+
+
+def test_scan_delta_survives_compaction_of_consumed_shards(store):
+    """A delta cursor at the top of fully-consumed shards stays exact
+    when organize() merges those shards: the merged shard inherits the
+    run's seq interval, so it sits entirely at-or-below the cursor."""
+    store.create_table_from_page("t", _page(0, 200))
+    for i in range(1, 8):
+        store.append("t", _page(i * 200, i * 200 + 200))
+    tok = store.delta_token("t")  # consumed everything so far
+    assert store.organize().get("t", 0) >= 4
+    store.append("t", _page(1600, 1650))
+    tok2 = store.delta_token("t")
+    delta = store.scan_delta("t", tok[0], tok2[0])
+    assert int(delta.count) == 50
+    ks = sorted(np.asarray(delta.block("k").data[:50]).tolist())
+    assert ks == list(range(1600, 1650))
+
+
+def test_scan_delta_straddling_merge_raises(store):
+    """When compaction merges rows at-or-below the cursor with rows
+    above it into ONE shard, the range is unreconstructable — scan_delta
+    must refuse (DeltaUnavailable) instead of double-counting."""
+    from presto_tpu.connectors.spi import DeltaUnavailable
+
+    store.create_table_from_page("t", _page(0, 200))
+    tok = store.delta_token("t")  # cursor strictly inside what follows
+    for i in range(1, 8):
+        store.append("t", _page(i * 200, i * 200 + 200))
+    assert store.organize().get("t", 0) >= 4
+    tok2 = store.delta_token("t")
+    with pytest.raises(DeltaUnavailable):
+        store.scan_delta("t", tok[0], tok2[0])

@@ -54,6 +54,13 @@ GATED = (
     # group-by) so a default-path change can't silently shelve them
     "join_build", "join_probe_n1",
     "pallas_join_build", "pallas_join_probe", "pallas_groupby_hash",
+    # streaming ingest + incremental matviews (PR 14): delta refresh
+    # must scale with the delta, not the base (the micro RAISES when
+    # the refresh falls off the delta path, and its speedup_vs_full
+    # ratio carries the >=5x acceptance floor via ratio_floors);
+    # mixed_soak_qps RAISES when zero reads were served by the qcache
+    # patch verdict, so a broken patch path fails the gate outright
+    "matview_refresh_delta", "ingest_append", "mixed_soak_qps",
 )
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
@@ -152,6 +159,19 @@ def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
             print(mline)
             if r["serialize_MBps"] < mbps_floor * (1.0 - tolerance):
                 failures.append(mline)
+        # acceptance-ratio floors (e.g. matview delta refresh >= 5x a
+        # full recompute at 1% delta) — absolute ratios, no tolerance:
+        # the ratio is self-normalizing across machines
+        ratio_floor = (gate.get("ratio_floors") or {}).get(name)
+        if ratio_floor:
+            ratio_val = r.get("speedup_vs_full")
+            rline = (
+                f"{name}: speedup_vs_full {ratio_val} vs floor "
+                f"{ratio_floor}x"
+            )
+            print(rline)
+            if ratio_val is None or ratio_val < ratio_floor:
+                failures.append(rline)
     failures += run_qps_gate(tolerance, baseline_path)
     if failures:
         print(f"\nbench_gate: FAIL — {len(failures)} check(s) regressed "
