@@ -30,7 +30,16 @@ stats-not-snapshotted (error)
 cache-not-snapshotted (error)
     A module-level `*Cache` instance in exec/qcache.py missing from
     `snapshot_all()` — the one aggregation point EXPLAIN ANALYZE and
-    the server stats endpoints read."""
+    the server stats endpoints read.
+
+stats-not-exported (error)
+    A `*Stats` class that reaches a snapshot surface (passes
+    stats-not-snapshotted) but never reaches the unified metrics plane
+    (presto_tpu/obs/): its name never appears — as a reference or a
+    parameter annotation — inside an export/metrics-named function.
+    Snapshot-only stats show in EXPLAIN ANALYZE but stay invisible to
+    `/v1/metrics` and `system.runtime.metrics`; every silo must feed
+    both."""
 
 from __future__ import annotations
 
@@ -54,6 +63,7 @@ _BREAKER_DOCS = ("docs/fault-tolerance.md", "docs/tuning.md")
 _QCACHE_FILE = "presto_tpu/exec/qcache.py"
 _SNAPSHOT_ALL = "snapshot_all"
 _SURFACE_TOKENS = ("snapshot", "stats", "status", "explain", "summary")
+_EXPORT_TOKENS = ("export", "metrics")
 _STATS_SCOPES = ("presto_tpu/exec/", "presto_tpu/server/")
 
 
@@ -71,6 +81,7 @@ class ObservabilityCoveragePass(AnalysisPass):
         "breaker-undocumented",
         "stats-not-snapshotted",
         "cache-not-snapshotted",
+        "stats-not-exported",
     )
 
     def run(self, project: Project) -> List[Finding]:
@@ -194,6 +205,7 @@ class ObservabilityCoveragePass(AnalysisPass):
                     )
 
         surfaced: Set[str] = set()
+        exported: Set[str] = set()
         for sf in project.iter_files("presto_tpu/"):
             for fn, cnode in iter_scoped_defs(sf.tree.body):
                 cls = cnode.name if cnode is not None else None
@@ -202,6 +214,44 @@ class ObservabilityCoveragePass(AnalysisPass):
                 fn_is_surface = any(
                     t in fn.name for t in _SURFACE_TOKENS
                 )
+                # metrics-plane reach: the class named (by reference or
+                # by parameter annotation — quoted annotations are str
+                # constants) inside an export/metrics-named function.
+                # Str constants count ONLY in annotation positions: a
+                # docstring or help text merely mentioning the class is
+                # not an export.
+                if any(t in fn.name for t in _EXPORT_TOKENS):
+                    ann_ids: Set[int] = set()
+                    a = fn.args
+                    ann_roots = [
+                        arg.annotation
+                        for arg in (
+                            list(getattr(a, "posonlyargs", []))
+                            + list(a.args) + list(a.kwonlyargs)
+                            + [a.vararg, a.kwarg]
+                        )
+                        if arg is not None and arg.annotation is not None
+                    ]
+                    if fn.returns is not None:
+                        ann_roots.append(fn.returns)
+                    for sub in ast.walk(fn):
+                        if isinstance(sub, ast.AnnAssign):
+                            ann_roots.append(sub.annotation)
+                    for root in ann_roots:
+                        for sub in ast.walk(root):
+                            ann_ids.add(id(sub))
+                    for node in ast.walk(fn):
+                        ref = None
+                        if isinstance(node, ast.Name):
+                            ref = node.id
+                        elif (
+                            id(node) in ann_ids
+                            and isinstance(node, ast.Constant)
+                            and isinstance(node.value, str)
+                        ):
+                            ref = node.value.split(".")[-1].strip("'\"")
+                        if ref in stats_classes and cls != ref:
+                            exported.add(ref)
                 # local/param typing for (a): v = CStats() assigns and
                 # `x: CStats` annotations inside this function
                 typed: Dict[str, str] = {}
@@ -271,7 +321,7 @@ class ObservabilityCoveragePass(AnalysisPass):
                     ):
                         surfaced.add(mod_typed[node.func.value.id])
 
-        return [
+        findings = [
             Finding(
                 "stats-not-snapshotted", "error", rel, line,
                 f"{name} is not reachable from any snapshot/stats/"
@@ -281,6 +331,20 @@ class ObservabilityCoveragePass(AnalysisPass):
             for name, (rel, line) in sorted(stats_classes.items())
             if name not in surfaced
         ]
+        # only classes that PASS stats-not-snapshotted are held to the
+        # export bar — a write-only silo already has the stronger finding
+        findings += [
+            Finding(
+                "stats-not-exported", "error", rel, line,
+                f"{name} reaches a snapshot surface but never the "
+                f"metrics plane — no export/metrics-named function "
+                f"references it (presto_tpu/obs/export.py)",
+                name,
+            )
+            for name, (rel, line) in sorted(stats_classes.items())
+            if name in surfaced and name not in exported
+        ]
+        return findings
 
     # -- qcache globals ------------------------------------------------------
 

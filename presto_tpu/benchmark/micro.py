@@ -1515,6 +1515,41 @@ def run_mixed_soak_qps_bench(sf: float, runs: int = RUNS) -> Dict:
     }
 
 
+def run_metrics_scrape_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Prometheus scrape cost of the unified registry (obs/metrics.py):
+    `render()` with the default producers registered plus a synthetic
+    series population — the /v1/metrics handler's hot path, which a
+    per-15s scraper must never make a serving-latency event. rows/s
+    counts samples rendered per wall second."""
+    from ..obs.metrics import METRICS
+
+    # realistic series population on top of the default exports: 64
+    # labeled counter series + histogram observations
+    for i in range(64):
+        METRICS.counter(
+            "presto_bench_scrape_total", 1, {"series": f"s{i:02d}"}
+        )
+        METRICS.observe("presto_bench_scrape_seconds", 0.0002 * (i + 1))
+    nsamples = len(METRICS.collect())
+    iters = 50
+    best = float("inf")
+    for _ in range(max(runs, 1)):
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            text = METRICS.render()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    if "presto_bench_scrape_total" not in text:
+        raise RuntimeError("scrape output missing the bench series")
+    return {
+        "name": "metrics_scrape",
+        "rows": nsamples,
+        "rows_per_s": round(nsamples / best),
+        "ms": round(best * 1e3, 3),
+        "note": f"{nsamples} samples per scrape at "
+                f"{best * 1e6:.0f}us each ({len(text)} bytes)",
+    }
+
+
 HOST_BENCHES = {
     "serde_lz4": run_serde_bench,
     "serde_encoded": run_serde_encoded_bench,
@@ -1526,6 +1561,7 @@ HOST_BENCHES = {
     "matview_refresh_delta": run_matview_refresh_delta_bench,
     "ingest_append": run_ingest_append_bench,
     "mixed_soak_qps": run_mixed_soak_qps_bench,
+    "metrics_scrape": run_metrics_scrape_bench,
 }
 
 

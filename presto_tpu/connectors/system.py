@@ -21,6 +21,11 @@ from .spi import Connector
 QUERIES = "system.runtime.queries"
 NODES = "system.runtime.nodes"
 MATERIALIZED_VIEWS = "system.runtime.materialized_views"
+# the unified observability plane (presto_tpu/obs/): every metric
+# sample the /v1/metrics scrape would return, and every span of the
+# recently kept query traces, queryable as SQL
+METRICS = "system.runtime.metrics"
+TASKS = "system.runtime.tasks"
 # jmx-analog runtime metrics (reference presto-jmx connector exposing
 # the JVM's Runtime/Memory/OperatingSystem MBeans as tables): the
 # process table is this interpreter's runtime MBean, the memory table
@@ -183,6 +188,16 @@ _QUERIES_SCHEMA: Dict[str, T.Type] = {
 _NODES_SCHEMA: Dict[str, T.Type] = {
     "node_id": T.VARCHAR, "state": T.VARCHAR, "coordinator": T.VARCHAR,
 }
+_METRICS_SCHEMA: Dict[str, T.Type] = {
+    "name": T.VARCHAR, "type": T.VARCHAR, "labels": T.VARCHAR,
+    "value": T.DOUBLE,
+}
+_TASKS_SCHEMA: Dict[str, T.Type] = {
+    "trace_id": T.VARCHAR, "span_id": T.VARCHAR, "parent_id": T.VARCHAR,
+    "name": T.VARCHAR, "status": T.VARCHAR, "start_s": T.DOUBLE,
+    "wall_ms": T.DOUBLE, "rows_out": T.BIGINT, "bytes_out": T.BIGINT,
+    "attrs": T.VARCHAR,
+}
 _MATVIEWS_SCHEMA: Dict[str, T.Type] = {
     "name": T.VARCHAR, "base_tables": T.VARCHAR, "incremental": T.VARCHAR,
     "reason": T.VARCHAR, "staleness_versions": T.BIGINT,
@@ -228,6 +243,84 @@ def _mat_views_page(mgr) -> Page:
     )
 
 
+def _metrics_page() -> Page:
+    from ..obs.metrics import METRICS as REGISTRY
+
+    samples = REGISTRY.collect()
+    if not samples:
+        from ..ops.union import empty_page
+
+        return empty_page(_METRICS_SCHEMA)
+    return Page.from_dict(
+        {
+            "name": _varchar([s[0] for s in samples]),
+            "type": _varchar([s[1] for s in samples]),
+            "labels": _varchar(
+                [
+                    ",".join(f"{k}={v}" for k, v in s[2]) or None
+                    for s in samples
+                ]
+            ),
+            "value": (
+                np.array([float(s[3]) for s in samples], np.float64),
+                T.DOUBLE,
+            ),
+        }
+    )
+
+
+def _tasks_page() -> Page:
+    """One row per span over the trace store's kept traces — the merged
+    fleet trees, so a cluster query's worker task spans appear here."""
+    from ..obs.span import TRACES
+
+    spans = [s for tr in TRACES.recent() for s in tr.spans()]
+    if not spans:
+        from ..ops.union import empty_page
+
+        return empty_page(_TASKS_SCHEMA)
+
+    def _intattr(span, key) -> int:
+        try:
+            return int(span.attrs.get(key, -1))
+        except (TypeError, ValueError):
+            return -1
+
+    return Page.from_dict(
+        {
+            "trace_id": _varchar([s.trace_id for s in spans]),
+            "span_id": _varchar([s.span_id for s in spans]),
+            "parent_id": _varchar([s.parent_id for s in spans]),
+            "name": _varchar([s.name for s in spans]),
+            "status": _varchar([s.status for s in spans]),
+            "start_s": (
+                np.array([s.start for s in spans], np.float64), T.DOUBLE,
+            ),
+            "wall_ms": (
+                np.array([s.wall_s * 1e3 for s in spans], np.float64),
+                T.DOUBLE,
+            ),
+            "rows_out": (
+                np.array([_intattr(s, "rows") for s in spans], np.int64),
+                T.BIGINT,
+            ),
+            "bytes_out": (
+                np.array([_intattr(s, "bytes") for s in spans], np.int64),
+                T.BIGINT,
+            ),
+            "attrs": _varchar(
+                [
+                    ",".join(
+                        f"{k}={v}" for k, v in sorted(s.attrs.items())
+                        if k not in ("rows", "bytes")
+                    ) or None
+                    for s in spans
+                ]
+            ),
+        }
+    )
+
+
 class SystemCatalog(Connector):
     """Routes system.runtime.* to live snapshots, everything else to the
     wrapped catalog. `manager`/`node_manager` are late-bound attributes —
@@ -252,7 +345,8 @@ class SystemCatalog(Connector):
     # -- metadata --
 
     _SYSTEM_TABLES = (
-        QUERIES, NODES, JMX_PROCESS, JMX_MEMORY, MATERIALIZED_VIEWS
+        QUERIES, NODES, JMX_PROCESS, JMX_MEMORY, MATERIALIZED_VIEWS,
+        METRICS, TASKS,
     )
 
     def table_names(self) -> List[str]:
@@ -269,13 +363,17 @@ class SystemCatalog(Connector):
             return dict(_JMX_MEMORY_SCHEMA)
         if table == MATERIALIZED_VIEWS:
             return dict(_MATVIEWS_SCHEMA)
+        if table == METRICS:
+            return dict(_METRICS_SCHEMA)
+        if table == TASKS:
+            return dict(_TASKS_SCHEMA)
         return self.wrapped.schema(table)
 
     def row_count(self, table: str) -> int:
         if table == QUERIES:
             return len(self.manager.list_queries()) if self.manager else 0
-        if table in (NODES, JMX_PROCESS, JMX_MEMORY):
-            return 1
+        if table in (NODES, JMX_PROCESS, JMX_MEMORY, METRICS, TASKS):
+            return 1  # planner estimate; exact counts come from the page
         if table == MATERIALIZED_VIEWS:
             mgr = self.matview_manager
             return len(mgr.views) if mgr is not None else 0
@@ -306,6 +404,10 @@ class SystemCatalog(Connector):
             return _memory_page(self.memory_manager, self.node_manager)
         if table == MATERIALIZED_VIEWS:
             return _mat_views_page(self.matview_manager)
+        if table == METRICS:
+            return _metrics_page()
+        if table == TASKS:
+            return _tasks_page()
         return self.wrapped.page(table)
 
     def exact_row_count(self, table: str) -> int:

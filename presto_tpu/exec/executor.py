@@ -119,14 +119,28 @@ class Executor:
             # the pre-cache behavior (one session reuses its own trace).
             fn = self._local_kernels.get(key)
             if fn is None:
-                fn = jax.jit(make_fn()) if self.jit else make_fn()
+                fn = self._build_kernel(make_fn)
                 self._local_kernels[key] = fn
             return fn
         gkey = (self._backend, self.jit, key)
         fn = KERNEL_CACHE.get(gkey)
         if fn is None:
-            fn = jax.jit(make_fn()) if self.jit else make_fn()
+            fn = self._build_kernel(make_fn)
             KERNEL_CACHE.put(gkey, fn)
+        return fn
+
+    def _build_kernel(self, make_fn):
+        """Cache-fill: jit (compilation itself is lazy, paid at the first
+        call) and, when the observability plane is on, wrap in the
+        compile-vs-execute profiler. The wrapper is stored in the cache
+        so "first call" stays attached to the entry's lifetime; it is
+        exception-transparent (the breaker protocol in _kernel_guarded
+        classifies faults by the escaping exception)."""
+        from ..obs.kernelprof import KERNEL_PROFILE, profiling_enabled
+
+        fn = jax.jit(make_fn()) if self.jit else make_fn()
+        if profiling_enabled():
+            fn = KERNEL_PROFILE.wrap(fn)
         return fn
 
     def _kernel_guarded(self, breaker_name, key, make_fn, *args):

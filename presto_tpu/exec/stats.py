@@ -29,7 +29,13 @@ class NodeStats:
     rows_in: int = 0
     rows_out: int = 0
     retries: int = 0
-    out_bytes: int = 0  # device bytes of the node's output page (last call)
+    # device bytes of the node's output page. `out_bytes` is the LAST
+    # call's page (the node's live footprint — the collector's
+    # peak_bytes high-water sums these); multi-dispatch nodes report
+    # honestly through the cumulative total and per-dispatch peak.
+    out_bytes: int = 0
+    out_bytes_total: int = 0  # cumulative across all dispatches
+    out_bytes_peak: int = 0  # largest single dispatch
     detail: str = ""  # connector-provided annotation (e.g. file pruning)
 
     def line(self) -> str:
@@ -42,6 +48,11 @@ class NodeStats:
         ]
         if self.calls != 1:
             parts.append(f"{self.calls} calls")
+            if self.out_bytes_total != self.out_bytes:
+                parts.append(
+                    f"Σ{_fmt_bytes(self.out_bytes_total)}"
+                    f" (peak {_fmt_bytes(self.out_bytes_peak)})"
+                )
         if self.retries:
             parts.append(f"{self.retries} retries")
         if self.detail:
@@ -107,6 +118,8 @@ class StatsCollector:
         s.wall_s += wall_s
         s.retries += retries
         s.out_bytes = out_bytes
+        s.out_bytes_total += out_bytes
+        s.out_bytes_peak = max(s.out_bytes_peak, out_bytes)
         if self.sync_counts:
             s.rows_in += self._count(rows_in)
             s.rows_out += self._count(rows_out)
@@ -123,6 +136,9 @@ class StatsCollector:
         for s, rows_in, rows_out in pending:
             s.rows_in += self._count(rows_in)
             s.rows_out += self._count(rows_out)
+        from ..obs.export import export_node_stats
+
+        export_node_stats(self.by_node)
 
     def lookup(self, node) -> Optional[NodeStats]:
         return self.by_node.get(id(node))

@@ -1,0 +1,30 @@
+"""Observability plane: span trees + metrics registry + kernel profile.
+
+One subsystem unifying the engine's stats silos (see
+docs/observability.md): `Trace`/`TRACES` for per-query span trees that
+survive retry and merge across the fleet, `METRICS` for the
+process-wide Prometheus-rendered registry, `KERNEL_PROFILE` for the
+compile-vs-execute split of KERNEL_CACHE entries.
+"""
+
+from .kernelprof import KERNEL_PROFILE, KernelProfile
+from .metrics import METRICS, MetricsRegistry
+from .span import (
+    TRACES,
+    Span,
+    Trace,
+    TraceStore,
+    render_critical_path,
+)
+
+__all__ = [
+    "KERNEL_PROFILE",
+    "KernelProfile",
+    "METRICS",
+    "MetricsRegistry",
+    "TRACES",
+    "Span",
+    "Trace",
+    "TraceStore",
+    "render_critical_path",
+]

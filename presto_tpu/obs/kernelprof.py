@@ -1,0 +1,97 @@
+"""Kernel compile-vs-execute split for KERNEL_CACHE entries.
+
+jax.jit compiles lazily at the FIRST call of the jitted callable, so a
+cache entry's first invocation pays trace + lower + compile (+ one
+execution) and every later invocation pays dispatch only. Wrapping the
+callable at cache-fill time splits those two costs: EXPLAIN ANALYZE
+can separate warm-up from steady state, and the metrics plane exports
+`presto_kernel_{compile,execute}_*` series.
+
+The wrapper must be exception-transparent: `_kernel_guarded`'s breaker
+protocol classifies kernel faults by the exception that escapes the
+call — swallowing or re-wrapping it here would break fallback retry.
+Only successful calls are recorded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class KernelProfile:
+    """Process-wide compile/execute accounting (the kernel cache itself
+    is process-wide, keyed by backend — see exec/qcache.KERNEL_CACHE)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.executions = 0
+        self.execute_s = 0.0
+
+    def record(self, first_call: bool, seconds: float) -> None:
+        with self._lock:
+            if first_call:
+                self.compiles += 1
+                self.compile_s += seconds
+            else:
+                self.executions += 1
+                self.execute_s += seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "compile_s": self.compile_s,
+                "executions": self.executions,
+                "execute_s": self.execute_s,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.compiles = 0
+            self.compile_s = 0.0
+            self.executions = 0
+            self.execute_s = 0.0
+
+    def wrap(self, fn: Callable) -> "_ProfiledKernel":
+        return _ProfiledKernel(self, fn)
+
+
+class _ProfiledKernel:
+    """Callable shim stored in KERNEL_CACHE in place of the raw (jitted)
+    function. First successful call = compile bucket (includes the one
+    execution jit performs while compiling); later calls = execute
+    bucket (dispatch wall — jax dispatch is async, so this is time to
+    enqueue, not device time)."""
+
+    __slots__ = ("_profile", "fn", "_compiled", "_lock")
+
+    def __init__(self, profile: KernelProfile, fn: Callable):
+        self._profile = profile
+        self.fn = fn
+        self._compiled = False
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        # the first-call decision must be atomic: two threads racing the
+        # first call would otherwise both book the compile bucket
+        with self._lock:
+            first = not self._compiled
+            self._compiled = True
+        self._profile.record(first, dt)
+        return out
+
+
+def profiling_enabled() -> bool:
+    from ..server import knobs
+
+    return knobs.trace_enabled()
+
+
+KERNEL_PROFILE = KernelProfile()

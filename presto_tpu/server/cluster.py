@@ -385,6 +385,12 @@ class HttpScheduler:
         stats under _lock — the write must take the same lock."""
         with self._lock:
             self.stats.caches = snapshot
+            from ..obs.export import export_scheduler_stats
+
+            # republish the cumulative scheduler counters as gauges once
+            # per query (idempotent; the registry takes its own lock
+            # inside ours, never the reverse)
+            export_scheduler_stats(self.stats)
 
     def stats_snapshot(self) -> dict:
         """Point-in-time copy of SchedulerStats for EXPLAIN ANALYZE and
@@ -393,23 +399,44 @@ class HttpScheduler:
         with self._lock:
             return self.stats.snapshot()
 
-    def run(self, root: N.PlanNode, query_id: Optional[str] = None):
+    def run(self, root: N.PlanNode, query_id: Optional[str] = None,
+            trace_ctx: Optional[tuple] = None):
         """Execute with bounded query-level re-execution: a retryable
         failure that escaped per-task retry (e.g. a mid-stream worker
-        loss) re-runs the whole plan against a fresh worker snapshot."""
+        loss) re-runs the whole plan against a fresh worker snapshot.
+
+        `trace_ctx` is the observability plane's (Trace, parent span_id)
+        pair (docs/observability.md): each query-level attempt gets its
+        own child span, so a retried query shows up as SIBLING attempt
+        subtrees, never an overwrite."""
         if query_id is None:
             import uuid
 
             # unique across sessions sharing these workers: per-query
             # memory accounting must never merge two queries
             query_id = f"q_{uuid.uuid4().hex[:12]}"
+        trace = trace_ctx[0] if trace_ctx else None
         for attempt in range(self.max_query_retries + 1):
             # distinct per-attempt query id: a prior attempt's dying
             # tasks must not share memory accounting with the re-run
             qid = query_id if attempt == 0 else f"{query_id}.r{attempt}"
+            aspan = None
+            if trace is not None:
+                aspan = trace.begin(
+                    f"attempt {attempt}", parent_id=trace_ctx[1],
+                    query_id=qid,
+                )
             try:
-                return self._run_attempt(root, qid)
+                result = self._run_attempt(
+                    root, qid,
+                    tctx=(trace, aspan.span_id) if trace else None,
+                )
+                if trace is not None:
+                    trace.finish(aspan)
+                return result
             except RuntimeError as exc:
+                if trace is not None:
+                    trace.finish(aspan, "error", error=str(exc)[:200])
                 retryable = getattr(exc, "retryable", None)
                 if retryable is None:
                     retryable = _retryable_message(str(exc))
@@ -428,7 +455,8 @@ class HttpScheduler:
                 if not self.nodes.active_workers():
                     raise
 
-    def _run_attempt(self, root: N.PlanNode, query_id: str):
+    def _run_attempt(self, root: N.PlanNode, query_id: str,
+                     tctx: Optional[tuple] = None):
         # snapshot membership for the whole attempt (threaded explicitly
         # so concurrent queries can't clobber each other): producer
         # partition counts must match consumer task counts even if a node
@@ -454,14 +482,47 @@ class HttpScheduler:
                 dyn_links=self._dyn_links(fragment, specs),
                 dyn_values={},
                 wire_caps=wire_caps,
+                tctx=tctx,
+            )
+            rspan = (
+                tctx[0].begin("root-fragment", parent_id=tctx[1])
+                if tctx else None
             )
             ex = FragmentExecutor(self.catalog, {}, sources)
-            return ex.run(fragment)
+            try:
+                result = ex.run(fragment)
+            except Exception:
+                if rspan is not None:
+                    tctx[0].finish(rspan, "error")
+                raise
+            if rspan is not None:
+                tctx[0].finish(rspan)
+            return result
         finally:
+            # sweep final worker span payloads into the merged tree
+            # BEFORE cancellation deletes task state on the workers
+            self._collect_spans(all_tasks, tctx)
             # free worker-side output buffers (reference: task results are
             # acknowledged and deleted after consumption); on failure this
             # doubles as sibling-task cancellation
             self._cancel_tasks(all_tasks)
+
+    def _collect_spans(self, tasks: List[Tuple[str, str]],
+                       tctx: Optional[tuple]) -> None:
+        """Final merge sweep: pull each task's status once and fold its
+        span payload into the query trace. Mid-tree producer stages are
+        never status-polled on the happy path (their consumers are other
+        workers), so without this sweep their spans would be lost. Tasks
+        from failed POSTs 404 here — best effort by design."""
+        if tctx is None:
+            return
+        trace = tctx[0]
+        for uri, task_id in tasks:
+            try:
+                st = self._task_status(uri, task_id)
+            except Exception:  # noqa: BLE001 — observability, best effort
+                continue
+            trace.add_remote(st.get("spans") or ())
 
     def _cancel_tasks(self, tasks: List[Tuple[str, str]]) -> None:
         for uri, task_id in tasks:
@@ -613,7 +674,8 @@ class HttpScheduler:
                          workers: List[str], all_tasks,
                          query_id: Optional[str] = None,
                          dyn_links=None, dyn_values: Optional[dict] = None,
-                         wire_caps: Optional[dict] = None):
+                         wire_caps: Optional[dict] = None,
+                         tctx: Optional[tuple] = None):
         """Run producer stages for each exchange; returns either
         {sid: (kind, handles)} (sharded consumer) or {sid: [pages]}
         (coordinator consumer).
@@ -646,7 +708,7 @@ class HttpScheduler:
                 handles = self._run_sharded_stage(
                     ex.child, ("hash", ex.keys), workers, all_tasks,
                     query_id, dyn_produce=entries, dyn_values=dyn_values,
-                    wire_caps=wire_caps,
+                    wire_caps=wire_caps, tctx=tctx,
                 )
                 resolved[sid] = ("repartition", handles)
             else:
@@ -661,7 +723,7 @@ class HttpScheduler:
                         sharded_consumer and ex.kind == "replicate"
                     ),
                     dyn_produce=entries, dyn_values=dyn_values,
-                    wire_caps=wire_caps,
+                    wire_caps=wire_caps, tctx=tctx,
                 )
                 resolved[sid] = ("gather", handles)
             if entries and any(
@@ -685,6 +747,10 @@ class HttpScheduler:
                 deadline=self.task_deadline,
                 stats=ex_stats,
             )
+            gspan = (
+                tctx[0].begin(f"exchange {sid}", parent_id=tctx[1])
+                if tctx else None
+            )
             pages = []
             try:
                 for page in client.pages():
@@ -696,11 +762,18 @@ class HttpScheduler:
                 # would add ~0.5s of server-side wait per producer to
                 # every retry attempt
                 self._record_exchange(sid, ex_stats, ())
+                if gspan is not None:
+                    tctx[0].finish(gspan, "error", error=str(e)[:200])
                 raise TaskFailure(
                     str(e), uri=e.uri, task_id=e.task_id,
                     retryable=_retryable_message(str(e)),
                 ) from None
             self._record_exchange(sid, ex_stats, handles)
+            if gspan is not None:
+                snap = ex_stats.snapshot()
+                tctx[0].finish(
+                    gspan, pages=snap["pages"], bytes=snap["wire_bytes"]
+                )
             out[sid] = pages
         return out
 
@@ -726,6 +799,12 @@ class HttpScheduler:
             ms = st.get("memoryStats") or {}
             revocations += int(ms.get("revocations") or 0)
         entry["producer"] = encode.snapshot()
+        # unified metrics plane: one fold per gather (each ExchangeStats
+        # and producer-encode accumulator lives for exactly one gather)
+        from ..obs.export import export_exchange_stats, export_wire_stats
+
+        export_exchange_stats(ex_stats)
+        export_wire_stats("producer_encode", encode)
         with self._lock:
             self.stats.exchange[sid] = entry
             if spilled or revocations or mem_events:
@@ -746,7 +825,8 @@ class HttpScheduler:
                            unbounded_output: bool = False,
                            dyn_produce=None,
                            dyn_values: Optional[dict] = None,
-                           wire_caps: Optional[dict] = None) -> List[Tuple[str, str]]:
+                           wire_caps: Optional[dict] = None,
+                           tctx: Optional[tuple] = None) -> List[Tuple[str, str]]:
         """One task per worker for sharded stages (splits/repartition
         inputs); scan-less single-distribution stages run as ONE task so
         rows are never duplicated. Returns [(worker_uri, task_id)]."""
@@ -756,11 +836,19 @@ class HttpScheduler:
             ex.kind == "repartition" for ex in specs.values()
         )
         workers = all_workers if sharded else all_workers[:1]
+        sspan = None
+        if tctx is not None:
+            sspan = tctx[0].begin(
+                f"stage {output[0]}:{type(fragment).__name__}",
+                parent_id=tctx[1], tasks=len(workers),
+            )
+            tctx = (tctx[0], sspan.span_id)
         child_resolved = self._resolve_sources(
             specs, True, all_workers, all_tasks, query_id,
             dyn_links=self._dyn_links(fragment, specs),
             dyn_values=dyn_values,
             wire_caps=wire_caps,
+            tctx=tctx,
         )
 
         # row-range splits per scanned table
@@ -815,7 +903,8 @@ class HttpScheduler:
                 "wire": wire_caps,
             }
             launched.append(
-                self._post_with_retry(uri, spec, all_workers, all_tasks)
+                self._post_with_retry(uri, spec, all_workers, all_tasks,
+                                      tctx=tctx)
             )
         # surface start failures eagerly, retrying each failed task onto
         # an alternate healthy worker (catalogs are deterministic across
@@ -827,20 +916,27 @@ class HttpScheduler:
             # start-failure retries (task-level) are separate concerns
             handles.append(
                 self._ensure_started(uri, task_id, spec, all_workers,
-                                     all_tasks)
+                                     all_tasks, tctx=tctx)
             )
+        if sspan is not None:
+            # the stage span covers launch (dispatch + start confirmation);
+            # its children — per-attempt dispatch spans and the workers'
+            # remote task spans — carry the execution wall
+            tctx[0].finish(sspan)
         return handles
 
     # -- task start + retry --
 
     def _post_with_retry(self, uri: str, spec: dict,
-                         snapshot: List[str], all_tasks):
+                         snapshot: List[str], all_tasks,
+                         tctx: Optional[tuple] = None):
         """POST a task, retrying a refused connection onto alternates.
         Returns (uri, task_id, spec, attempts_used)."""
         attempt = 1
         while True:
             task_id = f"t_{next(self._task_ids)}"
-            failed = self._try_post(uri, task_id, spec, all_tasks)
+            failed = self._try_post(uri, task_id, spec, all_tasks,
+                                    tctx=tctx)
             if failed is None:
                 return uri, task_id, spec, attempt
             error = failed["error"]
@@ -861,25 +957,45 @@ class HttpScheduler:
                 self.stats.task_retries += 1
 
     def _try_post(self, uri: str, task_id: str, spec: dict,
-                  all_tasks) -> Optional[dict]:
+                  all_tasks, tctx: Optional[tuple] = None) -> Optional[dict]:
         """POST a task; returns None on success, else a synthesized
         FAILED status dict (never raises for transport errors). The task
         id is registered for cleanup BEFORE posting: if the POST response
         is lost after the worker already accepted the task, query cleanup
-        still deletes it (DELETE of an unknown task is a no-op)."""
+        still deletes it (DELETE of an unknown task is a no-op).
+
+        This is the single choke point every task POST goes through, so
+        the per-ATTEMPT dispatch span lives here: each (re)post gets its
+        own span under the stage, and the spec carries (trace_id, that
+        span's id) so the worker parents its task span to this exact
+        attempt — a retry is a sibling subtree, never an overwrite."""
         all_tasks.append((uri, task_id))
+        dspan = None
+        if tctx is not None:
+            dspan = tctx[0].begin(
+                f"dispatch {task_id}", parent_id=tctx[1], worker=uri,
+            )
+            spec["trace"] = {
+                "trace_id": tctx[0].trace_id, "parent": dspan.span_id,
+            }
         try:
             self._post_task(uri, task_id, spec)
+            if dspan is not None:
+                tctx[0].finish(dspan)
             return None
         except urllib.error.HTTPError as e:
             # the worker answered: honor its structured verdict
             detail, retryable = _http_error_details(e)
+            if dspan is not None:
+                tctx[0].finish(dspan, "error", error=detail[:200])
             return {
                 "state": "FAILED",
                 "error": detail,
                 "errorInfo": {"retryable": retryable},
             }
         except (urllib.error.URLError, ConnectionError, OSError) as e:
+            if dspan is not None:
+                tctx[0].finish(dspan, "error", error=str(e)[:200])
             return {
                 "state": "FAILED",
                 "error": f"POST to {uri} refused: {e}",
@@ -888,7 +1004,8 @@ class HttpScheduler:
 
     def _ensure_started(self, uri: str, task_id: str, spec: dict,
                         snapshot: List[str], all_tasks,
-                        attempt: int = 1) -> Tuple[str, str]:
+                        attempt: int = 1,
+                        tctx: Optional[tuple] = None) -> Tuple[str, str]:
         """Eager failure surfacing with bounded retry: a task FAILED at
         the status check is re-posted (same spec) to an alternate worker
         after backoff + jitter; unrecoverable failures cancel the
@@ -905,6 +1022,11 @@ class HttpScheduler:
                         "error": str(tf),
                         "errorInfo": {"retryable": tf.retryable},
                     }
+            if tctx is not None:
+                # merge whatever spans the worker reported — a FAILED
+                # attempt's task span (status="error") lands in the tree
+                # HERE, before its replacement is even posted
+                tctx[0].add_remote(status.get("spans") or ())
             if status.get("state") != "FAILED":
                 # started (RUNNING or FINISHED): reset the consecutive-
                 # failure streak feeding the blacklist
@@ -928,7 +1050,8 @@ class HttpScheduler:
             time.sleep(self._backoff(attempt - 1))
             uri = self._pick_alternate(uri, snapshot)
             task_id = f"t_{next(self._task_ids)}"
-            failed = self._try_post(uri, task_id, spec, all_tasks)
+            failed = self._try_post(uri, task_id, spec, all_tasks,
+                                    tctx=tctx)
             posted = failed is None
             if not posted:
                 status = failed  # skip the status poll: classify directly
@@ -1228,50 +1351,102 @@ class HttpClusterSession:
     def _run_fragmented(self, sql: str, use_result_cache: bool = True):
         """The one plan -> fragment -> schedule pipeline both query()
         and explain_analyze() go through; returns (fragmented node,
-        result page). Both serving caches (exec/qcache.py) sit in front
-        of the scheduler: the fragmented plan is cached per (sql, worker
-        count, broadcast config) and validated against connector snapshot
-        versions, and a snapshot-identical repeat serves its page without
-        touching the fleet at all. Worker-count changes (blacklist,
-        re-admission) change the plan key, so failover replans instead of
-        reusing a stale fragmentation."""
+        result page, trace_or_None, phase_ms). Both serving caches
+        (exec/qcache.py) sit in front of the scheduler: the fragmented
+        plan is cached per (sql, worker count, broadcast config) and
+        validated against connector snapshot versions, and a
+        snapshot-identical repeat serves its page without touching the
+        fleet at all. Worker-count changes (blacklist, re-admission)
+        change the plan key, so failover replans instead of reusing a
+        stale fragmentation.
+
+        Tracing (docs/observability.md): the coordinator opens the query
+        root + plan/execute phase spans; the scheduler hangs per-attempt
+        / per-stage / per-dispatch spans under the execute span and
+        merges the workers' remote spans into the same tree."""
         from ..exec import qcache
+        from ..obs import span as obs_span
+        from ..obs.export import export_query
         from ..plan.fragment import fragment_plan
 
-        n_workers = max(len(self.scheduler.nodes.active_workers()), 2)
-        pkey = ("c", sql, self.broadcast_threshold, n_workers,
-                id(self.catalog))
-        ent = qcache.PLAN_CACHE.lookup(pkey, self.catalog)
-        if ent is not None:
-            node = ent.plan
-        else:
-            node = self._planner.plan(sql)
-            node = fragment_plan(node, self.catalog,
-                                 self.broadcast_threshold,
-                                 num_workers=n_workers)
-            qcache.PLAN_CACHE.store(pkey, node, self.catalog)
-        rkey = ("cr", sql, self.broadcast_threshold, n_workers,
-                id(self.catalog))
-        pre = None
-        if use_result_cache:
-            hit = qcache.RESULT_CACHE.lookup(rkey, self.catalog)
-            if hit is not None:
-                self.scheduler.record_caches(qcache.snapshot_all())
-                return node, hit.page
-            pre = qcache.RESULT_CACHE.preversions(node, self.catalog)
-        page = self.scheduler.run(node, query_id=f"q_{next(self._query_ids)}")
-        if pre is not None and qcache.plan_is_deterministic(node):
-            qcache.RESULT_CACHE.store(
-                rkey, page, getattr(node, "titles", ()), self.catalog, pre
+        trace = obs_span.TRACES.new_trace() if obs_span.enabled() else None
+        root = (
+            trace.begin("query", sql=sql[:200])
+            if trace is not None else None
+        )
+        status = "ok"
+        phase_ms: dict = {}
+        try:
+            pspan = (
+                trace.begin("plan", parent=root)
+                if trace is not None else None
             )
-        self.scheduler.record_caches(qcache.snapshot_all())
-        return node, page
+            n_workers = max(len(self.scheduler.nodes.active_workers()), 2)
+            pkey = ("c", sql, self.broadcast_threshold, n_workers,
+                    id(self.catalog))
+            ent = qcache.PLAN_CACHE.lookup(pkey, self.catalog)
+            if ent is not None:
+                node = ent.plan
+            else:
+                node = self._planner.plan(sql)
+                node = fragment_plan(node, self.catalog,
+                                     self.broadcast_threshold,
+                                     num_workers=n_workers)
+                qcache.PLAN_CACHE.store(pkey, node, self.catalog)
+            if trace is not None:
+                trace.finish(pspan)
+                phase_ms["plan"] = round(pspan.wall_s * 1e3, 3)
+            rkey = ("cr", sql, self.broadcast_threshold, n_workers,
+                    id(self.catalog))
+            pre = None
+            if use_result_cache:
+                hit = qcache.RESULT_CACHE.lookup(rkey, self.catalog)
+                if hit is not None:
+                    self.scheduler.record_caches(qcache.snapshot_all())
+                    return node, hit.page, trace, phase_ms
+                pre = qcache.RESULT_CACHE.preversions(node, self.catalog)
+            espan = (
+                trace.begin("execute", parent=root)
+                if trace is not None else None
+            )
+            try:
+                page = self.scheduler.run(
+                    node, query_id=f"q_{next(self._query_ids)}",
+                    trace_ctx=(
+                        (trace, espan.span_id) if trace is not None else None
+                    ),
+                )
+            except Exception:
+                if trace is not None:
+                    trace.finish(espan, "error")
+                raise
+            if trace is not None:
+                trace.finish(espan, rows=int(page.count))
+                phase_ms["execute"] = round(espan.wall_s * 1e3, 3)
+            if pre is not None and qcache.plan_is_deterministic(node):
+                qcache.RESULT_CACHE.store(
+                    rkey, page, getattr(node, "titles", ()), self.catalog,
+                    pre,
+                )
+            self.scheduler.record_caches(qcache.snapshot_all())
+            return node, page, trace, phase_ms
+        except Exception:
+            status = "error"
+            raise
+        finally:
+            if trace is not None:
+                trace.finish(root, status)
+                export_query(status, root.wall_s, phase_ms)
 
     def query(self, sql: str):
         from ..session import QueryResult
 
-        node, page = self._run_fragmented(sql)
-        return QueryResult(page, node.titles)
+        node, page, trace, phase_ms = self._run_fragmented(sql)
+        res = QueryResult(page, node.titles)
+        if trace is not None:
+            res.trace_id = trace.trace_id
+            res.phase_ms = phase_ms
+        return res
 
     def explain_analyze(self, sql: str) -> str:
         """Run the query over the cluster and render the fragmented plan
@@ -1281,7 +1456,9 @@ class HttpClusterSession:
         lives in Session.explain_analyze_plan)."""
         # bypass the result cache: EXPLAIN ANALYZE must actually execute
         # to have wire/memory stats worth reporting
-        node, _page = self._run_fragmented(sql, use_result_cache=False)
+        node, _page, trace, _phase_ms = self._run_fragmented(
+            sql, use_result_cache=False
+        )
         tree = N.plan_tree_str(node)
         lines = [tree]
         st = self.scheduler.stats_snapshot()
@@ -1319,6 +1496,15 @@ class HttpClusterSession:
             from ..exec import qcache
 
             lines.append("-- caches: " + qcache.format_summary(st["caches"]))
+        if trace is not None:
+            # same renderer as Session.explain_analyze_plan — one source
+            # of truth for the single-process and cluster critical path
+            from ..obs.span import render_critical_path
+
+            lines.append(
+                "-- trace: "
+                + render_critical_path(trace, knobs.trace_topk())
+            )
         return "\n".join(lines)
 
     def close(self):

@@ -94,6 +94,12 @@ class CoordinatorServer:
         if self.syscat is not None:
             self.syscat.manager = self.manager
             self.syscat.node_manager = node_manager
+        # resource-group occupancy on /v1/metrics: a scrape-time
+        # producer under a fixed key (a re-created coordinator replaces
+        # the previous registration, never accumulates)
+        from ..obs.export import register_resource_groups
+
+        register_resource_groups(self.manager.groups)
         self.started_at = time.time()
         self.shutting_down = False
         self.authenticator = authenticator
@@ -192,12 +198,12 @@ class CoordinatorServer:
 
             def do_GET(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
-                # health/status stays unauthenticated (load balancers +
-                # cluster heartbeats); every data-bearing surface requires
-                # the principal
-                if parts[:2] not in (["v1", "info"], ["v1", "status"]) and (
-                    self._authenticate() is None
-                ):
+                # health/status/metrics stay unauthenticated (load
+                # balancers, cluster heartbeats, Prometheus scrapers);
+                # every data-bearing surface requires the principal
+                if parts[:2] not in (
+                    ["v1", "info"], ["v1", "status"], ["v1", "metrics"]
+                ) and (self._authenticate() is None):
                     return
                 qs = {}
                 if "?" in self.path:
@@ -267,6 +273,20 @@ class CoordinatorServer:
                         "version": VERSION,
                         "caches": qcache.snapshot_all(),
                     })
+                    return
+                if parts == ["v1", "metrics"]:
+                    # Prometheus text exposition 0.0.4 over the unified
+                    # MetricsRegistry (obs/metrics.py): every stats silo
+                    # — qcache, breakers, exchange, wire, scheduler,
+                    # kernel profile, resource groups — in one scrape
+                    from ..obs.metrics import METRICS
+
+                    self._send(
+                        200, METRICS.render().encode(),
+                        content_type=(
+                            "text/plain; version=0.0.4; charset=utf-8"
+                        ),
+                    )
                     return
                 if not parts or parts == ["ui"]:
                     self._send(

@@ -40,6 +40,12 @@ class QueryCompletedEvent:
     end_time: float
     wall_s: float
     rows: Optional[int]
+    # observability plane (obs/span.py): the query's trace id — join key
+    # into system.runtime.tasks / the trace store — and per-phase wall
+    # timings ({"plan": ms, "execute": ms, ...}) from the span tree.
+    # None when tracing is disabled (PRESTO_TPU_TRACE=0)
+    trace_id: Optional[str] = None
+    phase_ms: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +118,8 @@ class EventBus:
             info.created_at, info.started_at, end,
             end - (info.started_at or end),
             len(info.rows) if info.rows is not None else None,
+            trace_id=getattr(info, "trace_id", None),
+            phase_ms=getattr(info, "phase_ms", None),
         )
         self._fire("query_completed", ev)
 

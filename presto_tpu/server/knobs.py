@@ -26,6 +26,33 @@ def task_deadline_s() -> float:
     return float(os.environ.get("PRESTO_TPU_TASK_DEADLINE_S", "600"))
 
 
+def trace_enabled() -> bool:
+    """Master switch for the observability plane's per-query span trees
+    and kernel compile/execute profiling (docs/observability.md). On by
+    default — the bench gate asserts the overhead stays ≤5% of warm
+    northstar p50; set PRESTO_TPU_TRACE=0 to shed even that."""
+    return os.environ.get("PRESTO_TPU_TRACE", "1") not in ("0", "false", "")
+
+
+def trace_keep() -> int:
+    """How many completed traces the in-process TraceStore retains for
+    `system.runtime.tasks` and EXPLAIN ANALYZE's `-- trace:` footer;
+    older traces are evicted FIFO."""
+    try:
+        return int(os.environ.get("PRESTO_TPU_TRACE_KEEP", "64"))
+    except ValueError:
+        return 64
+
+
+def trace_topk() -> int:
+    """How many spans (ranked by exclusive wall) the `-- trace:`
+    critical-path rendering lists."""
+    try:
+        return int(os.environ.get("PRESTO_TPU_TRACE_TOPK", "5"))
+    except ValueError:
+        return 5
+
+
 def revoke_watermark() -> float:
     """Fraction of the memory limit at which revocation (offload/spill)
     starts, shared by the worker-local memory pool and the cluster

@@ -46,6 +46,10 @@ class QueryInfo:
     user: str = "user"
     source: Optional[str] = None
     properties: dict = dataclasses.field(default_factory=dict)
+    # observability (obs/): set from the QueryResult when the executing
+    # session traced the query; ride the query_completed event
+    trace_id: Optional[str] = None
+    phase_ms: Optional[dict] = None
 
     @property
     def priority(self) -> int:  # query_priority scheduling policy input
@@ -258,6 +262,8 @@ class QueryManager:
                     for t, b in zip(result.titles, result.page.blocks)
                 ]
                 info.rows = result.rows()
+                info.trace_id = getattr(result, "trace_id", None)
+                info.phase_ms = getattr(result, "phase_ms", None)
                 with self._lock:
                     if info.state != CANCELED:
                         info.state = FINISHED

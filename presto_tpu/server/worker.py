@@ -516,6 +516,11 @@ class TaskState:
         self.spill_space = None
         self.memory_stats: Optional[dict] = None
         self.spill_stats: Optional[dict] = None
+        # serialized span dicts for this task (obs/span.py), shipped in
+        # the status payload and merged into the coordinator's trace —
+        # the worker NEVER registers its trace globally, so the HTTP
+        # merge path is exercised even by in-process workers
+        self.spans: list = []
 
 
 # message fragments marking failures that would recur identically on any
@@ -782,6 +787,23 @@ class WorkerServer:
                         "caches": qcache.snapshot_all(),
                     })
                     return
+                if parts == ["v1", "metrics"]:
+                    # Prometheus text exposition — same registry the
+                    # coordinator scrapes (process-global), so an
+                    # in-process fleet shares one plane and a real
+                    # remote worker exposes its own
+                    from ..obs.metrics import METRICS
+
+                    body = METRICS.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if parts == ["v1", "memory"]:
                     # reference MemoryResource polled by the coordinator's
                     # ClusterMemoryManager: buffer + execution ledgers,
@@ -808,6 +830,9 @@ class WorkerServer:
                         "exchangeStats": ex_stats,
                         "memoryStats": t.memory_stats,
                         "spillStats": t.spill_stats,
+                        # serialized span dicts the coordinator merges
+                        # (Trace.add_remote) into the query's one tree
+                        "spans": t.spans or None,
                     })
                     return
                 if (
@@ -925,6 +950,22 @@ class WorkerServer:
             self.pool, state.query_id, state.abort, bound=bound
         )
         state.buffers = buffers
+        # task span opened BEFORE fault injection: a failed attempt must
+        # still ship an error-status span in its FAILED status payload so
+        # the coordinator's merged tree shows the attempt (retry =
+        # sibling spans, never an overwrite). The Trace is standalone —
+        # never registered in the global TRACES store — so the only way
+        # home is the status payload, same as a real remote worker.
+        from ..obs.span import Trace, enabled as _trace_enabled
+
+        tctx = spec.get("trace") or {}
+        task_trace = task_span = None
+        if tctx.get("trace_id") and _trace_enabled():
+            task_trace = Trace(str(tctx["trace_id"]))
+            task_span = task_trace.begin(
+                f"task {task_id}", parent_id=tctx.get("parent"),
+                worker=self.node_id,
+            )
         try:
             if self.fault_rate > 0:
                 # fault injection (reference: test-only task failures,
@@ -1118,7 +1159,41 @@ class WorkerServer:
                 # guaranteed spill cleanup on finish, failure AND kill
                 space.release()
             buffers.finish()
+            try:
+                self._finish_observability(task_id, state, task_trace,
+                                           task_span)
+            except Exception:  # noqa: BLE001 — observability must never
+                # change task outcome or wedge teardown
+                pass
             state.done.set()
+
+    def _finish_observability(self, task_id: str, state: TaskState,
+                              task_trace, task_span) -> None:
+        """Close the task span (rows/bytes attrs from the wire stats,
+        error status for FAILED) into state.spans, and fold this task's
+        serde/pull accounting + outcome counter into the metrics plane."""
+        from ..obs.export import (
+            METRICS, export_exchange_stats, export_wire_stats,
+        )
+
+        wire_snap = state.wire_stats.snapshot()
+        if task_trace is not None and task_span is not None:
+            status = "error" if state.state == "FAILED" else "ok"
+            attrs = {
+                "pages": wire_snap.get("pages", 0),
+                "bytes": wire_snap.get("wire_bytes", 0),
+            }
+            if state.error_info:
+                attrs["error"] = state.error_info.get("message", "")[:200]
+            task_trace.finish(task_span, status=status, **attrs)
+            state.spans = task_trace.to_dicts()
+        METRICS.counter(
+            "presto_worker_tasks_total", 1, {"state": state.state},
+            help="Worker tasks run",
+        )
+        export_wire_stats("task_encode", state.wire_stats)
+        if state.pull_stats is not None:
+            export_exchange_stats(state.pull_stats)
 
     def start(self) -> "WorkerServer":
         self._thread.start()

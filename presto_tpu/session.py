@@ -37,6 +37,10 @@ class QueryResult:
     def __init__(self, page, titles):
         self.page = page
         self.titles = list(titles)
+        # observability plane (docs/observability.md): set by the traced
+        # dispatch paths; None when tracing is off or N/A (DDL, EXPLAIN)
+        self.trace_id: Optional[str] = None
+        self.phase_ms: Optional[dict] = None
 
     def rows(self) -> List[tuple]:
         return self.page.to_pylist()
@@ -351,10 +355,11 @@ class Session:
         return self._dispatch_query(sql, ast, effective)
 
     def _dispatch_query(self, sql, ast, effective):
-        node = self.plan(sql)
         if not isinstance(ast, t.Explain):
-            # plain SELECT: the result-cache fast path
-            return self._execute_plan_cached(node)
+            # plain SELECT: the result-cache fast path, under plan /
+            # execute phase spans when the observability plane is on
+            return self._run_select_traced(sql)
+        node = self.plan(sql)
         from .page import Page
 
         etype = getattr(ast, "etype", "logical")
@@ -397,6 +402,40 @@ class Session:
             lines = N.plan_tree_str(node).split("\n")
         pg = Page.from_dict({"Query Plan": lines})
         return QueryResult(pg, ("Query Plan",))
+
+    def _run_select_traced(self, sql: str) -> QueryResult:
+        """Plan + execute with per-phase spans. The trace lands in the
+        process TraceStore (system.runtime.tasks), the phase timings on
+        the QueryResult (and from there on the query_completed event),
+        and the completion counters in the metrics registry."""
+        from .obs import span as obs_span
+
+        if not obs_span.enabled():
+            return self._execute_plan_cached(self.plan(sql))
+        from .obs.export import export_query
+
+        trace = obs_span.TRACES.new_trace()
+        root = trace.begin("query", sql=sql[:200])
+        status = "ok"
+        phase_ms: dict = {}
+        try:
+            span = trace.begin("plan", parent=root)
+            node = self.plan(sql)
+            trace.finish(span)
+            phase_ms["plan"] = round(span.wall_s * 1e3, 3)
+            span = trace.begin("execute", parent=root)
+            res = self._execute_plan_cached(node)
+            trace.finish(span, rows=res.row_count())
+            phase_ms["execute"] = round(span.wall_s * 1e3, 3)
+            res.trace_id = trace.trace_id
+            res.phase_ms = phase_ms
+            return res
+        except Exception:
+            status = "error"
+            raise
+        finally:
+            trace.finish(root, status)
+            export_query(status, root.wall_s, phase_ms)
 
     def _execute_plan_cached(self, node) -> QueryResult:
         """Execute a planned query through the result cache: a hit serves
@@ -1273,10 +1312,36 @@ class Session:
             local.matmul_groupby = self.matmul_groupby
         if hasattr(local, "dynamic_filtering"):
             local.dynamic_filtering = self.dynamic_filtering
+        from .obs import span as obs_span
+        from .obs.kernelprof import KERNEL_PROFILE
+
+        traced = obs_span.enabled()
+        kprof_before = KERNEL_PROFILE.snapshot()
+        trace = root = exec_span = None
+        if traced:
+            trace = obs_span.TRACES.new_trace()
+            root = trace.begin("query")
+            exec_span = trace.begin("execute", parent=root)
         ex.run(node)
         # fold parked device row-count scalars in one batch (the lazy
         # collector avoids a blocking host sync per plan node)
         collector.resolve()
+        if traced:
+            trace.finish(exec_span)
+            trace.finish(root)
+            # graft per-node stats as synthetic spans so the -- trace:
+            # footer ranks the same units the cluster path ships
+            def _graft(n):
+                s = collector.lookup(n)
+                if s is not None:
+                    trace.add_synthetic(
+                        type(n).__name__, exec_span, s.wall_s,
+                        rows=s.rows_out, bytes=s.out_bytes_total,
+                    )
+                for c in n.children:
+                    _graft(c)
+
+            _graft(node)
         tree = N.plan_tree_str(node, collector=collector)
         total_ms = collector.total_wall_s() * 1e3
         peak = collector.peak_bytes / (1024 * 1024)
@@ -1357,8 +1422,31 @@ class Session:
         mgr = getattr(self, "matviews_mgr", None)
         if mgr is not None and mgr.views:
             matview_txt = "\n-- matview: " + mgr.format_summary()
+        # observability footers (docs/observability.md): the critical
+        # path from the SAME span-tree renderer the cluster path uses,
+        # and the compile-vs-execute split this run added to the
+        # process-wide kernel profile
+        trace_txt = kernel_txt = ""
+        if traced:
+            from .server import knobs as _knobs
+
+            trace_txt = "\n-- trace: " + obs_span.render_critical_path(
+                trace, _knobs.trace_topk()
+            )
+            kp = KERNEL_PROFILE.snapshot()
+            d_comp = kp["compiles"] - kprof_before["compiles"]
+            d_exec = kp["executions"] - kprof_before["executions"]
+            if d_comp or d_exec:
+                d_comp_s = kp["compile_s"] - kprof_before["compile_s"]
+                d_exec_s = kp["execute_s"] - kprof_before["execute_s"]
+                kernel_txt = (
+                    f"\n-- kernels: compile +{d_comp}"
+                    f" ({d_comp_s * 1e3:,.1f}ms),"
+                    f" execute +{d_exec} ({d_exec_s * 1e3:,.1f}ms)"
+                )
         return (
-            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{cache_txt}{matview_txt}\n"
+            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{cache_txt}"
+            f"{matview_txt}{trace_txt}{kernel_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
         )
 

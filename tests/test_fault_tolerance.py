@@ -397,6 +397,93 @@ FT_QUERIES = [
 ]
 
 
+def test_fault_trace_merged_span_tree():
+    """Observability acceptance (docs/observability.md): under injected
+    faults the coordinator's merged span tree contains the FAILED
+    attempt (status="error", recorded on the worker before the fault
+    fired), the retry as a SIBLING span, and no orphans; phase walls
+    account for the query wall; the worker serves /v1/metrics."""
+    import urllib.request
+
+    from presto_tpu.obs.span import TRACES
+
+    workers = [
+        WorkerServer(TpchCatalog(sf=E2E_SF), fault_rate=0.3).start()
+        for _ in range(2)
+    ]
+    nodes = NodeManager(
+        [w.uri for w in workers], interval=3600,
+        task_failure_threshold=50,
+    )
+    sess = HttpClusterSession(
+        TpchCatalog(sf=E2E_SF), nodes,
+        scheduler_opts={
+            "backoff_base": 0.01, "backoff_cap": 0.1,
+            "max_task_retries": 4, "max_query_retries": 4,
+        },
+    )
+    try:
+        trace = None
+        for i in range(12):  # 30% fault rate: a faulted-but-recovered
+            # run is statistically certain within the bound. The
+            # predicate is vacuously true but textually distinct per
+            # iteration, so the coordinator result cache (which keys on
+            # the SQL) cannot short-circuit the dispatch we need to
+            # fault.
+            res = sess.query(
+                "select count(*), sum(o_totalprice) from orders "
+                f"where o_orderkey > -{i + 1}"
+            )
+            assert res.trace_id is not None
+            tr = TRACES.get(res.trace_id)
+            assert tr is not None
+            if any(s.status == "error" for s in tr.spans()):
+                trace = tr
+                break
+        assert trace is not None, "no faulted query observed"
+        spans = trace.spans()
+        by_id = {s.span_id: s for s in spans}
+        # one tree: every span (coordinator AND worker) shares the id,
+        # worker task spans actually merged, nothing dangling
+        assert all(s.trace_id == trace.trace_id for s in spans)
+        assert any(s.name.startswith("task ") for s in spans)
+        assert trace.orphans() == []
+        errors = [s for s in spans if s.status == "error"]
+        assert errors
+        # the retry rides as a sibling subtree: for a failed worker task
+        # its dispatch span has a later-posted ok sibling under the same
+        # stage; a failed attempt/dispatch has an ok sibling directly
+        def _has_retry_sibling(e):
+            node = by_id.get(e.parent_id) if e.name.startswith("task ") else e
+            if node is None:
+                return False
+            return any(
+                s.parent_id == node.parent_id
+                and s.span_id != node.span_id
+                and s.status == "ok" and s.start >= node.start
+                for s in spans
+            )
+        assert any(_has_retry_sibling(e) for e in errors)
+        # phase spans (root's direct children) account for the wall
+        root = trace.root()
+        assert root is not None and root.wall_s > 0
+        kid_sum = sum(k.wall_s for k in trace.children(root.span_id))
+        assert abs(kid_sum - root.wall_s) <= 0.1 * root.wall_s
+        # the worker role serves the unified metrics plane
+        with urllib.request.urlopen(workers[0].uri + "/v1/metrics") as r:
+            assert "text/plain" in r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        for needle in (
+            "presto_qcache_hits_total", "presto_breakers_open_count",
+            "presto_exchange_pages_total", "presto_kernel_compiles_total",
+            "presto_worker_tasks_total",
+        ):
+            assert needle in text
+    finally:
+        for w in workers:
+            w.stop()
+
+
 def test_cluster_survives_fault_rate():
     """Acceptance: with fault_rate=0.3 on EVERY worker, the TPC-H subset
     completes with oracle-correct results, and the retries that made that

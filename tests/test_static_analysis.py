@@ -1191,11 +1191,89 @@ def test_coverage_stats_class_must_reach_a_surface(tmp_path):
 
             def snapshot_all():
                 return {"lit": LIT.snapshot()}
+
+            def export_lit(stats: "LitStats"):
+                pass
         """,
     })
     found = run_passes(proj, [p_cov.PASS])
     assert rules(found) == ["stats-not-snapshotted"]
     assert found[0].context == "DarkStats"
+
+
+def test_coverage_snapshotted_stats_must_also_export(tmp_path):
+    """TP: a Stats class that reaches a snapshot surface but never an
+    export/metrics-named function ships dark on /v1/metrics."""
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/m.py": """
+            class SiloStats:
+                def snapshot(self):
+                    return {}
+
+            SILO = SiloStats()
+
+            def snapshot_all():
+                return {"silo": SILO.snapshot()}
+        """,
+    })
+    found = run_passes(proj, [p_cov.PASS])
+    assert rules(found) == ["stats-not-exported"]
+    assert found[0].context == "SiloStats"
+
+
+def test_coverage_exported_stats_clean(tmp_path):
+    """FP guard: a quoted parameter annotation or a bare class reference
+    inside an export/metrics-named function counts as metrics reach."""
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/m.py": """
+            class AnnStats:
+                def snapshot(self):
+                    return {}
+
+            class RefStats:
+                def snapshot(self):
+                    return {}
+
+            ANN = AnnStats()
+            REF = RefStats()
+
+            def snapshot_all():
+                return {"a": ANN.snapshot(), "r": REF.snapshot()}
+
+            def export_ann_stats(stats: "AnnStats"):
+                pass
+
+            def _metrics_ref_producer():
+                return RefStats
+        """,
+    })
+    assert run_passes(proj, [p_cov.PASS]) == []
+
+
+def test_coverage_docstring_mention_is_not_an_export(tmp_path):
+    """TP guard: a Stats class named only in an export-named function's
+    docstring (or any non-annotation str constant) has NOT reached the
+    metrics plane — only annotation positions count for str constants."""
+    proj = make_project(tmp_path, {
+        "presto_tpu/exec/m.py": """
+            class DocStats:
+                def snapshot(self):
+                    return {}
+
+            DOC = DocStats()
+
+            def snapshot_all():
+                return {"d": DOC.snapshot()}
+
+            def export_other_things():
+                '''Folds counters; see DocStats for the snapshot shape.'''
+                help = "unrelated to DocStats"
+                return help
+        """,
+    })
+    found = run_passes(proj, [p_cov.PASS])
+    assert rules(found) == ["stats-not-exported"]
+    assert found[0].context == "DocStats"
 
 
 def test_coverage_qcache_global_must_be_in_snapshot_all(tmp_path):

@@ -61,6 +61,10 @@ GATED = (
     # mixed_soak_qps RAISES when zero reads were served by the qcache
     # patch verdict, so a broken patch path fails the gate outright
     "matview_refresh_delta", "ingest_append", "mixed_soak_qps",
+    # observability plane (PR 15): one Prometheus scrape of the unified
+    # registry (producers + render) must stay cheap enough that a 15s
+    # scraper is never a serving-latency event
+    "metrics_scrape",
 )
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
@@ -173,6 +177,7 @@ def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
             if ratio_val is None or ratio_val < ratio_floor:
                 failures.append(rline)
     failures += run_qps_gate(tolerance, baseline_path)
+    failures += run_tracing_overhead_gate(baseline_path)
     if failures:
         print(f"\nbench_gate: FAIL — {len(failures)} check(s) regressed "
               f">{tolerance:.0%} vs {os.path.basename(baseline_path)}:")
@@ -270,6 +275,77 @@ def run_qps_gate(tolerance: float, baseline_path: str = DEFAULT_BASELINE):
             f"below the {gate.get('min_speedup_p50', 5.0)}x acceptance line"
         )
     return failures
+
+
+def run_tracing_overhead_gate(baseline_path: str = DEFAULT_BASELINE):
+    """Tracing-overhead floor (BASELINE.json `tracing_overhead_gate`):
+    warm northstar p50 with PRESTO_TPU_TRACE=1 must stay within
+    `max_overhead_frac` (default 5%) of the p50 with tracing off, plus
+    `abs_slack_ms` of absolute slack — at sub-millisecond warm p50 a
+    pure percentage is below box noise. The default-on observability
+    plane earns its place HERE: regress the hot path and CI says no.
+    Returns failure strings ([] = green/skipped)."""
+    import jax
+
+    with open(baseline_path) as f:
+        gate = json.load(f).get("tracing_overhead_gate")
+    if not gate:
+        return []
+    if jax.default_backend() != gate.get("backend"):
+        print(
+            f"tracing_overhead_gate: baseline backend "
+            f"{gate.get('backend')!r} != live {jax.default_backend()!r} "
+            f"— skipping"
+        )
+        return []
+    if jax.default_backend() == "cpu" and len(jax.devices()) < 2:
+        # same single-device ORDER BY wedge run_qps_gate documents
+        print("tracing_overhead_gate: single-device CPU runtime — "
+              "skipping (set --xla_force_host_platform_device_count=2)")
+        return []
+    from presto_tpu.benchmark.northstar_qps import run
+
+    sf = float(gate.get("sf", 0.01))
+    clients = int(gate.get("clients", 1))
+    iters = int(gate.get("iters", 10))
+
+    def _warm_p50(trace: str) -> float:
+        prev = os.environ.get("PRESTO_TPU_TRACE")
+        os.environ["PRESTO_TPU_TRACE"] = trace
+        try:
+            out = run(sf=sf, clients=clients, iters=iters,
+                      join_timeout_s=120)
+        finally:
+            if prev is None:
+                os.environ.pop("PRESTO_TPU_TRACE", None)
+            else:
+                os.environ["PRESTO_TPU_TRACE"] = prev
+        if out["errors"]:
+            raise RuntimeError(
+                f"{out['errors']} request errors with trace={trace}"
+            )
+        return float(out["warm_p50_ms"])
+
+    try:
+        # off first, on second: any cache warm-up penalty lands on the
+        # traced run, so the comparison can only overstate the overhead
+        p50_off = _warm_p50("0")
+        p50_on = _warm_p50("1")
+    except Exception as e:  # noqa: BLE001 — a wedged/erroring driver is
+        # a gate failure, not a crash
+        return [f"tracing_overhead: driver failed — {e!r}"]
+    frac = float(gate.get("max_overhead_frac", 0.05))
+    slack = float(gate.get("abs_slack_ms", 0.2))
+    ceiling = p50_off * (1.0 + frac) + slack
+    overhead = (p50_on / p50_off - 1.0) if p50_off > 0 else 0.0
+    line = (
+        f"tracing_overhead: warm p50 {p50_on}ms traced vs {p50_off}ms "
+        f"untraced ({overhead:+.1%}, ceiling {ceiling:.3f}ms)"
+    )
+    print(line)
+    if p50_on > ceiling:
+        return [line]
+    return []
 
 
 def main(argv=None) -> int:
