@@ -1641,12 +1641,80 @@ def run_exchange_bench(sf: float, runs: int = RUNS) -> Optional[Dict]:
 
     b = Bench("exchange_all_to_all", total, step, (key_d, pay_d))
     sec = time_device_bench(b, runs)
+    # bytes crossing the interconnect per pass: both int64 columns move
+    exchanged = total * (key.itemsize + payload.itemsize)
     return {
         "name": b.name,
         "rows": b.rows,
         "rows_per_s": round(b.rows / sec),
         "ms": round(sec * 1e3, 3),
+        "wire_bytes": exchanged,
+        "wire_GBps": round(exchanged / sec / 1e9, 2),
         "note": f"{n_dev} devices",
+    }
+
+
+def run_exchange_hier_bench(sf: float, runs: int = RUNS) -> Optional[Dict]:
+    """Hierarchical producer regroup (server/hier.hier_partition: ONE
+    device step, then ragged wire pages) vs the flat per-partition
+    compact loop (server/worker._hash_partition, nparts device dispatches
+    per batch) on the same batch and topology. Requires >1 device;
+    returns None (skipped) on a single chip."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return None
+    from .. import types as T
+    from ..expr.ir import col
+    from ..page import Page
+    from ..server.hier import hier_partition
+    from ..server.serde import local_capabilities
+    from ..server.worker import _hash_partition
+
+    # fan-out where the flat loop's O(nparts) dispatches dominate — the
+    # shape of a real fleet (16 consumers); hier's cost is ~flat in
+    # nparts so the ratio grows with fan-out beyond this
+    nparts = 16
+    rows = max(int(400_000 * sf), 8192)
+    rng = np.random.default_rng(0)
+    page = Page.from_dict({
+        "k": rng.integers(0, 1 << 40, rows).astype(np.int64),
+        "v": np.arange(rows, dtype=np.int64),
+    })
+    caps = local_capabilities()
+    key_exprs = (col("k", T.BIGINT),)
+
+    def _best(fn):
+        fn()  # warm: compile + caches
+        best = float("inf")
+        for _ in range(max(runs, 1)):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    hier_s = _best(
+        lambda: hier_partition(page, key_exprs, nparts, caps=caps)
+    )
+    flat_s = _best(
+        lambda: _hash_partition(page, key_exprs, nparts, caps=caps)
+    )
+    wire = sum(
+        len(d)
+        for datas in hier_partition(page, key_exprs, nparts,
+                                    caps=caps).values()
+        for d in datas
+    )
+    return {
+        "name": "exchange_hier",
+        "rows": rows,
+        "rows_per_s": round(rows / hier_s),
+        "ms": round(hier_s * 1e3, 3),
+        "flat_ms": round(flat_s * 1e3, 3),
+        "speedup_vs_flat": round(flat_s / hier_s, 3),
+        "wire_bytes": wire,
+        "note": f"{n_dev} devices, {nparts} partitions",
     }
 
 
@@ -1695,15 +1763,20 @@ def run_suite(
             results.append(hctor(sf, runs))
         except Exception as e:  # noqa: BLE001
             errors[hname] = repr(e)[:300]
-    if not only or "exchange_all_to_all" in only:
+    for xname, xctor in (
+        ("exchange_all_to_all", run_exchange_bench),
+        ("exchange_hier", run_exchange_hier_bench),
+    ):
+        if only and xname not in only:
+            continue
         try:
-            r = run_exchange_bench(sf, runs)
+            r = xctor(sf, runs)
             if r is not None:
                 results.append(r)
             else:
-                errors["exchange_all_to_all"] = "skipped: single device"
+                errors[xname] = "skipped: single device"
         except Exception as e:  # noqa: BLE001
-            errors["exchange_all_to_all"] = repr(e)[:300]
+            errors[xname] = repr(e)[:300]
     return {
         "suite": "operator_micro",
         "backend": jax.devices()[0].platform,

@@ -345,6 +345,8 @@ replicated subtrees delegate to the single-node Executor."""
         raise ExecutionError(f"unknown exchange kind {node.kind!r}")
 
     def _repartition(self, sp: SPage, keys) -> SPage:
+        import time
+
         cap = sp.shard_capacity
         n = self.n
         axis = self.axis
@@ -355,10 +357,18 @@ replicated subtrees delegate to the single-node Executor."""
             recv, dropped = exchange_by_hash(p, keys, axis, n, cap)
             return recv, dropped
 
+        t0 = time.perf_counter()
         out, (dropped,) = self._apply(
             ("repartition", tuple(keys)), local, [sp], n_extra=1
         )
-        if int(jnp.sum(dropped)) != 0:  # cannot happen; fail loudly if it does
+        total_dropped = int(jnp.sum(dropped))  # host sync: collective done
+        self.exchange_events.append({
+            "kind": "repartition",
+            "shards": n,
+            "rows": out.total_count(),
+            "collective_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+        if total_dropped != 0:  # cannot happen; fail loudly if it does
             raise ExecutionError("exchange dropped rows")
         return self._shrink_sp(out)
 

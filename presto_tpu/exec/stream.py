@@ -52,6 +52,36 @@ from .memory import MemoryExceededError, MemoryPool
 from .stats import page_device_bytes
 
 
+def coalesce_pages(
+    pages: Iterator[Page], target_rows: int
+) -> Iterator[Page]:
+    """Merge consecutive small pages into ~target_rows batches.
+
+    The hierarchical exchange (server/hier.py) ships RAGGED paged
+    partitions — wire pages of at most PRESTO_TPU_RAGGED_PAGE_ROWS live
+    rows, so skew never pads the wire. The flip side is many small
+    pages per batch; feeding them one-by-one into the streaming sinks
+    would dispatch a device kernel per sliver. This coalescer restores
+    batch efficiency on the consumer: accumulate until target_rows,
+    concat once, hand the sinks full batches. A stream of only empty
+    pages coalesces to ONE empty page, so schema survives; a truly
+    empty iterator stays empty."""
+    held: List[Page] = []
+    held_rows = 0
+    for page in pages:
+        n = int(page.count)
+        if n >= target_rows and not held:
+            yield page
+            continue
+        held.append(page)
+        held_rows += n
+        if held_rows >= target_rows:
+            yield held[0] if len(held) == 1 else concat_pages(held)
+            held, held_rows = [], 0
+    if held:
+        yield held[0] if len(held) == 1 else concat_pages(held)
+
+
 @dataclasses.dataclass
 class HostTable:
     """Host-RAM offloaded rows (the spill-file analog): numpy columns +

@@ -25,6 +25,7 @@ if TYPE_CHECKING:  # annotations only — avoids exec/server import cycles
     from ..exec.stats import NodeStats
     from ..server.cluster import SchedulerStats
     from ..server.exchange import ExchangeStats
+    from ..server.hier import HierExchangeStats
     from ..server.resource_groups import GroupStats
     from ..server.serde import WireStats
 
@@ -58,6 +59,15 @@ def ensure_default_exports() -> None:
     )
     METRICS.declare_counter(
         "presto_exchange_wire_bytes_total", "Exchange bytes off the wire"
+    )
+    METRICS.declare_counter(
+        "presto_exchange_hidden_seconds_total",
+        "Exchange wire wall hidden behind device compute",
+    )
+    METRICS.declare_counter(
+        "presto_hier_exchanges_total",
+        "Output batches regrouped by the hierarchical exchange",
+        {"role": "task"},
     )
     METRICS.declare_counter(
         "presto_wire_encode_seconds_total", "Page serialization wall"
@@ -218,6 +228,56 @@ def export_exchange_stats(pull: "ExchangeStats") -> None:
     METRICS.counter(
         "presto_exchange_decode_seconds_total",
         (snap.get("decode_ms") or 0) / 1e3,
+    )
+    # overlap plane (hierarchical exchange): wire wall split into the
+    # part the consumer actually waited for vs the part its device
+    # compute hid behind prefetching pullers
+    METRICS.counter(
+        "presto_exchange_consumer_wait_seconds_total",
+        (snap.get("consumer_wait_ms") or 0) / 1e3,
+    )
+    METRICS.counter(
+        "presto_exchange_hidden_seconds_total",
+        (snap.get("hidden_ms") or 0) / 1e3,
+    )
+
+
+def export_hier_stats(stats: "HierExchangeStats",
+                      role: str = "task") -> None:
+    """Fold one endpoint's hierarchical-exchange accounting into the
+    metrics plane — called once when the endpoint retires. `role`
+    labels the fold point ("task" = a worker's own producer regroup,
+    "gather" = the coordinator's per-exchange fold over its producers'
+    status payloads) so an in-process fleet sharing one registry never
+    double-counts one series."""
+    snap = stats.snapshot()
+    label = {"role": role}
+    METRICS.counter(
+        "presto_hier_exchanges_total", snap.get("exchanges", 0), label,
+        help="Output batches regrouped by the hierarchical exchange",
+    )
+    METRICS.counter(
+        "presto_hier_collective_exchanges_total",
+        snap.get("collective_exchanges", 0), label,
+    )
+    METRICS.counter("presto_hier_rows_total", snap.get("rows", 0), label)
+    METRICS.counter(
+        "presto_hier_collective_seconds_total",
+        (snap.get("collective_ms") or 0) / 1e3, label,
+    )
+    METRICS.counter(
+        "presto_hier_wire_pages_total", snap.get("wire_pages", 0), label
+    )
+    METRICS.counter(
+        "presto_hier_ragged_pad_rows_total",
+        snap.get("ragged_pad_rows", 0), label,
+    )
+    METRICS.counter(
+        "presto_hier_fixed_pad_rows_total",
+        snap.get("fixed_pad_rows", 0), label,
+    )
+    METRICS.counter(
+        "presto_hier_fallbacks_total", snap.get("fallbacks", 0), label
     )
 
 

@@ -157,6 +157,28 @@ def from_partitions(
     )
 
 
+def wire_padding(
+    counts: Sequence[int], page_rows: Optional[int] = None
+) -> dict:
+    """Padding accounting for shipping these partition sizes as a wire
+    unit (server/hier.py): the RAGGED paged layout allocates
+    ceil(rows/page_rows) pages per non-empty partition (only the last
+    page partial), while the FIXED layout a dense collective output
+    buffer carries pads every live partition to the largest one. Returns
+    row counts so the hierarchical exchange stats (and the skew tests)
+    can assert the ragged unit beats pad-to-max under skew."""
+    pr = page_rows or page_rows_default()
+    live = [int(c) for c in counts if int(c) > 0]
+    rows = sum(live)
+    ragged_alloc = sum(-(-c // pr) * pr for c in live)
+    fixed_alloc = len(live) * (max(live) if live else 0)
+    return {
+        "rows": rows,
+        "ragged_pad_rows": max(ragged_alloc - rows, 0),
+        "fixed_pad_rows": max(fixed_alloc - rows, 0),
+    }
+
+
 def occupancy_stats(rp: RaggedPages) -> dict:
     """The EXPLAIN ANALYZE payload for one layout instance."""
     return {

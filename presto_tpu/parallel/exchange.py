@@ -48,6 +48,19 @@ def shuffle_write(
     h = hash_rows(keys)
     part = (h % jnp.uint64(num_parts)).astype(jnp.int32)
     part = jnp.where(live, part, num_parts)  # dead rows -> dropped
+    return shuffle_write_parts(page, part, num_parts, part_capacity)
+
+
+def shuffle_write_parts(
+    page: Page, part: jnp.ndarray, num_parts: int, part_capacity: int
+) -> Tuple[Page, jnp.ndarray, jnp.ndarray]:
+    """shuffle_write over PRECOMPUTED per-row destinations: `part[i]` in
+    [0, num_parts) routes row i, anything >= num_parts drops it (dead
+    rows / overflow sentinel). Shared by the mesh repartition above and
+    the hierarchical exchange producer (server/hier.py), whose routing —
+    downstream partition modulo local device — is not a plain
+    hash-modulo."""
+    part = jnp.minimum(part.astype(jnp.int32), num_parts)
     order = jnp.argsort(part, stable=True)
     part_s = part[order]
     bins = jnp.arange(num_parts, dtype=part_s.dtype)

@@ -324,6 +324,11 @@ class SchedulerStats:
     # memoryStats/spillStats): disk bytes spilled, revocations absorbed,
     # spill events seen — the cluster half of EXPLAIN ANALYZE's memory line
     memory: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # hierarchical-exchange rollup (server/hier.py) of the LAST query:
+    # mid-tree repartition producers are never pulled by the coordinator
+    # (their consumers are other workers), so their hier snapshots are
+    # folded query-wide by the final status sweep (_collect_task_obs)
+    hier: Dict[str, object] = dataclasses.field(default_factory=dict)
     # serving-cache counters (exec/qcache.py snapshot_all) refreshed after
     # every cluster query — plan/result hits the coordinator served plus
     # the process-wide kernel cache
@@ -474,7 +479,8 @@ class HttpScheduler:
             self.stats.wire_caps = wire_caps
             self.stats.exchange = {}
             self.stats.memory = {}
-        all_tasks: List[Tuple[str, str]] = []
+            self.stats.hier = {}
+        all_tasks: List[Tuple[str, str, bool]] = []
         try:
             fragment, specs = self._cut(root)
             sources = self._resolve_sources(
@@ -499,33 +505,52 @@ class HttpScheduler:
                 tctx[0].finish(rspan)
             return result
         finally:
-            # sweep final worker span payloads into the merged tree
-            # BEFORE cancellation deletes task state on the workers
-            self._collect_spans(all_tasks, tctx)
+            # sweep final worker span + hier payloads into the merged
+            # accounting BEFORE cancellation deletes task state
+            self._collect_task_obs(all_tasks, tctx)
             # free worker-side output buffers (reference: task results are
             # acknowledged and deleted after consumption); on failure this
             # doubles as sibling-task cancellation
             self._cancel_tasks(all_tasks)
 
-    def _collect_spans(self, tasks: List[Tuple[str, str]],
-                       tctx: Optional[tuple]) -> None:
-        """Final merge sweep: pull each task's status once and fold its
-        span payload into the query trace. Mid-tree producer stages are
+    def _collect_task_obs(self, tasks: List[Tuple[str, str, bool]],
+                          tctx: Optional[tuple]) -> None:
+        """Final merge sweep: pull task status once and fold its span
+        payload into the query trace plus its hierarchical-exchange
+        snapshot into the query rollup. Mid-tree producer stages are
         never status-polled on the happy path (their consumers are other
-        workers), so without this sweep their spans would be lost. Tasks
-        from failed POSTs 404 here — best effort by design."""
-        if tctx is None:
+        workers), so without this sweep their spans AND their hier stats
+        would be lost. With tracing off, only partitioned-output
+        producers are polled (the sole carriers of hier stats) — the
+        common untraced single-stage query pays zero extra round-trips.
+        Tasks from failed POSTs 404 here — best effort by design."""
+        trace = tctx[0] if tctx is not None else None
+        if trace is None:
+            tasks = [t for t in tasks if t[2]]
+        if not tasks:
             return
-        trace = tctx[0]
-        for uri, task_id in tasks:
+        from ..obs.export import export_hier_stats
+        from .hier import HierExchangeStats
+
+        hier = HierExchangeStats()
+        for uri, task_id, _partitioned in tasks:
             try:
                 st = self._task_status(uri, task_id)
             except Exception:  # noqa: BLE001 — observability, best effort
                 continue
-            trace.add_remote(st.get("spans") or ())
+            if trace is not None:
+                trace.add_remote(st.get("spans") or ())
+            hier.merge_snapshot(
+                (st.get("exchangeStats") or {}).get("hier")
+            )
+        snap = hier.snapshot()
+        if snap.get("exchanges") or snap.get("fallbacks"):
+            with self._lock:
+                self.stats.hier = snap
+            export_hier_stats(hier, role="gather")
 
-    def _cancel_tasks(self, tasks: List[Tuple[str, str]]) -> None:
-        for uri, task_id in tasks:
+    def _cancel_tasks(self, tasks: List[Tuple[str, str, bool]]) -> None:
+        for uri, task_id, _partitioned in tasks:
             try:
                 req = urllib.request.Request(
                     f"{uri}/v1/task/{task_id}", method="DELETE"
@@ -772,7 +797,10 @@ class HttpScheduler:
             if gspan is not None:
                 snap = ex_stats.snapshot()
                 tctx[0].finish(
-                    gspan, pages=snap["pages"], bytes=snap["wire_bytes"]
+                    gspan, pages=snap["pages"], bytes=snap["wire_bytes"],
+                    wire_ms=snap["pull_ms"],
+                    hidden_ms=snap["hidden_ms"],
+                    overlap=snap["overlap_frac"],
                 )
             out[sid] = pages
         return out
@@ -785,6 +813,9 @@ class HttpScheduler:
         query cleanup) into the scheduler's observable accounting."""
         entry = ex_stats.snapshot()
         encode = WireStats()
+        from .hier import HierExchangeStats
+
+        hier = HierExchangeStats()
         mem_events: set = set()
         spilled = revocations = 0
         for uri, task in handles:
@@ -792,15 +823,23 @@ class HttpScheduler:
                 st = self._task_status(uri, task)
             except Exception:  # noqa: BLE001 — observability, best effort
                 continue
-            encode.merge_snapshot(st.get("exchangeStats") or {})
+            ex = st.get("exchangeStats") or {}
+            encode.merge_snapshot(ex)
+            hier.merge_snapshot(ex.get("hier"))
             sp = st.get("spillStats") or {}
             spilled += int(sp.get("disk_bytes") or 0)
             mem_events.update(sp.get("events") or ())
             ms = st.get("memoryStats") or {}
             revocations += int(ms.get("revocations") or 0)
         entry["producer"] = encode.snapshot()
+        hier_snap = hier.snapshot()
+        if hier_snap.get("exchanges") or hier_snap.get("fallbacks"):
+            entry["hier"] = hier_snap
         # unified metrics plane: one fold per gather (each ExchangeStats
-        # and producer-encode accumulator lives for exactly one gather)
+        # and producer-encode accumulator lives for exactly one gather).
+        # hier stats are NOT exported here — the final status sweep
+        # (_collect_task_obs) covers every task exactly once, including
+        # these gather producers
         from ..obs.export import export_exchange_stats, export_wire_stats
 
         export_exchange_stats(ex_stats)
@@ -969,7 +1008,11 @@ class HttpScheduler:
         own span under the stage, and the spec carries (trace_id, that
         span's id) so the worker parents its task span to this exact
         attempt — a retry is a sibling subtree, never an overwrite."""
-        all_tasks.append((uri, task_id))
+        # partitioned-output producers are the only tasks the final
+        # observability sweep must poll when tracing is off (their
+        # exchangeStats["hier"] is unreachable any other way — their
+        # consumers are other workers, not the coordinator)
+        all_tasks.append((uri, task_id, bool(spec.get("partition_keys"))))
         dspan = None
         if tctx is not None:
             dspan = tctx[0].begin(
@@ -1483,6 +1526,38 @@ class HttpClusterSession:
                 + f", encode {prod.get('encode_ms', 0)}ms, decode "
                 f"{ex['decode_ms']}ms, pull peak {ex['peak_concurrent']} "
                 f"concurrent"
+            )
+            if ex.get("pull_ms") is not None:
+                # overlap proof: wire wall vs what the consumer actually
+                # waited for — the difference was hidden behind compute
+                lines.append(
+                    f"-- exchange {sid} overlap: wire "
+                    f"{ex['pull_ms']}ms, consumer wait "
+                    f"{ex.get('consumer_wait_ms', 0)}ms, hidden "
+                    f"{ex.get('hidden_ms', 0)}ms "
+                    f"({round(100 * ex.get('overlap_frac', 0.0))}%)"
+                )
+            hier = ex.get("hier")
+            if hier:
+                lines.append(
+                    f"-- exchange {sid} hier: "
+                    f"{hier['collective_exchanges']}/{hier['exchanges']} "
+                    f"collective, device {hier['collective_ms']}ms, "
+                    f"{hier['wire_pages']} ragged pages, pad "
+                    f"{hier['ragged_pad_rows']} rows (fixed would be "
+                    f"{hier['fixed_pad_rows']}), "
+                    f"fallbacks {hier['fallbacks']}"
+                )
+        if st.get("hier"):
+            # query-wide rollup from the final task sweep: mid-tree
+            # repartition producers' hierarchical regroup accounting
+            h = st["hier"]
+            lines.append(
+                f"-- hier: {h['collective_exchanges']}/{h['exchanges']} "
+                f"batches collective, device {h['collective_ms']}ms, "
+                f"{h['wire_pages']} ragged pages, pad "
+                f"{h['ragged_pad_rows']} rows (fixed would be "
+                f"{h['fixed_pad_rows']}), fallbacks {h['fallbacks']}"
             )
         if st["memory"]:
             m = st["memory"]

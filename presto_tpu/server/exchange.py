@@ -91,6 +91,22 @@ class ExchangeStats:
         self.inflight = 0
         self.peak_inflight = 0
         self.by_source: dict = {}
+        # overlap accounting (hierarchical exchange): `consumer_wait_s`
+        # is the wall the consumer spent BLOCKED on an empty staging
+        # deque — wire time the prefetch failed to hide. pull_s minus it
+        # is the wire wall hidden behind the consumer's device compute.
+        self.consumer_wait_s = 0.0
+
+    def add_sources(self, n: int) -> None:
+        """Locked source-count bump: one stats object may span several
+        clients (a task with many sources), whose __init__ runs on task
+        threads while the scheduler snapshots — the += must not tear."""
+        with self._lock:
+            self.sources += int(n)
+
+    def consumer_waited(self, seconds: float) -> None:
+        with self._lock:
+            self.consumer_wait_s += seconds
 
     def puller_started(self) -> None:
         with self._lock:
@@ -123,7 +139,15 @@ class ExchangeStats:
             self.decode_s += seconds
 
     def snapshot(self) -> dict:
+        """One consistent snapshot under the stats lock: the scheduler
+        and the worker status endpoint read this while pullers mutate
+        counters, so every field (including the derived overlap numbers)
+        comes from a single locked read — pages always equals the
+        by_source sum, hidden_ms is never computed from a torn pair."""
         with self._lock:
+            pull_ms = round(self.pull_s * 1e3, 2)
+            wait_ms = round(self.consumer_wait_s * 1e3, 2)
+            hidden_ms = round(max(pull_ms - wait_ms, 0.0), 2)
             return {
                 "pages": self.pages,
                 "wire_bytes": self.wire_bytes,
@@ -131,8 +155,12 @@ class ExchangeStats:
                 "sources": self.sources,
                 "peak_concurrent": self.peak_concurrent,
                 "peak_inflight": self.peak_inflight,
-                "pull_ms": round(self.pull_s * 1e3, 2),
+                "pull_ms": pull_ms,
                 "decode_ms": round(self.decode_s * 1e3, 2),
+                "consumer_wait_ms": wait_ms,
+                "hidden_ms": hidden_ms,
+                "overlap_frac": round(hidden_ms / pull_ms, 3)
+                if pull_ms > 0 else 0.0,
                 "by_source": dict(self.by_source),
             }
 
@@ -251,6 +279,17 @@ class ExchangeClient:
         self.staging_bytes = (
             DEFAULT_STAGING_BYTES if staging_bytes is None else staging_bytes
         )
+        # hierarchical-exchange tranche prefetch: guarantee each puller
+        # can keep PRESTO_TPU_HIER_EXCHANGE_PREFETCH max-size responses
+        # staged ahead of the consumer, so the next inter-host tranche
+        # is on the wire while the current one's device-side collective
+        # runs — the staging budget is a floor here, never a shrink
+        prefetch = max(knobs.hier_exchange_prefetch(), 0)
+        if prefetch:
+            self.staging_bytes = max(
+                self.staging_bytes,
+                prefetch * self.max_response_bytes * max(len(self.locations), 1),
+            )
         if deadline is None:
             deadline = knobs.task_deadline_s()
         self.deadline = deadline
@@ -258,8 +297,7 @@ class ExchangeClient:
             1, DEFAULT_CONCURRENCY if concurrency is None else concurrency
         )
         self.stats = stats or ExchangeStats()
-        self.stats.sources += len(self.locations)  # additive: one stats
-        # object may span several clients (a task with many sources)
+        self.stats.add_sources(len(self.locations))
         # decode on the puller threads: deserialization parallelizes
         # across producers AND overlaps the consumer (numpy/stripe
         # decompression release the GIL). Off = stage raw bytes and
@@ -408,20 +446,30 @@ class ExchangeClient:
         try:
             while True:
                 with self._cond:
+                    # time the consumer spends HERE with an empty deque
+                    # is wire latency the prefetch failed to hide; time
+                    # between _drain calls is the consumer's device
+                    # compute, which the pullers' in-flight tranches
+                    # overlap. pull_s - consumer_wait_s = hidden wall.
+                    waited = time.perf_counter()
                     while (
                         not self._staged
                         and self._error is None
                         and self._done < len(self.locations)
                     ):
                         self._cond.wait(timeout=0.5)
+                    waited = time.perf_counter() - waited
                     if self._staged:
                         idx, data, dec, nbytes = self._staged.popleft()
                         self._staged_bytes -= nbytes
                         self._cond.notify_all()
                     elif self._error is not None:
+                        self.stats.consumer_waited(waited)
                         raise self._error
                     else:
+                        self.stats.consumer_waited(waited)
                         return
+                self.stats.consumer_waited(waited)
                 yield idx, data, dec
         finally:
             self.close()

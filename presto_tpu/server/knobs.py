@@ -53,6 +53,55 @@ def trace_topk() -> int:
         return 5
 
 
+def hier_exchange_enabled() -> bool:
+    """Master switch for the hierarchical exchange plane (server/hier.py):
+    partitioned task output regroups rows with ONE device dispatch (a
+    `lax.all_to_all` collective when the local mesh has enough devices, a
+    fused grouping kernel otherwise) and ships ragged paged partitions
+    over the PTP2 wire. Off (`PRESTO_TPU_HIER_EXCHANGE=0`) every producer
+    uses the flat per-partition loop. The knob gates the PRODUCER only —
+    consumers decode both shapes, so flipping it mid-fleet is safe."""
+    return os.environ.get("PRESTO_TPU_HIER_EXCHANGE", "1") not in (
+        "0", "false", ""
+    )
+
+
+def hier_exchange_min_devices() -> int:
+    """Local devices required before the intra-host regroup uses the
+    shard_map `lax.all_to_all` collective; below it (including the
+    1-chip case) the fused single-dispatch grouping kernel runs
+    instead — still one dispatch per exchange, no per-partition loop."""
+    try:
+        return int(os.environ.get("PRESTO_TPU_HIER_EXCHANGE_MIN_DEVICES",
+                                  "2"))
+    except ValueError:
+        return 2
+
+
+def hier_exchange_min_rows() -> int:
+    """Rows below which the collective regroup is not worth the
+    host→device shard scatter: small batches take the fused grouping
+    kernel even on a multi-device host."""
+    try:
+        return int(os.environ.get("PRESTO_TPU_HIER_EXCHANGE_MIN_ROWS",
+                                  "8192"))
+    except ValueError:
+        return 8192
+
+
+def hier_exchange_prefetch() -> int:
+    """Tranche prefetch depth for the pull side: each puller thread may
+    keep this many `max_response_bytes` responses staged ahead of the
+    consumer, so the next inter-host tranche is already on the wire
+    while the current tranche's device-side work runs — the overlap
+    that hides wire latency behind collective compute."""
+    try:
+        return int(os.environ.get("PRESTO_TPU_HIER_EXCHANGE_PREFETCH",
+                                  "2"))
+    except ValueError:
+        return 2
+
+
 def revoke_watermark() -> float:
     """Fraction of the memory limit at which revocation (offload/spill)
     starts, shared by the worker-local memory pool and the cluster

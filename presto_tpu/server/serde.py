@@ -124,11 +124,16 @@ _CODEC_PREFERENCE = ("zstd", "lz4", "zlib", "raw")
 
 def local_capabilities() -> dict:
     """Codecs + wire version THIS process can decode, advertised through
-    the worker /v1/status handshake."""
+    the worker /v1/status handshake. The ``hier`` advert says this build
+    understands the hierarchical exchange's ragged paged wire unit
+    (server/hier.py) — producers only take the hierarchical path when
+    EVERY fleet member advertises it (negotiate intersects), so a host
+    without collective support degrades the fleet to flat PTP2."""
     codecs = (["zstd"] if _zstd_d is not None else []) + list(_BASELINE_CODECS)
     return {
         "version": 1 if _FORCE_V1 else WIRE_VERSION,
         "codecs": codecs,
+        "hier": {"ragged": True},
     }
 
 
@@ -148,18 +153,27 @@ def negotiate(peer_caps: Sequence[Optional[dict]]) -> dict:
     caps = local_capabilities()
     version = caps["version"]
     codecs = set(caps["codecs"])
+    hier = bool((caps.get("hier") or {}).get("ragged"))
     for pc in peer_caps:
         if not isinstance(pc, dict):
             version = 1
             codecs &= set(_BASELINE_CODECS)
+            hier = False
             continue
         version = min(version, int(pc.get("version", 1)))
         codecs &= set(pc.get("codecs", _BASELINE_CODECS))
+        # hierarchical exchange is all-or-nothing: one worker without
+        # the advert (old build, no collective support) degrades every
+        # producer to the flat PTP2 loop — monotonic, never mixed
+        hier = hier and bool((pc.get("hier") or {}).get("ragged"))
     codecs.add("raw")  # raw is the universal floor
-    return {
+    out = {
         "version": max(version, 1),
         "codecs": [c for c in _CODEC_PREFERENCE if c in codecs],
     }
+    if hier:
+        out["hier"] = {"ragged": True}
+    return out
 
 
 # ---------------------------------------------------------------------------

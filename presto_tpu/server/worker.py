@@ -509,6 +509,8 @@ class TaskState:
 
         self.wire_stats = WireStats()
         self.pull_stats = None  # ExchangeStats, set when sources exist
+        self.hier_stats = None  # HierExchangeStats, set when this task
+        # partitions output through the hierarchical exchange plane
         # memory-arbitration observability, filled at task end: the exec
         # pool snapshot (peak/revocations/over-frees) and spill stats
         # (events, disk bytes, hybrid join partition/recursion counters)
@@ -608,7 +610,8 @@ class StreamingFragmentExecutor(StreamingExecutor):
                  memory_budget: Optional[int] = None,
                  query_id: str = "",
                  worker_pool=None,
-                 spill_space=None):
+                 spill_space=None,
+                 coalesce_remote: bool = False):
         super().__init__(
             catalog, batch_rows=batch_rows, memory_budget=memory_budget,
             query_id=query_id, worker_pool=worker_pool,
@@ -616,6 +619,7 @@ class StreamingFragmentExecutor(StreamingExecutor):
         )
         self.splits = splits or {}
         self.source_streams = source_streams or {}
+        self.coalesce_remote = coalesce_remote
         # TABLESAMPLE: distinct per-worker hash salt derived from this
         # task's split assignment, so workers sampling disjoint row
         # ranges never reuse one positional mask (ops/filter.sample_page)
@@ -623,6 +627,22 @@ class StreamingFragmentExecutor(StreamingExecutor):
 
     def stream(self, node: N.PlanNode):
         if isinstance(node, RemoteSource):
+            if self.coalesce_remote:
+                # the hierarchical exchange ships ragged wire pages
+                # (small, skew-proportional); coalesce them back into
+                # full batches so the sinks dispatch one kernel per
+                # batch_rows, not one per wire sliver
+                # (exec/stream.coalesce_pages). Flat-path exchanges
+                # stream straight through — buffering full-size pages
+                # would only stall the pull pipeline.
+                from ..exec.stream import coalesce_pages
+                from ..ops.ragged import page_rows_default
+
+                target = min(self.batch_rows, 4 * page_rows_default())
+                yield from coalesce_pages(
+                    self.source_streams[node.source_id](), target
+                )
+                return
             yield from self.source_streams[node.source_id]()
             return
         yield from super().stream(node)
@@ -823,6 +843,8 @@ class WorkerServer:
                     ex_stats = t.wire_stats.snapshot()
                     if t.pull_stats is not None:
                         ex_stats["pull"] = t.pull_stats.snapshot()
+                    if t.hier_stats is not None:
+                        ex_stats["hier"] = t.hier_stats.snapshot()
                     self._send(200, {
                         "state": t.state, "error": t.error,
                         "errorInfo": t.error_info,
@@ -1027,12 +1049,24 @@ class WorkerServer:
             # clean finishes all delete their spill files
             spill_space = self.spill.open(state.query_id)
             state.spill_space = spill_space
+            # incoming ragged slivers are possible only when the fleet
+            # negotiated the hierarchical exchange AND the knob is on
+            # (upstream producers share this negotiation); otherwise
+            # stream remote pages through untouched
+            from .hier import hier_negotiated as _hier_neg
+
+            coalesce_remote = (
+                bool(spec.get("sources"))
+                and knobs.hier_exchange_enabled()
+                and _hier_neg(wire_caps)
+            )
             ex = StreamingFragmentExecutor(
                 self.catalog, splits, streams,
                 memory_budget=self.exec_budget,
                 query_id=state.query_id,
                 worker_pool=self.pool,
                 spill_space=spill_space,
+                coalesce_remote=coalesce_remote,
             )
             state.executor = ex
             # executor-held bytes join the worker ledger + the revoking
@@ -1066,6 +1100,25 @@ class WorkerServer:
                 if part_keys and nparts > 1
                 else None
             )
+            # hierarchical exchange (server/hier.py): regroup partitioned
+            # output with ONE device step + ragged wire pages, when the
+            # fleet negotiated the capability, the knob is on, and the
+            # breaker is closed. Any fault mid-task trips the breaker
+            # and degrades the REST of this task (and, once open, every
+            # later task) to the flat per-partition loop — monotonic.
+            use_hier = False
+            if keys is not None:
+                from ..exec.breaker import BREAKERS
+                from .hier import HierExchangeStats, hier_negotiated, \
+                    hier_partition
+
+                use_hier = (
+                    knobs.hier_exchange_enabled()
+                    and hier_negotiated(wire_caps)
+                    and BREAKERS.allow("hier_exchange")
+                )
+                if use_hier:
+                    state.hier_stats = HierExchangeStats()
             # page-at-a-time into the bounded buffers: put() applies
             # backpressure when the consumer lags past the bound; pages
             # bigger than the bound split into row slices first
@@ -1102,10 +1155,32 @@ class WorkerServer:
                         acc.unsupported = True
                 for piece in _split_to_bound(page, bound):
                     if keys is not None:
-                        parts = _hash_partition(
-                            piece, keys, nparts, caps=wire_caps,
-                            stats=state.wire_stats,
-                        )
+                        if use_hier:
+                            try:
+                                parts = hier_partition(
+                                    piece, keys, nparts, caps=wire_caps,
+                                    stats=state.wire_stats,
+                                    hier=state.hier_stats,
+                                )
+                                BREAKERS.record_success("hier_exchange")
+                            except Exception as e:  # noqa: BLE001 — any
+                                # hier fault degrades to the flat loop;
+                                # output correctness must not depend on
+                                # the optimized path
+                                BREAKERS.record_failure(
+                                    "hier_exchange", repr(e)
+                                )
+                                state.hier_stats.record_fallback()
+                                use_hier = False
+                                parts = _hash_partition(
+                                    piece, keys, nparts, caps=wire_caps,
+                                    stats=state.wire_stats,
+                                )
+                        else:
+                            parts = _hash_partition(
+                                piece, keys, nparts, caps=wire_caps,
+                                stats=state.wire_stats,
+                            )
                         for p, data in parts.items():
                             for d in data:
                                 buffers.put(p, d)
@@ -1185,6 +1260,18 @@ class WorkerServer:
             }
             if state.error_info:
                 attrs["error"] = state.error_info.get("message", "")[:200]
+            if state.hier_stats is not None:
+                hs = state.hier_stats.snapshot()
+                if hs.get("exchanges"):
+                    attrs["hier_collective_ms"] = hs["collective_ms"]
+                    attrs["hier_wire_pages"] = hs["wire_pages"]
+            if state.pull_stats is not None:
+                # the span's overlap proof: wire wall the pullers spent
+                # vs the fraction the consumer's device compute hid
+                ps = state.pull_stats.snapshot()
+                if ps.get("pull_ms"):
+                    attrs["wire_ms"] = ps["pull_ms"]
+                    attrs["wire_hidden_ms"] = ps["hidden_ms"]
             task_trace.finish(task_span, status=status, **attrs)
             state.spans = task_trace.to_dicts()
         METRICS.counter(
@@ -1194,6 +1281,10 @@ class WorkerServer:
         export_wire_stats("task_encode", state.wire_stats)
         if state.pull_stats is not None:
             export_exchange_stats(state.pull_stats)
+        if state.hier_stats is not None:
+            from ..obs.export import export_hier_stats
+
+            export_hier_stats(state.hier_stats)
 
     def start(self) -> "WorkerServer":
         self._thread.start()

@@ -1407,6 +1407,28 @@ class Session:
                 if overs:
                     parts.append(f"over_frees={overs}")
                 mem_txt = "\n-- memory: " + ", ".join(parts)
+        # mesh-exchange line: repartition collectives (ICI all_to_all
+        # wall, measured to host sync) and grouped-join chunked exchanges
+        exch_txt = ""
+        ex_ev = getattr(ex, "exchange_events", None)
+        if ex_ev:
+            reparts = [e for e in ex_ev if e.get("kind") == "repartition"]
+            grouped = [e for e in ex_ev if "buckets" in e]
+            parts = []
+            if reparts:
+                coll_ms = sum(e["collective_ms"] for e in reparts)
+                rows = sum(e["rows"] for e in reparts)
+                parts.append(
+                    f"{len(reparts)} repartition collectives over "
+                    f"{reparts[0]['shards']} shards, {rows:,} rows, "
+                    f"device {coll_ms:,.1f}ms"
+                )
+            for e in grouped:
+                parts.append(
+                    f"grouped join buckets={e['buckets']} "
+                    f"peak {e['per_shard_bytes']:,}B/shard"
+                )
+            exch_txt = "\n-- exchange: " + "; ".join(parts)
         # serving-cache observability (exec/qcache.py): process-wide
         # hits/misses/evictions/bytes for the plan, result and kernel
         # caches — EXPLAIN ANALYZE itself always re-executes, so these
@@ -1445,7 +1467,7 @@ class Session:
                     f" execute +{d_exec} ({d_exec_s * 1e3:,.1f}ms)"
                 )
         return (
-            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{cache_txt}"
+            f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{exch_txt}{cache_txt}"
             f"{matview_txt}{trace_txt}{kernel_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
         )

@@ -10,8 +10,11 @@ def test_suite_runs_every_operator():
     assert table["backend"] == "cpu"
     names = {r["name"] for r in table["results"]}
     # every device bench + the host serde bench must produce a row;
-    # the exchange bench runs on the 8-device test mesh
-    expected = set(DEVICE_BENCHES) | {"serde_lz4", "exchange_all_to_all"}
+    # the exchange benches run on the 8-device test mesh (never
+    # "skipped" here — the multichip gate pins that on single-device)
+    expected = set(DEVICE_BENCHES) | {
+        "serde_lz4", "exchange_all_to_all", "exchange_hier",
+    }
     assert expected <= names, (
         f"missing: {expected - names}; errors: {table['errors']}"
     )
@@ -19,6 +22,12 @@ def test_suite_runs_every_operator():
     for r in table["results"]:
         assert r["rows_per_s"] > 0, r
         assert r["ms"] > 0, r
+    hier = next(r for r in table["results"] if r["name"] == "exchange_hier")
+    assert hier["speedup_vs_flat"] > 0 and hier["wire_bytes"] > 0, hier
+    a2a = next(
+        r for r in table["results"] if r["name"] == "exchange_all_to_all"
+    )
+    assert a2a["wire_bytes"] > 0, a2a
 
 
 def test_single_bench_selection():

@@ -1,0 +1,385 @@
+"""Hierarchical exchange (server/hier.py): device collectives intra-host,
+ragged paged partitions on the PTP2 wire inter-host, overlapped pulls.
+
+Covers the acceptance surface of the exchange hierarchy: hier-vs-flat
+oracle equality on both regroup paths (shard_map all_to_all collective
+and the single-chip fused kernel), mixed-fleet capability degradation
+(one worker without the `hier` advert -> the whole fleet runs the flat
+PTP2 loop with identical results), the 100:1-skew wire-padding claim
+(ragged pages carry less pad than pad-to-max), breaker-gated fallback
+when the hier path faults mid-task, the ExchangeStats.snapshot()
+consistency fix under a mutation hammer, and the stats plumbing
+(scheduler rollup, EXPLAIN ANALYZE footers, /v1/metrics export)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import presto_tpu  # noqa: F401  (enables x64)
+from presto_tpu import types as T
+from presto_tpu.connectors.tpch import TpchCatalog
+from presto_tpu.expr.ir import col
+from presto_tpu.page import Page
+from presto_tpu.server.exchange import ExchangeStats
+from presto_tpu.server.hier import HierExchangeStats, hier_partition
+from presto_tpu.server.serde import deserialize_page, local_capabilities
+from presto_tpu.server.worker import WorkerServer, _hash_partition
+
+SF = 0.01
+
+KEYS = (col("k", T.BIGINT),)
+
+
+def _page(n=20000, seed=0):
+    rng = np.random.default_rng(seed)
+    return Page.from_dict({
+        "k": rng.integers(0, 1_000_000, n).astype(np.int64),
+        "v": rng.standard_normal(n),
+    })
+
+
+def _decode_sorted(datas):
+    """Multiset view of a partition's serialized pages: rows sorted by
+    (k, v) so arrival order never matters."""
+    ks, vs = [], []
+    for raw in datas:
+        pg = deserialize_page(raw)
+        n = int(pg.count)
+        d = {nm: np.asarray(b.data)[:n] for nm, b in zip(pg.names, pg.blocks)}
+        ks.append(d["k"])
+        vs.append(d["v"])
+    k = np.concatenate(ks) if ks else np.array([], np.int64)
+    v = np.concatenate(vs) if vs else np.array([])
+    order = np.lexsort((v, k))
+    return k[order], v[order]
+
+
+def _assert_oracle_equal(hier_out, flat_out, nparts):
+    assert set(hier_out) == set(flat_out) == set(range(nparts))
+    for p in range(nparts):
+        hk, hv = _decode_sorted(hier_out[p])
+        fk, fv = _decode_sorted(flat_out[p])
+        assert np.array_equal(hk, fk), f"partition {p} keys differ"
+        assert np.allclose(hv, fv), f"partition {p} payloads differ"
+
+
+# -- producer regroup: hier vs flat oracle ----------------------------------
+
+
+def test_hier_collective_matches_flat():
+    """Multi-device regroup (shard_map lax.all_to_all over the 8-device
+    virtual mesh) partitions identically to the flat per-partition loop."""
+    import jax
+
+    assert len(jax.devices()) >= 2, "conftest must force a multi-device mesh"
+    page = _page()
+    caps = local_capabilities()
+    hs = HierExchangeStats()
+    # nparts=4 throughout the unit tests: the collective regroup is
+    # compile-cached per (n_devices, nparts, names), so sharing the
+    # topology keeps the suite to ONE shard_map compile
+    hier_out = hier_partition(page, KEYS, 4, caps=caps, hier=hs)
+    flat_out = _hash_partition(page, KEYS, 4, caps=caps)
+    _assert_oracle_equal(hier_out, flat_out, 4)
+    snap = hs.snapshot()
+    assert snap["exchanges"] == 1
+    assert snap["collective_exchanges"] == 1, snap
+    assert snap["rows"] == int(page.count)
+    assert snap["wire_pages"] >= 4
+
+
+def test_hier_fused_matches_flat(monkeypatch):
+    """Single-chip fused regroup (argsort + boundary slicing, one device
+    dispatch) partitions identically to the flat loop."""
+    monkeypatch.setenv("PRESTO_TPU_HIER_EXCHANGE_MIN_DEVICES", "9999")
+    page = _page(seed=1)
+    caps = local_capabilities()
+    hs = HierExchangeStats()
+    hier_out = hier_partition(page, KEYS, 4, caps=caps, hier=hs)
+    flat_out = _hash_partition(page, KEYS, 4, caps=caps)
+    _assert_oracle_equal(hier_out, flat_out, 4)
+    assert hs.snapshot()["collective_exchanges"] == 0
+
+
+def test_hier_dead_rows_and_empty_partitions():
+    """Dead rows (count < capacity) never ship; empty partitions still
+    ship exactly one (empty) page — the flat-path parity contract."""
+    full = _page(4096, seed=2)
+    page = Page(full.blocks, full.names, 1000)  # 3096 dead rows
+    caps = local_capabilities()
+    out = hier_partition(page, KEYS, 4, caps=caps)
+    total = 0
+    for p in range(4):
+        assert len(out[p]) >= 1
+        k, _v = _decode_sorted(out[p])
+        total += len(k)
+    assert total == 1000
+    # single-key page: every row hashes to ONE partition, others empty
+    one = Page.from_dict({
+        "k": np.zeros(64, np.int64), "v": np.ones(64),
+    })
+    out = hier_partition(one, KEYS, 4, caps=caps)
+    sizes = {
+        p: sum(int(deserialize_page(r).count) for r in out[p]) for p in out
+    }
+    assert sorted(sizes.values()) == [0, 0, 0, 64]
+    for p, n_rows in sizes.items():
+        if n_rows == 0:  # empty partition ships exactly ONE empty page
+            assert len(out[p]) == 1
+
+
+# -- ragged wire pages under skew -------------------------------------------
+
+
+def test_skewed_partitions_ragged_beats_fixed(monkeypatch):
+    """At 100:1 partition skew the ragged paged wire unit must carry
+    less padding than a pad-to-max (fixed) encoding — the reason the
+    inter-host wire ships ragged pages."""
+    monkeypatch.setenv("PRESTO_TPU_RAGGED_PAGE_ROWS", "256")
+    rng = np.random.default_rng(3)
+    nparts = 4  # same topology as above: reuses the cached collective
+    # ~100:1 skew: find a key per partition by probing the real hash,
+    # then weight partition 0 with 100x the rows of the others
+    probe = Page.from_dict({
+        "k": np.arange(4096, dtype=np.int64),
+        "v": np.zeros(4096),
+    })
+    flat = _hash_partition(probe, KEYS, nparts)
+    rep = {}
+    for p in range(nparts):
+        k, _ = _decode_sorted(flat[p])
+        assert len(k), f"probe found no key for partition {p}"
+        rep[p] = k[0]
+    ks = np.concatenate(
+        [np.full(10000, rep[0], np.int64)]
+        + [np.full(100, rep[p], np.int64) for p in range(1, nparts)]
+    )
+    rng.shuffle(ks)
+    page = Page.from_dict({"k": ks, "v": np.zeros(len(ks))})
+    hs = HierExchangeStats()
+    hier_partition(page, KEYS, nparts, caps=local_capabilities(), hier=hs)
+    snap = hs.snapshot()
+    assert snap["ragged_pad_rows"] < snap["fixed_pad_rows"], snap
+    assert snap["pad_saved_rows"] > 0, snap
+
+
+def test_wire_padding_accounting():
+    from presto_tpu.ops.ragged import wire_padding
+
+    pad = wire_padding([10100] + [101] * 9, 2048)
+    assert pad["rows"] == 11009
+    # ragged: ceil-to-page slack only; fixed: every partition padded to
+    # the hot one's size
+    assert pad["ragged_pad_rows"] < pad["fixed_pad_rows"]
+    # no live rows -> no padding either way
+    assert wire_padding([0, 0], 2048) == {
+        "rows": 0, "ragged_pad_rows": 0, "fixed_pad_rows": 0,
+    }
+
+
+# -- knob + capability + breaker degradation --------------------------------
+
+
+def _cluster(worker_caps=None):
+    from presto_tpu.server.cluster import HttpClusterSession, NodeManager
+
+    cats = [TpchCatalog(sf=SF) for _ in range(2)]
+    workers = [
+        WorkerServer(cats[0]).start(),
+        WorkerServer(cats[1], **(
+            {"wire_caps": worker_caps} if worker_caps else {}
+        )).start(),
+    ]
+    nodes = NodeManager([w.uri for w in workers], interval=3600)
+    sess = HttpClusterSession(TpchCatalog(sf=SF), nodes)
+    return sess, workers
+
+
+GROUP_SQL = (
+    "select o_orderpriority, count(*) c, sum(o_totalprice) s from orders "
+    "group by o_orderpriority order by o_orderpriority"
+)
+
+
+def _oracle_rows(sql=GROUP_SQL):
+    from presto_tpu.session import Session
+
+    return [tuple(r) for r in Session(TpchCatalog(sf=SF)).query(sql).rows()]
+
+
+def test_hier_fleet_runs_hier_and_reports():
+    """A fleet that fully advertises `hier` runs the hierarchical
+    producer path: oracle-equal rows, query-level hier rollup in the
+    scheduler stats, and the EXPLAIN ANALYZE footers."""
+    sess, workers = _cluster()
+    try:
+        got = [tuple(r) for r in sess.query(GROUP_SQL).rows()]
+        assert got == _oracle_rows()
+        caps = sess.scheduler.stats.wire_caps
+        assert caps.get("hier") == {"ragged": True}, caps
+        snap = sess.scheduler.stats_snapshot()
+        assert snap["hier"].get("exchanges", 0) > 0, snap["hier"]
+        assert snap["hier"]["fallbacks"] == 0, snap["hier"]
+        txt = sess.explain_analyze(GROUP_SQL)
+        assert "-- hier: " in txt, txt
+        assert "overlap: wire " in txt, txt
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_mixed_fleet_degrades_to_flat():
+    """One worker without the `hier` advert (an old build): negotiation
+    drops the capability fleet-wide, every producer runs the flat PTP2
+    loop, and results stay oracle-equal — monotonic degradation, never
+    a mixed wire."""
+    old_caps = {"version": 2, "codecs": ["lz4", "zlib", "raw"]}
+    sess, workers = _cluster(worker_caps=old_caps)
+    try:
+        got = [tuple(r) for r in sess.query(GROUP_SQL).rows()]
+        assert got == _oracle_rows()
+        caps = sess.scheduler.stats.wire_caps
+        assert "hier" not in (caps or {}), caps
+        snap = sess.scheduler.stats_snapshot()
+        assert not snap["hier"], snap["hier"]
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_hier_knob_off_forces_flat(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_HIER_EXCHANGE", "0")
+    sess, workers = _cluster()
+    try:
+        got = [tuple(r) for r in sess.query(GROUP_SQL).rows()]
+        assert got == _oracle_rows()
+        assert not sess.scheduler.stats_snapshot()["hier"]
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_hier_fault_trips_breaker_and_falls_back(monkeypatch):
+    """A hier-path fault mid-task degrades that batch (and the rest of
+    the task) to the flat loop: results stay oracle-equal, the
+    `hier_exchange` breaker records the failure, and the fallback is
+    visible in the hier rollup."""
+    from presto_tpu.exec.breaker import BREAKERS
+    from presto_tpu.server import hier as hier_mod
+
+    def _boom(*a, **kw):
+        raise RuntimeError("injected hier fault")
+
+    monkeypatch.setattr(hier_mod, "hier_partition", _boom)
+    BREAKERS.reset()
+    sess, workers = _cluster()
+    try:
+        got = [tuple(r) for r in sess.query(GROUP_SQL).rows()]
+        assert got == _oracle_rows()
+        snap = sess.scheduler.stats_snapshot()
+        assert snap["hier"].get("fallbacks", 0) > 0, snap["hier"]
+        assert snap["hier"].get("exchanges", 0) == 0, snap["hier"]
+        bsnap = BREAKERS.snapshot().get("hier_exchange")
+        assert bsnap and bsnap["total_failures"] > 0, bsnap
+    finally:
+        for w in workers:
+            w.stop()
+        BREAKERS.reset()
+
+
+# -- ExchangeStats.snapshot() consistency (the Fix satellite) ---------------
+
+
+def test_exchange_stats_snapshot_consistent_under_hammer():
+    """snapshot() must never return a torn view: pages always equals the
+    by_source sum, and the derived overlap fields are internally
+    consistent — even while pullers hammer every counter."""
+    stats = ExchangeStats()
+    stop = threading.Event()
+
+    def hammer(src):
+        while not stop.is_set():
+            stats.request_started()
+            stats.pages_staged(src, 1, 100)
+            stats.request_finished(0.001)
+            stats.consumer_waited(0.0004)
+
+    threads = [
+        threading.Thread(target=hammer, args=(f"w{i}",), daemon=True)
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            s = stats.snapshot()
+            assert s["pages"] == sum(s["by_source"].values()), s
+            assert s["hidden_ms"] == round(
+                max(s["pull_ms"] - s["consumer_wait_ms"], 0.0), 2
+            ), s
+            if s["pull_ms"] > 0:
+                assert s["overlap_frac"] == round(
+                    s["hidden_ms"] / s["pull_ms"], 3
+                ), s
+            assert s["wire_bytes"] == s["pages"] * 100, s
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_hier_stats_merge_snapshot_roundtrip():
+    a = HierExchangeStats()
+    a.record_batch(100, 0.25, True, 3,
+                   {"ragged_pad_rows": 7, "fixed_pad_rows": 30})
+    a.record_fallback()
+    b = HierExchangeStats()
+    b.merge_snapshot(a.snapshot())
+    b.merge_snapshot(None)  # tolerated: old worker without hier stats
+    sa, sb = a.snapshot(), b.snapshot()
+    assert sa == sb, (sa, sb)
+    assert sb["pad_saved_rows"] == 23
+
+
+# -- metrics + consumer coalescing ------------------------------------------
+
+
+def test_hier_metrics_exported():
+    from presto_tpu.obs.export import export_hier_stats
+    from presto_tpu.obs.metrics import METRICS
+
+    hs = HierExchangeStats()
+    hs.record_batch(64, 0.01, False, 2,
+                    {"ragged_pad_rows": 1, "fixed_pad_rows": 5})
+    export_hier_stats(hs)
+    export_hier_stats(hs, role="gather")
+    text = METRICS.render()
+    assert 'presto_hier_exchanges_total{role="task"}' in text, text
+    assert 'presto_hier_exchanges_total{role="gather"}' in text
+    assert "presto_hier_ragged_pad_rows_total" in text
+    assert "presto_exchange_hidden_seconds_total" in text
+
+
+def test_coalesce_pages_regroups_ragged_slivers():
+    """The consumer-side coalescer folds many small ragged wire pages
+    back into batch-sized pages without losing or duplicating rows."""
+    from presto_tpu.exec.stream import coalesce_pages
+
+    slivers = [
+        Page.from_dict({"x": np.arange(i * 10, i * 10 + 10, dtype=np.int64)})
+        for i in range(20)
+    ]
+    out = list(coalesce_pages(iter(slivers), target_rows=50))
+    assert len(out) < len(slivers)
+    got = np.concatenate([
+        np.asarray(p.blocks[0].data)[: int(p.count)] for p in out
+    ])
+    assert np.array_equal(np.sort(got), np.arange(200))
+    # all-empty stream collapses to ONE empty page, schema preserved
+    empties = [Page.from_dict({"x": np.array([], np.int64)})] * 3
+    out = list(coalesce_pages(iter(empties), target_rows=50))
+    assert len(out) == 1 and int(out[0].count) == 0
+    assert out[0].names == ("x",)
+    # empty iterator stays empty
+    assert list(coalesce_pages(iter(()), target_rows=50)) == []

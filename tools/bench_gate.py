@@ -176,6 +176,7 @@ def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
             print(rline)
             if ratio_val is None or ratio_val < ratio_floor:
                 failures.append(rline)
+    failures += run_multichip_gate(runs, tolerance, baseline_path)
     failures += run_qps_gate(tolerance, baseline_path)
     failures += run_tracing_overhead_gate(baseline_path)
     if failures:
@@ -186,6 +187,96 @@ def run_gate(sf: float = 0.1, runs: int = 3, tolerance: float = 0.10,
         return 1
     print("bench_gate: OK")
     return 0
+
+
+def run_multichip_gate(runs: int, tolerance: float,
+                       baseline_path: str = DEFAULT_BASELINE):
+    """Multi-device exchange floors (BASELINE.json `multichip_gate`):
+    the exchange micros need >=2 devices, which the in-process suite
+    above cannot provide once jax has initialized single-chip — so this
+    gate re-runs them in a SUBPROCESS with `--virtual-devices N`. A
+    gated bench that comes back missing/skipped is a FAILURE, not a
+    skip: the all_to_all micro regressed to 'skipped: single device'
+    for ten PRs before this gate existed. Floors: rows/s per bench
+    (tolerance applies) and the hier-vs-flat `speedup_vs_flat` ratio
+    (absolute — self-normalizing across machines).
+    Returns failure strings ([] = green/skipped)."""
+    import subprocess
+    import tempfile
+
+    with open(baseline_path) as f:
+        gate = json.load(f).get("multichip_gate")
+    if not gate or not gate.get("values"):
+        return []
+    if gate.get("backend") != "cpu":
+        # recorded on real multi-chip hardware: only comparable there
+        import jax
+
+        if jax.default_backend() != gate.get("backend"):
+            print(
+                f"multichip_gate: baseline backend {gate.get('backend')!r}"
+                f" != live {jax.default_backend()!r} — skipping"
+            )
+            return []
+    n_dev = int(gate.get("virtual_devices", 2))
+    sf = float(gate.get("sf", 0.1))
+    names = list(gate["values"])
+    repo_root = os.path.abspath(os.path.join(_HERE, os.pardir))
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        out_path = tf.name
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "presto_tpu.benchmark.micro",
+                "--virtual-devices", str(n_dev), "--sf", str(sf),
+                "--runs", str(runs), "--out", out_path, "--only", *names,
+            ],
+            capture_output=True, text=True, cwd=repo_root, timeout=1200,
+        )
+        if proc.returncode != 0:
+            return [
+                "multichip_gate: micro subprocess failed "
+                f"(exit {proc.returncode}): {proc.stderr.strip()[-300:]}"
+            ]
+        with open(out_path) as f:
+            table = json.load(f)
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    got = {r["name"]: r for r in table["results"]}
+    failures = []
+    for name in names:
+        base = gate["values"][name]
+        r = got.get(name)
+        if r is None:
+            failures.append(
+                f"{name}: missing from {n_dev}-device run "
+                f"({table['errors'].get(name, 'no result')})"
+            )
+            continue
+        cur = r["rows_per_s"]
+        ratio = cur / base
+        note = f" [{r['note']}]" if r.get("note") else ""
+        line = (
+            f"{name}: {cur:,} rows/s vs baseline {base:,} "
+            f"({ratio:.2f}x){note}"
+        )
+        print(line)
+        if ratio < 1.0 - tolerance:
+            failures.append(line)
+        ratio_floor = (gate.get("ratio_floors") or {}).get(name)
+        if ratio_floor:
+            ratio_val = r.get("speedup_vs_flat")
+            rline = (
+                f"{name}: speedup_vs_flat {ratio_val} vs floor "
+                f"{ratio_floor}x"
+            )
+            print(rline)
+            if ratio_val is None or ratio_val < ratio_floor:
+                failures.append(rline)
+    return failures
 
 
 def run_qps_gate(tolerance: float, baseline_path: str = DEFAULT_BASELINE):
