@@ -64,7 +64,10 @@ _QCACHE_FILE = "presto_tpu/exec/qcache.py"
 _SNAPSHOT_ALL = "snapshot_all"
 _SURFACE_TOKENS = ("snapshot", "stats", "status", "explain", "summary")
 _EXPORT_TOKENS = ("export", "metrics")
-_STATS_SCOPES = ("presto_tpu/exec/", "presto_tpu/server/")
+_STATS_SCOPES = (
+    "presto_tpu/exec/", "presto_tpu/server/",
+    "presto_tpu/plan/history.py",
+)
 
 
 def _const_str(node) -> Optional[str]:

@@ -1550,6 +1550,177 @@ def run_metrics_scrape_bench(sf: float, runs: int = RUNS) -> Dict:
     }
 
 
+class _MisleadingStatsCatalog:
+    """Delegating wrapper whose column_stats answers come from a fixed
+    table — the feedback micro's stand-in for a connector with stale
+    statistics, steering the static planner into a provably bad join
+    order that only recorded history can correct."""
+
+    def __init__(self, inner, ndvs):
+        self.inner = inner
+        self._ndvs = ndvs
+
+    def column_stats(self, table, column):
+        from ..plan.stats import ColumnStats
+
+        ndv = self._ndvs.get((table, column))
+        return None if ndv is None else ColumnStats(ndv=float(ndv))
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+def _feedback_fixture(sf: float):
+    """(catalog, session, sql, probe_rows): a 3-way join whose stale
+    catalog stats make the greedy planner start from the exploding
+    dup-side join (~n*m/64 intermediate rows) instead of the selective
+    one (~0.6% of probe)."""
+    from .. import types as T
+    from ..connectors.memory import MemoryCatalog
+    from ..page import Page
+    from ..session import Session
+
+    n = max(int(2_000_000 * sf), 100_000)
+    # dup scales with sf too: the misordered intermediate is ~n*m/8
+    # rows, and the suite-runnability test (sf=0.005) must not pay the
+    # gate-scale (sf=0.1, m=2000) explosion several runs over
+    m, s = max(int(20_000 * sf), 200), 64
+    rng = np.random.default_rng(3)
+    inner = MemoryCatalog({
+        "probe": Page.from_dict({
+            "pk": (rng.integers(0, 64, n).astype(np.int64), T.BIGINT),
+            "ps": (rng.integers(0, 10_000, n).astype(np.int64), T.BIGINT),
+            "pv": (rng.integers(0, 1000, n).astype(np.int64), T.BIGINT),
+        }),
+        "dup": Page.from_dict({
+            "d": (rng.integers(0, 8, m).astype(np.int64), T.BIGINT),
+            "dv": (rng.integers(0, 1000, m).astype(np.int64), T.BIGINT),
+        }),
+        "sel": Page.from_dict({
+            "s": (np.arange(s, dtype=np.int64), T.BIGINT),
+            "sv": (rng.integers(0, 1000, s).astype(np.int64), T.BIGINT),
+        }),
+    })
+    # the lies: dup.d claims unique (its 8-value skew is what explodes),
+    # while the genuinely selective sel join claims NDV 50 — so the
+    # static cost model prefers building dup*probe first
+    cat = _MisleadingStatsCatalog(inner, {
+        ("dup", "d"): m, ("probe", "ps"): 50, ("sel", "s"): 50,
+    })
+    sql = (
+        "select count(*) c, sum(pv) v from probe, dup, sel "
+        "where probe.pk = dup.d and probe.ps = sel.s"
+    )
+    return cat, Session(cat), sql, n
+
+
+def run_feedback_replan_bench(sf: float, runs: int = RUNS) -> Dict:
+    """History-based adaptive execution (plan/history.py): the same
+    3-way join planned cold from misleading catalog stats (greedy order
+    explodes an intermediate) vs planned warm from recorded observed
+    cardinalities (selective join first). RAISES when the warm plan's
+    history lookups never hit, so the gate catches a dead feedback loop
+    as well as a slow one; `speedup_vs_full` carries the >=1.5x
+    acceptance ratio (BASELINE.json ratio_floors)."""
+    import os
+
+    from ..exec import qcache
+    from ..plan.history import HISTORY
+
+    cat, sess, sql, n = _feedback_fixture(sf)
+    prev = os.environ.get("PRESTO_TPU_FEEDBACK")
+    os.environ["PRESTO_TPU_FEEDBACK"] = "0"
+    try:
+        HISTORY.reset()
+        sess.query(sql)  # static warmup: compiles the bad order's kernels
+        best_static = float("inf")
+        for _ in range(max(runs, 1)):
+            qcache.RESULT_CACHE.reset()
+            t0 = time.perf_counter()
+            r_static = sess.query(sql).rows()
+            best_static = min(best_static, time.perf_counter() - t0)
+        os.environ["PRESTO_TPU_FEEDBACK"] = "1"
+        qcache.RESULT_CACHE.reset()
+        sess.query(sql)  # observe-once: records the misordered run
+        qcache.RESULT_CACHE.reset()
+        sess.query(sql)  # warm warmup: compiles the corrected order
+        h0 = HISTORY.stats.snapshot()["hits"]
+        best_warm = float("inf")
+        for _ in range(max(runs, 1)):
+            qcache.RESULT_CACHE.reset()
+            t0 = time.perf_counter()
+            r_warm = sess.query(sql).rows()
+            best_warm = min(best_warm, time.perf_counter() - t0)
+        if HISTORY.stats.snapshot()["hits"] == h0:
+            raise RuntimeError("warm runs never consulted plan history")
+        if r_warm != r_static:
+            raise RuntimeError(
+                f"adaptive plan changed the answer: {r_warm} != {r_static}"
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("PRESTO_TPU_FEEDBACK", None)
+        else:
+            os.environ["PRESTO_TPU_FEEDBACK"] = prev
+    speedup = best_static / best_warm
+    return {
+        "name": "feedback_replan",
+        "rows": n,
+        "rows_per_s": round(n / best_warm),
+        "ms": round(best_warm * 1e3, 3),
+        "speedup_vs_full": round(speedup, 2),
+        "note": f"history-driven {best_warm * 1e3:.1f}ms vs static "
+                f"{best_static * 1e3:.1f}ms = {speedup:.1f}x",
+    }
+
+
+def run_feedback_lookup_bench(sf: float, runs: int = RUNS) -> Dict:
+    """Warm-path cost of the feedback store itself: fingerprint + lookup
+    of every recordable frame of a live 3-join plan against a populated
+    store — the exact work StatsDeriver adds to each plan when history
+    is on. rows/s counts frame lookups; keeps the lookup overhead
+    visible so the <=5% budget on the serving fast path stays honest."""
+    import os
+
+    from ..plan.history import HISTORY, fingerprint, _walk_plan
+
+    cat, sess, sql, n = _feedback_fixture(sf)
+    prev = os.environ.get("PRESTO_TPU_FEEDBACK")
+    os.environ["PRESTO_TPU_FEEDBACK"] = "1"
+    try:
+        HISTORY.reset()
+        sess.query(sql)  # populate the store with this plan's frames
+        node = sess.plan(sql)
+        nodes: list = []
+        _walk_plan(node, nodes.append)
+        iters = 200
+        best = float("inf")
+        for _ in range(max(runs, 1)):
+            t0 = time.perf_counter()
+            for _i in range(iters):
+                memo: dict = {}
+                for nd in nodes:
+                    HISTORY.lookup(fingerprint(nd, memo), cat)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        hits = HISTORY.stats.snapshot()["hits"]
+        if hits == 0:
+            raise RuntimeError("lookup loop never hit the store")
+    finally:
+        if prev is None:
+            os.environ.pop("PRESTO_TPU_FEEDBACK", None)
+        else:
+            os.environ["PRESTO_TPU_FEEDBACK"] = prev
+    lookups = len(nodes)
+    return {
+        "name": "feedback_lookup",
+        "rows": lookups,
+        "rows_per_s": round(lookups / best),
+        "ms": round(best * 1e3, 4),
+        "note": f"{lookups} frame lookups at {best / lookups * 1e9:.0f}ns "
+                f"each over a {len(nodes)}-node plan",
+    }
+
+
 HOST_BENCHES = {
     "serde_lz4": run_serde_bench,
     "serde_encoded": run_serde_encoded_bench,
@@ -1562,6 +1733,8 @@ HOST_BENCHES = {
     "ingest_append": run_ingest_append_bench,
     "mixed_soak_qps": run_mixed_soak_qps_bench,
     "metrics_scrape": run_metrics_scrape_bench,
+    "feedback_replan": run_feedback_replan_bench,
+    "feedback_lookup": run_feedback_lookup_bench,
 }
 
 

@@ -32,6 +32,10 @@ TASKS = "system.runtime.tasks"
 # the device/host pool gauges a JVM would publish per memory pool
 JMX_PROCESS = "system.jmx.process"
 JMX_MEMORY = "system.jmx.memory"
+# history-based adaptive execution (plan/history.py): every live
+# feedback-store entry — semantic plan-frame fingerprint, observed vs
+# estimated cardinality, hybrid-join partition memory — as a table
+PLAN_HISTORY = "system.runtime.plan_history"
 
 
 def _varchar(values: List[Optional[str]]) -> Block:
@@ -198,6 +202,12 @@ _TASKS_SCHEMA: Dict[str, T.Type] = {
     "wall_ms": T.DOUBLE, "rows_out": T.BIGINT, "bytes_out": T.BIGINT,
     "attrs": T.VARCHAR,
 }
+_PLAN_HISTORY_SCHEMA: Dict[str, T.Type] = {
+    "fingerprint": T.VARCHAR, "kind": T.VARCHAR, "rows": T.DOUBLE,
+    "est_rows": T.DOUBLE, "observations": T.BIGINT,
+    "mispredicts": T.BIGINT, "hybrid_parts": T.BIGINT,
+    "hybrid_depth": T.BIGINT, "tables": T.VARCHAR,
+}
 _MATVIEWS_SCHEMA: Dict[str, T.Type] = {
     "name": T.VARCHAR, "base_tables": T.VARCHAR, "incremental": T.VARCHAR,
     "reason": T.VARCHAR, "staleness_versions": T.BIGINT,
@@ -264,6 +274,59 @@ def _metrics_page() -> Page:
             "value": (
                 np.array([float(s[3]) for s in samples], np.float64),
                 T.DOUBLE,
+            ),
+        }
+    )
+
+
+def _plan_history_page() -> Page:
+    """One row per live feedback-store entry (plan/history.py). The
+    fingerprint is the semantic frame key the planner looks up, so a
+    `rows` column here IS what the next plan of the same frame will use."""
+    from ..plan.history import HISTORY
+
+    entries = HISTORY.rows_snapshot()
+    if not entries:
+        from ..ops.union import empty_page
+
+        return empty_page(_PLAN_HISTORY_SCHEMA)
+    return Page.from_dict(
+        {
+            "fingerprint": _varchar([fp for fp, _ in entries]),
+            "kind": _varchar([e.kind or None for _, e in entries]),
+            "rows": (
+                np.array(
+                    [-1.0 if e.rows is None else float(e.rows)
+                     for _, e in entries],
+                    np.float64,
+                ),
+                T.DOUBLE,
+            ),
+            "est_rows": (
+                np.array(
+                    [-1.0 if e.est_rows is None else float(e.est_rows)
+                     for _, e in entries],
+                    np.float64,
+                ),
+                T.DOUBLE,
+            ),
+            "observations": (
+                np.array([e.n for _, e in entries], np.int64), T.BIGINT,
+            ),
+            "mispredicts": (
+                np.array([e.mispredicts for _, e in entries], np.int64),
+                T.BIGINT,
+            ),
+            "hybrid_parts": (
+                np.array([e.hybrid_parts for _, e in entries], np.int64),
+                T.BIGINT,
+            ),
+            "hybrid_depth": (
+                np.array([e.hybrid_depth for _, e in entries], np.int64),
+                T.BIGINT,
+            ),
+            "tables": _varchar(
+                [",".join(e.tables) or None for _, e in entries]
             ),
         }
     )
@@ -346,7 +409,7 @@ class SystemCatalog(Connector):
 
     _SYSTEM_TABLES = (
         QUERIES, NODES, JMX_PROCESS, JMX_MEMORY, MATERIALIZED_VIEWS,
-        METRICS, TASKS,
+        METRICS, TASKS, PLAN_HISTORY,
     )
 
     def table_names(self) -> List[str]:
@@ -367,12 +430,16 @@ class SystemCatalog(Connector):
             return dict(_METRICS_SCHEMA)
         if table == TASKS:
             return dict(_TASKS_SCHEMA)
+        if table == PLAN_HISTORY:
+            return dict(_PLAN_HISTORY_SCHEMA)
         return self.wrapped.schema(table)
 
     def row_count(self, table: str) -> int:
         if table == QUERIES:
             return len(self.manager.list_queries()) if self.manager else 0
-        if table in (NODES, JMX_PROCESS, JMX_MEMORY, METRICS, TASKS):
+        if table in (
+            NODES, JMX_PROCESS, JMX_MEMORY, METRICS, TASKS, PLAN_HISTORY,
+        ):
             return 1  # planner estimate; exact counts come from the page
         if table == MATERIALIZED_VIEWS:
             mgr = self.matview_manager
@@ -408,6 +475,8 @@ class SystemCatalog(Connector):
             return _metrics_page()
         if table == TASKS:
             return _tasks_page()
+        if table == PLAN_HISTORY:
+            return _plan_history_page()
         return self.wrapped.page(table)
 
     def exact_row_count(self, table: str) -> int:

@@ -108,6 +108,9 @@ replicated subtrees delegate to the single-node Executor."""
         self.axis = axis
         self.n = mesh.shape[axis]
         self.local = Executor(catalog, collector=collector)
+        # estimate caches in the delegate key on mesh width (_est_env):
+        # per-shard sizing derived at one width must not serve another
+        self.local.mesh_n = self.n
         self._steps: Dict = {}
         self.collector = collector
         # per-shard byte budget for exchanged join intermediates: when an

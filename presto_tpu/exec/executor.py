@@ -386,7 +386,8 @@ class Executor:
             cache = self._ps_cache = LRUCache(
                 max_entries=1024, name="plan_stats"
             )
-        hit = cache.get(node, count=False)
+        key = (node,) + self._est_env()
+        hit = cache.get(key, count=False)
         if hit is not None:
             return hit[0]
         try:
@@ -395,8 +396,19 @@ class Executor:
             ps = derive(node, self.catalog)
         except Exception:  # noqa: BLE001 — estimation is best-effort
             ps = None
-        cache.put(node, (ps,))
+        cache.put(key, (ps,))
         return ps
+
+    def _est_env(self) -> tuple:
+        """Environment half of the estimate-cache keys: the feedback
+        store's generation (a history record/invalidation must never let
+        a live executor keep serving estimates derived from superseded
+        observations) plus the mesh width (a DistributedExecutor shares
+        this object as its local delegate; per-shard sizing decisions
+        must not alias across mesh shapes)."""
+        from ..plan.history import plan_env_token
+
+        return plan_env_token(), getattr(self, "mesh_n", 1)
 
     # -- composite-key packing (ops/keypack.py) --
     def _keypack_plan(self, node, keys, page: Page, equality_only=False,
@@ -530,7 +542,8 @@ class Executor:
             cache = self._est_cache = LRUCache(
                 max_entries=4096, name="row_est"
             )
-        hit = cache.get(node, count=False)
+        key = (node,) + self._est_env()
+        hit = cache.get(key, count=False)
         if hit is not None:
             return hit[0]
         try:
@@ -539,7 +552,7 @@ class Executor:
             est = float(derive(node, self.catalog).rows)
         except Exception:  # noqa: BLE001 — estimation is best-effort
             est = None
-        cache.put(node, (est,))
+        cache.put(key, (est,))
         return est
 
     # -- dynamic filters (exec/dynfilter.py) --

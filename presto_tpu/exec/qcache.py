@@ -533,6 +533,14 @@ KERNEL_CACHE = LRUCache(
     max_entries=_env_int("PRESTO_TPU_COMPILE_CACHE_ENTRIES", 1024),
     name="kernel",
 )
+# observed-cardinality feedback entries (plan/history.py HistoryStore):
+# byte-bounded like the result cache — an entry is ~a few hundred bytes,
+# so the default bound holds tens of thousands of plan-node frames
+HISTORY_CACHE = LRUCache(
+    max_entries=_env_int("PRESTO_TPU_FEEDBACK_ENTRIES", 8192),
+    max_bytes=_env_int("PRESTO_TPU_FEEDBACK_BYTES", 4 << 20),
+    name="history",
+)
 
 _persistent_enabled = [False]
 
@@ -582,6 +590,7 @@ def snapshot_all() -> Dict[str, dict]:
         "plan": PLAN_CACHE.snapshot(),
         "result": RESULT_CACHE.snapshot(),
         "kernel": KERNEL_CACHE.snapshot(),
+        "history": HISTORY_CACHE.snapshot(),
     }
 
 
@@ -589,7 +598,7 @@ def format_summary(snap: Dict[str, dict]) -> str:
     """One-line cache summary for EXPLAIN ANALYZE surfaces (the single
     formatter both the single-process and cluster renders share)."""
     parts = []
-    for name in ("plan", "result", "kernel"):
+    for name in ("plan", "result", "kernel", "history"):
         s = snap.get(name)
         if s is None:
             continue
@@ -604,5 +613,11 @@ def format_summary(snap: Dict[str, dict]) -> str:
 
 def reset_all() -> None:
     """Test hook: drop every cached entry AND zero the counters."""
-    for c in (PLAN_CACHE, RESULT_CACHE, KERNEL_CACHE):
+    for c in (PLAN_CACHE, RESULT_CACHE, KERNEL_CACHE, HISTORY_CACHE):
         c.reset()
+    # the feedback store layers a generation counter and its own stats
+    # over HISTORY_CACHE; reset those too or a cleared cache would keep
+    # serving a stale generation to executor-side estimate caches
+    from ..plan import history as _history
+
+    _history.HISTORY.reset()

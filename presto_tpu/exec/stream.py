@@ -951,15 +951,40 @@ class StreamingExecutor:
                 self.pool.free(nb)
 
     def _hybrid_partition_count(self, total_bytes: int, share: int,
-                                cap: int = 64) -> int:
+                                cap: int = 64, node=None) -> int:
         import os
 
         env = int(os.environ.get("PRESTO_TPU_HYBRID_JOIN_PARTS", "0"))
         if env > 0:
-            return env
+            return env  # manual override beats both heuristics
         # 2x headroom per partition (arXiv:2112.02480: over-partitioning
         # is cheap, under-partitioning forces recursion)
-        return min(max(-(-total_bytes * 2 // max(share, 1)), 2), cap)
+        P = min(max(-(-total_bytes * 2 // max(share, 1)), 2), cap)
+        if node is not None:
+            P = self._hybrid_history_parts(node, P, cap)
+        return P
+
+    def _hybrid_history_parts(self, node: N.Join, P: int, cap: int) -> int:
+        """History-based sizing (plan/history.py): a join frame that
+        previously recursed with P0 partitions wants ~P0 * 2^depth up
+        front — recursion repartitions the SAME rows on fresh hash bits,
+        so pre-scaling buys the one-pass layout the byte estimate
+        undersized. Never shrinks below the byte-derived count."""
+        try:
+            from ..plan.history import HISTORY, feedback_on, fingerprint
+
+            if not feedback_on():
+                return P
+            ent = HISTORY.lookup(fingerprint(node), self.catalog)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            from .breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+            return P
+        if ent is None or not ent.hybrid_parts:
+            return P
+        want = ent.hybrid_parts << max(int(ent.hybrid_depth), 0)
+        return min(max(P, want), cap)
 
     def _hybrid_setup(self, node: N.Join, spilled) -> dict:
         """Eager setup phase of the hybrid hash join: hash-partition the
@@ -974,7 +999,7 @@ class StreamingExecutor:
         share = self._spill_share()
         row_b = max(spilled.row_bytes, 1)
         total_bytes = spilled.num_rows * row_b
-        P = self._hybrid_partition_count(total_bytes, share)
+        P = self._hybrid_partition_count(total_bytes, share, node=node)
         chunk_rows = max(share // (2 * row_b), 1 << 10)
         parts = hash_partition_indices(
             spilled, node.right_keys, P, chunk_rows, salt=0,
@@ -1072,6 +1097,7 @@ class StreamingExecutor:
         self.spill_stats["hybrid_parts"] = max(
             self.spill_stats["hybrid_parts"], P
         )
+        depth_before = self.spill_stats["hybrid_depth"]
         res_lut = jnp.asarray(setup["res_np"])
         probe_spill = (
             SpilledRows(space=self._spill(), tag="hybrid_probe")
@@ -1139,6 +1165,31 @@ class StreamingExecutor:
                 node, build(empty, node.right_keys), right_names,
                 iter([first_probe]),
             )
+        self._record_hybrid_outcome(node, P, depth_before)
+
+    def _record_hybrid_outcome(self, node: N.Join, P: int,
+                               depth_before: int) -> None:
+        """Remember how this join frame actually partitioned (the
+        feedback half of _hybrid_history_parts). spill_stats tracks the
+        query-wide max depth, so only depth growth since THIS join
+        started is attributable to it."""
+        try:
+            from ..plan.history import HISTORY, feedback_on, fingerprint
+            from .qcache import plan_tables
+
+            if not feedback_on():
+                return
+            d = self.spill_stats["hybrid_depth"]
+            HISTORY.record(
+                fingerprint(node), catalog=self.catalog,
+                tables=plan_tables(node),
+                hybrid=(P, d - depth_before if d > depth_before else 0),
+                kind="Join",
+            )
+        except Exception as exc:  # noqa: BLE001 — bookkeeping only
+            from .breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
 
     def _join_partition(self, node: N.Join, build_sub, probe_sub,
                         right_names, depth: int, chunk_rows: int,

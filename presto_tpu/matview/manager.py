@@ -275,9 +275,10 @@ class MatViewManager:
                     base_rows += int(cat.row_count(tb))
                 except Exception:  # noqa: BLE001 — stats miss: skip cap
                     pass
-            if base_rows and total > maintenance.DELTA_MAX_FRAC * base_rows:
+            if not self._delta_worthwhile(mv, total, base_rows):
                 return None
             wcat = s._writable()
+            t0 = time.perf_counter()
             delta = maintenance.run_core(cat, mv.mplan, deltas)
             if qcache.table_versions(cat, mv.tables) != versions:
                 continue  # writer raced the delta execution — retry
@@ -309,6 +310,11 @@ class MatViewManager:
                         count=merged.count,
                     ),
                 )
+            self._record_refresh_wall(
+                mv, delta_per_row_s=(
+                    (time.perf_counter() - t0) / max(total, 1)
+                ),
+            )
             with self._lock:
                 mv.versions = versions
                 mv.tokens = new_tokens
@@ -323,9 +329,64 @@ class MatViewManager:
             return "delta"
         return None
 
+    def _delta_worthwhile(self, mv: MatView, total: int,
+                          base_rows: int) -> bool:
+        """Delta-vs-full break-even. With history feedback on
+        (plan/history.py) and BOTH refresh modes measured for this view,
+        the decision is the measured one — predicted delta wall vs the
+        last full-recompute wall — instead of the fixed
+        PRESTO_TPU_MATVIEW_DELTA_MAX_FRAC row-ratio cap (which stays the
+        static fallback and the manual override when feedback is off)."""
+        try:
+            from ..plan.history import HISTORY, feedback_on
+
+            if feedback_on():
+                ent = HISTORY.lookup(
+                    f"mv:{mv.name}", self._session.catalog
+                )
+                if (
+                    ent is not None
+                    and ent.delta_per_row_s is not None
+                    and ent.full_wall_s is not None
+                ):
+                    return ent.delta_per_row_s * total < ent.full_wall_s
+        except Exception as exc:  # noqa: BLE001 — degrade to the cap
+            from ..exec.breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+        return not (
+            base_rows and total > maintenance.DELTA_MAX_FRAC * base_rows
+        )
+
+    def _record_refresh_wall(self, mv: MatView,
+                             delta_per_row_s=None,
+                             full_wall_s=None) -> None:
+        """Feed observed refresh walls back into the history store. Keyed
+        per view with NO table-version dependency: walls measure the
+        refresh pipeline, not a data snapshot, and base-table writes are
+        exactly when the next refresh needs them."""
+        try:
+            from ..plan.history import HISTORY, feedback_on
+
+            if feedback_on():
+                HISTORY.record(
+                    f"mv:{mv.name}", catalog=self._session.catalog,
+                    tables=(), kind="MatView",
+                    delta_per_row_s=delta_per_row_s,
+                    full_wall_s=full_wall_s,
+                )
+        except Exception as exc:  # noqa: BLE001 — bookkeeping only
+            from ..exec.breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+
     def _refresh_full(self, mv: MatView, reason: str) -> None:
         s = self._session
+        t0 = time.perf_counter()
         page, versions, tokens = self._run_consistent(mv.plan)
+        self._record_refresh_wall(
+            mv, full_wall_s=time.perf_counter() - t0
+        )
         wcat = s._writable()
         wcat.replace(
             mv.name,

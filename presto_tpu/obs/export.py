@@ -23,6 +23,7 @@ from .metrics import METRICS, Sample
 if TYPE_CHECKING:  # annotations only — avoids exec/server import cycles
     from ..exec.qcache import CacheStats
     from ..exec.stats import NodeStats
+    from ..plan.history import FeedbackStats
     from ..server.cluster import SchedulerStats
     from ..server.exchange import ExchangeStats
     from ..server.hier import HierExchangeStats
@@ -84,6 +85,7 @@ def ensure_default_exports() -> None:
     METRICS.register_producer("qcache", _metrics_qcache_producer)
     METRICS.register_producer("breakers", _metrics_breaker_producer)
     METRICS.register_producer("kernel_profile", _metrics_kernel_producer)
+    METRICS.register_producer("feedback", _metrics_feedback_producer)
 
 
 # ---------------------------------------------------------------------------
@@ -109,15 +111,43 @@ def export_cache_stats(cache: str, stats: "CacheStats") -> List[Sample]:
 
 
 def _metrics_qcache_producer() -> List[Sample]:
-    from ..exec.qcache import KERNEL_CACHE, PLAN_CACHE, RESULT_CACHE
+    from ..exec.qcache import (
+        HISTORY_CACHE, KERNEL_CACHE, PLAN_CACHE, RESULT_CACHE,
+    )
 
     out: List[Sample] = []
     for name, cache in (
         ("plan", PLAN_CACHE), ("result", RESULT_CACHE),
-        ("kernel", KERNEL_CACHE),
+        ("kernel", KERNEL_CACHE), ("history", HISTORY_CACHE),
     ):
         out.extend(export_cache_stats(name, cache.stats))
     return out
+
+
+def export_feedback_stats(stats: "FeedbackStats") -> List[Sample]:
+    """The adaptive-execution plane's FeedbackStats (plan/history.py) as
+    `presto_feedback_*` samples: store traffic, estimate quality, and
+    mid-query replans."""
+    snap = stats.snapshot()
+    out: List[Sample] = []
+    for field in ("hits", "misses", "records", "invalidations",
+                  "decays", "mispredictions", "replans"):
+        out.append((
+            f"presto_feedback_{field}_total", "counter", (),
+            float(snap[field]),
+        ))
+    err = snap.get("mean_abs_rel_err")
+    if err is not None:
+        out.append((
+            "presto_feedback_estimate_rel_error", "gauge", (), float(err)
+        ))
+    return out
+
+
+def _metrics_feedback_producer() -> List[Sample]:
+    from ..plan.history import HISTORY
+
+    return export_feedback_stats(HISTORY.stats)
 
 
 def _metrics_breaker_producer() -> List[Sample]:

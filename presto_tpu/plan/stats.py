@@ -116,18 +116,61 @@ def literal_value(lit: ir.Literal) -> Optional[float]:
 
 class StatsDeriver:
     """One memoized derivation walk (reference StatsCalculator's rule set,
-    collapsed into a visitor)."""
+    collapsed into a visitor).
 
-    def __init__(self, catalog):
+    With history-based feedback on (plan/history.py, PRESTO_TPU_FEEDBACK
+    + the adaptive_plan breaker), every derived estimate is overridden by
+    a validated OBSERVED row count for the node's semantic frame before
+    it is memoized — so join reordering, build/probe-side selection and
+    the fragmenter's broadcast switch all run on measured rows. Pass
+    use_history=False to force the static derivation (the breaker's
+    fallback, and the baseline the error surfaces compare against)."""
+
+    def __init__(self, catalog, use_history: Optional[bool] = None):
         self.catalog = catalog
         self._memo: Dict[int, PlanStats] = {}
+        self._fp_memo: Dict[int, tuple] = {}
+        self._history = None
+        if use_history is not False:
+            try:
+                from . import history as H
+
+                if use_history or H.feedback_on():
+                    self._history = H.HISTORY
+            except Exception:  # noqa: BLE001 — feedback is best-effort
+                self._history = None
 
     def stats(self, node: N.PlanNode) -> PlanStats:
         got = self._memo.get(id(node))
         if got is None:
             got = self._derive(node)
+            if self._history is not None:
+                got = self._observed(node, got)
             self._memo[id(node)] = got
         return got
+
+    def _observed(self, node: N.PlanNode, ps: PlanStats) -> PlanStats:
+        """Replace the estimated row count with the store's observation
+        when one is live for this node's frame; column stats stay derived
+        (history records counts, not distributions) with NDVs re-capped.
+        Any store fault trips the adaptive_plan breaker and reverts this
+        walk to static derivation."""
+        try:
+            from . import history as H
+
+            fp = H.fingerprint(node, self._fp_memo)
+            obs = H.HISTORY.observed_rows(fp, self.catalog)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            from ..exec.breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+            self._history = None
+            return ps
+        if obs is None:
+            return ps
+        return PlanStats(
+            obs, {c: s.cap_ndv(obs) for c, s in ps.columns.items()}
+        )
 
     # -- per-node rules --
 

@@ -284,6 +284,15 @@ class NodeManager:
             self.probe_all()
 
 
+def _has_remote_source(node) -> bool:
+    """True when a producer subtree pulls from a deeper exchange — its
+    static estimate would bottom out at the RemoteSource default, so
+    observed-vs-estimated comparisons there are meaningless."""
+    if isinstance(node, RemoteSource):
+        return True
+    return any(_has_remote_source(c) for c in node.children)
+
+
 class TaskFailure(RuntimeError):
     """A task (or its stage) failed. Carries the worker URI, task id,
     attempt number, and whether the cause is retryable on another
@@ -314,6 +323,10 @@ class SchedulerStats:
     dynfilters_shipped: int = 0
     dynfilter_wait_s: float = 0.0
     dynfilter_timeouts: int = 0
+    # mid-query adaptive replans (plan/history.py): attempts abandoned
+    # at an exchange boundary because the observed stage output
+    # contradicted the estimate grossly enough to re-plan downstream
+    adaptive_replans: int = 0
     # pipelined exchange observability (server/exchange.py): per-source
     # pull stats of the LAST query attempt (coordinator-side gathers) +
     # best-effort producer-side encode stats polled from task statuses,
@@ -405,7 +418,7 @@ class HttpScheduler:
             return self.stats.snapshot()
 
     def run(self, root: N.PlanNode, query_id: Optional[str] = None,
-            trace_ctx: Optional[tuple] = None):
+            trace_ctx: Optional[tuple] = None, adapt: bool = True):
         """Execute with bounded query-level re-execution: a retryable
         failure that escaped per-task retry (e.g. a mid-stream worker
         loss) re-runs the whole plan against a fresh worker snapshot.
@@ -435,6 +448,7 @@ class HttpScheduler:
                 result = self._run_attempt(
                     root, qid,
                     tctx=(trace, aspan.span_id) if trace else None,
+                    adapt=adapt,
                 )
                 if trace is not None:
                     trace.finish(aspan)
@@ -461,7 +475,7 @@ class HttpScheduler:
                     raise
 
     def _run_attempt(self, root: N.PlanNode, query_id: str,
-                     tctx: Optional[tuple] = None):
+                     tctx: Optional[tuple] = None, adapt: bool = True):
         # snapshot membership for the whole attempt (threaded explicitly
         # so concurrent queries can't clobber each other): producer
         # partition counts must match consumer task counts even if a node
@@ -489,6 +503,7 @@ class HttpScheduler:
                 dyn_values={},
                 wire_caps=wire_caps,
                 tctx=tctx,
+                adapt=adapt,
             )
             rspan = (
                 tctx[0].begin("root-fragment", parent_id=tctx[1])
@@ -700,7 +715,8 @@ class HttpScheduler:
                          query_id: Optional[str] = None,
                          dyn_links=None, dyn_values: Optional[dict] = None,
                          wire_caps: Optional[dict] = None,
-                         tctx: Optional[tuple] = None):
+                         tctx: Optional[tuple] = None,
+                         adapt: bool = False):
         """Run producer stages for each exchange; returns either
         {sid: (kind, handles)} (sharded consumer) or {sid: [pages]}
         (coordinator consumer).
@@ -802,8 +818,60 @@ class HttpScheduler:
                     hidden_ms=snap["hidden_ms"],
                     overlap=snap["overlap_frac"],
                 )
+            if adapt:
+                self._maybe_adaptive_replan(specs[sid], pages)
             out[sid] = pages
         return out
+
+    def _maybe_adaptive_replan(self, ex, pages) -> None:
+        """Mid-query adaptation (plan/history.py): the coordinator just
+        materialized a producer stage, so its TRUE cardinality is known
+        while the downstream fragments are still unexecuted. When the
+        observation contradicts the estimate grossly enough
+        (PRESTO_TPU_FEEDBACK_REPLAN_FACTOR) the observation is recorded
+        and AdaptiveReplan raised; the session layer re-plans the
+        downstream fragments against the now-updated history and
+        re-runs through the same retry machinery worker failures use
+        (it re-runs with adapt=False, so one replan per query)."""
+        from ..plan import history as H
+        from . import knobs
+
+        try:
+            if not H.feedback_on():
+                return
+            child = ex.child
+            if _has_remote_source(child) or not self._has_scan(child):
+                return  # nested-exchange estimates are not comparable
+            observed = float(sum(int(p.count) for p in pages))
+            if observed < knobs.feedback_replan_min_rows():
+                return
+            from ..plan.stats import derive
+
+            est = float(derive(child, self.catalog).rows)
+            if observed < knobs.feedback_replan_factor() * max(est, 1.0):
+                return
+            from ..exec.qcache import plan_tables
+
+            recorded = H.HISTORY.record(
+                H.fingerprint(child), catalog=self.catalog,
+                tables=plan_tables(child), rows=observed, est_rows=est,
+                kind=type(child).__name__,
+            )
+            if not recorded:
+                return  # unversioned tables: a re-plan would not differ
+        except Exception as exc:  # noqa: BLE001 — adaptation must never
+            from ..exec.breaker import BREAKERS  # fail a healthy query
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+            return
+        with H.HISTORY.stats._lock:
+            H.HISTORY.stats.replans += 1
+        with self._lock:
+            self.stats.adaptive_replans += 1
+        raise H.AdaptiveReplan(
+            f"stage output {observed:,.0f} rows vs estimate {est:,.0f}: "
+            "re-planning downstream fragments on observed cardinality"
+        )
 
     def _record_exchange(self, sid: str, ex_stats: "ExchangeStats",
                          handles) -> None:
@@ -1424,18 +1492,27 @@ class HttpClusterSession:
                 trace.begin("plan", parent=root)
                 if trace is not None else None
             )
+            from ..plan import history as H
+
             n_workers = max(len(self.scheduler.nodes.active_workers()), 2)
-            pkey = ("c", sql, self.broadcast_threshold, n_workers,
-                    id(self.catalog))
-            ent = qcache.PLAN_CACHE.lookup(pkey, self.catalog)
-            if ent is not None:
-                node = ent.plan
-            else:
-                node = self._planner.plan(sql)
-                node = fragment_plan(node, self.catalog,
-                                     self.broadcast_threshold,
-                                     num_workers=n_workers)
-                qcache.PLAN_CACHE.store(pkey, node, self.catalog)
+
+            def plan_fresh():
+                # pkey carries the feedback generation: a history record
+                # or invalidation must re-plan, never reuse a fragmented
+                # plan built on superseded observations
+                key = ("c", sql, self.broadcast_threshold, n_workers,
+                       id(self.catalog), H.plan_env_token())
+                ent = qcache.PLAN_CACHE.lookup(key, self.catalog)
+                if ent is not None:
+                    return ent.plan
+                planned = self._planner.plan(sql)
+                planned = fragment_plan(planned, self.catalog,
+                                        self.broadcast_threshold,
+                                        num_workers=n_workers)
+                qcache.PLAN_CACHE.store(key, planned, self.catalog)
+                return planned
+
+            node = plan_fresh()
             if trace is not None:
                 trace.finish(pspan)
                 phase_ms["plan"] = round(pspan.wall_s * 1e3, 3)
@@ -1453,12 +1530,33 @@ class HttpClusterSession:
                 if trace is not None else None
             )
             try:
-                page = self.scheduler.run(
-                    node, query_id=f"q_{next(self._query_ids)}",
-                    trace_ctx=(
-                        (trace, espan.span_id) if trace is not None else None
-                    ),
-                )
+                try:
+                    page = self.scheduler.run(
+                        node, query_id=f"q_{next(self._query_ids)}",
+                        trace_ctx=(
+                            (trace, espan.span_id) if trace is not None
+                            else None
+                        ),
+                    )
+                except H.AdaptiveReplan:
+                    # mid-query adaptation: the scheduler recorded the
+                    # contradicting observation before raising, so a
+                    # fresh plan (new generation -> new pkey) reorders /
+                    # re-distributes downstream fragments on measured
+                    # rows. The re-run has adaptation off: one replan
+                    # per query, and a second misprediction just runs.
+                    node = plan_fresh()
+                    page = self.scheduler.run(
+                        node, query_id=f"q_{next(self._query_ids)}",
+                        trace_ctx=(
+                            (trace, espan.span_id) if trace is not None
+                            else None
+                        ),
+                        adapt=False,
+                    )
+                    from ..exec.breaker import BREAKERS
+
+                    BREAKERS.record_success("adaptive_plan")
             except Exception:
                 if trace is not None:
                     trace.finish(espan, "error")

@@ -102,6 +102,43 @@ def hier_exchange_prefetch() -> int:
         return 2
 
 
+def feedback_enabled() -> bool:
+    """Master switch for history-based adaptive execution
+    (plan/history.py): record observed per-plan-node cardinalities at
+    query completion and feed them back into join ordering, broadcast
+    switching, hybrid-join sizing, matview delta decisions, and the
+    coordinator's mid-query replan. Off by default — flip
+    PRESTO_TPU_FEEDBACK=1 to opt in; the adaptive_plan breaker reverts
+    to static plans on repeated faults either way."""
+    return os.environ.get("PRESTO_TPU_FEEDBACK", "0") not in (
+        "0", "false", ""
+    )
+
+
+def feedback_replan_factor() -> float:
+    """Observed-vs-estimated row factor at an exchange boundary past
+    which the coordinator abandons the attempt and re-plans downstream
+    fragments against the recorded observation (server/cluster.py).
+    Generous by default: a replan repeats producer work, so only a
+    gross misprediction should pay for one."""
+    try:
+        return float(os.environ.get("PRESTO_TPU_FEEDBACK_REPLAN_FACTOR",
+                                    "8"))
+    except ValueError:
+        return 8.0
+
+
+def feedback_replan_min_rows() -> int:
+    """Observed rows below which a mid-query misprediction is never
+    worth a replan, whatever the ratio — re-running producers costs
+    more than finishing a small stage badly."""
+    try:
+        return int(os.environ.get("PRESTO_TPU_FEEDBACK_REPLAN_MIN_ROWS",
+                                  "4096"))
+    except ValueError:
+        return 4096
+
+
 def revoke_watermark() -> float:
     """Fraction of the memory limit at which revocation (offload/spill)
     starts, shared by the worker-local memory pool and the cluster

@@ -254,10 +254,16 @@ class Session:
     def _plan_env_key(self):
         """Planning-relevant session state: plans keyed by the same AST
         are only interchangeable within one catalog object, view set,
-        join-distribution config, and mesh width."""
+        join-distribution config, mesh width, and feedback-store
+        generation (plan/history.py: a recorded observation or an
+        invalidation must re-plan, never reuse a plan built on
+        superseded history)."""
+        from .plan.history import plan_env_token
+
         mesh_n = self.mesh.devices.size if self.mesh is not None else 0
         views_fp = tuple(sorted(self.views.items())) if self.views else ()
-        return (id(self.catalog), mesh_n, self.broadcast_threshold, views_fp)
+        return (id(self.catalog), mesh_n, self.broadcast_threshold,
+                views_fp, plan_env_token())
 
     def _engine_env_key(self):
         """Execution-engine identity, part of the RESULT cache key: two
@@ -453,12 +459,48 @@ class Session:
         if hit is not None:
             return QueryResult(hit.page, hit.titles)
         pre = qcache.RESULT_CACHE.preversions(node, self.catalog)
-        page = self.executor.run(node)
+        page = self._run_observed(node)
         if pre is not None and qcache.plan_is_deterministic(node):
             qcache.RESULT_CACHE.store(
                 key, page, node.titles, self.catalog, pre
             )
         return QueryResult(page, node.titles)
+
+    def _run_observed(self, node):
+        """Observe-once execution hook for history-based feedback
+        (plan/history.py): when the plane is on AND the store lacks a
+        live entry for some frame of this plan, run through a fresh
+        collector-attached executor (the explain_analyze construction —
+        the shared session executor can't have a collector swapped in
+        per query under the server's concurrency) and fold the observed
+        cardinalities in at completion. Plans whose frames are all
+        remembered take the plain path: the warm cost is one store walk,
+        not an instrumented run."""
+        try:
+            from .plan import history as H
+
+            observe = H.feedback_on() and H.HISTORY.wants_observation(
+                node, self.catalog
+            )
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            from .exec.breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+            observe = False
+        if not observe:
+            return self.executor.run(node)
+        from .exec.stats import StatsCollector
+
+        collector = StatsCollector()
+        ex = self._collector_executor(collector)
+        page = ex.run(node)
+        try:
+            H.HISTORY.record_plan(node, collector, self.catalog)
+        except Exception as exc:  # noqa: BLE001 — bookkeeping only
+            from .exec.breaker import BREAKERS
+
+            BREAKERS.record_failure("adaptive_plan", repr(exc))
+        return page
 
     # -- DDL / DML tasks (reference execution/CreateTableTask.java,
     # CreateTableAsSelect via TableWriter/TableFinish operators,
@@ -1278,13 +1320,12 @@ class Session:
         cat.replace(name, Page(page.blocks, tuple(tl.lower() for tl in titles), page.count))
         return self._row_count_result(before - int(page.count))
 
-    def explain_analyze_plan(self, node: N.PlanNode) -> str:
-        """Execute the plan with per-operator accounting and render the
-        annotated tree (reference EXPLAIN ANALYZE via ExplainAnalyzeOperator,
-        presto-main/.../execution/ExplainAnalyzeContext.java)."""
-        from .exec.stats import StatsCollector
-
-        collector = StatsCollector()
+    def _collector_executor(self, collector):
+        """Fresh executor with a per-query stats collector, matching the
+        engine the session actually runs (mesh / streaming / local plus
+        the session's strategy overrides). Used by EXPLAIN ANALYZE and
+        by the feedback plane's observe-once runs: the shared executor
+        can't have a collector swapped in per query under concurrency."""
         if self.mesh is not None:
             from .exec.dist import DistributedExecutor
 
@@ -1312,6 +1353,16 @@ class Session:
             local.matmul_groupby = self.matmul_groupby
         if hasattr(local, "dynamic_filtering"):
             local.dynamic_filtering = self.dynamic_filtering
+        return ex
+
+    def explain_analyze_plan(self, node: N.PlanNode) -> str:
+        """Execute the plan with per-operator accounting and render the
+        annotated tree (reference EXPLAIN ANALYZE via ExplainAnalyzeOperator,
+        presto-main/.../execution/ExplainAnalyzeContext.java)."""
+        from .exec.stats import StatsCollector
+
+        collector = StatsCollector()
+        ex = self._collector_executor(collector)
         from .obs import span as obs_span
         from .obs.kernelprof import KERNEL_PROFILE
 
@@ -1438,6 +1489,29 @@ class Session:
         cache_txt = "\n-- caches: " + qcache.format_summary(
             qcache.snapshot_all()
         )
+        # adaptive-execution feedback (plan/history.py): fold this run's
+        # observed cardinalities into the history store, then surface the
+        # plane's counters — lookup hits, estimate-vs-observed relative
+        # error, and mid-query replans — so a profiled query shows both
+        # what history it consumed and what it contributed
+        feedback_txt = ""
+        from .plan import history as _H
+
+        if _H.feedback_on():
+            try:
+                _H.HISTORY.record_plan(node, collector, self.catalog)
+            except Exception as exc:  # noqa: BLE001 — bookkeeping only
+                from .exec.breaker import BREAKERS
+
+                BREAKERS.record_failure("adaptive_plan", repr(exc))
+            fs = _H.HISTORY.stats.snapshot()
+            err = fs["mean_abs_rel_err"]
+            feedback_txt = (
+                f"\n-- feedback: hits={fs['hits']} misses={fs['misses']}"
+                f" records={fs['records']} est-err="
+                f"{'n/a' if err is None else f'{err:.2f}'}"
+                f" replans={fs['replans']}"
+            )
         # materialized-view freshness (matview/manager.py): which views
         # exist, delta vs recompute maintenance, and how stale each is
         matview_txt = ""
@@ -1468,7 +1542,7 @@ class Session:
                 )
         return (
             f"{tree}{dyn_txt}{breaker_txt}{mem_txt}{exch_txt}{cache_txt}"
-            f"{matview_txt}{trace_txt}{kernel_txt}\n"
+            f"{feedback_txt}{matview_txt}{trace_txt}{kernel_txt}\n"
             f"-- total {total_ms:,.1f}ms, peak live output {peak:,.2f}MB"
         )
 

@@ -114,6 +114,44 @@ class SqliteOracle:
         # TPC-DS Q17/Q39 oracle SQL can stay the spec text
         self.conn.create_aggregate("stddev_samp", 1, _StdDevSamp)
         self.conn.create_aggregate("var_samp", 1, _VarSamp)
+        # SQLite's math functions (sign, log10, ...) are compile-time
+        # optional and only standard since 3.35; probe each and register
+        # a Python fallback when the linked library lacks it so math
+        # oracle SQL runs unmodified
+        import math as _m
+
+        def _null_safe(fn):
+            return lambda *a: None if any(v is None for v in a) else fn(*a)
+
+        for fname, nargs, fn, probe in (
+            ("sign", 1, lambda v: (v > 0) - (v < 0), "sign(-1)"),
+            ("log10", 1, _m.log10, "log10(1)"),
+            ("log2", 1, _m.log2, "log2(1)"),
+            ("ln", 1, _m.log, "ln(1)"),
+            ("exp", 1, _m.exp, "exp(0)"),
+            ("sqrt", 1, _m.sqrt, "sqrt(1)"),
+            ("power", 2, lambda b, e: float(b) ** float(e), "power(2, 2)"),
+            ("degrees", 1, _m.degrees, "degrees(0)"),
+            ("radians", 1, _m.radians, "radians(0)"),
+            ("mod", 2, _m.fmod, "mod(4, 2)"),
+            ("pi", 0, _m.pi.__float__, "pi()"),
+            ("sin", 1, _m.sin, "sin(0)"),
+            ("cos", 1, _m.cos, "cos(0)"),
+            ("tan", 1, _m.tan, "tan(0)"),
+            ("asin", 1, _m.asin, "asin(0)"),
+            ("acos", 1, _m.acos, "acos(1)"),
+            ("atan", 1, _m.atan, "atan(0)"),
+            ("atan2", 2, _m.atan2, "atan2(0, 1)"),
+            ("floor", 1, _m.floor, "floor(0.5)"),
+            ("ceil", 1, _m.ceil, "ceil(0.5)"),
+            ("ceiling", 1, _m.ceil, "ceiling(0.5)"),
+        ):
+            try:
+                self.conn.execute(f"SELECT {probe}").fetchone()
+            except sqlite3.OperationalError:
+                self.conn.create_function(
+                    fname, nargs, _null_safe(fn), deterministic=True
+                )
         for name in tables or source.TABLE_NAMES:
             t = source.table(name, sf)
             cols = list(t.columns.keys())

@@ -113,6 +113,7 @@ _MODULE_TIMEOUTS = {
     "test_overload_chaos.py": 300,
     "test_query_cache.py": 240,
     "test_matview_chaos.py": 300,
+    "test_feedback.py": 240,
 }
 
 _SLOW_CANDIDATE_S = 30.0

@@ -1,0 +1,330 @@
+"""History-based adaptive execution (plan/history.py).
+
+Covers the PR 16 acceptance surface: semantic frame fingerprints,
+version-gated entry validity (shardstore upsert + DROP/re-CREATE
+aliasing), the coordinator's mid-query replan on a seeded wrong
+estimate (oracle-equal result, counters, plan_history table, metrics),
+the adaptive_plan breaker's static fallback, and store thread-safety.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryCatalog
+from presto_tpu.exec.breaker import BREAKERS
+from presto_tpu.page import Page
+from presto_tpu.plan import history as H
+from presto_tpu.plan.history import HISTORY, fingerprint
+from presto_tpu.session import Session
+
+
+@pytest.fixture(autouse=True)
+def _feedback_env(monkeypatch):
+    """Every test here runs with the plane ON over a fresh store and a
+    closed breaker; the knob is off by default everywhere else."""
+    monkeypatch.setenv("PRESTO_TPU_FEEDBACK", "1")
+    HISTORY.reset()
+    BREAKERS.reset()
+    yield
+    HISTORY.reset()
+    BREAKERS.reset()
+
+
+def _mem_catalog(n=8192, seed=7):
+    rng = np.random.default_rng(seed)
+    return MemoryCatalog({
+        "t": Page.from_dict({
+            "k": (np.arange(n, dtype=np.int64), T.BIGINT),
+            "v": (rng.integers(0, 1000, n).astype(np.int64), T.BIGINT),
+        }),
+        "u": Page.from_dict({
+            "k": (rng.integers(0, 64, 512).astype(np.int64), T.BIGINT),
+            "w": (rng.integers(0, 1000, 512).astype(np.int64), T.BIGINT),
+        }),
+    })
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_join_order_invariant():
+    """(A JOIN B) and (B JOIN A) — and either build side — are the same
+    observed frame: a recorded cardinality must be findable from
+    whatever shape the next planning pass proposes."""
+    sess = Session(_mem_catalog())
+    a = sess.plan("select count(*) from t join u on t.k = u.k")
+    b = sess.plan("select count(*) from u join t on u.k = t.k")
+
+    def join_of(node):
+        from presto_tpu.plan import nodes as N
+
+        found = []
+        H._walk_plan(node, lambda n: found.append(n)
+                     if isinstance(n, N.Join) else None)
+        return found[0]
+
+    assert fingerprint(join_of(a)) == fingerprint(join_of(b))
+    # a different predicate is a different frame
+    c = sess.plan("select count(*) from t join u on t.k = u.k "
+                  "where t.v > 10")
+    assert fingerprint(join_of(c)) != fingerprint(join_of(a))
+
+
+def test_observed_rows_feed_planner_estimates():
+    """After one observed run, the deriver's row estimate for the same
+    frame IS the observation, not the static formula."""
+    from presto_tpu.plan.stats import StatsDeriver
+
+    cat = _mem_catalog()
+    sess = Session(cat)
+    sql = "select count(*) from t join u on t.k = u.k where t.v >= 0"
+    node = sess.plan(sql)
+    static = StatsDeriver(cat, use_history=False).stats(node.children[0])
+    sess.query(sql)  # observe-once records actuals
+    warm = StatsDeriver(cat).stats(node.children[0])
+    assert warm.rows == 1.0  # global count(*) output: exactly one row
+    assert HISTORY.stats.snapshot()["records"] > 0
+    assert static.rows >= warm.rows
+
+
+# ---------------------------------------------------------------------------
+# validity: table_version invalidation
+# ---------------------------------------------------------------------------
+
+
+def _shardstore(tmp_path):
+    from presto_tpu.connectors.shardstore import ShardStoreCatalog
+
+    cat = ShardStoreCatalog(str(tmp_path / "store"))
+    cat.create_table(
+        "events", {"k": T.BIGINT, "v": T.BIGINT}, unique_columns=["k"]
+    )
+    rng = np.random.default_rng(5)
+    cat.append("events", Page.from_dict({
+        "k": (np.arange(6000, dtype=np.int64), T.BIGINT),
+        "v": (rng.integers(0, 100, 6000).astype(np.int64), T.BIGINT),
+    }))
+    return cat
+
+
+def _live_fps(table):
+    return [fp for fp, e in HISTORY.rows_snapshot() if table in e.tables]
+
+
+def test_history_dropped_on_upsert(tmp_path):
+    cat = _shardstore(tmp_path)
+    sess = Session(cat)
+    sess.query("select count(*) from events where v*1 >= 0")
+    fps = _live_fps("events")
+    assert fps, "observed run recorded no events frames"
+    inv0 = HISTORY.stats.snapshot()["invalidations"]
+    # upsert bumps the per-table write counter -> every entry over the
+    # old snapshot must die at its next lookup
+    cat.upsert("events", Page.from_dict({
+        "k": (np.arange(10, dtype=np.int64), T.BIGINT),
+        "v": (np.full(10, 999, dtype=np.int64), T.BIGINT),
+    }))
+    for fp in fps:
+        assert HISTORY.lookup(fp, cat) is None
+    assert HISTORY.stats.snapshot()["invalidations"] >= inv0 + len(fps)
+
+
+def test_history_dropped_on_drop_recreate(tmp_path):
+    """DROP + re-CREATE must not alias: shardstore versions carry a
+    never-reused creation id, so entries recorded against the old
+    incarnation die even though the name (and schema) match."""
+    cat = _shardstore(tmp_path)
+    sess = Session(cat)
+    sess.query("select count(*) from events where v*1 >= 0")
+    fps = _live_fps("events")
+    assert fps
+    cat.drop_table("events")
+    cat.create_table(
+        "events", {"k": T.BIGINT, "v": T.BIGINT}, unique_columns=["k"]
+    )
+    cat.append("events", Page.from_dict({
+        "k": (np.arange(3, dtype=np.int64), T.BIGINT),
+        "v": (np.zeros(3, dtype=np.int64), T.BIGINT),
+    }))
+    for fp in fps:
+        assert HISTORY.lookup(fp, cat) is None
+    # and the new incarnation records cleanly over the same frames
+    sess2 = Session(cat)
+    assert sess2.query(
+        "select count(*) from events where v*1 >= 0"
+    ).rows() == [(3,)]
+    assert _live_fps("events")
+
+
+# ---------------------------------------------------------------------------
+# mid-query adaptation (cluster path)
+# ---------------------------------------------------------------------------
+
+
+def _skew_catalog():
+    """40k rows whose filter the static model underestimates ~16x: the
+    conjuncts are expression-shaped (k*1 >= 0), so the deriver falls to
+    default selectivities while every row actually passes."""
+    rng = np.random.default_rng(11)
+    n = 40_000
+    return MemoryCatalog({
+        "t": Page.from_dict({
+            "k": (np.arange(n, dtype=np.int64), T.BIGINT),
+            "v": (rng.integers(0, 100, n).astype(np.int64), T.BIGINT),
+        }),
+    })
+
+
+def test_mid_query_replan_oracle_equal():
+    from presto_tpu.obs.export import ensure_default_exports
+    from presto_tpu.obs.metrics import METRICS
+    from presto_tpu.server.cluster import HttpClusterSession, NodeManager
+    from presto_tpu.server.worker import WorkerServer
+
+    # the scan stage (filter + scan, gathered by the coordinator) is
+    # estimated at ~4% of the table (three default-selectivity
+    # conjuncts) but every row passes: a ~23x misprediction
+    sql = "select k, v from t where k*1 >= 0 and v*1 >= 0 and k+v >= 0"
+    workers = [WorkerServer(_skew_catalog()).start() for _ in range(2)]
+    nodes = NodeManager([w.uri for w in workers], interval=3600)
+    sess = HttpClusterSession(_skew_catalog(), nodes)
+    try:
+        res = sorted(sess.query(sql).rows())
+        assert sess.scheduler.stats.adaptive_replans >= 1, (
+            "seeded 23x misestimate did not trigger a mid-query replan"
+        )
+        assert HISTORY.stats.snapshot()["replans"] >= 1
+        # oracle: the same data through the single-process engine
+        assert res == sorted(Session(_skew_catalog()).query(sql).rows())
+        # a second execution of the same frame plans from the recorded
+        # observation: estimates now match reality, so no replan (the
+        # result cache is cleared to force a real re-execution)
+        from presto_tpu.exec import qcache
+
+        replans0 = sess.scheduler.stats.adaptive_replans
+        qcache.RESULT_CACHE.reset()
+        assert sorted(sess.query(sql).rows()) == res
+        assert sess.scheduler.stats.adaptive_replans == replans0
+    finally:
+        for w in workers:
+            w.stop()
+    # surfaces: the replan is visible in system.runtime.plan_history,
+    # the metrics plane, and the EXPLAIN ANALYZE feedback footer
+    from presto_tpu.connectors.system import SystemCatalog
+
+    sys_sess = Session(SystemCatalog(MemoryCatalog({})))
+    hist_rows = sys_sess.query(
+        "select kind, rows from system.runtime.plan_history"
+    ).rows()
+    assert hist_rows, "plan_history table empty after a recorded replan"
+    ensure_default_exports()
+    samples = {s[0]: s[3] for s in METRICS.collect() if not s[2]}
+    assert samples["presto_feedback_replans_total"] >= 1
+    local = Session(_skew_catalog())
+    txt = local.explain_analyze("select count(*) from t where v*1 >= 0")
+    (footer,) = [ln for ln in txt.splitlines() if "-- feedback:" in ln]
+    assert "replans=" in footer and not footer.endswith("replans=0")
+
+
+# ---------------------------------------------------------------------------
+# breaker: forced static fallback
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_forces_static_plans():
+    cat = _mem_catalog()
+    sess = Session(cat)
+    sql = "select count(*) from t join u on t.k = u.k"
+    sess.query(sql)
+    assert HISTORY.stats.snapshot()["records"] > 0
+    assert H.feedback_on() and H.plan_env_token() >= 0
+    # trip the adaptive_plan breaker: the plane must report OFF, the
+    # plan-env token must pin to the static constant, and queries must
+    # still answer (from static estimates) with the store untouched
+    br = BREAKERS.get("adaptive_plan")
+    for _ in range(br.failure_threshold):
+        BREAKERS.record_failure("adaptive_plan", "injected")
+    assert not H.feedback_on()
+    assert H.plan_env_token() == -1
+    hits0 = HISTORY.stats.snapshot()["hits"]
+    assert sess.query(sql + " where t.v >= -1").rows()
+    assert HISTORY.stats.snapshot()["hits"] == hits0  # no consultation
+    # thread-local forced fallback behaves the same way
+    BREAKERS.reset()
+    assert H.feedback_on()
+    with BREAKERS.forced_fallback("adaptive_plan"):
+        assert not H.feedback_on()
+        assert H.plan_env_token() == -1
+    assert H.feedback_on()
+
+
+# ---------------------------------------------------------------------------
+# concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_record_lookup_hammer():
+    """8 threads interleaving record/lookup/invalidate/snapshot against
+    one store: no exceptions, coherent counters, bounded size."""
+    cat = _mem_catalog(n=64)
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def worker(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            barrier.wait()
+            for i in range(400):
+                fp = f"join:hammer{int(rng.integers(0, 32)):02d}"
+                op = i % 4
+                if op == 0:
+                    HISTORY.record(
+                        fp, catalog=cat, tables=("t",),
+                        rows=float(rng.integers(1, 10_000)),
+                        est_rows=100.0, kind="Join",
+                    )
+                elif op == 1:
+                    ent = HISTORY.lookup(fp, cat)
+                    assert ent is None or ent.rows is None or ent.rows > 0
+                elif op == 2:
+                    HISTORY.rows_snapshot(limit=8)
+                else:
+                    HISTORY.observed_rows(fp, cat)
+        except Exception as exc:  # noqa: BLE001 — surfaced via errors
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    snap = HISTORY.stats.snapshot()
+    assert snap["records"] > 0
+    # generation moved with every record/invalidate and the LRU stayed
+    # within its configured bounds
+    assert HISTORY.generation >= snap["records"]
+    from presto_tpu.exec import qcache
+
+    hsnap = qcache.snapshot_all()["history"]
+    assert hsnap["entries"] <= hsnap["max_entries"]
+
+
+def test_estimate_caches_keyed_by_generation():
+    """Executor-level row-estimate caches must not serve estimates from
+    a superseded history generation (satellite: exec/executor.py)."""
+    from presto_tpu.exec.executor import Executor
+
+    cat = _mem_catalog()
+    ex = Executor(cat)
+    env0 = ex._est_env()
+    HISTORY.record("join:genkey", catalog=cat, tables=("t",), rows=5.0)
+    assert ex._est_env() != env0
+    assert ex._est_env()[-1] == getattr(ex, "mesh_n", 1)

@@ -314,8 +314,10 @@ def test_executor_stat_caches_bounded():
         ex._est_cache if hasattr(ex, "_est_cache") else None
         ex._est_rows(("fake", i))  # unhashable-safe: tuples hash fine
     assert len(ex._est_cache) <= 4096
-    # recent keys survive (LRU, not clear-on-threshold)
-    assert ex._est_cache.get(("fake", 4999), count=False) is not None
+    # recent keys survive (LRU, not clear-on-threshold); entries are
+    # keyed (node,) + environment (feedback generation, mesh width)
+    key = (("fake", 4999),) + ex._est_env()
+    assert ex._est_cache.get(key, count=False) is not None
 
 
 def test_time_dependent_kernels_not_shared_across_sessions():
@@ -405,7 +407,9 @@ def test_coordinator_status_and_explain_analyze_expose_caches():
     try:
         with urllib.request.urlopen(server.uri + "/v1/status", timeout=10) as r:
             status = json.loads(r.read())
-        assert set(status["caches"]) == {"plan", "result", "kernel"}
+        assert set(status["caches"]) == {
+            "plan", "result", "kernel", "history"
+        }
         for s in status["caches"].values():
             assert {"hits", "misses", "evictions", "bytes"} <= set(s)
     finally:

@@ -65,6 +65,13 @@ GATED = (
     # registry (producers + render) must stay cheap enough that a 15s
     # scraper is never a serving-latency event
     "metrics_scrape",
+    # history-based adaptive execution (PR 16): the warm history-driven
+    # plan must beat the cold static misordered plan (speedup_vs_full
+    # carries the >=1.5x acceptance floor via ratio_floors; the micro
+    # RAISES when warm runs never consult the store), and the store's
+    # fingerprint+lookup path must stay cheap enough that consulting
+    # history never becomes a planning-latency event
+    "feedback_replan", "feedback_lookup",
 )
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, os.pardir, "BASELINE.json")
